@@ -1,0 +1,51 @@
+"""Test harness: distributed-on-CPU via fake devices (SURVEY.md §4).
+
+The reference could only verify its distributed paths on real allocations
+(summit/, jlse/). Here every distributed test runs on CPU with 8 fake
+devices — real XLA collectives through the same shard_map code that runs on
+TPU slices. Env must be set before jax is imported anywhere.
+"""
+
+import os
+
+# The image pins JAX_PLATFORMS to the TPU tunnel; tests always run on the
+# fake-device CPU mesh unless explicitly opted onto hardware.
+if not os.environ.get("TPU_MPI_TESTS_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+# The suite hard-requires 8 fake devices; strip any pre-existing count flag
+# rather than producing confusing MeshErrors under a different value.
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax  # noqa: E402
+
+if not os.environ.get("TPU_MPI_TESTS_ON_TPU"):
+    # The image's sitecustomize registers the TPU plugin and sets
+    # jax_platforms programmatically, overriding the env var — force it back.
+    jax.config.update("jax_platforms", "cpu")
+
+# The reference is float64 throughout (MPI_DOUBLE); enable x64 so parity
+# tests can use the reference's dtype. Kernels take explicit dtypes, so
+# float32 paths are still exercised (SURVEY §7 hard part 1).
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from tpu_mpi_tests.comm.mesh import make_mesh
+
+    return make_mesh({"shard": 8})
+
+
+@pytest.fixture(scope="session")
+def mesh2d():
+    from tpu_mpi_tests.comm.mesh import make_mesh
+
+    return make_mesh({"x": 4, "y": 2})

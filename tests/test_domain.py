@@ -1,0 +1,107 @@
+import numpy as np
+import pytest
+
+from tpu_mpi_tests.arrays.domain import Domain1D, Domain2D
+from tpu_mpi_tests.utils import TpuMtError
+
+
+def x_cubed(x):
+    return x**3
+
+
+class TestDomain1D:
+    def test_sizes(self):
+        d = Domain1D(n_global=64, n_shards=4, n_bnd=2)
+        assert d.n_local == 16
+        assert d.n_ghosted == 20
+        assert d.delta == 8.0 / 64
+        assert d.scale == 64 / 8.0
+
+    def test_divisibility_fail_fast(self):
+        with pytest.raises(TpuMtError):
+            Domain1D(n_global=10, n_shards=3)
+
+    def test_coords_continuous_across_shards(self):
+        d = Domain1D(n_global=64, n_shards=4)
+        xs = np.concatenate([d.interior_coords(r) for r in range(4)])
+        np.testing.assert_allclose(xs, np.arange(64) * d.delta)
+
+    def test_ghost_coords_extend_grid(self):
+        d = Domain1D(n_global=64, n_shards=4, n_bnd=2)
+        g = d.ghosted_coords(1)
+        i = d.interior_coords(1)
+        np.testing.assert_allclose(g[2:-2], i)
+        # ghosts continue the same grid
+        np.testing.assert_allclose(g[1] - g[0], d.delta)
+        # rank 1's left ghosts == rank 0's last interior points
+        np.testing.assert_allclose(g[:2], d.interior_coords(0)[-2:])
+
+    def test_init_shard_physical_ghosts(self):
+        d = Domain1D(n_global=32, n_shards=4, n_bnd=2)
+        s0 = d.init_shard(x_cubed, 0)
+        # left physical ghosts: x = -2*delta, -delta (mpi_stencil_gt.cc:186-189)
+        np.testing.assert_allclose(
+            s0[:2], [(-2 * d.delta) ** 3, (-d.delta) ** 3]
+        )
+        s_last = d.init_shard(x_cubed, 3)
+        np.testing.assert_allclose(
+            s_last[-2:], [d.length**3, (d.length + d.delta) ** 3]
+        )
+        # interior ghosts of middle shards start zero (to be halo-filled)
+        s1 = d.init_shard(x_cubed, 1)
+        assert (s1[:2] == 0).all() and (s1[-2:] == 0).all()
+
+    def test_strip_ghosts_roundtrip(self):
+        d = Domain1D(n_global=32, n_shards=4, n_bnd=2)
+        zg = d.init_global(x_cubed)
+        assert zg.shape == (4 * 12,)
+        interior = d.strip_ghosts_global(zg)
+        np.testing.assert_allclose(interior, d.interior_global(x_cubed))
+
+
+def z_fn(x, y):
+    return x**3 + y**2
+
+
+class TestDomain2D:
+    @pytest.mark.parametrize("dim", [0, 1])
+    def test_shapes(self, dim):
+        d = Domain2D(
+            n_local_deriv=8, n_global_other=6, n_shards=4, dim=dim, n_bnd=2
+        )
+        assert d.local_shape[dim] == 8
+        assert d.local_shape[1 - dim] == 6
+        assert d.ghosted_shape[dim] == 12
+        assert d.global_ghosted_shape[dim] == 48
+        assert d.global_interior_shape[dim] == 32
+
+    @pytest.mark.parametrize("dim", [0, 1])
+    def test_strip_ghosts_matches_interior(self, dim):
+        d = Domain2D(
+            n_local_deriv=8, n_global_other=6, n_shards=4, dim=dim, n_bnd=2
+        )
+        zg = d.init_global(z_fn)
+        np.testing.assert_allclose(
+            d.strip_ghosts_global(zg), d.interior_global(z_fn)
+        )
+
+    @pytest.mark.parametrize("dim", [0, 1])
+    def test_edge_shard_physical_ghosts_filled(self, dim):
+        d = Domain2D(
+            n_local_deriv=8, n_global_other=6, n_shards=4, dim=dim, n_bnd=2
+        )
+        s0 = d.init_shard(z_fn, 0)
+        lo = [slice(None)] * 2
+        lo[dim] = slice(0, 2)
+        assert (s0[tuple(lo)] != 0).any()
+        s1 = d.init_shard(z_fn, 1)
+        assert (s1[tuple(lo)] == 0).all()
+
+    def test_ghost_continuity_between_shards(self):
+        d = Domain2D(
+            n_local_deriv=8, n_global_other=6, n_shards=4, dim=0, n_bnd=2
+        )
+        # what rank 1's left ghost *should* hold equals rank 0's last interior
+        x1, y1 = d._coords(1, ghosted=True, dtype=np.float64)
+        x0, _ = d._coords(0, ghosted=False, dtype=np.float64)
+        np.testing.assert_allclose(x1[:2], x0[-2:])

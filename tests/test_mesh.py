@@ -1,0 +1,65 @@
+import jax
+import pytest
+
+from tpu_mpi_tests.comm import mesh as M
+
+
+def test_eight_fake_devices():
+    assert jax.device_count() == 8
+
+
+def test_topology():
+    t = M.topology()
+    assert t.global_device_count == 8
+    assert t.process_count == 1
+    assert t.process_index == 0
+    assert not t.is_multi_host
+    assert t.platform == "cpu"
+
+
+def test_make_mesh_default():
+    m = M.make_mesh()
+    assert m.axis_names == ("shard",)
+    assert m.devices.shape == (8,)
+
+
+def test_make_mesh_2d_and_wildcard():
+    m = M.make_mesh({"x": 2, "y": -1})
+    assert m.shape == {"x": 2, "y": 4}
+    m2 = M.make_mesh([("dp", 4), ("sp", 2)])
+    assert m2.shape == {"dp": 4, "sp": 2}
+
+
+def test_make_mesh_bad_shapes():
+    with pytest.raises(M.MeshError):
+        M.make_mesh({"x": 3})
+    with pytest.raises(M.MeshError):
+        M.make_mesh({"x": -1, "y": -1})
+    with pytest.raises(M.MeshError):
+        M.make_mesh({"x": 3, "x2": -1})  # 8 % 3 != 0
+    with pytest.raises(M.MeshError, match="duplicate"):
+        M.make_mesh([("x", 2), ("x", 4)])
+
+
+def test_check_divisible():
+    from tpu_mpi_tests.utils import TpuMtError
+
+    assert M.check_divisible(8, 2) == 4
+    with pytest.raises(TpuMtError):
+        M.check_divisible(7, 2)
+    with pytest.raises(TpuMtError):
+        M.check_divisible(8, 0)
+
+
+def test_ranks_per_device():
+    assert M.ranks_per_device(None) == 1
+    assert M.ranks_per_device(8) == 1
+    assert M.ranks_per_device(16) == 2
+    with pytest.raises(M.MeshError):
+        M.ranks_per_device(12)
+
+
+def test_device_report_smoke():
+    s = M.device_report(verbose=True)
+    assert "0/1 processes" in s
+    assert "8 global" in s

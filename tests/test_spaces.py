@@ -1,0 +1,42 @@
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_mpi_tests.arrays.spaces import (
+    Space,
+    meminfo,
+    nbytes_report,
+    place,
+    to_device,
+)
+
+
+def test_space_parse():
+    assert Space.parse("device") is Space.DEVICE
+    assert Space.parse("MANAGED") is Space.MANAGED
+    assert Space.parse(Space.HOST) is Space.HOST
+
+
+def test_place_roundtrip_all_spaces():
+    x = np.arange(16, dtype=np.float32)
+    for space in Space:
+        y = place(x, space)
+        np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_to_device():
+    x = jnp.arange(8.0)
+    y = to_device(x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_meminfo_reports_placement():
+    x = place(np.zeros(4, np.float32), Space.DEVICE)
+    s = meminfo(x)
+    assert "nbytes=16" in s and "devices=" in s
+    assert meminfo(np.zeros(3)).startswith("host(")
+
+
+def test_nbytes_report():
+    a = jnp.zeros((1024, 1024), jnp.float32)
+    s = nbytes_report(a, a)
+    assert "2 arrays" in s and "8.0 MiB" in s

@@ -1,0 +1,26 @@
+"""tpu_mpi_tests — a TPU-native re-creation of bd4/gpu-mpi-tests.
+
+A framework for distributed TPU microbenchmarks with the capability matrix of
+the reference CUDA-aware-MPI suite (see SURVEY.md): mesh bootstrap in place of
+MPI_Init + set_rank_device, XLA collectives over ICI in place of CUDA-aware
+MPI, jnp + Pallas kernels in place of cuBLAS/gtensor/SYCL, XProf annotations
+in place of NVTX, and a real pytest suite in place of printf verification.
+
+Layer map (mirrors SURVEY.md §1, top to bottom):
+  tpu/          launch + aggregation            (≅ summit/, jlse/, avg.sh)
+  drivers/      benchmark drivers               (≅ the per-binary main()s)
+  instrument/   timers, trace ranges, reporting (≅ NVTX + MPI_Wtime)
+  comm/         mesh, collectives, halo         (≅ MPI layer)
+  kernels/      daxpy, stencil, pack, reduce    (≅ cuBLAS/gtensor/SYCL kernels)
+  arrays/       spaces, domain decomposition    (≅ gtensor spaces + ghost math)
+  runtime/      native C++ support runtime      (≅ cuda_error.h + harness glue)
+"""
+
+__version__ = "0.1.0"
+
+from tpu_mpi_tests.comm.mesh import (  # noqa: F401
+    Topology,
+    bootstrap,
+    make_mesh,
+    topology,
+)

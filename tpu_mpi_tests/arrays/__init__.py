@@ -1,0 +1,10 @@
+"""Array/memory abstraction layer.
+
+TPU-native replacement for the reference's L1 (SURVEY.md §1): gtensor spaces
+(device/managed/host), SYCL USM, and raw CUDA allocation become JAX memory
+kinds + explicit placement, and the ghost-cell/index arithmetic scattered
+through the reference drivers becomes :mod:`tpu_mpi_tests.arrays.domain`.
+"""
+
+from tpu_mpi_tests.arrays.spaces import Space, place  # noqa: F401
+from tpu_mpi_tests.arrays.domain import Domain1D, Domain2D  # noqa: F401

@@ -1,0 +1,127 @@
+"""Memory spaces: the TPU analog of device / managed / pinned-host memory.
+
+The reference's memory-space axis (SURVEY.md §2.3 last row) is explicit
+`cudaMalloc` vs `cudaMallocManaged` vs `cudaMallocHost`, selected per-build
+(`-DMANAGED`, `mpi_daxpy_nvtx.cc:178-198`) or per-test
+(`TEST_MANAGED` matrix, `mpi_stencil2d_gt.cc:696-728`), with `MEMINFO`
+introspection (`cuda_error.h:99-136`).
+
+On TPU the axes map to JAX memory kinds:
+
+* ``DEVICE``   → HBM (default ``"device"`` memory kind).
+* ``HOST``     → ``"pinned_host"`` memory kind when the backend supports it
+  (TPU does); arrays stay addressable by XLA but live in host RAM.
+* ``MANAGED``  → no direct analog (TPU has no page-migrating unified memory);
+  the closest semantics — "usable from both sides, runtime moves it" — is
+  host-resident data with implicit transfer on use. We implement it as
+  pinned-host placement when available, else plain host numpy handed to jit
+  (committed-on-use), and record the deviation explicitly.
+
+`meminfo` replaces the MEMINFO macro: it reports where an array actually
+lives.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+class Space(enum.Enum):
+    """Placement space for benchmark arrays (≅ gtensor spaces)."""
+
+    DEVICE = "device"
+    HOST = "host"
+    MANAGED = "managed"
+
+    @classmethod
+    def parse(cls, s: "str | Space") -> "Space":
+        if isinstance(s, Space):
+            return s
+        return cls[s.upper()]
+
+
+@functools.cache
+def _supported_memory_kinds() -> frozenset[str]:
+    kinds = set()
+    for d in jax.local_devices():
+        try:
+            kinds.update(m.kind for m in d.addressable_memories())
+        except (RuntimeError, NotImplementedError, AttributeError):
+            pass
+    return frozenset(kinds)
+
+
+def host_memory_kind() -> str | None:
+    """The backend's pinned-host memory kind, or None if unsupported."""
+    kinds = _supported_memory_kinds()
+    if "pinned_host" in kinds:
+        return "pinned_host"
+    if "unpinned_host" in kinds:
+        return "unpinned_host"
+    return None
+
+
+def place(x, space: Space | str = Space.DEVICE, sharding=None):
+    """Place an array in the requested space (≅ gt::copy into a spaced tensor).
+
+    ``sharding`` may be a `jax.sharding.Sharding`; for HOST/MANAGED it is
+    re-targeted at the host memory kind when supported.
+    """
+    space = Space.parse(space)
+    if space is Space.DEVICE:
+        return jax.device_put(x, sharding)
+
+    kind = host_memory_kind()
+    if kind is None:
+        # CPU backend without host memory kinds: DEVICE already is host RAM,
+        # so HOST/MANAGED degrade to plain placement. Documented deviation —
+        # the A/B benchmark axis collapses on this backend.
+        return jax.device_put(x, sharding)
+    if sharding is not None:
+        sharding = sharding.with_memory_kind(kind)
+    else:
+        dev = jax.local_devices()[0]
+        sharding = jax.sharding.SingleDeviceSharding(dev, memory_kind=kind)
+    return jax.device_put(x, sharding)
+
+
+def to_device(x, sharding=None):
+    """Explicit promotion host→HBM (≅ H2D `gt::copy` / `cudaMemcpy`).
+
+    With no explicit sharding, a committed host-resident array is re-placed
+    via its own sharding retargeted at device memory (a bare
+    ``device_put(x, None)`` would be a no-op and leave it pinned to host).
+    """
+    if sharding is None and isinstance(x, jax.Array):
+        sharding = x.sharding
+    if sharding is not None and getattr(sharding, "memory_kind", None) != "device":
+        try:
+            sharding = sharding.with_memory_kind("device")
+        except (ValueError, NotImplementedError):
+            pass  # backend without memory kinds (plain CPU): placement is moot
+    return jax.device_put(x, sharding)
+
+
+def meminfo(x) -> str:
+    """Introspect actual placement (≅ MEMINFO/PTRINFO, cuda_error.h:66-136)."""
+    if not isinstance(x, jax.Array):
+        return f"host(python:{type(x).__name__})"
+    shards = x.addressable_shards
+    kinds = sorted({s.data.sharding.memory_kind or "device" for s in shards})
+    devs = sorted({s.device.id for s in shards})
+    return (
+        f"kind={','.join(kinds)} devices={devs} "
+        f"nbytes={x.nbytes} dtype={x.dtype} shape={tuple(x.shape)}"
+    )
+
+
+def nbytes_report(*arrays) -> str:
+    """Rank-0 style allocation report (≅ cudaMemGetInfo print,
+    mpi_daxpy_nvtx.cc:201-205, and the device-bytes estimate,
+    mpi_stencil2d_sycl.cc:454-465)."""
+    total = sum(getattr(a, "nbytes", jnp.asarray(a).nbytes) for a in arrays)
+    return f"allocated {len(arrays)} arrays, {total / 2**20:.1f} MiB total"

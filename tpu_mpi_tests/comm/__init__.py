@@ -1,0 +1,14 @@
+"""Communication layer: mesh bootstrap, collectives, halo exchange.
+
+TPU-native replacement for the reference's MPI layer (SURVEY.md §2.3, §5.8):
+`jax.distributed` + `jax.sharding.Mesh` replace `MPI_Init`/communicators,
+XLA collectives (`ppermute`/`psum`/`all_gather`) over ICI replace CUDA-aware
+MPI point-to-point and collective calls.
+"""
+
+from tpu_mpi_tests.comm.mesh import (  # noqa: F401
+    Topology,
+    bootstrap,
+    make_mesh,
+    topology,
+)

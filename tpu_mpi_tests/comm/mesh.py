@@ -1,0 +1,199 @@
+"""Process bootstrap, topology discovery, and mesh construction.
+
+This module is the TPU-native consolidation of three things the reference
+re-implements in every driver (SURVEY.md §2.3):
+
+* ``MPI_Init`` + launch-script plumbing  → :func:`bootstrap` wrapping
+  ``jax.distributed.initialize`` (reference: ``mpi_daxpy_nvtx.cc:116``,
+  ``summit/run.sh``).
+* ``set_rank_device`` rank→device binding, copied five times in the reference
+  (e.g. ``mpi_daxpy.cc:36-62``) → :func:`topology` + :func:`device_report`;
+  in JAX the runtime owns the binding, so the framework's job is discovery,
+  divisibility checking, and reporting.
+* node-count discovery via ``MPI_Comm_split_type(MPI_COMM_TYPE_SHARED)``
+  (``mpi_daxpy_nvtx.cc:72-82``) → :class:`Topology` host/process fields,
+  which drive weak scaling exactly like the reference's node count.
+
+Everything here is importable and testable on CPU with
+``XLA_FLAGS=--xla_force_host_platform_device_count=N``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tpu_mpi_tests.utils import TpuMtError, check_divisible  # noqa: F401
+
+_DISTRIBUTED_INITIALIZED = False
+
+
+class MeshError(TpuMtError):
+    """Raised for invalid mesh/topology configurations (fail-fast, SURVEY §5.3)."""
+
+
+def _check_divisible(n: int, by: int, what: str) -> int:
+    try:
+        return check_divisible(n, by, what)
+    except TpuMtError as e:
+        raise MeshError(str(e)) from None
+
+
+def bootstrap(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize multi-process JAX if requested; no-op for single process.
+
+    ≅ ``MPI_Init`` (``mpi_daxpy_nvtx.cc:116``). Multi-process mode activates
+    when arguments are given or the standard coordinator env vars are set
+    (``JAX_COORDINATOR_ADDRESS``/``TPU_WORKER_*`` or an autodetectable TPU
+    environment). A plain no-arg call never latches state, so a later call
+    with explicit coordinator arguments still initializes; repeated
+    distributed init is a no-op.
+    """
+    global _DISTRIBUTED_INITIALIZED
+    want_distributed = (
+        coordinator_address is not None
+        or num_processes is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+    )
+    if not want_distributed or _DISTRIBUTED_INITIALIZED:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS"),
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _DISTRIBUTED_INITIALIZED = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Discovered process/device topology.
+
+    Field mapping to the reference:
+
+    * ``process_count``  ≅ node count from ``get_node_count``
+      (``mpi_daxpy_nvtx.cc:72-82``) — the weak-scaling unit.
+    * ``global_device_count`` ≅ ``world_size`` (one rank per device).
+    * ``local_device_count``  ≅ ranks-per-node from the shared-memory
+      communicator split.
+    """
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+    platform: str
+    device_kinds: tuple[str, ...]
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.process_count > 1
+
+
+def topology() -> Topology:
+    devices = jax.devices()
+    return Topology(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=len(devices),
+        platform=devices[0].platform,
+        device_kinds=tuple(sorted({d.device_kind for d in devices})),
+    )
+
+
+def device_report(verbose: bool = False) -> str:
+    """One-line (or per-device) binding report.
+
+    ≅ the ``set_rank_device`` printouts (``mpi_daxpy.cc:56-59`` reports
+    memory per rank; ``mpi_daxpy_gt.cc`` prints ``[device:vendor_id]``).
+    """
+    topo = topology()
+    lines = [
+        f"{topo.process_index}/{topo.process_count} processes, "
+        f"{topo.local_device_count} local / {topo.global_device_count} global "
+        f"devices, platform={topo.platform}, kinds={list(topo.device_kinds)}"
+    ]
+    if verbose:
+        for d in jax.local_devices():
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except (RuntimeError, NotImplementedError, AttributeError):
+                pass
+            mem = stats.get("bytes_limit")
+            mem_s = f", mem_limit={mem / 2**30:.1f}GiB" if mem else ""
+            lines.append(f"  device {d.id}: {d.device_kind}{mem_s}")
+    return "\n".join(lines)
+
+
+def make_mesh(
+    axes: Mapping[str, int] | Sequence[tuple[str, int]] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named device mesh with fail-fast shape checking.
+
+    ``axes`` maps axis name → size; at most one size may be ``-1`` (filled
+    with the remaining devices). ``None`` means a 1-D mesh named ``"shard"``
+    over all devices — the analog of ``MPI_COMM_WORLD`` for the reference's
+    1-D decompositions (SURVEY §2.3 row 1).
+
+    The mesh is the communicator abstraction: an ICI-major axis ordering is
+    used so that ``ppermute``/``psum`` over the innermost axes ride ICI
+    (devices enumerate local-first in JAX's default ordering).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+
+    if axes is None:
+        axes = {"shard": n}
+    items = list(axes.items()) if isinstance(axes, Mapping) else list(axes)
+    names = [k for k, _ in items]
+    sizes = [v for _, v in items]
+    if len(set(names)) != len(names):
+        raise MeshError(f"duplicate mesh axis names: {names}")
+
+    wildcards = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wildcards) > 1:
+        raise MeshError("at most one mesh axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if wildcards:
+        sizes[wildcards[0]] = _check_divisible(n, known, "mesh wildcard axis")
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != n:
+        raise MeshError(
+            f"mesh shape {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {n}"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def ranks_per_device(world_size: int | None = None) -> int:
+    """Oversubscription factor (reference ``ranks_per_device``,
+    ``mpi_daxpy.cc:49-51``).
+
+    Multiple processes per TPU chip are unsupported; the framework's analog is
+    multiple logical shards per chip handled *inside* one process (SURVEY §7
+    hard part 5), so this returns how many logical ranks each device carries
+    for a requested world size, with the reference's divisibility rule.
+    """
+    n_dev = jax.device_count()
+    if world_size is None or world_size <= n_dev:
+        return 1
+    return _check_divisible(world_size, n_dev, "world_size over devices")

@@ -1,0 +1,27 @@
+"""Shared fail-fast helpers used by every layer (≅ cuda_error.h's CHECK).
+
+Kept dependency-free so the array layer does not import the comm layer
+(layer order in the package docstring: comm/ sits above arrays/).
+"""
+
+from __future__ import annotations
+
+
+class TpuMtError(ValueError):
+    """Base error for invalid configurations (fail-fast, SURVEY §5.3)."""
+
+
+def check_divisible(n: int, by: int, what: str = "size") -> int:
+    """Fail-fast divisibility precondition.
+
+    The reference exits early when the global size does not divide evenly
+    across ranks (``mpi_stencil_gt.cc:141-145``, ``mpi_daxpy.cc:43-48``); the
+    framework raises instead so tests can assert on it.
+
+    Returns ``n // by``.
+    """
+    if by <= 0:
+        raise TpuMtError(f"{what}: divisor must be positive, got {by}")
+    if n % by != 0:
+        raise TpuMtError(f"{what}: {n} not evenly divisible by {by}")
+    return n // by
