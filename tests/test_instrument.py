@@ -1,0 +1,110 @@
+import io
+import json
+
+import jax.numpy as jnp
+
+from tpu_mpi_tests.instrument import PhaseTimer, Reporter
+from tpu_mpi_tests.instrument.timers import block
+from tpu_mpi_tests.instrument.trace import ProfilerGate, trace_range
+
+
+class TestPhaseTimer:
+    def test_accumulates(self):
+        t = PhaseTimer()
+        for _ in range(3):
+            with t.phase("a"):
+                pass
+        assert t.counts["a"] == 3
+        assert t.seconds["a"] >= 0
+
+    def test_warmup_skipped(self):
+        t = PhaseTimer(skip_first=2)
+        for _ in range(5):
+            with t.phase("x"):
+                pass
+        assert t.counts["x"] == 3
+
+    def test_lines_format(self):
+        t = PhaseTimer()
+        with t.phase("gather"):
+            pass
+        (line,) = t.lines()
+        assert line.startswith("TIME gather : 0.")
+
+    def test_timed_blocks_result(self):
+        t = PhaseTimer()
+        out = t.timed("k", lambda: jnp.ones(8) * 2)
+        assert float(out.sum()) == 16.0
+        assert t.counts["k"] == 1
+
+    def test_block_passthrough(self):
+        x = jnp.ones(4)
+        assert block(x) is x
+        a, b = block(x, x + 1)
+        assert float(b.sum()) == 8.0
+
+
+class TestReporter:
+    def test_line_shapes(self):
+        buf = io.StringIO()
+        r = Reporter(rank=2, size=8, stream=buf)
+        r.sum_line(12.5)
+        r.time_line("kernel", 0.25)
+        r.test_line(0, "device", True, 1.5, 1e-7)
+        r.test_line(1, "managed", False, 0.5, 0.0, extra_label="allreduce")
+        r.exchange_line(0.125)
+        out = buf.getvalue().splitlines()
+        assert out[0] == "2/8 SUM = 12.500000"
+        assert out[1] == "TIME kernel : 0.250000"
+        assert out[2].startswith("TEST dim:0, device , buf:1; 1.5")
+        assert "err=1" in out[2]
+        assert out[3].startswith("TEST dim:1, managed, buf:0; allreduce=0.5")
+        assert out[4] == "2/8 exchange time 0.12500000 ms"
+
+    def test_banner_rank0_only(self):
+        buf = io.StringIO()
+        Reporter(rank=1, size=2, stream=buf).banner("config")
+        assert buf.getvalue() == ""
+        Reporter(rank=0, size=2, stream=buf).banner("config")
+        assert buf.getvalue() == "config\n"
+
+    def test_jsonl_sink(self, tmp_path):
+        p = tmp_path / "out.jsonl"
+        buf = io.StringIO()
+        r = Reporter(stream=buf, jsonl_path=str(p))
+        r.sum_line(1.0)
+        r.time_line("kernel", 2.0)
+        r.close()
+        recs = [json.loads(ln) for ln in p.read_text().splitlines()]
+        assert recs[0]["kind"] == "sum" and recs[0]["value"] == 1.0
+        assert recs[1]["kind"] == "time" and recs[1]["phase"] == "kernel"
+
+
+def test_trace_range_and_gate_smoke(tmp_path):
+    with trace_range("phase"):
+        x = jnp.arange(4.0) * 2
+    assert float(x.sum()) == 12.0
+    # gate without logdir is a no-op; with logdir it must start/stop cleanly
+    with ProfilerGate(None):
+        pass
+    with ProfilerGate(str(tmp_path / "trace")):
+        jnp.ones(4).block_until_ready()
+
+
+def test_daxpy_driver_end_to_end(capsys):
+    from tpu_mpi_tests.drivers import daxpy as drv
+
+    rc = drv.main(["--n", "512", "--dtype", "float64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0/1 SUM = 131328.000000" in out  # 512*513/2
+    assert "TIME kernel :" in out
+
+
+def test_daxpy_driver_checksum_gate(capsys):
+    # sanity: a wrong `a` must trip the gate
+    from tpu_mpi_tests.drivers import daxpy as drv
+
+    rc = drv.main(["--n", "64", "--a", "3.0", "--dtype", "float64"])
+    assert rc == 1
+    assert "CHECKSUM FAIL" in capsys.readouterr().out

@@ -27,7 +27,9 @@ import enum
 import functools
 
 import jax
-import jax.numpy as jnp
+import numpy as np
+
+from tpu_mpi_tests.utils import TpuMtError
 
 
 class Space(enum.Enum):
@@ -41,7 +43,13 @@ class Space(enum.Enum):
     def parse(cls, s: "str | Space") -> "Space":
         if isinstance(s, Space):
             return s
-        return cls[s.upper()]
+        try:
+            return cls[s.upper()]
+        except KeyError:
+            raise TpuMtError(
+                f"unknown space {s!r}; valid: "
+                f"{[m.name.lower() for m in cls]}"
+            ) from None
 
 
 @functools.cache
@@ -123,5 +131,8 @@ def nbytes_report(*arrays) -> str:
     """Rank-0 style allocation report (≅ cudaMemGetInfo print,
     mpi_daxpy_nvtx.cc:201-205, and the device-bytes estimate,
     mpi_stencil2d_sycl.cc:454-465)."""
-    total = sum(getattr(a, "nbytes", jnp.asarray(a).nbytes) for a in arrays)
+    total = sum(
+        a.nbytes if hasattr(a, "nbytes") else np.asarray(a).nbytes
+        for a in arrays
+    )
     return f"allocated {len(arrays)} arrays, {total / 2**20:.1f} MiB total"

@@ -59,13 +59,18 @@ def bootstrap(
     distributed init is a no-op.
     """
     global _DISTRIBUTED_INITIALIZED
-    want_distributed = (
+    # Multi-host TPU slices advertise their worker set; >1 worker means
+    # jax.distributed.initialize() can autodetect everything itself.
+    tpu_workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multihost_tpu = "," in tpu_workers
+    explicit = (
         coordinator_address is not None
         or num_processes is not None
+        or process_id is not None
         or os.environ.get("JAX_COORDINATOR_ADDRESS")
         or os.environ.get("COORDINATOR_ADDRESS")
     )
-    if not want_distributed or _DISTRIBUTED_INITIALIZED:
+    if not (explicit or multihost_tpu) or _DISTRIBUTED_INITIALIZED:
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address

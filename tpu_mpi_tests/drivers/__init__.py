@@ -1,0 +1,15 @@
+"""Benchmark drivers — one per reference binary (SURVEY.md §2.1).
+
+| driver              | reference binary        |
+|---------------------|-------------------------|
+| daxpy               | daxpy.cu / daxpy_nvtx.cu|
+| mpi_daxpy           | mpi_daxpy.cc / mpi_daxpy_gt.cc |
+| mpi_daxpy_nvtx      | mpi_daxpy_nvtx.cc (flagship DAXPY) |
+| stencil1d           | mpi_stencil_gt.cc       |
+| stencil2d           | mpi_stencil2d_gt.cc (flagship stencil) + *_sycl variants |
+| gather_inplace      | mpigatherinplace.f90    |
+| envprobe            | mpienv.f90              |
+
+All drivers run unchanged on the fake-device CPU mesh (``--fake-devices N``)
+and on real TPU slices; the same shard_map code path executes in both.
+"""

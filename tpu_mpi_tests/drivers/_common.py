@@ -1,0 +1,74 @@
+"""Shared driver plumbing: config flags and platform selection.
+
+The reference's four config surfaces (argv positionals, compile-time defines,
+build options, env — SURVEY.md §5.6) are unified here into one argparse layer
+per driver; runtime flags replace the ``-DMANAGED``-style twin binaries.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument(
+        "--fake-devices",
+        type=int,
+        default=0,
+        metavar="N",
+        help="run on N fake CPU devices (distributed-on-CPU test mode; "
+        "≅ running the reference under mpirun -np N on one box)",
+    )
+    p.add_argument(
+        "--dtype",
+        default="float32",
+        choices=["float32", "float64", "bfloat16"],
+        help="element type; reference is float64 (MPI_DOUBLE) — TPU default "
+        "is float32, float64 enables the x64 software path",
+    )
+    p.add_argument("--jsonl", default=None, help="append JSONL records here")
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        help="capture an XProf trace to this dir (≅ nsys -c cudaProfilerApi)",
+    )
+    p.add_argument(
+        "--verbose", action="store_true", help="extra per-device reporting"
+    )
+    return p
+
+
+def setup_platform(args) -> None:
+    """Apply platform/dtype config. Must run before any JAX backend use.
+
+    ``--fake-devices N`` forces the CPU backend with N fake devices — the
+    image's sitecustomize registers the TPU plugin programmatically, so this
+    must go through jax.config, not just the env var.
+    """
+    import jax
+
+    if args.fake_devices:
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={args.fake_devices}"
+        )
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+        jax.config.update("jax_platforms", "cpu")
+    if args.dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+
+
+def jnp_dtype(args):
+    import jax.numpy as jnp
+
+    return {
+        "float32": jnp.float32,
+        "float64": jnp.float64,
+        "bfloat16": jnp.bfloat16,
+    }[args.dtype]

@@ -1,0 +1,83 @@
+"""Single-device DAXPY with checksum verification.
+
+≅ ``daxpy.cu`` (and, with ``--profile-dir``, ``daxpy_nvtx.cu`` — the NVTX
+twin is a flag here, not a second binary). Semantics preserved: n=1024
+default, a=2.0, x=i+1, y=-(i+1), result y=i+1, checksum n(n+1)/2 printed as
+``SUM = <v>`` (``daxpy.cu:82-88``). The copyInput/daxpy/copyOutput phase
+structure of ``daxpy_nvtx.cu:72-91`` maps to trace ranges + phase timers.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from tpu_mpi_tests.drivers import _common
+
+
+def run(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    import tpu_mpi_tests.kernels.daxpy as kd
+    from tpu_mpi_tests.arrays.spaces import Space, place, to_device
+    from tpu_mpi_tests.instrument import PhaseTimer, ProfilerGate, Reporter
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.instrument.trace import trace_range
+
+    dtype = _common.jnp_dtype(args)
+    rep = Reporter(jsonl_path=args.jsonl)
+    timer = PhaseTimer()
+    n, a = args.n, args.a
+
+    with ProfilerGate(args.profile_dir):
+        # initializeArrays on host, then copyInput H2D (daxpy_nvtx.cu:72-79)
+        i = np.arange(1, n + 1)
+        h_x = i.astype(dtype)
+        h_y = (-i).astype(dtype)
+        with trace_range("copyInput"), timer.phase("copyInput"):
+            d_x = block(to_device(place(h_x, Space.HOST)))
+            d_y = block(to_device(place(h_y, Space.HOST)))
+
+        with trace_range("daxpy"), timer.phase("kernel"):
+            d_y = block(kd.daxpy(jnp.asarray(a, dtype), d_x, d_y))
+
+        with trace_range("copyOutput"), timer.phase("copyOutput"):
+            y = np.asarray(d_y)
+
+    if args.print_elements:
+        for v in y:
+            rep.line(f"{v:f}")
+    total = float(y.sum(dtype=np.float64))
+    rep.sum_line(total)
+    for ln in timer.lines():
+        rep.line(ln)
+
+    expected = kd.expected_checksum(n)
+    # float32 accumulates rounding over large n; scale tolerance with n
+    tol = 0 if args.dtype == "float64" else max(1e-6 * expected, 1.0)
+    if abs(total - expected) > tol:
+        rep.line(f"CHECKSUM FAIL: got {total}, expected {expected}")
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument("--n", type=int, default=1024, help="vector length")
+    p.add_argument("--a", type=float, default=2.0, help="scalar multiplier")
+    p.add_argument(
+        "--print-elements",
+        action="store_true",
+        help="print every y element (the reference always does; daxpy.cu:84)",
+    )
+    args = p.parse_args(argv)
+    if args.n < 1:
+        p.error(f"--n must be positive, got {args.n}")
+    _common.setup_platform(args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Instrumentation: phase timers, trace ranges, structured reporting.
+
+TPU-native replacement for the reference's L4 (SURVEY.md §5.1, §5.5):
+NVTX ranges → XProf trace annotations; cudaProfilerStart/Stop gating →
+jax.profiler trace gating; MPI_Wtime/clock_gettime phase timers →
+perf_counter with mandatory block_until_ready discipline; printf result
+lines → stable formatted lines + JSONL.
+"""
+
+from tpu_mpi_tests.instrument.timers import PhaseTimer, block  # noqa: F401
+from tpu_mpi_tests.instrument.trace import ProfilerGate, trace_range  # noqa: F401
+from tpu_mpi_tests.instrument.report import Reporter  # noqa: F401

@@ -1,0 +1,105 @@
+"""Structured result reporting: stable stdout lines + JSONL.
+
+The reference's observability is machine-parseable printf lines redirected to
+``out-<tag>.txt`` and averaged offline by ``avg.sh`` (SURVEY.md §5.5). The
+framework keeps the exact line shapes (so the aggregation workflow survives)
+and adds a JSONL sink per record for real tooling.
+
+Line shapes preserved:
+  ``<rank>/<size> SUM = <v>``            (``mpi_daxpy.cc:157``)
+  ``TIME <phase> : <v>``                 (``mpi_daxpy_nvtx.cc:333-340``)
+  ``TEST dim:<d>, <space>, buf:<b>; <t>, err=<e>``
+                                         (``mpi_stencil2d_gt.cc:376-383,568``)
+  ``<rank>/<size> exchange time <ms> ms`` (``mpi_stencil2d_sycl.cc:530``)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, IO
+
+
+class Reporter:
+    """Rank-aware line + JSONL emitter.
+
+    ``rank``/``size`` default to the process topology; drivers emulating
+    multiple ranks in one process pass logical values. Banner lines
+    (run-config prints) are rank-0 only, like the reference's
+    (``mpi_stencil2d_gt.cc:682-688``).
+    """
+
+    def __init__(
+        self,
+        rank: int = 0,
+        size: int = 1,
+        jsonl_path: str | None = None,
+        stream: IO[str] | None = None,
+    ):
+        self.rank = rank
+        self.size = size
+        self.jsonl_path = jsonl_path
+        self.stream = stream or sys.stdout
+        self._jsonl_file: IO[str] | None = None
+
+    def line(self, text: str, record: dict[str, Any] | None = None):
+        print(text, file=self.stream, flush=True)
+        if record is not None:
+            self.jsonl(record)
+
+    def banner(self, text: str):
+        if self.rank == 0:
+            self.line(text)
+
+    def sum_line(self, value: float, label: str = "SUM", rank=None):
+        r = self.rank if rank is None else rank
+        self.line(
+            f"{r}/{self.size} {label} = {value:f}",
+            {"kind": "sum", "label": label, "rank": r, "size": self.size,
+             "value": float(value)},
+        )
+
+    def time_line(self, phase: str, seconds: float):
+        self.line(
+            f"TIME {phase} : {seconds:0.6f}",
+            {"kind": "time", "phase": phase, "seconds": float(seconds),
+             "rank": self.rank},
+        )
+
+    def test_line(self, dim: int, space: str, buf, seconds: float, err: float,
+                  extra_label: str | None = None):
+        space_s = f"{space:7s}"
+        if extra_label:
+            text = (f"TEST dim:{dim}, {space_s}, buf:{int(buf)}; "
+                    f"{extra_label}={seconds:f}")
+        else:
+            text = (f"TEST dim:{dim}, {space_s}, buf:{int(buf)}; "
+                    f"{seconds:f}, err={err:e}")
+        self.line(
+            text,
+            {"kind": "test", "dim": dim, "space": space, "buf": int(buf),
+             "seconds": float(seconds), "err": float(err),
+             "label": extra_label},
+        )
+
+    def exchange_line(self, ms_per_iter: float, rank=None):
+        r = self.rank if rank is None else rank
+        self.line(
+            f"{r}/{self.size} exchange time {ms_per_iter:0.8f} ms",
+            {"kind": "exchange", "rank": r, "size": self.size,
+             "ms_per_iter": float(ms_per_iter)},
+        )
+
+    def jsonl(self, record: dict[str, Any]):
+        if not self.jsonl_path:
+            return
+        if self._jsonl_file is None:
+            self._jsonl_file = open(self.jsonl_path, "a")
+        json.dump(record, self._jsonl_file)
+        self._jsonl_file.write("\n")
+        self._jsonl_file.flush()
+
+    def close(self):
+        if self._jsonl_file is not None:
+            self._jsonl_file.close()
+            self._jsonl_file = None

@@ -1,0 +1,84 @@
+"""Phase wall-clock timers with async-dispatch-honest synchronization.
+
+The reference brackets every timed phase with a device sync before reading
+the clock (``gt::synchronize`` before ``clock_gettime``,
+``mpi_stencil2d_gt.cc:254,520``; ``cudaDeviceSynchronize`` before the
+``MPI_Wtime`` reads, ``mpi_daxpy_nvtx.cc:242-249``). JAX dispatch is async,
+so the same discipline is mandatory here: every phase boundary calls
+``block_until_ready`` on the arrays produced by the phase, otherwise time is
+mis-attributed to whichever phase happens to flush the queue
+(SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import jax
+
+
+def block(*pytrees):
+    """Block until every jax.Array in the given pytrees is ready.
+
+    Returns the single argument (or tuple) for chaining:
+    ``y = block(f(x))`` ≅ kernel-then-``cudaDeviceSynchronize``.
+    """
+    for t in pytrees:
+        jax.block_until_ready(t)
+    return pytrees[0] if len(pytrees) == 1 else pytrees
+
+
+class PhaseTimer:
+    """Accumulating named phase timers (≅ the t_/k_/b_/g_ MPI_Wtime pairs of
+    ``mpi_daxpy_nvtx.cc:168,242-291,327`` and the per-iteration
+    ``clock_gettime`` loop of ``mpi_stencil2d_gt.cc:511-526``).
+
+    ``skip_first`` implements the warmup convention: the first ``skip_first``
+    entries into each phase are timed but not accumulated
+    (≅ ``i >= n_warmup`` accumulation guard, ``mpi_stencil2d_gt.cc:521-526``).
+    """
+
+    def __init__(self, skip_first: int = 0):
+        self.seconds: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+        self._entries: dict[str, int] = defaultdict(int)
+        self.skip_first = skip_first
+
+    @contextmanager
+    def phase(self, name: str, sync=None):
+        """Time a phase. ``sync`` (pytree) is blocked on *before* starting so
+        queued work from the previous phase is not charged to this one; the
+        phase body must return/produce arrays the caller blocks on, or pass
+        them via :func:`block` inside the body before exit."""
+        if sync is not None:
+            block(sync)
+        t0 = time.perf_counter()
+        yield
+        dt = time.perf_counter() - t0
+        self._entries[name] += 1
+        if self._entries[name] > self.skip_first:
+            self.seconds[name] += dt
+            self.counts[name] += 1
+
+    def timed(self, name: str, fn, *args, **kwargs):
+        """Run ``fn`` and block on its result inside the phase bracket."""
+        with self.phase(name):
+            out = block(fn(*args, **kwargs))
+        return out
+
+    def mean(self, name: str) -> float:
+        c = self.counts[name]
+        return self.seconds[name] / c if c else 0.0
+
+    def lines(self, prefix: str = "TIME") -> list[str]:
+        """Stable per-phase lines (≅ ``TIME <phase> : %0.3f``,
+        ``mpi_daxpy_nvtx.cc:333-340``)."""
+        return [
+            f"{prefix} {name} : {self.seconds[name]:0.6f}"
+            for name in self.seconds
+        ]
+
+    def as_dict(self) -> dict[str, float]:
+        return dict(self.seconds)

@@ -1,0 +1,55 @@
+"""Trace ranges and profiler gating.
+
+NVTX named ranges (``daxpy_nvtx.cu:72-91``, ``mpi_daxpy_nvtx.cc:177-325``)
+map to XProf/TensorBoard trace annotations; ``cudaProfilerStart/Stop`` +
+``nsys -c cudaProfilerApi`` capture gating (``summit/run.sh:15-19``) maps to
+``jax.profiler.start_trace/stop_trace`` around the region of interest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_range(name: str):
+    """Named range visible in XProf traces (≅ nvtxRangePushA/Pop).
+
+    Works both host-side (TraceAnnotation) and around traced code
+    (named_scope names the XLA ops for the compiled trace).
+    """
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
+
+
+class ProfilerGate:
+    """Capture gating (≅ cudaProfilerStart/Stop pairing with
+    ``nsys profile -c cudaProfilerApi``).
+
+    No-op unless constructed with a log dir, so drivers can leave the calls
+    in unconditionally exactly like the reference leaves NVTX in all builds.
+    """
+
+    def __init__(self, logdir: str | None = None):
+        self.logdir = logdir
+        self.active = False
+
+    def start(self):
+        if self.logdir and not self.active:
+            jax.profiler.start_trace(self.logdir)
+            self.active = True
+
+    def stop(self):
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

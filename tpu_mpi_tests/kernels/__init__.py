@@ -1,0 +1,22 @@
+"""Compute kernels: the TPU analog of cuBLAS/gtensor/SYCL device code.
+
+Each kernel comes in two flavors, mirroring the reference's own
+dual-implementation pattern (gtensor expression templates in
+``mpi_stencil2d_gt.cc`` vs hand SYCL kernels in ``mpi_stencil2d_sycl.cc``):
+
+* an XLA-expression version (jnp/lax — the compiler fuses and tiles it), and
+* a hand-written Pallas version (``*_pallas``) — the "hand CUDA/SYCL" analog.
+"""
+
+# NOTE: kernels.daxpy (the module) is deliberately not shadowed by its
+# same-named function here — import the module for daxpy.
+from tpu_mpi_tests.kernels.stencil import (  # noqa: F401
+    STENCIL5,
+    stencil1d_5,
+    stencil2d_1d_5,
+)
+from tpu_mpi_tests.kernels.reductions import (  # noqa: F401
+    err_norm,
+    sum_axis,
+    sum_squares,
+)

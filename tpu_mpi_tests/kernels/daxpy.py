@@ -1,0 +1,34 @@
+"""DAXPY: y ← a·x + y.
+
+TPU-native replacement for ``cublasDaxpy`` (``daxpy.cu:72-73``,
+``mpi_daxpy_gt.cc:81``). The XLA version is a fused elementwise op — on TPU
+this is HBM-bandwidth bound (3 array accesses per element), exactly like the
+cuBLAS call on V100, so GB/s is the comparable metric (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def daxpy(a, x, y):
+    """y ← a·x + y. ``a`` may be a python scalar or 0-d array."""
+    return a * x + y
+
+
+def daxpy_bytes(n: int, dtype=jnp.float32) -> int:
+    """Memory traffic of one daxpy: read x, read y, write y."""
+    return 3 * n * jnp.dtype(dtype).itemsize
+
+
+def init_xy(n: int, dtype=jnp.float32):
+    """Reference initialization x=i+1, y=-(i+1) (``daxpy.cu:56-59``), giving
+    y ← 2x+y = i+1 and the exact checksum n(n+1)/2."""
+    i = jnp.arange(1, n + 1, dtype=dtype)
+    return i, -i
+
+
+def expected_checksum(n: int) -> float:
+    return n * (n + 1) / 2

@@ -1,0 +1,52 @@
+"""Halo pack/unpack: edge-slice extraction and ghost-region writes.
+
+TPU-native replacement for the reference's staging-buffer machinery:
+``buf_from_view``/``buf_to_view`` SYCL kernels (``mpi_stencil2d_sycl.cc:
+82-116``), the gtensor view assignments in ``boundary_exchange_x``
+(``mpi_stencil2d_gt.cc:166-174,237-251``), and the negative-index slice
+helpers (``mpi_stencil2d_sycl_oo.cc:164-266``).
+
+Layout convention (matches arrays/domain.py): a ghosted array has, along the
+exchange axis with boundary width ``b``::
+
+    [0:b]        lo ghost      ← filled from left neighbor's hi edge
+    [b:2b]       lo edge       → sent to left neighbor
+    [n-2b:n-b]   hi edge       → sent to right neighbor
+    [n-b:n]      hi ghost      ← filled from right neighbor's lo edge
+
+XLA copies slices when it materializes them, so ``pack_edges`` *is* the
+"device staging buffer" of the reference; the Pallas variant
+(kernels/pack_pallas.py) makes the copy explicit for the hand-tuned path.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def pack_edges(z, axis: int = 0, n_bnd: int = 2):
+    """Extract (lo_edge, hi_edge) interior slices to send to neighbors
+    (≅ ``buf_from_view``)."""
+    n = z.shape[axis]
+    lo = lax.slice_in_dim(z, n_bnd, 2 * n_bnd, axis=axis)
+    hi = lax.slice_in_dim(z, n - 2 * n_bnd, n - n_bnd, axis=axis)
+    return lo, hi
+
+
+def unpack_ghosts(z, lo_ghost, hi_ghost, axis: int = 0, n_bnd: int = 2):
+    """Write received halo blocks into the ghost regions
+    (≅ ``buf_to_view``). Functional: returns the updated array."""
+    n = z.shape[axis]
+    z = lax.dynamic_update_slice_in_dim(z, lo_ghost, 0, axis=axis)
+    z = lax.dynamic_update_slice_in_dim(z, hi_ghost, n - n_bnd, axis=axis)
+    return z
+
+
+def interior(z, axis: int = 0, n_bnd: int = 2):
+    """Strip ghosts along ``axis``."""
+    return lax.slice_in_dim(z, n_bnd, z.shape[axis] - n_bnd, axis=axis)
+
+
+pack_edges_jit = jax.jit(pack_edges, static_argnames=("axis", "n_bnd"))
+unpack_ghosts_jit = jax.jit(unpack_ghosts, static_argnames=("axis", "n_bnd"))

@@ -1,0 +1,31 @@
+"""Reductions: sum-of-squares error norms and axis sums.
+
+TPU-native replacement for ``gt::sum_squares`` (``mpi_stencil_gt.cc:222``),
+``gt::sum_axis_to`` (``mpi_stencil2d_gt.cc:611,620``), and the SYCL
+``diff_norm`` reduction kernel (``mpi_stencil2d_sycl.cc:165-181``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def sum_squares(x):
+    return jnp.sum(jnp.square(x))
+
+
+@jax.jit
+def err_norm(numeric, actual):
+    """sqrt(Σ(numeric − actual)²) — the stencil correctness gate
+    (≅ ``diff_norm`` + sqrt at ``mpi_stencil_gt.cc:222``)."""
+    return jnp.sqrt(sum_squares(numeric - actual))
+
+
+def sum_axis(x, axis: int):
+    """Reduce one axis to a vector (≅ ``gt::sum_axis_to``)."""
+    return jnp.sum(x, axis=axis)
+
+
+sum_axis_jit = jax.jit(sum_axis, static_argnames=("axis",))

@@ -1,0 +1,85 @@
+"""5-point 4th-order centered first-derivative stencils.
+
+TPU-native replacement for the gtensor expression templates
+(``mpi_stencil_gt.cc:54-59``, ``mpi_stencil2d_gt.cc:84-110``) and the SYCL
+kernel (``mpi_stencil2d_sycl.cc:53-75``). Coefficients are the standard
+4th-order central difference (1/12, -2/3, 0, 2/3, -1/12); the input carries
+``n_bnd = 2`` ghost points per side along the stencil axis and the output is
+the interior (input size − 4 along that axis).
+
+Written as shifted slices summed into one expression — XLA fuses this into a
+single VPU pass over the array, which is the idiomatic TPU form of the
+reference's lazy expression templates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# 4th-order central first-derivative coefficients (× 1/delta).
+STENCIL5 = np.array([1.0 / 12.0, -2.0 / 3.0, 0.0, 2.0 / 3.0, -1.0 / 12.0])
+N_BND = 2  # (len(STENCIL5) - 1) // 2
+
+
+def stencil1d_5(y, scale=1.0, axis: int = 0):
+    """Apply the 5-point stencil along ``axis``.
+
+    ``y`` is ghosted along ``axis``; result has ``y.shape[axis] - 4`` there.
+    ``scale`` is 1/delta (the reference multiplies by ``scale`` after the
+    stencil, ``mpi_stencil_gt.cc:206``).
+    """
+    n = y.shape[axis]
+    if n < 2 * N_BND + 1:
+        raise ValueError(
+            f"stencil axis {axis} needs >= {2 * N_BND + 1} points, got {n}"
+        )
+    out = None
+    for k, c in enumerate(STENCIL5):
+        if c == 0.0:
+            continue
+        term = c * lax.slice_in_dim(y, k, n - 2 * N_BND + k, axis=axis)
+        out = term if out is None else out + term
+    return out * scale
+
+
+def stencil2d_1d_5(z, scale=1.0, dim: int = 0):
+    """2-D array, 1-D stencil along ``dim`` (≅ ``stencil2d_1d_5_d0/_d1``,
+    ``mpi_stencil2d_gt.cc:84-110``)."""
+    return stencil1d_5(z, scale=scale, axis=dim)
+
+
+stencil1d_5_jit = jax.jit(stencil1d_5, static_argnames=("axis",))
+stencil2d_1d_5_jit = jax.jit(stencil2d_1d_5, static_argnames=("dim",))
+
+
+def analytic_pairs():
+    """The reference's test functions: (f, df) pairs used by the drivers.
+
+    1-D: y = x³, dy/dx = 3x² (``mpi_stencil_gt.cc:171-172``).
+    2-D: z = x³ + y², dz/dx = 3x², dz/dy = 2y
+    (``mpi_stencil2d_gt.cc:431-433``).
+    """
+
+    def x_cubed(x):
+        return x**3
+
+    def x_cubed_deriv(x):
+        return 3 * x**2
+
+    def z_fn(x, y):
+        return x**3 + y**2
+
+    def dz_dx(x, y):
+        return 3 * x**2 + 0 * y
+
+    def dz_dy(x, y):
+        return 0 * x + 2 * y
+
+    return {
+        "1d": (x_cubed, x_cubed_deriv),
+        "2d_dim0": (z_fn, dz_dx),
+        "2d_dim1": (z_fn, dz_dy),
+    }
