@@ -1,0 +1,66 @@
+"""Driver-level integration tests: run the real main()s on the 8-fake-device
+mesh (≅ launching the reference binaries under mpirun -np 8)."""
+
+import re
+
+from tpu_mpi_tests.drivers import envprobe, gather_inplace, mpi_daxpy, mpi_daxpy_nvtx
+
+
+def test_mpi_daxpy(capsys):
+    rc = mpi_daxpy.main(["--n-total", "8192", "--dtype", "float64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # 8 per-rank SUM lines, each n(n+1)/2 for n=1024
+    sums = re.findall(r"(\d)/8 SUM = ([\d.]+)", out)
+    assert len(sums) == 8
+    assert all(float(v) == 1024 * 1025 / 2 for _, v in sums)
+
+
+def test_mpi_daxpy_nvtx_full_phase_structure(capsys):
+    rc = mpi_daxpy_nvtx.main(
+        ["--n-per-node", "65536", "--dtype", "float64", "--barrier"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    n = 65536 // 8
+    assert out.count("SUM = ") == 9  # 8 local + 1 ALLSUM
+    assert f"0/8 ALLSUM = {8 * (n + 1) / 2:f}" in out
+    for phase in ("total", "kernel", "barrier", "gather"):
+        assert f"TIME {phase} : " in out
+    assert "1 nodes, 8 ranks" in out
+
+
+def test_mpi_daxpy_nvtx_managed_space(capsys):
+    rc = mpi_daxpy_nvtx.main(
+        ["--n-per-node", "8192", "--dtype", "float64", "--space", "managed"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ALLSUM" in out
+
+
+def test_mpi_daxpy_nvtx_f32_tolerance(capsys):
+    # float32 path: checksum gate uses tolerance, must still pass
+    rc = mpi_daxpy_nvtx.main(["--n-per-node", "65536", "--dtype", "float32"])
+    assert rc == 0
+
+
+def test_gather_inplace_parity(capsys):
+    rc = gather_inplace.main(["--n-per-rank", "2048", "--dtype", "float64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # rank r local sum (r+1)*n; global sum n*36
+    assert "0/8 lsum=2048.0 asum=73728.0" in out
+    assert "7/8 lsum=16384.0 asum=73728.0" in out
+
+
+def test_envprobe(capsys, monkeypatch):
+    monkeypatch.setenv("MEMORY_PER_CORE", "1024")
+    rc = envprobe.main([])
+    assert rc == 0
+    assert "MEMORY_PER_CORE=1024" in capsys.readouterr().out
+
+    monkeypatch.delenv("MEMORY_PER_CORE")
+    rc = envprobe.main([])
+    assert rc == 0
+    assert "MEMORY_PER_CORE=<not set>" in capsys.readouterr().out

@@ -1,0 +1,203 @@
+"""Collectives over mesh axes: all-gather, allreduce, reduce, barrier.
+
+TPU-native replacement for the reference's MPI collectives (SURVEY.md §2.3):
+
+* ``MPI_Allgather`` on device buffers (``mpi_daxpy_nvtx.cc:285-288``) →
+  ``lax.all_gather`` inside ``shard_map`` — XLA compiles it to ICI DMA.
+* ``MPI_Allgather(MPI_IN_PLACE, ...)`` (``mpi_daxpy_nvtx.cc:285``,
+  ``mpigatherinplace.f90:39-40``) → :func:`all_gather_inplace`: the global
+  buffer is already sharded with each device holding its own filled slice
+  (the IN_PLACE precondition), gathered functionally with input donation to
+  approximate the no-extra-copy property (SURVEY §7 hard part 4).
+* in-place device ``MPI_Allreduce(MPI_SUM)`` (``mpi_stencil2d_gt.cc:615-625``)
+  → ``lax.psum`` via :func:`allreduce_sum`, donated.
+* ``MPI_Reduce(..., 0, ...)`` of scalar metrics (``mpi_stencil2d_gt.cc:
+  562-566``) → :func:`reduce_sum` (psum; every process holds the result,
+  rank 0 prints — same observable behavior).
+* ``MPI_Barrier`` (``mpi_daxpy_nvtx.cc:274-280``) → :func:`barrier`, a
+  completed 1-element psum.
+
+All functions are built per-mesh and jitted once; they run identically on
+fake CPU devices and TPU slices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def shard_1d(arr, mesh: Mesh, axis_name: str | None = None, axis: int = 0):
+    """Place a global array sharded along ``axis`` over ``axis_name``
+    (≅ each rank holding its block of the decomposed global array)."""
+    axis_name = axis_name or mesh.axis_names[0]
+    spec = [None] * getattr(arr, "ndim", 1)
+    spec[axis] = axis_name
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def replicate(arr, mesh: Mesh):
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _all_gather_fn(mesh: Mesh, axis_name: str, axis: int, ndim: int):
+    spec = [None] * ndim
+    spec[axis] = axis_name
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(*spec),
+        out_specs=P(),
+        # all_gather output is replicated by construction; static vma
+        # inference can't prove it on Auto-typed meshes
+        check_vma=False,
+    )
+    def gather(x):
+        return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+    return gather
+
+
+def all_gather(x_sharded, mesh: Mesh, axis_name: str | None = None,
+               axis: int = 0):
+    """Replicate a sharded array on every device (≅ ``MPI_Allgather`` of
+    each rank's shard into a full copy per rank)."""
+    axis_name = axis_name or mesh.axis_names[0]
+    return _all_gather_fn(mesh, axis_name, axis, x_sharded.ndim)(x_sharded)
+
+
+@functools.lru_cache(maxsize=None)
+def _all_gather_inplace_fn(mesh: Mesh, axis_name: str, axis: int, ndim: int):
+    spec = [None] * ndim
+    spec[axis] = axis_name
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(),
+        check_vma=False,
+    )
+    def gather(x):
+        return lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+    return gather
+
+
+def all_gather_inplace(allx_sharded, mesh: Mesh, axis_name: str | None = None,
+                       axis: int = 0):
+    """``MPI_Allgather(MPI_IN_PLACE)`` parity: input is the full-size global
+    buffer sharded so each device holds its own (already filled) slice;
+    output is the replicated gathered buffer. The input is donated so XLA may
+    reuse its memory — the closest functional analog of in-place semantics
+    with immutable arrays."""
+    axis_name = axis_name or mesh.axis_names[0]
+    return _all_gather_inplace_fn(mesh, axis_name, axis, allx_sharded.ndim)(
+        allx_sharded
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_fn(mesh: Mesh, axis_name: str, ndim: int):
+    spec = [axis_name] + [None] * (ndim - 1)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec)
+    )
+    def reduce(x):
+        return lax.psum(x, axis_name)
+
+    return reduce
+
+
+def allreduce_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
+    """In-place device ``MPI_Allreduce(MPI_SUM)`` parity
+    (``mpi_stencil2d_gt.cc:615-625``): every logical rank holds an
+    equal-length vector; afterwards every rank's buffer holds the elementwise
+    sum. ``per_rank`` has shape ``(n_ranks, L)`` sharded on axis 0 (one row
+    per rank); the result has the same shape/sharding with every row replaced
+    by the sum — the donated input approximates the in-place reuse."""
+    axis_name = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis_name]
+    if per_rank.shape[0] != n:
+        raise ValueError(
+            f"allreduce_sum: leading axis {per_rank.shape[0]} must equal "
+            f"mesh axis {axis_name}={n} (one row per rank)"
+        )
+    return _allreduce_fn(mesh, axis_name, per_rank.ndim)(per_rank)
+
+
+def host_value(x) -> np.ndarray:
+    """Fetch an array to host safely on every process.
+
+    ``np.asarray`` raises for arrays spanning non-addressable devices
+    (multi-host); fully-replicated arrays are read from the local replica
+    instead. Partially-sharded multi-host arrays must be gathered first
+    (use :func:`all_gather`)."""
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return np.asarray(x)
+    if x.is_fully_replicated:
+        return np.asarray(x.addressable_data(0))
+    raise ValueError(
+        "array spans non-addressable devices and is not replicated; "
+        "all_gather it before reading host-side"
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _per_rank_sums_fn(mesh: Mesh, axis_name: str, ndim: int):
+    spec = [None] * ndim
+    spec[0] = axis_name
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(axis_name),
+        check_vma=False,
+    )
+    def local_sum(x):
+        return jnp.sum(x).reshape(1)
+
+    return local_sum
+
+
+def per_rank_sums(x_sharded, mesh: Mesh, axis_name: str | None = None):
+    """Per-rank local sums, replicated so every process can read them
+    (≅ each rank computing its local checksum, ``mpi_daxpy_nvtx.cc:251-267``).
+
+    Returns a host numpy vector of length ``mesh.shape[axis_name]``.
+    """
+    axis_name = axis_name or mesh.axis_names[0]
+    sums = _per_rank_sums_fn(mesh, axis_name, x_sharded.ndim)(x_sharded)
+    return host_value(all_gather(sums, mesh, axis_name))
+
+
+def reduce_sum(values) -> float:
+    """Cross-process scalar metric reduction
+    (≅ ``MPI_Reduce(..., MPI_SUM, 0, ...)``, ``mpi_stencil2d_gt.cc:562-566``).
+
+    ``values`` are this process's host-side partial scalars (e.g. per-logical-
+    rank iteration times). Single-process: a plain sum. Multi-process: summed
+    across processes via a device collective; every process returns the same
+    total (rank 0 is simply the one that prints)."""
+    total = float(np.sum(values))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        total = float(
+            np.sum(multihost_utils.process_allgather(jnp.float32(total)))
+        )
+    return total
+
+
+def barrier(mesh: Mesh):
+    """≅ ``MPI_Barrier``: a completed collective across the mesh."""
+    x = shard_1d(jnp.ones((len(mesh.devices.flat),), jnp.int32), mesh)
+    _allreduce_fn(mesh, mesh.axis_names[0], 1)(x).block_until_ready()

@@ -33,9 +33,7 @@ def run(args) -> int:
 
     with ProfilerGate(args.profile_dir):
         # initializeArrays on host, then copyInput H2D (daxpy_nvtx.cu:72-79)
-        i = np.arange(1, n + 1)
-        h_x = i.astype(dtype)
-        h_y = (-i).astype(dtype)
+        h_x, h_y = kd.init_xy_np(n, dtype)
         with trace_range("copyInput"), timer.phase("copyInput"):
             d_x = block(to_device(place(h_x, Space.HOST)))
             d_y = block(to_device(place(h_y, Space.HOST)))
@@ -51,8 +49,8 @@ def run(args) -> int:
             rep.line(f"{v:f}")
     total = float(y.sum(dtype=np.float64))
     rep.sum_line(total)
-    for ln in timer.lines():
-        rep.line(ln)
+    for phase, secs in timer.as_dict().items():
+        rep.time_line(phase, secs)
 
     expected = kd.expected_checksum(n)
     # float32 accumulates rounding over large n; scale tolerance with n

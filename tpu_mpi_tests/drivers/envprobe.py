@@ -1,0 +1,60 @@
+"""Environment propagation probe.
+
+≅ ``mpienv.f90``: every rank reads ``MEMORY_PER_CORE`` (or a flag-chosen
+variable) and prints what it sees — debugging env propagation through the
+launch stack (the reference chased Spectrum-MPI eating this variable,
+``mpi_daxpy.cc:99-101``). In the JAX model env propagates per *process*, so
+one line is printed per process and one per local device row.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from tpu_mpi_tests.drivers import _common
+
+
+def run(args) -> int:
+    import jax
+
+    from tpu_mpi_tests.comm.mesh import bootstrap, topology
+    from tpu_mpi_tests.instrument import Reporter
+
+    bootstrap()
+    topo = topology()
+    rep = Reporter(
+        rank=topo.process_index,
+        size=topo.process_count,
+        jsonl_path=args.jsonl,
+    )
+    val = os.environ.get(args.var)
+    shown = val if val is not None else "<not set>"
+    rep.line(
+        f"{topo.process_index}/{topo.process_count} {args.var}={shown}",
+        {"kind": "envprobe", "var": args.var, "value": val,
+         "rank": topo.process_index},
+    )
+    if args.verbose:
+        for d in jax.local_devices():
+            rep.line(
+                f"{topo.process_index}/{topo.process_count} "
+                f"device {d.id} ({d.device_kind}) sees {args.var}={shown}"
+            )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--var",
+        default="MEMORY_PER_CORE",
+        help="environment variable to probe (reference: MEMORY_PER_CORE)",
+    )
+    args = p.parse_args(argv)
+    _common.setup_platform(args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

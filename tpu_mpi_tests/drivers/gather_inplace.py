@@ -1,0 +1,78 @@
+"""In-place allgather semantics probe.
+
+≅ ``mpigatherinplace.f90``: every rank fills its own slice of a shared
+global array, does ``MPI_Allgather(MPI_IN_PLACE)``, and prints its local sum
+next to the global sum; the global sum must equal the sum of local sums
+exactly. Reference default is 128Mi doubles per rank (``:11``); default here
+is smaller for the single-chip case and flag-scalable.
+
+Rank r's slice is filled with ``r + 1`` (``mpigatherinplace.f90:33-36``
+fills with the 1-based rank), so local sums are ``(r+1)*n`` and the global
+sum is ``n * world*(world+1)/2`` — integer-exact in every dtype up to large n.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from tpu_mpi_tests.drivers import _common
+
+
+def run(args) -> int:
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.comm import collectives as C
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.instrument import Reporter
+    from tpu_mpi_tests.instrument.timers import block
+
+    dtype = _common.jnp_dtype(args)
+    bootstrap()
+    topo = topology()
+    mesh = make_mesh()
+    world = topo.global_device_count
+    n = args.n_per_rank
+
+    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+
+    # fill own slice: global buffer whose shard r holds (r+1)
+    fill = np.repeat(np.arange(1, world + 1, dtype=np.float64), n)
+    allx = C.shard_1d(jnp.asarray(fill.astype(dtype)), mesh)
+    local_sums = [(r + 1) * n for r in range(world)]
+
+    g = block(C.all_gather_inplace(allx, mesh))
+    asum = float(np.asarray(g, dtype=np.float64).sum())
+
+    for r in range(world):
+        rep.line(
+            f"{r}/{world} lsum={local_sums[r]:.1f} asum={asum:.1f}",
+            {"kind": "gather_inplace", "rank": r, "lsum": local_sums[r],
+             "asum": asum},
+        )
+
+    expected = float(sum(local_sums))
+    if asum != expected:
+        rep.line(f"PARITY FAIL: asum {asum} != sum of lsums {expected}")
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--n-per-rank",
+        type=int,
+        default=1 << 20,
+        help="elements per rank (reference: 128Mi doubles)",
+    )
+    args = p.parse_args(argv)
+    if args.n_per_rank < 1:
+        p.error(f"--n-per-rank must be positive, got {args.n_per_rank}")
+    _common.setup_platform(args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""Multi-rank DAXPY with device + managed allocation pairs.
+
+≅ ``mpi_daxpy.cc`` / ``mpi_daxpy_gt.cc``: every rank runs the same DAXPY on
+its block; both an explicit-device pair and a "managed" pair are allocated
+and introspected (MEMINFO), the kernel runs on the **managed** pair
+(``mpi_daxpy.cc:140-141``) and the checksum is read host-side from managed
+memory (``:152-156``); each rank prints ``rank/size SUM = <v>``. The
+``MEMORY_PER_CORE`` env probe (``:99-108``) is preserved.
+
+Ranks are mesh devices; run with ``--fake-devices N`` for the reference's
+``mpirun -np N`` shape on one box.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from tpu_mpi_tests.drivers import _common
+
+
+def run(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    import tpu_mpi_tests.kernels.daxpy as kd
+    from tpu_mpi_tests.comm import collectives as C
+    from tpu_mpi_tests.comm.mesh import (
+        bootstrap,
+        check_divisible,
+        device_report,
+        make_mesh,
+        topology,
+    )
+    from tpu_mpi_tests.arrays.spaces import Space, meminfo, place
+    from tpu_mpi_tests.instrument import Reporter
+    from tpu_mpi_tests.instrument.timers import block
+
+    dtype = _common.jnp_dtype(args)
+    bootstrap()
+    topo = topology()
+    mesh = make_mesh()
+    world = topo.global_device_count
+    n = check_divisible(args.n_total, world, "n_total over ranks")
+
+    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+
+    # env probe (mpi_daxpy.cc:99-108)
+    mb_per_core = os.environ.get("MEMORY_PER_CORE")
+    if mb_per_core is None:
+        rep.banner("MEMORY_PER_CORE is not set")
+    else:
+        rep.banner(f"MEMORY_PER_CORE={mb_per_core}")
+    rep.banner(device_report(verbose=args.verbose))
+
+    # every rank initializes the same local values x=i+1, y=-(i+1)
+    # (mpi_daxpy.cc:94-97) — globally that's the per-rank pattern tiled
+    lx, ly = kd.init_xy_np(n, dtype)
+    h_x = np.tile(lx, world)
+    h_y = np.tile(ly, world)
+
+    # explicit-device pair AND managed pair (mpi_daxpy.cc:115-119)
+    d_x = C.shard_1d(jnp.asarray(h_x), mesh)
+    d_y = C.shard_1d(jnp.asarray(h_y), mesh)
+    m_x = place(h_x, Space.MANAGED, d_x.sharding)
+    m_y = place(h_y, Space.MANAGED, d_y.sharding)
+    if args.verbose:
+        for name, a in [("d_x", d_x), ("d_y", d_y), ("m_x", m_x),
+                        ("m_y", m_y)]:
+            rep.line(f"MEMINFO {name}: {meminfo(a)}")
+
+    # kernel runs on the managed pair (mpi_daxpy.cc:140-141)
+    m_y = block(kd.daxpy(jnp.asarray(args.a, dtype), m_x, m_y))
+
+    # per-rank checksums of the managed result (mpi_daxpy.cc:152-156);
+    # computed as a collective so multi-host processes can all read them
+    sums = C.per_rank_sums(m_y, mesh).astype(np.float64).reshape(-1)
+    for r in range(world):
+        rep.sum_line(sums[r], rank=r)
+
+    expected = kd.expected_checksum(n)
+    tol = 0 if args.dtype == "float64" else max(1e-5 * expected, 1.0)
+    ok = all(abs(s - expected) <= tol for s in sums)
+    if not ok:
+        rep.line(f"CHECKSUM FAIL: {sums} != {expected}")
+        return 1
+    del d_x, d_y
+    return 0
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--n-total",
+        type=int,
+        default=1 << 20,
+        help="total elements across ranks (split evenly)",
+    )
+    p.add_argument("--a", type=float, default=2.0)
+    args = p.parse_args(argv)
+    if args.n_total < 1:
+        p.error(f"--n-total must be positive, got {args.n_total}")
+    _common.setup_platform(args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""Flagship DAXPY benchmark: weak-scaled, phase-timed, with device allgather.
+
+≅ ``mpi_daxpy_nvtx.cc`` (call stack in SURVEY.md §3.1). Semantics preserved:
+
+* weak scaling by node count: ``nall = n_per_node * nodes``, ``n = nall /
+  world_size`` (``:121-132``; node ≙ JAX process, SURVEY §7 hard part 7);
+* per-rank init ``x[i] = (i+1)/n``, ``y = -x``, ``a = 2`` → ``y = x``,
+  local SUM ``(n+1)/2`` (``:207-217``);
+* managed vs pinned-host+explicit-copy allocation twins — a runtime
+  ``--space`` flag here instead of the ``-DMANAGED`` twin binaries;
+* ``MPI_Allgather(MPI_IN_PLACE)`` of x + regular allgather of y on device
+  buffers (``:282-291``) → donated/plain all_gather over the mesh axis;
+* global checksum ALLSUM (``:293-310``), phase timers total/kernel/barrier/
+  gather printed as ``TIME <phase> : <s>`` (``:333-340``), trace ranges for
+  every phase (NVTX names preserved), profiler gating via ``--profile-dir``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from tpu_mpi_tests.drivers import _common
+
+
+def run(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    import tpu_mpi_tests.kernels.daxpy as kd
+    from tpu_mpi_tests.comm import collectives as C
+    from tpu_mpi_tests.comm.mesh import (
+        bootstrap,
+        check_divisible,
+        device_report,
+        make_mesh,
+        topology,
+    )
+    from tpu_mpi_tests.arrays.spaces import Space, meminfo, place
+    from tpu_mpi_tests.instrument import (
+        PhaseTimer,
+        ProfilerGate,
+        Reporter,
+    )
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.instrument.trace import trace_range
+
+    dtype = _common.jnp_dtype(args)
+    bootstrap()
+    topo = topology()
+    mesh = make_mesh()
+    world = topo.global_device_count
+    managed = args.space == "managed"
+
+    # weak scaling by "node" (process) count, mpi_daxpy_nvtx.cc:121-132
+    nodes = topo.process_count
+    nall = args.n_per_node * nodes
+    n = check_divisible(nall, world, "nall over ranks")
+
+    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+    rep.banner(
+        f"{nodes} nodes, {world} ranks, {n} elements each, total {nall}"
+    )
+    mb_per_core = os.environ.get("MEMORY_PER_CORE")
+    rep.banner(
+        f"MEMORY_PER_CORE={mb_per_core}"
+        if mb_per_core
+        else "MEMORY_PER_CORE is not set"
+    )
+    rep.banner(device_report(verbose=args.verbose))
+
+    timer = PhaseTimer()
+    gate = ProfilerGate(args.profile_dir)
+    gate.start()
+
+    with timer.phase("total"):
+        # ── allocateArrays / initializeArrays (+ copyInput if unmanaged) ──
+        with trace_range("initializeArrays"), timer.phase("init"):
+            # per-rank pattern (i+1)/n tiled across ranks (:207-217)
+            lx, ly = kd.init_xy_scaled_np(n, dtype)
+            h_x = np.tile(lx, world)
+            h_y = np.tile(ly, world)
+        if managed:
+            # managed ≈ host-resident, device reads it implicitly (SURVEY
+            # §2.3 memory-space row): place sharded into host memory kind
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+            with trace_range("allocateArrays"), timer.phase("alloc"):
+                d_x = block(place(h_x, Space.MANAGED, sh))
+                d_y = block(place(h_y, Space.MANAGED, sh))
+        else:
+            with trace_range("copyInput"), timer.phase("copyInput"):
+                d_x = block(C.shard_1d(jnp.asarray(h_x), mesh))
+                d_y = block(C.shard_1d(jnp.asarray(h_y), mesh))
+        if args.verbose:
+            rep.line(f"MEMINFO d_x: {meminfo(d_x)}")
+            rep.line(f"MEMINFO d_y: {meminfo(d_y)}")
+
+        # ── kernel (:242-249) ──
+        with trace_range("daxpy"), timer.phase("kernel"):
+            d_y = block(kd.daxpy(jnp.asarray(args.a, dtype), d_x, d_y))
+
+        # ── localSum (+ copyOutput if unmanaged) (:251-268) ──
+        # computed as a collective so multi-host processes can all read it
+        with trace_range("localSum"), timer.phase("localSum"):
+            local_sums = C.per_rank_sums(d_y, mesh).astype(np.float64)
+        local_sums = local_sums.reshape(-1)
+        for r in range(world):
+            rep.sum_line(local_sums[r], rank=r)
+
+        # ── copyPrepAllxInplace (:270-272): own slice into the gather buf ──
+        with trace_range("copyPrepAllxInplace"), timer.phase("copyPrep"):
+            d_allx = block(jnp.copy(d_x))
+
+        # ── optional barrier (:274-280) ──
+        if args.barrier:
+            with trace_range("mpiBarrier"), timer.phase("barrier"):
+                C.barrier(mesh)
+
+        # ── allgather x (IN_PLACE) + y (:282-291) ──
+        with trace_range("mpiAllGather"), timer.phase("gather"):
+            with trace_range("x"):
+                g_allx = C.all_gather_inplace(d_allx, mesh)
+            with trace_range("y"):
+                g_ally = C.all_gather(d_y, mesh)
+            block(g_allx, g_ally)
+
+        # ── allSum global checksum (:293-310) ──
+        with trace_range("allSum"), timer.phase("allSum"):
+            all_sum = float(
+                C.host_value(g_ally).astype(np.float64).sum()
+            )
+        rep.sum_line(all_sum, label="ALLSUM")
+
+    gate.stop()
+    for phase in ("total", "kernel", "barrier", "gather"):
+        if timer.counts[phase]:
+            rep.time_line(phase, timer.seconds[phase])
+
+    # verification: y = x elementwise → ALLSUM = world*(n+1)/2; gathered x
+    # must equal the original global x (in-place parity)
+    expected_all = world * (n + 1) / 2
+    tol = 0 if args.dtype == "float64" else max(1e-5 * abs(expected_all), 1.0)
+    ok = abs(all_sum - expected_all) <= tol
+    if not np.array_equal(C.host_value(g_allx), h_x):
+        rep.line("GATHER PARITY FAIL: gathered x != filled buffer")
+        ok = False
+    if not ok:
+        rep.line(f"CHECKSUM FAIL: ALLSUM {all_sum} != {expected_all}")
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--n-per-node",
+        type=int,
+        default=48 * 1024 * 1024,
+        help="elements per node for weak scaling (reference: 48Mi doubles)",
+    )
+    p.add_argument("--a", type=float, default=2.0)
+    p.add_argument(
+        "--space",
+        default="device",
+        choices=["device", "managed"],
+        help="allocation mode (≅ the -DMANAGED twin binaries)",
+    )
+    p.add_argument(
+        "--barrier",
+        action="store_true",
+        help="time an explicit barrier before the gather (≅ -DBARRIER)",
+    )
+    args = p.parse_args(argv)
+    if args.n_per_node < 1:
+        p.error(f"--n-per-node must be positive, got {args.n_per_node}")
+    _common.setup_platform(args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @jax.jit
@@ -30,5 +31,22 @@ def init_xy(n: int, dtype=jnp.float32):
     return i, -i
 
 
+def init_xy_np(n: int, dtype=np.float64):
+    """Host-side variant of :func:`init_xy` (``mpi_daxpy.cc:94-97``)."""
+    i = np.arange(1, n + 1, dtype=np.float64).astype(dtype)
+    return i, -i
+
+
+def init_xy_scaled_np(n: int, dtype=np.float64):
+    """Flagship init x=(i+1)/n, y=-x (``mpi_daxpy_nvtx.cc:207-217``); with
+    a=2 the result is y=x and the local checksum is (n+1)/2."""
+    x = (np.arange(1, n + 1, dtype=np.float64) / n).astype(dtype)
+    return x, -x
+
+
 def expected_checksum(n: int) -> float:
     return n * (n + 1) / 2
+
+
+def expected_checksum_scaled(n: int) -> float:
+    return (n + 1) / 2
