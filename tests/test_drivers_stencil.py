@@ -1,0 +1,44 @@
+"""Driver-level tests for the stencil pillar."""
+
+import re
+
+from tpu_mpi_tests.drivers import stencil1d
+
+
+def test_stencil1d_exact_f64(capsys):
+    rc = stencil1d.main(["--n-global", "4096", "--dtype", "float64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    errs = re.findall(r"\d/8 \[cpu\] err_norm = ([\d.]+)", out)
+    assert len(errs) == 8
+    assert all(float(e) < 1e-6 for e in errs)
+    assert out.count("exchange time") == 8
+
+
+def test_stencil1d_all_stagings(capsys):
+    for staging in ("direct", "device", "host"):
+        rc = stencil1d.main(
+            ["--n-global", "4096", "--dtype", "float64", "--staging", staging]
+        )
+        assert rc == 0, staging
+
+
+def test_stencil1d_f32_gate_scales(capsys):
+    rc = stencil1d.main(["--n-global", "65536", "--dtype", "float32"])
+    assert rc == 0
+
+
+def test_stencil1d_tight_tol_fails(capsys):
+    rc = stencil1d.main(
+        ["--n-global", "65536", "--dtype", "float32", "--tol", "1e-12"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ERR_NORM FAIL" in out
+
+
+def test_stencil1d_mi_units(capsys):
+    rc = stencil1d.main(["--n-global-mi", "1", "--dtype", "float64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "n_global=1048576" in out

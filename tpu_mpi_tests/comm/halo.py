@@ -1,0 +1,271 @@
+"""Halo exchange over a mesh axis: the framework's ring-communication core.
+
+TPU-native replacement for the reference's nonblocking neighbor exchange
+(``boundary_exchange`` ``mpi_stencil_gt.cc:83-122``, ``boundary_exchange_x/y``
+``mpi_stencil2d_gt.cc:136-373``, SYCL variants): ``lax.ppermute`` shifts ±1
+inside ``shard_map``, which XLA compiles to async ICI DMA — giving the
+send/compute overlap the reference codes by hand with Irecv/Isend/Waitall.
+
+This is deliberately the ring-attention-shaped primitive (SURVEY.md §5.7): a
+1-D process ring exchanging edge blocks with neighbors ±1; sequence/context
+parallelism reuses exactly this component.
+
+Staging modes (SURVEY §7 hard part 3) keep the reference's benchmark matrix:
+
+* ``DIRECT`` ≅ passing device view pointers straight to CUDA-aware MPI
+  (``boundary_exchange_y`` unstaged path): plain ``ppermute`` on edge
+  slices; XLA packs as needed.
+* ``DEVICE_STAGED`` ≅ explicit pack into contiguous device buffers first
+  (``boundary_exchange_x`` mandatory staging, ``stage_device`` option):
+  pack kernels materialize the buffers (optimization_barrier pins them),
+  then ``ppermute``.
+* ``HOST_STAGED`` ≅ the non-GPU-aware-MPI fallback (``stage_host`` paths,
+  ``mpi_stencil2d_gt.cc:148-156,167-174,236-249``): edge blocks take an
+  explicit device→host→device round-trip outside the compiled program.
+  Single-controller measurement mode (requires fully-addressable arrays).
+
+Non-periodic boundaries follow the reference: edge ranks keep their
+analytically-filled physical ghosts (``mpi_stencil_gt.cc:185-196``).
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_mpi_tests.kernels.pack import pack_edges, unpack_ghosts
+
+
+class Staging(enum.Enum):
+    DIRECT = "direct"
+    DEVICE_STAGED = "device"
+    HOST_STAGED = "host"
+
+    @classmethod
+    def parse(cls, s: "str | Staging") -> "Staging":
+        if isinstance(s, Staging):
+            return s
+        try:
+            return cls(s.lower())
+        except ValueError:
+            from tpu_mpi_tests.utils import TpuMtError
+
+            raise TpuMtError(
+                f"unknown staging mode {s!r}; valid: "
+                f"{[m.value for m in cls]}"
+            ) from None
+
+
+def exchange_shard(
+    z,
+    *,
+    axis_name: str,
+    axis: int = 0,
+    n_bnd: int = 2,
+    periodic: bool = False,
+    staged: bool = False,
+):
+    """Per-shard halo exchange, for use *inside* ``shard_map``.
+
+    ``z`` is one ghosted local block. Sends the interior edge slices to
+    neighbors ±1 on the ring and writes received blocks into the ghost
+    regions. On non-periodic edge ranks the existing (physical) ghosts are
+    kept. Returns the updated block.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    lo_edge, hi_edge = pack_edges(z, axis=axis, n_bnd=n_bnd)
+    if staged:
+        # materialize contiguous staging buffers (≅ sbuf_l/sbuf_r device
+        # buffers, mpi_stencil2d_gt.cc:141-145) — the barrier stops XLA from
+        # fusing the pack into the transfer, mirroring the explicit copy
+        lo_edge, hi_edge = lax.optimization_barrier((lo_edge, hi_edge))
+
+    if n == 1:
+        if periodic:
+            return unpack_ghosts(z, hi_edge, lo_edge, axis=axis, n_bnd=n_bnd)
+        return z
+
+    fwd = [(i, (i + 1) % n) for i in range(n if periodic else n - 1)]
+    bwd = [((i + 1) % n, i) for i in range(n if periodic else n - 1)]
+    # hi edges travel right: my lo ghost receives left neighbor's hi edge
+    from_left = lax.ppermute(hi_edge, axis_name, fwd)
+    # lo edges travel left: my hi ghost receives right neighbor's lo edge
+    from_right = lax.ppermute(lo_edge, axis_name, bwd)
+
+    if not periodic:
+        # edge ranks keep their analytic physical ghosts
+        # (non-receivers get zeros from ppermute, so select the old values)
+        cur_lo = lax.slice_in_dim(z, 0, n_bnd, axis=axis)
+        cur_hi = lax.slice_in_dim(
+            z, z.shape[axis] - n_bnd, z.shape[axis], axis=axis
+        )
+        from_left = jnp.where(idx == 0, cur_lo, from_left)
+        from_right = jnp.where(idx == n - 1, cur_hi, from_right)
+    return unpack_ghosts(z, from_left, from_right, axis=axis, n_bnd=n_bnd)
+
+
+@functools.lru_cache(maxsize=None)
+def _exchange_fn(
+    mesh: Mesh,
+    axis_name: str,
+    axis: int,
+    ndim: int,
+    n_bnd: int,
+    periodic: bool,
+    staged: bool,
+):
+    spec = [None] * ndim
+    spec[axis] = axis_name
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec)
+    )
+    def exchange(z):
+        return exchange_shard(
+            z,
+            axis_name=axis_name,
+            axis=axis,
+            n_bnd=n_bnd,
+            periodic=periodic,
+            staged=staged,
+        )
+
+    return exchange
+
+
+def halo_exchange(
+    zg,
+    mesh: Mesh,
+    axis_name: str | None = None,
+    axis: int = 0,
+    n_bnd: int = 2,
+    periodic: bool = False,
+    staging: Staging | str = Staging.DIRECT,
+):
+    """Exchange halos of a ghosted-global sharded array (see arrays/domain.py
+    for the layout: each shard holds its ghosted block along ``axis``).
+
+    Functional and donated: returns the array with interior ghosts filled
+    from neighbors; the input buffer may be reused by XLA
+    (≅ in-place ghost updates of the reference).
+    """
+    staging = Staging.parse(staging)
+    axis_name = axis_name or mesh.axis_names[0]
+    if staging is Staging.HOST_STAGED:
+        return _host_staged_exchange(
+            zg, mesh, axis_name, axis, n_bnd, periodic
+        )
+    return _exchange_fn(
+        mesh,
+        axis_name,
+        axis,
+        zg.ndim,
+        n_bnd,
+        periodic,
+        staging is Staging.DEVICE_STAGED,
+    )(zg)
+
+
+def _host_staged_exchange(zg, mesh, axis_name, axis, n_bnd, periodic):
+    """Edge blocks round-trip through host memory (≅ stage_host paths).
+
+    Deliberately unfused and synchronous — this mode exists to measure the
+    cost of losing device-direct communication, like the reference's
+    non-GPU-aware-MPI fallback.
+    """
+    if isinstance(zg, jax.Array) and not zg.is_fully_addressable:
+        raise ValueError(
+            "HOST_STAGED exchange requires fully-addressable arrays "
+            "(single-controller measurement mode); use DIRECT/DEVICE_STAGED "
+            "on multi-host meshes"
+        )
+    n_shards = mesh.shape[axis_name]
+    blocks = np.split(np.asarray(zg), n_shards, axis=axis)
+    nloc = blocks[0].shape[axis]
+
+    def sl(a, start, stop):
+        s = [slice(None)] * a.ndim
+        s[axis] = slice(start, stop)
+        return tuple(s)
+
+    out = [b.copy() for b in blocks]
+    for r in range(n_shards):
+        left = (r - 1) % n_shards
+        right = (r + 1) % n_shards
+        if periodic or r > 0:
+            # lo ghost ← left neighbor's hi edge
+            out[r][sl(out[r], 0, n_bnd)] = blocks[left][
+                sl(blocks[left], nloc - 2 * n_bnd, nloc - n_bnd)
+            ]
+        if periodic or r < n_shards - 1:
+            # hi ghost ← right neighbor's lo edge
+            out[r][sl(out[r], nloc - n_bnd, nloc)] = blocks[right][
+                sl(blocks[right], n_bnd, 2 * n_bnd)
+            ]
+    result = np.concatenate(out, axis=axis)
+    return jax.device_put(result.astype(zg.dtype), zg.sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def stencil_fn(
+    mesh: Mesh,
+    axis_name: str,
+    axis: int,
+    ndim: int,
+    scale: float,
+):
+    """Per-shard stencil application over the ghosted-global layout:
+    each shard's ghosted block yields its interior derivative
+    (out shard size = in shard size − 2·n_bnd along ``axis``)."""
+    from tpu_mpi_tests.kernels.stencil import stencil1d_5
+
+    spec = [None] * ndim
+    spec[axis] = axis_name
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec)
+    )
+    def apply(z):
+        return stencil1d_5(z, scale=scale, axis=axis)
+
+    return apply
+
+
+@functools.lru_cache(maxsize=None)
+def exchange_stencil_fused_fn(
+    mesh: Mesh,
+    axis_name: str,
+    axis: int,
+    ndim: int,
+    n_bnd: int,
+    scale: float,
+    staged: bool = False,
+):
+    """Halo exchange + stencil in ONE compiled program — the idiomatic TPU
+    form (XLA overlaps the ppermute DMA with interior compute). This is the
+    fused A-side of the split-vs-fused measurement (SURVEY §7 hard part 2)."""
+    from tpu_mpi_tests.kernels.stencil import stencil1d_5
+
+    spec = [None] * ndim
+    spec[axis] = axis_name
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec)
+    )
+    def step(z):
+        z = exchange_shard(
+            z, axis_name=axis_name, axis=axis, n_bnd=n_bnd, staged=staged
+        )
+        return stencil1d_5(z, scale=scale, axis=axis)
+
+    return step

@@ -1,0 +1,143 @@
+"""Distributed 1-D 5-point stencil with halo exchange and error-norm gate.
+
+≅ ``mpi_stencil_gt.cc`` (call stack SURVEY.md §3.3): y = x³ over n_global
+points (default 32Mi, ``--n-global-mi`` in Mi units like the reference argv),
+decomposed across ranks with ghost width 2; one timed halo exchange; stencil
+derivative; per-rank ``err_norm`` vs the analytic 3x², exact to rounding for
+a cubic. Output lines preserved::
+
+    <rank>/<size> exchange time <s>
+    <rank>/<size> [<device>] err_norm = <v>
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from tpu_mpi_tests.drivers import _common
+
+
+def run(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.arrays.domain import Domain1D
+    from tpu_mpi_tests.comm import collectives as C
+    from tpu_mpi_tests.comm import halo as H
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.instrument import ProfilerGate, Reporter
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.kernels.stencil import analytic_pairs
+    from tpu_mpi_tests.utils import TpuMtError
+
+    dtype = _common.jnp_dtype(args)
+    bootstrap()
+    topo = topology()
+    mesh = make_mesh()
+    world = topo.global_device_count
+    axis_name = mesh.axis_names[0]
+
+    n_global = args.n_global
+    d = Domain1D(n_global=n_global, n_shards=world, n_bnd=2)
+    f, df = analytic_pairs()["1d"]
+
+    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+    rep.banner(
+        f"stencil1d: n_global={n_global} world={world} "
+        f"n_local={d.n_local} dtype={args.dtype} staging={args.staging}"
+    )
+
+    zg = C.shard_1d(jnp.asarray(d.init_global(f, dtype)), mesh)
+    zg = block(zg)
+
+    staging = H.Staging.parse(args.staging)
+    with ProfilerGate(args.profile_dir):
+        # untimed warmup so the timed exchange measures communication, not
+        # trace+compile (exchange is idempotent: ghosts are rewritten with
+        # identical values) — async-dispatch discipline, SURVEY §7 part 2
+        zg = block(H.halo_exchange(zg, mesh, staging=staging))
+        # one timed exchange (mpi_stencil_gt.cc:200-205)
+        t0 = time.perf_counter()
+        zg = block(H.halo_exchange(zg, mesh, staging=staging))
+        seconds = time.perf_counter() - t0
+        if topo.process_index == 0:
+            for r in range(world):
+                rep.line(
+                    f"{r}/{world} exchange time {seconds:0.8f}",
+                    {"kind": "exchange1d", "rank": r, "seconds": seconds},
+                )
+
+        deriv = block(H.stencil_fn(mesh, axis_name, 0, 1, d.scale)(zg))
+
+    # per-rank err norms vs analytic derivative
+    actual = d.interior_global(df, np.float64)
+    numeric = C.host_value(C.all_gather(deriv, mesh)).astype(np.float64)
+    per_rank_err = np.sqrt(
+        ((numeric - actual) ** 2).reshape(world, d.n_local).sum(axis=1)
+    )
+    kind = jax.devices()[0].device_kind
+    if topo.process_index == 0:
+        for r in range(world):
+            rep.line(
+                f"{r}/{world} [{kind}] err_norm = {per_rank_err[r]:.8f}",
+                {"kind": "err_norm", "rank": r, "err": float(per_rank_err[r])},
+            )
+
+    if args.tol is not None:
+        tol = args.tol
+    elif args.dtype == "float64":
+        tol = 1e-6
+    else:
+        # f32/bf16: cancellation error ≈ eps·max|y|·scale per point
+        # (SURVEY §7 hard part 1); a broken halo exceeds this by >10³
+        eps = float(np.finfo(np.dtype(args.dtype).newbyteorder("=")).eps) if args.dtype != "bfloat16" else 7.8e-3
+        ymax = d.length**3
+        tol = 8 * eps * ymax * d.scale * np.sqrt(n_global)
+    if per_rank_err.max() > tol:
+        rep.line(
+            f"ERR_NORM FAIL: max {per_rank_err.max():.8g} > tol {tol:.8g}"
+        )
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--n-global-mi",
+        type=int,
+        default=None,
+        help="global size in Mi elements (reference argv unit; default 32)",
+    )
+    p.add_argument(
+        "--n-global",
+        type=int,
+        default=32 * 1024 * 1024,
+        help="global size in elements (exact; overridden by --n-global-mi)",
+    )
+    p.add_argument(
+        "--staging",
+        default="direct",
+        choices=["direct", "device", "host"],
+        help="halo staging mode (≅ reference stage_host/device variants)",
+    )
+    p.add_argument(
+        "--tol",
+        type=float,
+        default=None,
+        help="err_norm gate (default: dtype-dependent)",
+    )
+    args = p.parse_args(argv)
+    if args.n_global_mi is not None:
+        args.n_global = args.n_global_mi * 1024 * 1024
+    if args.n_global < 1:
+        p.error(f"global size must be positive, got {args.n_global}")
+    _common.setup_platform(args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
