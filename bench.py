@@ -1,0 +1,75 @@
+"""Headline benchmark: 2-D stencil full-step throughput at 8192².
+
+Runs the flagship per-iteration pipeline — halo exchange + 5-point stencil
+derivative + interior update, the ``mpi_stencil2d_gt.cc:511-535`` hot loop —
+on an 8192×8192 float32 domain over all available devices and prints ONE
+JSON line.
+
+Timing discipline: iterations run in one device-side ``lax.fori_loop`` (each
+data-dependent on the last), synced by a host read; two run lengths are
+differenced to cancel the fixed controller round-trip (~106 ms on the axon
+TPU tunnel, whose ``block_until_ready`` does not actually wait — see
+``tpu_mpi_tests/instrument/timers.py``).
+
+Baseline: the reference publishes no numbers (BASELINE.md); the comparison
+point is the V100 roofline for the same loop in the reference's float64 —
+(2 reads + 1 write) × 8 B × 8192² bytes/iter over ~810 GB/s STREAM-class
+HBM2 bandwidth ≈ 503 iter/s. ``vs_baseline`` is measured iter/s over that.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+V100_F64_ITERS_PER_S = 503.0  # 810e9 / (3 * 8 * 8192**2)
+
+
+def main() -> None:
+    import numpy as np
+
+    from tpu_mpi_tests.arrays.domain import Domain2D
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.halo import iterate_fused_fn
+    from tpu_mpi_tests.comm.mesh import make_mesh, topology
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.kernels.stencil import analytic_pairs
+    from tpu_mpi_tests.utils import check_divisible
+
+    n = 8192
+    topo = topology()
+    world = topo.global_device_count
+    mesh = make_mesh()
+
+    check_divisible(n, world, "bench domain over devices")
+    d = Domain2D(
+        n_local_deriv=n // world, n_global_other=n, n_shards=world, dim=0
+    )
+    f, _ = analytic_pairs()["2d_dim0"]
+    zg = shard_1d(np.asarray(d.init_global(f, np.float32)), mesh)
+    run = iterate_fused_fn(mesh, mesh.axis_names[0], 0, 2, d.n_bnd, d.scale)
+
+    zg = block(run(zg, 3))  # compile + warm
+    n_short, n_long = 100, 1100
+    t0 = time.perf_counter()
+    zg = block(run(zg, n_short))
+    t_short = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    zg = block(run(zg, n_long))
+    t_long = time.perf_counter() - t0
+    iters_per_s = (n_long - n_short) / (t_long - t_short)
+
+    print(
+        json.dumps(
+            {
+                "metric": "stencil2d_fullstep_8192_iters_per_s",
+                "value": round(iters_per_s, 2),
+                "unit": "iter/s",
+                "vs_baseline": round(iters_per_s / V100_F64_ITERS_PER_S, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,22 @@ stencil1d_5_jit = jax.jit(stencil1d_5, static_argnames=("axis",))
 stencil2d_1d_5_jit = jax.jit(stencil2d_1d_5, static_argnames=("dim",))
 
 
+def dual_dim_step(z, n_bnd: int, scale_x: float, scale_y: float):
+    """Both-dim derivative + residual of a block ghosted along both axes —
+    the flagship per-shard pipeline (≅ ``stencil2d_1d_5_d0`` + ``_d1`` +
+    ``gt::sum_squares``, ``mpi_stencil2d_gt.cc:84-110,555``).
+
+    Returns ``(dz_dx, dz_dy, residual)``; the derivatives have the ghost
+    frame stripped (interior shape in both dims).
+    """
+    zx = lax.slice_in_dim(z, n_bnd, z.shape[1] - n_bnd, axis=1)
+    dz_dx = stencil1d_5(zx, scale=scale_x, axis=0)
+    zy = lax.slice_in_dim(z, n_bnd, z.shape[0] - n_bnd, axis=0)
+    dz_dy = stencil1d_5(zy, scale=scale_y, axis=1)
+    residual = jnp.sum(jnp.square(dz_dx)) + jnp.sum(jnp.square(dz_dy))
+    return dz_dx, dz_dy, residual
+
+
 def analytic_pairs():
     """The reference's test functions: (f, df) pairs used by the drivers.
 
