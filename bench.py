@@ -31,12 +31,13 @@ def main() -> None:
     from tpu_mpi_tests.arrays.domain import Domain2D
     from tpu_mpi_tests.comm.collectives import shard_1d
     from tpu_mpi_tests.comm.halo import iterate_fused_fn
-    from tpu_mpi_tests.comm.mesh import make_mesh, topology
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
     from tpu_mpi_tests.instrument.timers import block
     from tpu_mpi_tests.kernels.stencil import analytic_pairs
     from tpu_mpi_tests.utils import check_divisible
 
     n = 8192
+    bootstrap()
     topo = topology()
     world = topo.global_device_count
     mesh = make_mesh()

@@ -1,0 +1,53 @@
+"""Driver-level tests for the flagship 2-D stencil matrix (≅ the in-situ
+integration-test role of ``mpi_stencil2d_gt.cc``'s main, SURVEY.md §4.4)."""
+
+import re
+
+from tpu_mpi_tests.drivers import stencil2d
+
+SMALL = ["--n-local", "32", "--n-other", "64", "--n-iter", "3",
+         "--n-warmup", "2"]
+
+
+def test_full_matrix_f64(capsys):
+    rc = stencil2d.main(SMALL + ["--dtype", "float64", "--managed"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    deriv = re.findall(
+        r"TEST dim:(\d), (device|managed)\s*, buf:(\d); ([\d.]+), "
+        r"err=([\d.e+-]+)",
+        out,
+    )
+    assert len(deriv) == 8  # 2 dims x 2 buf x 2 spaces
+    assert {(d, s, b) for d, s, b, _, _ in deriv} == {
+        (d, s, b)
+        for d in "01"
+        for s in ("device", "managed")
+        for b in "01"
+    }
+    assert all(float(e) < 1e-8 for *_, e in deriv)
+    allred = re.findall(r"allreduce=([\d.]+)", out)
+    assert len(allred) == 4  # 2 dims x 2 spaces
+
+
+def test_matrix_f32_device_only(capsys):
+    rc = stencil2d.main(SMALL + ["--dtype", "float32"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count("TEST dim:") == 4 + 2
+
+
+def test_tight_tol_fails(capsys):
+    rc = stencil2d.main(SMALL + ["--dtype", "float32", "--tol", "1e-14"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ERR_NORM FAIL" in out
+
+
+def test_rejects_bad_sizes(capsys):
+    import pytest
+
+    with pytest.raises(SystemExit):
+        stencil2d.main(["--n-local", "3"])
+    with pytest.raises(SystemExit):
+        stencil2d.main(["--n-iter", "0"])

@@ -202,13 +202,18 @@ class Domain2D:
             axis=self.dim,
         )
 
+    def interior_shard(self, fn, rank: int, dtype=np.float64) -> np.ndarray:
+        """One rank's unghosted block of fn(x, y) — per-rank err-norm
+        reference values (the global field is never materialized)."""
+        x, y = self._coords(rank, ghosted=False, dtype=dtype)
+        return fn(x[:, None], y[None, :]).astype(dtype)
+
     def interior_global(self, fn, dtype=np.float64) -> np.ndarray:
         """Unghosted global field fn(x, y) — err-norm reference values."""
-        blocks = []
-        for r in range(self.n_shards):
-            x, y = self._coords(r, ghosted=False, dtype=dtype)
-            blocks.append(fn(x[:, None], y[None, :]).astype(dtype))
-        return np.concatenate(blocks, axis=self.dim)
+        return np.concatenate(
+            [self.interior_shard(fn, r, dtype) for r in range(self.n_shards)],
+            axis=self.dim,
+        )
 
     def strip_ghosts_global(self, zg: np.ndarray) -> np.ndarray:
         ng = self.ghosted_shape[self.dim]

@@ -97,6 +97,18 @@ def place(x, space: Space | str = Space.DEVICE, sharding=None):
     return jax.device_put(x, sharding)
 
 
+def ensure_device(x):
+    """Promote a host-resident (managed/pinned) array to device memory if
+    needed — the managed-space migration-on-first-device-touch rule (TPU has
+    no page-migrating unified memory; compiled programs need HBM buffers)."""
+    if (
+        isinstance(x, jax.Array)
+        and getattr(x.sharding, "memory_kind", None) not in (None, "device")
+    ):
+        return to_device(x)
+    return x
+
+
 def to_device(x, sharding=None):
     """Explicit promotion host→HBM (≅ H2D `gt::copy` / `cudaMemcpy`).
 

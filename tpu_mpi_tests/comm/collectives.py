@@ -46,6 +46,71 @@ def replicate(arr, mesh: Mesh):
     return jax.device_put(arr, NamedSharding(mesh, P()))
 
 
+def shard_blocks(
+    mesh: Mesh,
+    global_shape,
+    dtype,
+    block_fn,
+    axis_name: str | None = None,
+    axis: int = 0,
+    sharding=None,
+):
+    """Build a sharded global array from per-rank host blocks WITHOUT ever
+    materializing the global array on host (≅ each MPI rank initializing
+    only its local block — the reference never holds the global domain
+    anywhere, e.g. ``mpi_stencil2d_gt.cc:445-456``).
+
+    ``block_fn(rank)`` returns the numpy block owned by logical rank
+    ``rank`` along ``axis``. Works multi-host: the callback runs only for
+    addressable shards.
+    """
+    axis_name = axis_name or mesh.axis_names[0]
+    if sharding is None:
+        spec = [None] * len(global_shape)
+        spec[axis] = axis_name
+        sharding = NamedSharding(mesh, P(*spec))
+    n_shards = mesh.shape[axis_name]
+    block_len = global_shape[axis] // n_shards
+
+    def cb(index):
+        start = index[axis].start or 0
+        return np.asarray(block_fn(start // block_len), dtype=dtype)
+
+    return jax.make_array_from_callback(tuple(global_shape), sharding, cb)
+
+
+@functools.lru_cache(maxsize=None)
+def _per_rank_sq_diff_fn(mesh: Mesh, axis_name: str, axis: int, ndim: int):
+    spec = [None] * ndim
+    spec[axis] = axis_name
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(*spec), P(*spec)),
+        out_specs=P(axis_name), check_vma=False,
+    )
+    def f(a, b):
+        d = a - b
+        return jnp.sum(d * d).reshape(1)
+
+    return f
+
+
+def per_rank_err_norms(
+    numeric, actual, mesh: Mesh, axis_name: str | None = None, axis: int = 0
+) -> np.ndarray:
+    """Per-logical-rank ``sqrt(Σ(numeric − actual)²)`` computed shard-local
+    on device (≅ each rank's err_norm, ``mpi_stencil_gt.cc:222``), gathered
+    as one tiny vector — the global fields are never replicated."""
+    axis_name = axis_name or mesh.axis_names[0]
+    s = _per_rank_sq_diff_fn(mesh, axis_name, axis, numeric.ndim)(
+        numeric, actual
+    )
+    return np.sqrt(
+        host_value(all_gather(s, mesh, axis_name)).reshape(-1)
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _all_gather_fn(mesh: Mesh, axis_name: str, axis: int, ndim: int):
     spec = [None] * ndim

@@ -159,6 +159,9 @@ def halo_exchange(
     """
     staging = Staging.parse(staging)
     axis_name = axis_name or mesh.axis_names[0]
+    from tpu_mpi_tests.arrays.spaces import ensure_device
+
+    zg = ensure_device(zg)
     if staging is Staging.HOST_STAGED:
         return _host_staged_exchange(
             zg, mesh, axis_name, axis, n_bnd, periodic

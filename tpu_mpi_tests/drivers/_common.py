@@ -40,26 +40,35 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     return p
 
 
-def setup_platform(args) -> None:
-    """Apply platform/dtype config. Must run before any JAX backend use.
+def force_cpu_devices(n: int) -> None:
+    """Force the CPU backend with ``n`` fake devices.
 
-    ``--fake-devices N`` forces the CPU backend with N fake devices — the
-    image's sitecustomize registers the TPU plugin programmatically, so this
-    must go through jax.config, not just the env var.
+    The image's sitecustomize registers the TPU plugin programmatically, so
+    this must go through jax.config, not just the env var. XLA_FLAGS is read
+    only at first backend init — call before any JAX backend use; a live
+    backend keeps its device count (callers must fail-fast on too few).
     """
     import jax
 
-    if args.fake_devices:
-        flags = [
-            f
-            for f in os.environ.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f
-        ]
-        flags.append(
-            f"--xla_force_host_platform_device_count={args.fake_devices}"
-        )
-        os.environ["XLA_FLAGS"] = " ".join(flags)
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    try:
         jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; device check happens downstream
+
+
+def setup_platform(args) -> None:
+    """Apply platform/dtype config. Must run before any JAX backend use."""
+    import jax
+
+    if args.fake_devices:
+        force_cpu_devices(args.fake_devices)
     if args.dtype == "float64":
         jax.config.update("jax_enable_x64", True)
 

@@ -1,0 +1,312 @@
+"""Flagship 2-D stencil benchmark: full dim × space × staging test matrix.
+
+≅ ``mpi_stencil2d_gt.cc`` (call stack SURVEY.md §3.2). A 2-D array is
+decomposed along the derivative dim (0 or 1); each test runs ``n_warmup``
+untimed + ``n_iter`` timed halo exchanges, applies the 5-point stencil, and
+reports the rank-summed exchange time plus the rank-summed error norm vs the
+analytic derivative of z = x³ + y²::
+
+    TEST dim:<d>, <device|managed>, buf:<b>; <seconds>, err=<e>
+
+followed by the axis-reduction + in-place-allreduce benchmark
+(``test_sum``, ``mpi_stencil2d_gt.cc:574-649``)::
+
+    TEST dim:<d>, <device|managed>; allreduce=<seconds>
+
+Matrix semantics (staging ↔ the reference's ``buf`` flag):
+
+* dim 0 (non-contiguous in the reference): device staging is mandatory
+  there, so ``buf:0`` → DEVICE_STAGED, ``buf:1`` → HOST_STAGED
+  (``stage_host``, ``mpi_stencil2d_gt.cc:148-156``).
+* dim 1 (contiguous): ``buf:0`` → DIRECT (MPI straight on device views),
+  ``buf:1`` → DEVICE_STAGED (``stage_device``, ``:258-373``).
+* ``--managed`` adds the managed-space twins (``TEST_MANAGED`` matrix,
+  ``:696-728``): arrays start host-resident (pinned host memory kind) and
+  migrate on first device use.
+
+Timing discipline: iterations are chained (each exchange consumes the
+previous result) and synchronized once at the end with a hard host-read
+sync; the reported seconds are multiplied by the logical world size to match
+the reference's ``MPI_Reduce(MPI_SUM)`` of per-rank times (``:562-566``).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from tpu_mpi_tests.drivers import _common
+
+
+def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
+    import jax
+
+    from tpu_mpi_tests.arrays.domain import Domain2D
+    from tpu_mpi_tests.arrays.spaces import Space
+    from tpu_mpi_tests.comm import collectives as C
+    from tpu_mpi_tests.comm import halo as H
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.kernels.stencil import analytic_pairs
+
+    dtype = _common.jnp_dtype(args)
+    world = topo.global_device_count
+    axis_name = mesh.axis_names[0]
+    d = Domain2D(
+        n_local_deriv=args.n_local,
+        n_global_other=args.n_other,
+        n_shards=world,
+        dim=dim,
+    )
+    f, df = analytic_pairs()[f"2d_dim{dim}"]
+
+    if dim == 0:
+        staging = H.Staging.HOST_STAGED if buf else H.Staging.DEVICE_STAGED
+    else:
+        staging = H.Staging.DEVICE_STAGED if buf else H.Staging.DIRECT
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_mpi_tests.arrays.spaces import host_memory_kind
+
+    spec = [None, None]
+    spec[dim] = axis_name
+    sharding = NamedSharding(mesh, P(*spec))
+    if Space.parse(space) is not Space.DEVICE:
+        kind = host_memory_kind()
+        if kind is not None:
+            sharding = sharding.with_memory_kind(kind)
+    zg = C.shard_blocks(
+        mesh,
+        d.global_ghosted_shape,
+        dtype,
+        lambda r: d.init_shard(f, r, dtype),
+        axis=dim,
+        sharding=sharding,
+    )
+
+    for _ in range(args.n_warmup):
+        zg = H.halo_exchange(zg, mesh, axis=dim, staging=staging)
+    zg = block(zg)
+
+    t0 = time.perf_counter()
+    for _ in range(args.n_iter):
+        zg = H.halo_exchange(zg, mesh, axis=dim, staging=staging)
+    zg = block(zg)
+    seconds = time.perf_counter() - t0
+
+    dz = block(H.stencil_fn(mesh, axis_name, dim, 2, d.scale)(zg))
+    actual = C.shard_blocks(
+        mesh,
+        d.global_interior_shape,
+        dtype,
+        lambda r: d.interior_shard(df, r, np.float64),
+        axis=dim,
+    )
+    per_rank = C.per_rank_err_norms(dz, actual, mesh, axis=dim)
+    err_sum = float(per_rank.sum())
+    # rank-summed time: every logical rank experiences the same wall clock
+    rep.test_line(dim, space, buf, seconds * world, err_sum)
+
+    tol = args.tol if args.tol is not None else _default_tol(args, d)
+    if per_rank.max() > tol:
+        rep.line(
+            f"ERR_NORM FAIL dim:{dim} {space} buf:{int(buf)}: "
+            f"max {per_rank.max():.8g} > tol {tol:.8g}"
+        )
+        return 1
+    return 0
+
+
+def _default_tol(args, d) -> float:
+    if args.dtype == "float64":
+        return 1e-5
+    eps = 7.8e-3 if args.dtype == "bfloat16" else 1.2e-7
+    # both axes use the same grid spacing (like the reference's shared dx,
+    # mpi_stencil2d_gt.cc:445-456), so the non-decomposed axis spans
+    # length·n_other/n_deriv — z = x³ + y² must be bounded by the REAL
+    # coordinate extents or the f32 cancellation estimate is far too small
+    other_extent = d.length * d.n_global_other / d.n_global_deriv
+    x_max = d.length if d.dim == 0 else other_extent
+    y_max = other_extent if d.dim == 0 else d.length
+    zmax = x_max**3 + y_max**2
+    n_pts = d.n_global_deriv * d.n_global_other
+    return 8 * eps * zmax * d.scale * np.sqrt(n_pts / d.n_shards)
+
+
+def _sum_test(args, mesh, topo, rep, dim: int, space: str) -> int:
+    """Axis reduction + timed allreduce (≅ test_sum, :574-649): local sum
+    along the decomposed dim, then psum across ranks; the allreduce is timed
+    by differencing loops with and without it."""
+    import jax
+
+    from tpu_mpi_tests.arrays.domain import Domain2D
+    from tpu_mpi_tests.arrays.spaces import Space, ensure_device
+    from tpu_mpi_tests.comm import collectives as C
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.kernels.reductions import sum_axis
+
+    import functools
+
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    dtype = _common.jnp_dtype(args)
+    world = topo.global_device_count
+    axis_name = mesh.axis_names[0]
+    d = Domain2D(
+        n_local_deriv=args.n_local,
+        n_global_other=args.n_other,
+        n_shards=world,
+        dim=dim,
+    )
+
+    spec = [None, None]
+    spec[dim] = axis_name
+    fill = np.pi / world
+    sharding = NamedSharding(mesh, P(*spec))
+    if Space.parse(space) is not Space.DEVICE:
+        from tpu_mpi_tests.arrays.spaces import host_memory_kind
+
+        kind = host_memory_kind()
+        if kind is not None:
+            sharding = sharding.with_memory_kind(kind)
+    z = C.shard_blocks(
+        mesh,
+        d.global_interior_shape,
+        dtype,
+        lambda r: np.full(d.local_shape, fill, dtype),
+        axis=dim,
+        sharding=sharding,
+    )
+    # managed migration on first device touch (see arrays/spaces.py)
+    z = ensure_device(z)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(*spec),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )
+    def local_sum(zz):
+        return sum_axis(zz, axis=dim).reshape(1, -1)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=P(axis_name),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )
+    def allreduce(s):
+        from jax import lax
+
+        return lax.psum(s, axis_name)
+
+    expected = np.full(d.n_global_other, np.pi * args.n_local)
+
+    # warmup + correctness
+    s = block(allreduce(local_sum(z)))
+    got = C.host_value(s.addressable_shards[0].data).reshape(-1) if s.is_fully_addressable else None
+    if got is not None and not np.allclose(
+        got, expected, rtol=1e-3 if args.dtype == "bfloat16" else 1e-5
+    ):
+        rep.line(f"ALLREDUCE FAIL dim:{dim} {space}: {got[:3]} != {expected[:3]}")
+        return 1
+
+    t0 = time.perf_counter()
+    for _ in range(args.n_iter):
+        s = allreduce(local_sum(z))
+    block(s)
+    t_with = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(args.n_iter):
+        s = local_sum(z)
+    block(s)
+    t_without = time.perf_counter() - t0
+
+    seconds = max(t_with - t_without, 0.0)
+    rep.test_line(dim, space, 0, seconds * world, 0.0, extra_label="allreduce")
+    return 0
+
+
+def run(args) -> int:
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.instrument import ProfilerGate, Reporter
+
+    bootstrap()
+    topo = topology()
+    mesh = make_mesh()
+    world = topo.global_device_count
+
+    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+    rep.banner(
+        f"stencil2d: n_local={args.n_local} n_other={args.n_other} "
+        f"world={world} n_iter={args.n_iter} n_warmup={args.n_warmup} "
+        f"dtype={args.dtype} managed={args.managed}"
+    )
+
+    spaces = ["device"] + (["managed"] if args.managed else [])
+    rc = 0
+    with ProfilerGate(args.profile_dir):
+        for dim in (0, 1):
+            for buf in (True, False):
+                for space in spaces:
+                    rc |= _deriv_test(args, mesh, topo, rep, dim, space, buf)
+        for dim in (0, 1):
+            for space in spaces:
+                rc |= _sum_test(args, mesh, topo, rep, dim, space)
+    return rc
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--n-local",
+        type=int,
+        default=1024,
+        help="per-shard size along the derivative dim "
+        "(≅ n_local_deriv argv, default 1024, mpi_stencil2d_gt.cc:656)",
+    )
+    p.add_argument(
+        "--n-other",
+        type=int,
+        default=512 * 1024,
+        help="global size of the non-decomposed dim "
+        "(≅ n_global_other = 512Ki, mpi_stencil2d_gt.cc:676)",
+    )
+    p.add_argument(
+        "--n-iter", type=int, default=1000, help="timed iterations (≅ :657)"
+    )
+    p.add_argument(
+        "--n-warmup", type=int, default=5, help="untimed warmup (≅ :658)"
+    )
+    p.add_argument(
+        "--managed",
+        action="store_true",
+        help="add managed-space twins to the matrix (≅ -DTEST_MANAGED)",
+    )
+    p.add_argument(
+        "--tol",
+        type=float,
+        default=None,
+        help="per-rank err_norm gate (default dtype-dependent)",
+    )
+    args = p.parse_args(argv)
+    for name in ("n_local", "n_other", "n_iter"):
+        if getattr(args, name) < 1:
+            p.error(f"--{name.replace('_', '-')} must be positive")
+    if args.n_local < 5:
+        p.error("--n-local must be >= 5 (stencil width)")
+    _common.setup_platform(args)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

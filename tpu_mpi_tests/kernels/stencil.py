@@ -63,6 +63,11 @@ def dual_dim_step(z, n_bnd: int, scale_x: float, scale_y: float):
     Returns ``(dz_dx, dz_dy, residual)``; the derivatives have the ghost
     frame stripped (interior shape in both dims).
     """
+    if n_bnd != N_BND:
+        raise ValueError(
+            f"dual_dim_step requires n_bnd == {N_BND} (the 5-point stencil "
+            f"strips exactly 2*{N_BND} along its axis), got {n_bnd}"
+        )
     zx = lax.slice_in_dim(z, n_bnd, z.shape[1] - n_bnd, axis=1)
     dz_dx = stencil1d_5(zx, scale=scale_x, axis=0)
     zy = lax.slice_in_dim(z, n_bnd, z.shape[0] - n_bnd, axis=0)
