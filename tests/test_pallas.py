@@ -1,0 +1,70 @@
+"""Pallas kernel tests (interpreter mode on CPU; the same code compiles on
+TPU — validated there manually, see BASELINE.md A/B numbers).
+
+≅ the role of ``test_buf_view`` for the SYCL pack/unpack kernels
+(``mpi_stencil2d_sycl.cc:118-159``), promoted from a commented-out visual
+check to real assertions (SURVEY.md §4.3)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_mpi_tests.kernels import pallas_kernels as PK
+from tpu_mpi_tests.kernels.daxpy import init_xy
+from tpu_mpi_tests.kernels.pack import pack_edges, unpack_ghosts
+from tpu_mpi_tests.kernels.stencil import stencil1d_5
+
+
+def rng(seed, shape, dtype=np.float32):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape).astype(dtype)
+    )
+
+
+def test_daxpy_matches_reference_init():
+    x, y = init_xy(1024, jnp.float32)
+    out = PK.daxpy_pallas(2.0, x, y)
+    assert jnp.allclose(out, x)  # y = 2x + (-x) = x (daxpy.cu:56-59,72-73)
+
+
+def test_daxpy_multi_block():
+    x, y = init_xy(128 * 1024, jnp.float32)
+    out = PK.daxpy_pallas(2.0, x, y, block_rows=64)
+    assert jnp.allclose(out, x)
+
+
+def test_daxpy_rejects_unaligned():
+    x = jnp.ones(100)
+    with pytest.raises(ValueError, match="128"):
+        PK.daxpy_pallas(2.0, x, x)
+
+
+@pytest.mark.parametrize("dim", [0, 1])
+def test_stencil_matches_xla(dim):
+    shape = (260, 256) if dim == 0 else (256, 260)
+    z = rng(dim, shape)
+    got = PK.stencil2d_pallas(z, 3.0, dim=dim, tile=128)
+    ref = stencil1d_5(z, 3.0, axis=dim)
+    assert got.shape == ref.shape
+    assert jnp.allclose(got, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("dim", [0, 1])
+def test_stencil_ragged_strips(dim):
+    # extents that no power-of-two strip divides (257 prime factors)
+    shape = (1028, 384) if dim == 0 else (384, 1028)
+    z = rng(10 + dim, shape)
+    got = PK.stencil2d_pallas(z, 2.0, dim=dim, tile=256)
+    assert jnp.allclose(got, stencil1d_5(z, 2.0, axis=dim), atol=1e-5)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_pack_unpack_roundtrip(axis):
+    z = rng(20 + axis, (64, 48))
+    lo, hi = PK.pack_edges_pallas(z, axis=axis)
+    rlo, rhi = pack_edges(z, axis=axis)
+    assert jnp.allclose(lo, rlo) and jnp.allclose(hi, rhi)
+    got = PK.unpack_ghosts_pallas(z, lo, hi, axis=axis)
+    ref = unpack_ghosts(z, lo, hi, axis=axis)
+    assert jnp.allclose(got, ref)

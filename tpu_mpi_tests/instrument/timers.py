@@ -84,6 +84,30 @@ def block(*pytrees):
     return pytrees[0] if len(pytrees) == 1 else pytrees
 
 
+def dispatch_rate(f, *args, n_iter: int = 2000, n_base: int = 200) -> float:
+    """Mean seconds per call of ``f(*args)`` under async dispatch.
+
+    Dispatches ``n_base`` then ``n_base + n_iter`` independent calls, hard-
+    syncing once per batch on the last result only (in-order device queues
+    make the last result's completion prove the batch drained); the
+    difference cancels the fixed controller round-trip (~106 ms on the axon
+    tunnel) and dispatch ramp. Use when the op can't be chained
+    shape-preservingly (else prefer a device-side ``lax.fori_loop``)."""
+    block(f(*args))  # compile + warm
+
+    def run(n):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = f(*args)
+        block(r)
+        return time.perf_counter() - t0
+
+    t_base = run(n_base)
+    t_full = run(n_base + n_iter)
+    return max(t_full - t_base, 1e-12) / n_iter
+
+
 class PhaseTimer:
     """Accumulating named phase timers (≅ the t_/k_/b_/g_ MPI_Wtime pairs of
     ``mpi_daxpy_nvtx.cc:168,242-291,327`` and the per-iteration

@@ -1,0 +1,250 @@
+"""Hand-written Pallas TPU kernels: the "raw CUDA/SYCL" tier.
+
+The reference carries every kernel twice: a portable expression-template
+version (gtensor, ``mpi_stencil2d_gt.cc``) and a hand-written one (SYCL
+``parallel_for``, ``mpi_stencil2d_sycl.cc:53-116``; cuBLAS call,
+``daxpy.cu:72-73``). This module is the hand-written tier for TPU — explicit
+VMEM staging, DMA pipelines, and tile-aligned grids — mirroring:
+
+* ``daxpy_pallas``       ≅ ``cublasDaxpy`` (``daxpy.cu:72-73``)
+* ``stencil2d_pallas``   ≅ ``stencil2d_1d_5`` SYCL kernel
+  (``mpi_stencil2d_sycl.cc:53-75``): grid of full-extent strips along the
+  non-derivative dim, each strip staged in VMEM where the 5 shifted reads
+  are VPU shifts. This is the explicit form of what XLA fuses automatically
+  (kernels/stencil.py) — the A/B pair the reference keeps on purpose.
+* ``pack_edges_pallas`` / ``unpack_ghosts_pallas`` ≅ ``buf_from_view`` /
+  ``buf_to_view`` staging kernels (``mpi_stencil2d_sycl.cc:82-116``).
+
+All kernels run compiled on TPU and in interpreter mode elsewhere
+(``interpret=None`` auto-selects), so the same tests cover both.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_mpi_tests.kernels.stencil import N_BND, STENCIL5
+
+
+def _auto_interpret(interpret: bool | None) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# DAXPY
+# ---------------------------------------------------------------------------
+
+
+def _daxpy_kernel(a_ref, x_ref, y_ref, out_ref):
+    out_ref[:] = a_ref[0] * x_ref[:] + y_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def daxpy_pallas(a, x, y, block_rows: int = 512, interpret: bool | None = None):
+    """y ← a·x + y on 1-D arrays (≅ ``cublasDaxpy``).
+
+    The array is viewed as (rows, 128) lanes and processed in
+    ``block_rows``-row VMEM tiles; n must be a multiple of 128 (driver sizes
+    are powers of two, like the reference's 48Mi-per-node sizing).
+    """
+    n = x.shape[0]
+    if n % 128 != 0:
+        raise ValueError(f"daxpy_pallas needs n % 128 == 0, got {n}")
+    rows = n // 128
+    block_rows = min(block_rows, rows)
+    x2 = x.reshape(rows, 128)
+    y2 = y.reshape(rows, 128)
+    a_arr = jnp.asarray(a, x.dtype).reshape(1)
+    grid = (pl.cdiv(rows, block_rows),)
+    out = pl.pallas_call(
+        _daxpy_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, 128), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=_auto_interpret(interpret),
+    )(a_arr, x2, y2)
+    return out.reshape(n)
+
+
+# ---------------------------------------------------------------------------
+# 2-D array, 1-D 5-point stencil with explicit halo DMA
+# ---------------------------------------------------------------------------
+
+
+def _stencil_strip_kernel(z_ref, scale_ref, out_ref, *, axis, m):
+    # full ghosted extent along `axis` is resident in VMEM; the 5 shifted
+    # reads become VPU shifts, accumulated in registers (≅ the SYCL kernel's
+    # 5 global loads per output element, but staged once)
+    z = z_ref[:]
+    acc = None
+    # .tolist() → weak python floats: no x64 promotion of f32 blocks
+    for k, c in enumerate(STENCIL5.tolist()):
+        if c == 0.0:
+            continue
+        term = c * jax.lax.slice_in_dim(z, k, k + m, axis=axis)
+        acc = term if acc is None else acc + term
+    out_ref[:] = acc * scale_ref[0]
+
+
+# VMEM is ~16 MiB/core; input strip + output strip, each double-buffered by
+# the pallas pipeline, must fit
+_VMEM_BUDGET_BYTES = 14 * 1024 * 1024
+
+
+def _fit_strip(tile: int, extent: int, rows_bytes: int, min_strip: int) -> int:
+    """Largest strip ≤ tile fitting the VMEM budget (``rows_bytes`` = bytes
+    per unit strip: 2·(ghosted+interior)·itemsize). Ragged final blocks are
+    fine — pallas masks out-of-bounds loads/stores."""
+    strip = min(tile, extent)
+    while strip > min_strip and strip * rows_bytes > _VMEM_BUDGET_BYTES:
+        strip //= 2
+    if strip * rows_bytes > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"stencil2d_pallas: even a {strip}-wide strip of extent "
+            f"{extent} exceeds the VMEM budget; use the XLA stencil"
+        )
+    return strip
+
+
+@functools.partial(jax.jit, static_argnames=("dim", "tile", "interpret"))
+def stencil2d_pallas(
+    z,
+    scale,
+    dim: int = 0,
+    tile: int = 256,
+    interpret: bool | None = None,
+):
+    """5-point first derivative along ``dim`` of a 2-D array ghosted along
+    ``dim`` (out = in − 2·N_BND there) as a hand-tiled Pallas kernel
+    (≅ the SYCL ``stencil2d_1d_5``, ``mpi_stencil2d_sycl.cc:53-75``).
+
+    Tiling: the grid walks the NON-derivative dim in ``tile``-wide strips;
+    each strip holds the full ghosted derivative extent in VMEM (Mosaic
+    requires HBM slices 8-sublane-aligned, which ghosted interiors never
+    are, so the halo travels with the strip). The derivative extent is
+    therefore VMEM-bounded (strips auto-shrink to fit the ~14 MiB budget);
+    ragged final strips are masked by the pallas pipeline.
+    """
+    nx, ny = z.shape
+    if dim == 0:
+        mx, mn = nx - 2 * N_BND, ny  # out shape
+        # min_strip 64 lets very tall arrays still fit (lanes pad to 128 in
+        # the DMA then — a real bandwidth cost the A/B comparison surfaces)
+        strip = _fit_strip(
+            tile, mn, 2 * (nx + mx) * z.dtype.itemsize, min_strip=64
+        )
+        grid = (pl.cdiv(mn, strip),)
+        in_spec = pl.BlockSpec(
+            (nx, strip), lambda j: (0, j), memory_space=pltpu.VMEM
+        )
+        out_spec = pl.BlockSpec(
+            (mx, strip), lambda j: (0, j), memory_space=pltpu.VMEM
+        )
+        kernel = functools.partial(_stencil_strip_kernel, axis=0, m=mx)
+        out_shape = (mx, mn)
+    else:
+        mx, mn = nx, ny - 2 * N_BND
+        strip = _fit_strip(
+            tile, mx, 2 * (ny + mn) * z.dtype.itemsize, min_strip=8
+        )
+        grid = (pl.cdiv(mx, strip),)
+        in_spec = pl.BlockSpec(
+            (strip, ny), lambda i: (i, 0), memory_space=pltpu.VMEM
+        )
+        out_spec = pl.BlockSpec(
+            (strip, mn), lambda i: (i, 0), memory_space=pltpu.VMEM
+        )
+        kernel = functools.partial(_stencil_strip_kernel, axis=1, m=mn)
+        out_shape = (mx, mn)
+
+    scale_arr = jnp.asarray(scale, z.dtype).reshape(1)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(out_shape, z.dtype),
+        grid=grid,
+        in_specs=[in_spec, pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=out_spec,
+        interpret=_auto_interpret(interpret),
+    )(z, scale_arr)
+
+
+# ---------------------------------------------------------------------------
+# Halo pack/unpack staging kernels
+# ---------------------------------------------------------------------------
+
+
+def _pack_kernel(z_ref, lo_ref, hi_ref, *, axis, n_bnd):
+    n = z_ref.shape[axis]
+    if axis == 0:
+        lo_ref[:] = z_ref[pl.ds(n_bnd, n_bnd), :]
+        hi_ref[:] = z_ref[pl.ds(n - 2 * n_bnd, n_bnd), :]
+    else:
+        lo_ref[:] = z_ref[:, pl.ds(n_bnd, n_bnd)]
+        hi_ref[:] = z_ref[:, pl.ds(n - 2 * n_bnd, n_bnd)]
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "n_bnd", "interpret"))
+def pack_edges_pallas(z, axis: int = 0, n_bnd: int = N_BND,
+                      interpret: bool | None = None):
+    """Copy the two interior edge slices into contiguous staging buffers
+    (≅ ``buf_from_view``, ``mpi_stencil2d_sycl.cc:82-96``)."""
+    shape = list(z.shape)
+    shape[axis] = n_bnd
+    buf = jax.ShapeDtypeStruct(tuple(shape), z.dtype)
+    return pl.pallas_call(
+        functools.partial(_pack_kernel, axis=axis, n_bnd=n_bnd),
+        out_shape=(buf, buf),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=_auto_interpret(interpret),
+    )(z)
+
+
+def _unpack_kernel(z_ref, lo_ref, hi_ref, out_ref, *, axis, n_bnd):
+    out_ref[:] = z_ref[:]
+    n = z_ref.shape[axis]
+    if axis == 0:
+        out_ref[pl.ds(0, n_bnd), :] = lo_ref[:]
+        out_ref[pl.ds(n - n_bnd, n_bnd), :] = hi_ref[:]
+    else:
+        out_ref[:, pl.ds(0, n_bnd)] = lo_ref[:]
+        out_ref[:, pl.ds(n - n_bnd, n_bnd)] = hi_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "n_bnd", "interpret"))
+def unpack_ghosts_pallas(z, lo_ghost, hi_ghost, axis: int = 0,
+                         n_bnd: int = N_BND, interpret: bool | None = None):
+    """Write received halo buffers into the ghost regions
+    (≅ ``buf_to_view``, ``mpi_stencil2d_sycl.cc:102-116``)."""
+    return pl.pallas_call(
+        functools.partial(_unpack_kernel, axis=axis, n_bnd=n_bnd),
+        out_shape=jax.ShapeDtypeStruct(z.shape, z.dtype),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_auto_interpret(interpret),
+    )(z, lo_ghost, hi_ghost)
