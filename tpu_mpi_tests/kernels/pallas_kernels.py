@@ -186,6 +186,64 @@ def stencil2d_pallas(
     )(z, scale_arr)
 
 
+def _iterate_kernel_dim1(z_ref, scale_eps_ref, out_ref, *, mn):
+    z = z_ref[:]
+    acc = None
+    for k, c in enumerate(STENCIL5.tolist()):
+        if c == 0.0:
+            continue
+        term = c * jax.lax.slice_in_dim(z, k, k + mn, axis=1)
+        acc = term if acc is None else acc + term
+    interior = (
+        jax.lax.slice_in_dim(z, N_BND, N_BND + mn, axis=1)
+        + scale_eps_ref[0] * acc
+    )
+    out_ref[:] = jnp.concatenate(
+        [
+            jax.lax.slice_in_dim(z, 0, N_BND, axis=1),
+            interior,
+            jax.lax.slice_in_dim(z, N_BND + mn, 2 * N_BND + mn, axis=1),
+        ],
+        axis=1,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"),
+                   donate_argnums=0)
+def stencil2d_iterate_pallas(
+    z, scale_eps, tile: int = 64, interpret: bool | None = None
+):
+    """One in-place Jacobi-style step: ``interior += scale_eps · stencil``
+    along dim 1, ghosts preserved — shape-preserving so iterations chain,
+    with the input buffer aliased to the output (true in-place; ≅ the
+    reference updating ``d_dz`` from ``d_z`` each hot-loop iteration with
+    persistent buffers, ``mpi_stencil2d_sycl.cc:218-239``).
+
+    Two HBM passes per call (read z, write z) versus XLA's 6 (one per
+    stencil tap + writes) — the VMEM-staged shifts are register-cheap along
+    the lane dim. This is the bench.py fast path.
+    """
+    nx, ny = z.shape
+    mn = ny - 2 * N_BND
+    strip = _fit_strip(tile, nx, 2 * (ny + ny) * z.dtype.itemsize, min_strip=8)
+    se = jnp.asarray(scale_eps, z.dtype).reshape(1)
+    return pl.pallas_call(
+        functools.partial(_iterate_kernel_dim1, mn=mn),
+        out_shape=jax.ShapeDtypeStruct((nx, ny), z.dtype),
+        grid=(pl.cdiv(nx, strip),),
+        in_specs=[
+            pl.BlockSpec((strip, ny), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (strip, ny), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        input_output_aliases={0: 0},
+        interpret=_auto_interpret(interpret),
+    )(z, se)
+
+
 # ---------------------------------------------------------------------------
 # Halo pack/unpack staging kernels
 # ---------------------------------------------------------------------------
