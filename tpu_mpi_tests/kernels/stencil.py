@@ -37,7 +37,8 @@ def stencil1d_5(y, scale=1.0, axis: int = 0):
             f"stencil axis {axis} needs >= {2 * N_BND + 1} points, got {n}"
         )
     out = None
-    for k, c in enumerate(STENCIL5):
+    # .tolist() → weak python floats: no x64 promotion of f32 inputs
+    for k, c in enumerate(STENCIL5.tolist()):
         if c == 0.0:
             continue
         term = c * lax.slice_in_dim(y, k, n - 2 * N_BND + k, axis=axis)
