@@ -1,0 +1,131 @@
+// tpumt_avg — native result aggregator (≅ avg.sh, /root/reference/avg.sh:1-15).
+//
+// The reference greps a pattern in every out-*.txt and awk-averages the
+// ':'-delimited second field. This tool keeps that exact contract (default
+// pattern "gather", field 2, per-file mean) and extends it with min/max/count
+// stats and JSONL key extraction, as a single static binary so aggregation
+// works on TPU-VM workers without a Python environment.
+//
+// Usage:
+//   tpumt_avg [-p PATTERN] [-k JSON_KEY] [-s] file.txt [file2.txt ...]
+//     -p PATTERN   substring to select lines (default: "gather")
+//     -k KEY       extract `"KEY": <number>` from matching JSONL lines
+//                  instead of the ':'-delimited field
+//     -s           print min/max/count alongside the mean
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <limits>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Stats {
+  double sum = 0.0;
+  double mn = std::numeric_limits<double>::infinity();
+  double mx = -std::numeric_limits<double>::infinity();
+  long count = 0;
+
+  void add(double v) {
+    sum += v;
+    mn = std::min(mn, v);
+    mx = std::max(mx, v);
+    ++count;
+  }
+};
+
+// Field 2 of a ':'-delimited line, like `awk -F: '{ ... $2 ... }'`.
+bool parse_colon_field(const std::string& line, double* out) {
+  auto pos = line.find(':');
+  if (pos == std::string::npos) return false;
+  auto rest = line.substr(pos + 1);
+  auto next = rest.find(':');
+  if (next != std::string::npos) rest = rest.substr(0, next);
+  char* end = nullptr;
+  double v = std::strtod(rest.c_str(), &end);
+  if (end == rest.c_str()) return false;
+  *out = v;
+  return true;
+}
+
+// `"key": <number>` anywhere in the line (naive but dependency-free; our
+// JSONL records are flat, emitted by instrument/report.py).
+bool parse_json_key(const std::string& line, const std::string& key,
+                    double* out) {
+  const std::string needle = "\"" + key + "\":";
+  auto pos = line.find(needle);
+  if (pos == std::string::npos) return false;
+  const char* start = line.c_str() + pos + needle.size();
+  char* end = nullptr;
+  double v = std::strtod(start, &end);
+  if (end == start) return false;
+  *out = v;
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string pattern = "gather";
+  std::string json_key;
+  bool stats = false;
+  std::vector<std::string> files;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    if (arg == "-p" && i + 1 < argc) {
+      pattern = argv[++i];
+    } else if (arg == "-k" && i + 1 < argc) {
+      json_key = argv[++i];
+    } else if (arg == "-s") {
+      stats = true;
+    } else if (arg == "-h" || arg == "--help") {
+      std::fprintf(stderr,
+                   "usage: %s [-p PATTERN] [-k JSON_KEY] [-s] files...\n",
+                   argv[0]);
+      return 0;
+    } else {
+      files.push_back(arg);
+    }
+  }
+  if (files.empty()) {
+    std::fprintf(stderr, "tpumt_avg: no input files\n");
+    return 1;
+  }
+
+  std::printf("PATTERN=%s\n", pattern.c_str());
+  int rc = 0;
+  for (const auto& path : files) {
+    std::ifstream in(path);
+    if (!in) {
+      std::fprintf(stderr, "tpumt_avg: cannot open %s\n", path.c_str());
+      rc = 1;
+      continue;
+    }
+    Stats st;
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.find(pattern) == std::string::npos) continue;
+      double v;
+      bool ok = json_key.empty() ? parse_colon_field(line, &v)
+                                 : parse_json_key(line, json_key, &v);
+      if (ok) st.add(v);
+    }
+    if (st.count == 0) {
+      std::printf("%s no-matches\n", path.c_str());
+      continue;
+    }
+    if (stats) {
+      std::printf("%s %g min=%g max=%g n=%ld\n", path.c_str(),
+                  st.sum / st.count, st.mn, st.mx, st.count);
+    } else {
+      std::printf("%s %g\n", path.c_str(), st.sum / st.count);
+    }
+  }
+  return rc;
+}

@@ -1,0 +1,77 @@
+// libtpumt — native monotonic clock + accumulating phase timers.
+//
+// The reference's timing primitive is clock_gettime(CLOCK_MONOTONIC) read
+// around each hot-loop iteration (mpi_stencil_gt.cc:200-204,
+// mpi_stencil2d_gt.cc:512-526) and MPI_Wtime phase brackets
+// (mpi_daxpy_nvtx.cc:168,242-291). This library is the same primitive for
+// the TPU framework's host side, loaded via ctypes
+// (tpu_mpi_tests/instrument/native_time.py): a raw monotonic nanosecond
+// clock plus a small slot-based accumulator so repeated phase brackets cost
+// two calls and no Python arithmetic.
+
+#include <cstdint>
+#include <ctime>
+
+namespace {
+
+constexpr int kMaxSlots = 64;
+
+struct Slot {
+  double accum_s;
+  double started_at;
+  std::int64_t count;
+  int running;
+};
+
+Slot g_slots[kMaxSlots];
+
+double now_s() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + 1e-9 * ts.tv_nsec;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Raw CLOCK_MONOTONIC in nanoseconds (≅ the reference's timespec reads).
+std::int64_t tpumt_monotonic_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<std::int64_t>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+}
+
+// Slot-based accumulating phase timers; slot ∈ [0, 64).
+int tpumt_phase_start(int slot) {
+  if (slot < 0 || slot >= kMaxSlots) return -1;
+  g_slots[slot].started_at = now_s();
+  g_slots[slot].running = 1;
+  return 0;
+}
+
+int tpumt_phase_stop(int slot) {
+  if (slot < 0 || slot >= kMaxSlots || !g_slots[slot].running) return -1;
+  g_slots[slot].accum_s += now_s() - g_slots[slot].started_at;
+  g_slots[slot].count += 1;
+  g_slots[slot].running = 0;
+  return 0;
+}
+
+double tpumt_phase_seconds(int slot) {
+  if (slot < 0 || slot >= kMaxSlots) return -1.0;
+  return g_slots[slot].accum_s;
+}
+
+std::int64_t tpumt_phase_count(int slot) {
+  if (slot < 0 || slot >= kMaxSlots) return -1;
+  return g_slots[slot].count;
+}
+
+int tpumt_phase_reset(int slot) {
+  if (slot < 0 || slot >= kMaxSlots) return -1;
+  g_slots[slot] = Slot{};
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,79 @@
+"""Native-layer tests: C++ aggregator (≅ avg.sh) and phase-timer library.
+
+Native artifacts build on demand via make; tests skip if no toolchain."""
+
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+AVG = REPO / "tpu" / "avg.py"
+
+
+@pytest.fixture()
+def outfiles(tmp_path):
+    (tmp_path / "out-a.txt").write_text(
+        "TIME gather : 1.5\nTIME gather : 2.5\nTIME kernel : 9.0\n"
+    )
+    (tmp_path / "out-b.txt").write_text("TIME gather : 4.0\n")
+    (tmp_path / "out-c.txt").write_text(
+        '{"kind": "time", "phase": "gather", "seconds": 0.25}\n'
+        '{"kind": "time", "phase": "gather", "seconds": 0.75}\n'
+    )
+    return tmp_path
+
+
+def run_avg(args, cwd):
+    return subprocess.run(
+        [sys.executable, str(AVG), *args],
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+    )
+
+
+def test_avg_python_fallback_matches_reference_semantics(outfiles):
+    r = run_avg(["--no-native", "out-a.txt", "out-b.txt"], outfiles)
+    assert r.returncode == 0
+    assert "PATTERN=gather" in r.stdout  # avg.sh:9 prints the pattern
+    assert "out-a.txt 2" in r.stdout  # mean of 1.5, 2.5
+    assert "out-b.txt 4" in r.stdout
+
+
+def test_avg_jsonl_key(outfiles):
+    r = run_avg(["--no-native", "-k", "seconds", "out-c.txt"], outfiles)
+    assert r.returncode == 0
+    assert "out-c.txt 0.5" in r.stdout
+
+
+def test_avg_default_glob_and_pattern(outfiles):
+    r = run_avg(["--no-native", "--pattern", "kernel"], outfiles)
+    assert "out-a.txt 9" in r.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_aggregator_matches_python(outfiles):
+    r_native = run_avg(["-s", "out-a.txt"], outfiles)
+    assert r_native.returncode == 0
+    assert "out-a.txt 2 min=1.5 max=2.5 n=2" in r_native.stdout
+
+
+def test_native_time_monotonic_and_slots():
+    from tpu_mpi_tests.instrument import native_time as NT
+
+    t0 = NT.monotonic_ns()
+    time.sleep(0.01)
+    assert NT.monotonic_ns() - t0 >= 9_000_000  # >= 9 ms elapsed
+
+    s = NT.NativePhaseSlots()
+    s.reset(0)
+    for _ in range(2):
+        s.start(0)
+        time.sleep(0.005)
+        s.stop(0)
+    assert s.count(0) == 2
+    assert 0.008 <= s.seconds(0) <= 1.0

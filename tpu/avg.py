@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Result aggregator (≅ avg.sh, /root/reference/avg.sh:1-15).
+
+Prefers the native C++ aggregator (native/tpumt_avg, built on demand);
+falls back to an equivalent Python implementation. Contract preserved from
+the reference: select lines matching a pattern (default "gather"), average
+the ':'-delimited second field per file. Extensions: ``--key`` extracts a
+numeric field from JSONL records instead; ``--stats`` adds min/max/count.
+
+Usage: avg.py [--pattern PAT] [--key JSONKEY] [--stats] [files...]
+(default files: out-*.txt like the reference)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+
+
+def native_binary() -> Path | None:
+    exe = NATIVE_DIR / "tpumt_avg"
+    if not exe.exists() and not os.environ.get("TPU_MPI_TESTS_NO_NATIVE"):
+        try:
+            subprocess.run(
+                ["make", "-C", str(NATIVE_DIR), "tpumt_avg"],
+                capture_output=True,
+                check=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    return exe if exe.exists() else None
+
+
+def python_aggregate(pattern, key, stats, files) -> int:
+    print(f"PATTERN={pattern}")
+    rc = 0
+    for path in files:
+        try:
+            lines = Path(path).read_text().splitlines()
+        except OSError:
+            print(f"avg.py: cannot open {path}", file=sys.stderr)
+            rc = 1
+            continue
+        vals = []
+        for line in lines:
+            if pattern not in line:
+                continue
+            if key:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if key in rec and isinstance(rec[key], (int, float)):
+                    vals.append(float(rec[key]))
+            else:
+                parts = line.split(":")
+                if len(parts) < 2:
+                    continue
+                try:
+                    vals.append(float(parts[1].split()[0].rstrip(",;")))
+                except (ValueError, IndexError):
+                    continue
+        if not vals:
+            print(f"{path} no-matches")
+            continue
+        mean = sum(vals) / len(vals)
+        if stats:
+            print(
+                f"{path} {mean:g} min={min(vals):g} max={max(vals):g} "
+                f"n={len(vals)}"
+            )
+        else:
+            print(f"{path} {mean:g}")
+    return rc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--pattern", "-p", default="gather")
+    p.add_argument("--key", "-k", default=None,
+                   help="JSONL numeric field to aggregate")
+    p.add_argument("--stats", "-s", action="store_true")
+    p.add_argument("--no-native", action="store_true",
+                   help="force the Python fallback")
+    p.add_argument("files", nargs="*", default=None)
+    args = p.parse_args(argv)
+    files = args.files or sorted(glob.glob("out-*.txt"))
+    if not files:
+        print("avg.py: no input files", file=sys.stderr)
+        return 1
+
+    if not args.no_native:
+        exe = native_binary()
+        if exe is not None:
+            cmd = [str(exe), "-p", args.pattern]
+            if args.key:
+                cmd += ["-k", args.key]
+            if args.stats:
+                cmd.append("-s")
+            return subprocess.run(cmd + files).returncode
+    return python_aggregate(args.pattern, args.key, args.stats, files)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

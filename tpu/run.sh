@@ -1,0 +1,51 @@
+#!/bin/bash
+# TPU-pod launch wrapper (≅ summit/run.sh, /root/reference/summit/run.sh:1-32).
+#
+# Runs ONE worker's share of a driver; on a multi-host pod, invoke on every
+# worker (e.g. `gcloud compute tpus tpu-vm ssh $TPU --worker=all --command=...`).
+# jax.distributed autodetects the pod topology on TPU VMs; for manual
+# coordination export JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+# JAX_PROCESS_ID first (≅ jsrun/mpirun rank wiring).
+#
+# Usage: ./run.sh device|managed xprof|none <driver> [extra driver args...]
+#   arg1: memory space twin (≅ um|noum managed/unmanaged binaries)
+#   arg2: profiler capture (≅ nsys|nvprof|none; xprof writes a trace dir
+#         openable in TensorBoard/XProf)
+#   arg3: driver module under tpu_mpi_tests.drivers (e.g. mpi_daxpy_nvtx,
+#         stencil2d)
+# Output: out-<tag>.txt in the CWD (+ out-<tag>.jsonl), aggregate with avg.py.
+
+set -eu
+
+if [ $# -lt 3 ]; then
+  echo "Usage: $0 device|managed xprof|none <driver> [driver args...]"
+  exit 1
+fi
+
+space=$1
+prof=$2
+driver=$3
+shift 3
+
+repo_dir=$(cd "$(dirname "$0")/.." && pwd)
+out_dir=$PWD
+tag="${space}_${prof}_${driver}_$(hostname -s)"
+
+prof_args=""
+if [ "$prof" == "xprof" ]; then
+  mkdir -p profile
+  prof_args="--profile-dir profile/${tag}"
+fi
+
+space_args=""
+case "$driver" in
+  mpi_daxpy_nvtx) space_args="--space ${space}" ;;
+  stencil2d) if [ "$space" == "managed" ]; then space_args="--managed"; fi ;;
+esac
+
+cd "$out_dir"
+PYTHONPATH="$repo_dir${PYTHONPATH:+:$PYTHONPATH}" \
+  python -m "tpu_mpi_tests.drivers.${driver}" \
+  $space_args $prof_args --jsonl "out-${tag}.jsonl" "$@" \
+  > "out-${tag}.txt" 2>&1
+echo "wrote out-${tag}.txt"

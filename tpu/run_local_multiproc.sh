@@ -1,0 +1,42 @@
+#!/bin/bash
+# Multi-process distributed run on ONE machine with fake CPU devices
+# (≅ `mpirun -np N` on a workstation — the dev-loop the reference lacks;
+# SURVEY.md §4 "multi-node without a cluster").
+#
+# Each process gets 1 fake CPU device and they form a real jax.distributed
+# world over localhost, exercising the same bootstrap/collective paths as a
+# TPU pod.
+#
+# Usage: ./run_local_multiproc.sh <nprocs> <driver> [driver args...]
+
+set -eu
+
+if [ $# -lt 2 ]; then
+  echo "Usage: $0 <nprocs> <driver> [driver args...]"
+  exit 1
+fi
+
+nprocs=$1
+driver=$2
+shift 2
+
+repo_dir=$(cd "$(dirname "$0")/.." && pwd)
+port=$((10000 + RANDOM % 20000))
+
+pids=()
+for ((i = 0; i < nprocs; i++)); do
+  JAX_COORDINATOR_ADDRESS="localhost:${port}" \
+  JAX_NUM_PROCESSES="$nprocs" \
+  JAX_PROCESS_ID="$i" \
+  PYTHONPATH="$repo_dir${PYTHONPATH:+:$PYTHONPATH}" \
+    python -m "tpu_mpi_tests.drivers.${driver}" --fake-devices 1 "$@" \
+    > "out-local-${i}.txt" 2>&1 &
+  pids+=($!)
+done
+
+rc=0
+for pid in "${pids[@]}"; do
+  wait "$pid" || rc=$?
+done
+echo "done (rc=$rc); outputs in out-local-*.txt"
+exit $rc

@@ -72,6 +72,10 @@ def bootstrap(
     )
     if not (explicit or multihost_tpu) or _DISTRIBUTED_INITIALIZED:
         return
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
     jax.distributed.initialize(
         coordinator_address=coordinator_address
         or os.environ.get("JAX_COORDINATOR_ADDRESS")

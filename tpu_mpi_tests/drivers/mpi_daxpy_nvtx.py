@@ -101,6 +101,13 @@ def run(args) -> int:
 
         # ── kernel (:242-249) ──
         with trace_range("daxpy"), timer.phase("kernel"):
+            # managed arrays migrate to HBM on first device touch (TPU has
+            # no page-migrating UVM; see arrays/spaces.ensure_device), so
+            # the migration cost lands in kernel time like UVM page faults
+            from tpu_mpi_tests.arrays.spaces import ensure_device
+
+            d_x = ensure_device(d_x)
+            d_y = ensure_device(d_y)
             d_y = block(kd.daxpy(jnp.asarray(args.a, dtype), d_x, d_y))
 
         # ── localSum (+ copyOutput if unmanaged) (:251-268) ──

@@ -1,0 +1,109 @@
+"""ctypes bridge to the native phase-timer library (native/phasetimer.cc).
+
+The reference's host-side clock is ``clock_gettime(CLOCK_MONOTONIC)``
+(``mpi_stencil_gt.cc:200-204``); libtpumt is the same primitive for this
+framework. The library is built on demand (``make -C native``) and cached;
+everything degrades to ``time.perf_counter_ns`` when no toolchain is
+available, so the native path is an optimization, never a requirement.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+import subprocess
+import time
+from pathlib import Path
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent.parent / "native"
+_LIB_NAME = "libtpumt.so"
+
+
+@functools.lru_cache(maxsize=None)
+def _load() -> ctypes.CDLL | None:
+    lib_path = _NATIVE_DIR / _LIB_NAME
+    if not lib_path.exists():
+        if os.environ.get("TPU_MPI_TESTS_NO_NATIVE"):
+            return None
+        try:
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR), _LIB_NAME],
+                capture_output=True,
+                check=True,
+                timeout=120,
+            )
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+    try:
+        lib = ctypes.CDLL(str(lib_path))
+    except OSError:
+        return None
+    lib.tpumt_monotonic_ns.restype = ctypes.c_int64
+    lib.tpumt_phase_seconds.restype = ctypes.c_double
+    lib.tpumt_phase_count.restype = ctypes.c_int64
+    for fn in (lib.tpumt_phase_start, lib.tpumt_phase_stop,
+               lib.tpumt_phase_reset):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_int]
+    lib.tpumt_phase_seconds.argtypes = [ctypes.c_int]
+    lib.tpumt_phase_count.argtypes = [ctypes.c_int]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def monotonic_ns() -> int:
+    """CLOCK_MONOTONIC nanoseconds via the native lib (perf_counter_ns
+    fallback)."""
+    lib = _load()
+    if lib is None:
+        return time.perf_counter_ns()
+    return lib.tpumt_monotonic_ns()
+
+
+class NativePhaseSlots:
+    """Slot-based accumulating timers backed by libtpumt (Python fallback).
+
+    ≅ the t_/k_/b_/g_ accumulator variables of ``mpi_daxpy_nvtx.cc``,
+    kept out of Python arithmetic when native.
+    """
+
+    def __init__(self):
+        self._lib = _load()
+        self._py_accum: dict[int, float] = {}
+        self._py_count: dict[int, int] = {}
+        self._py_start: dict[int, int] = {}
+
+    def start(self, slot: int) -> None:
+        if self._lib is not None:
+            self._lib.tpumt_phase_start(slot)
+        else:
+            self._py_start[slot] = time.perf_counter_ns()
+
+    def stop(self, slot: int) -> None:
+        if self._lib is not None:
+            self._lib.tpumt_phase_stop(slot)
+        else:
+            dt = time.perf_counter_ns() - self._py_start.pop(slot)
+            self._py_accum[slot] = self._py_accum.get(slot, 0.0) + dt * 1e-9
+            self._py_count[slot] = self._py_count.get(slot, 0) + 1
+
+    def seconds(self, slot: int) -> float:
+        if self._lib is not None:
+            return self._lib.tpumt_phase_seconds(slot)
+        return self._py_accum.get(slot, 0.0)
+
+    def count(self, slot: int) -> int:
+        if self._lib is not None:
+            return self._lib.tpumt_phase_count(slot)
+        return self._py_count.get(slot, 0)
+
+    def reset(self, slot: int) -> None:
+        if self._lib is not None:
+            self._lib.tpumt_phase_reset(slot)
+        else:
+            self._py_accum.pop(slot, None)
+            self._py_count.pop(slot, None)
