@@ -46,6 +46,7 @@ class Staging(enum.Enum):
     DIRECT = "direct"
     DEVICE_STAGED = "device"
     HOST_STAGED = "host"
+    PALLAS_RDMA = "pallas"
 
     @classmethod
     def parse(cls, s: "str | Staging") -> "Staging":
@@ -141,6 +142,43 @@ def _exchange_fn(
     return exchange
 
 
+@functools.lru_cache(maxsize=None)
+def _exchange_pallas_fn(
+    mesh: Mesh,
+    axis_name: str,
+    axis: int,
+    ndim: int,
+    n_bnd: int,
+    periodic: bool,
+    interpret: bool | None = None,
+):
+    """Hand-tuned exchange: explicit inter-chip RDMA instead of ppermute
+    (≅ the reference's manual CUDA-aware-MPI staging path, SURVEY §5.8)."""
+    from tpu_mpi_tests.kernels.pallas_kernels import ring_halo_pallas
+
+    spec = [None] * ndim
+    spec[axis] = axis_name
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec),
+        check_vma=False,
+    )
+    def exchange(z):
+        if mesh.shape[axis_name] == 1 and not periodic:
+            return z  # nothing to exchange; physical ghosts stand
+        return ring_halo_pallas(
+            z,
+            axis_name=axis_name,
+            axis=axis,
+            n_bnd=n_bnd,
+            periodic=periodic,
+            interpret=interpret,
+        )
+
+    return exchange
+
+
 def halo_exchange(
     zg,
     mesh: Mesh,
@@ -166,6 +204,10 @@ def halo_exchange(
         return _host_staged_exchange(
             zg, mesh, axis_name, axis, n_bnd, periodic
         )
+    if staging is Staging.PALLAS_RDMA:
+        return _exchange_pallas_fn(
+            mesh, axis_name, axis, zg.ndim, n_bnd, periodic
+        )(zg)
     return _exchange_fn(
         mesh,
         axis_name,

@@ -121,8 +121,9 @@ def main(argv=None) -> int:
     p.add_argument(
         "--staging",
         default="direct",
-        choices=["direct", "device", "host"],
-        help="halo staging mode (≅ reference stage_host/device variants)",
+        choices=["direct", "device", "host", "pallas"],
+        help="halo staging mode (≅ reference stage_host/device variants; "
+        "'pallas' = hand-written inter-chip RDMA ring kernel)",
     )
     p.add_argument(
         "--tol",

@@ -61,7 +61,10 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
     )
     f, df = analytic_pairs()[f"2d_dim{dim}"]
 
-    if dim == 0:
+    if args.rdma:
+        # hand-written remote-DMA ring kernel replaces every staged path
+        staging = H.Staging.PALLAS_RDMA
+    elif dim == 0:
         staging = H.Staging.HOST_STAGED if buf else H.Staging.DEVICE_STAGED
     else:
         staging = H.Staging.DEVICE_STAGED if buf else H.Staging.DIRECT
@@ -291,6 +294,12 @@ def main(argv=None) -> int:
         "--managed",
         action="store_true",
         help="add managed-space twins to the matrix (≅ -DTEST_MANAGED)",
+    )
+    p.add_argument(
+        "--rdma",
+        action="store_true",
+        help="use the hand-written pallas remote-DMA ring for every "
+        "exchange (≅ running the SYCL hand-kernel variant of the matrix)",
     )
     p.add_argument(
         "--tol",

@@ -245,6 +245,134 @@ def stencil2d_iterate_pallas(
 
 
 # ---------------------------------------------------------------------------
+# Ring halo exchange over ICI (inter-chip RDMA)
+# ---------------------------------------------------------------------------
+
+
+def _ring_halo_kernel(z_ref, out_ref, comm, send_sem, recv_sem,
+                      *, axis_name, axis, n_bnd, periodic, use_barrier):
+    """Bidirectional neighbor exchange with explicit remote DMA
+    (≅ the ``MPI_Irecv``/``Isend``/``Waitall`` body of ``boundary_exchange``,
+    ``mpi_stencil_gt.cc:96-121``: post both directions, overlap, wait, then
+    write ghosts).
+
+    Symmetric form: every device sends both directions on the ring
+    (including the wrap-around pair), then non-periodic edge ranks simply
+    keep their original physical ghosts — identical masking to the XLA
+    ``ppermute`` path, and no conditional semaphore accounting to deadlock.
+    comm slot 0 ← left neighbor's hi edge; slot 1 ← right neighbor's lo
+    edge.
+    """
+    n_dev = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    # idx is int32; keep the modulus int32 too (x64 would promote the int)
+    right = jax.lax.rem(idx + 1, jnp.int32(n_dev))
+    left = jax.lax.rem(idx - 1 + jnp.int32(n_dev), jnp.int32(n_dev))
+    size = z_ref.shape[axis]
+
+    if use_barrier:
+        # neighborhood barrier: both neighbors have entered this call, so
+        # their comm scratch is live and last call's reads are done (guide
+        # pattern; protects chained iterations). Hardware only — the
+        # interpreter serializes devices, so the hazard cannot occur there,
+        # and remote signals are unimplemented in interpret mode.
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    def edge(lo, hi):
+        if axis == 0:
+            return z_ref.at[pl.ds(lo, hi - lo), :]
+        return z_ref.at[:, pl.ds(lo, hi - lo)]
+
+    # my hi edge travels right into their slot 0 ("from_left")
+    rdma_hi = pltpu.make_async_remote_copy(
+        src_ref=edge(size - 2 * n_bnd, size - n_bnd),
+        dst_ref=comm.at[0],
+        send_sem=send_sem.at[0],
+        recv_sem=recv_sem.at[0],
+        device_id=right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    # my lo edge travels left into their slot 1 ("from_right")
+    rdma_lo = pltpu.make_async_remote_copy(
+        src_ref=edge(n_bnd, 2 * n_bnd),
+        dst_ref=comm.at[1],
+        send_sem=send_sem.at[1],
+        recv_sem=recv_sem.at[1],
+        device_id=left,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma_hi.start()
+    rdma_lo.start()
+    rdma_hi.wait()
+    rdma_lo.wait()
+
+    out_ref[:] = z_ref[:]
+
+    @pl.when(jnp.logical_or(bool(periodic), idx > 0))
+    def _():
+        if axis == 0:
+            out_ref[pl.ds(0, n_bnd), :] = comm[0]
+        else:
+            out_ref[:, pl.ds(0, n_bnd)] = comm[0]
+
+    @pl.when(jnp.logical_or(bool(periodic), idx < n_dev - 1))
+    def _():
+        if axis == 0:
+            out_ref[pl.ds(size - n_bnd, n_bnd), :] = comm[1]
+        else:
+            out_ref[:, pl.ds(size - n_bnd, n_bnd)] = comm[1]
+
+
+def ring_halo_pallas(
+    z,
+    *,
+    axis_name: str,
+    axis: int = 0,
+    n_bnd: int = N_BND,
+    periodic: bool = False,
+    collective_id: int = 7,
+    interpret: bool | None = None,
+):
+    """Per-shard halo exchange with explicit inter-chip RDMA — the
+    hand-tuned analog of ``exchange_shard``'s ``ppermute`` (SURVEY.md §5.8:
+    ≅ the manual staged CUDA-aware-MPI path). Call *inside* ``shard_map``
+    over ``axis_name``; ghost regions along ``axis`` are filled from ring
+    neighbors, physical ghosts kept on non-periodic edges."""
+    if axis == 0:
+        comm_shape = (2, n_bnd, z.shape[1])
+    else:
+        comm_shape = (2, z.shape[0], n_bnd)
+    interp = _auto_interpret(interpret)
+    return pl.pallas_call(
+        functools.partial(
+            _ring_halo_kernel,
+            axis_name=axis_name,
+            axis=axis,
+            n_bnd=n_bnd,
+            periodic=periodic,
+            use_barrier=not interp,
+        ),
+        out_shape=jax.ShapeDtypeStruct(z.shape, z.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM(comm_shape, z.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interp,
+    )(z)
+
+
+# ---------------------------------------------------------------------------
 # Halo pack/unpack staging kernels
 # ---------------------------------------------------------------------------
 
