@@ -24,6 +24,7 @@ Measured on one v5e chip: ~1190 iter/s ≈ 2.4× the baseline.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 V100_F64_ITERS_PER_S = 503.0  # 810e9 / (3 * 8 * 8192**2)
@@ -41,7 +42,14 @@ def main() -> None:
     from tpu_mpi_tests.kernels.stencil import analytic_pairs
     from tpu_mpi_tests.utils import check_divisible
 
-    n = 8192
+    # TPU_MPI_BENCH_N / _FAKE_DEVICES shrink the run for CI smoke; the
+    # official metric is the 8192 default on real hardware (the baseline
+    # constant assumes it)
+    n = int(os.environ.get("TPU_MPI_BENCH_N", 8192))
+    if os.environ.get("TPU_MPI_BENCH_FAKE_DEVICES"):
+        from tpu_mpi_tests.drivers._common import force_cpu_devices
+
+        force_cpu_devices(int(os.environ["TPU_MPI_BENCH_FAKE_DEVICES"]))
     eps = 1e-6
     bootstrap()
     topo = topology()
@@ -67,7 +75,8 @@ def main() -> None:
         run = iterate_fused_fn(mesh, axis_name, 1, 2, d.n_bnd, d.scale, eps)
 
     zg = block(run(zg, 3))  # compile + warm
-    n_short, n_long = 100, 1100
+    n_short = int(os.environ.get("TPU_MPI_BENCH_ITERS_SHORT", 100))
+    n_long = int(os.environ.get("TPU_MPI_BENCH_ITERS_LONG", 1100))
     t0 = time.perf_counter()
     zg = block(run(zg, n_short))
     t_short = time.perf_counter() - t0

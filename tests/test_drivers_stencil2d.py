@@ -44,6 +44,16 @@ def test_tight_tol_fails(capsys):
     assert "ERR_NORM FAIL" in out
 
 
+def test_pallas_kernel_mode(capsys):
+    rc = stencil2d.main(
+        SMALL + ["--dtype", "float64", "--kernel", "pallas"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    deriv = re.findall(r"err=([\d.e+-]+)", out)
+    assert deriv and all(float(e) < 1e-8 for e in deriv)
+
+
 def test_rejects_bad_sizes(capsys):
     import pytest
 

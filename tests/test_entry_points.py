@@ -1,0 +1,72 @@
+"""Smoke tests for the repo-root entry points the benchmark harness calls:
+``bench.py`` (one JSON line) and ``__graft_entry__`` (single-chip compile +
+multi-chip dryrun). Run in subprocesses because each needs its own backend
+configuration."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_py(code: str, env_extra: dict | None = None):
+    env = dict(os.environ)
+    # a clean backend per subprocess; the conftest's fake-device setup must
+    # not leak in
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+
+
+def test_bench_prints_one_json_line_smoke():
+    r = run_py(
+        "import bench; bench.main()",
+        {
+            "TPU_MPI_BENCH_N": "128",
+            # the difference timing needs enough iterations that real work
+            # dominates timer noise, or the sign can flip; fake devices
+            # force the CPU backend (env JAX_PLATFORMS alone is overridden
+            # by the image's sitecustomize) and exercise the sharded path
+            "TPU_MPI_BENCH_ITERS_SHORT": "50",
+            "TPU_MPI_BENCH_ITERS_LONG": "1050",
+            "TPU_MPI_BENCH_FAKE_DEVICES": "4",
+        },
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    rec = json.loads(lines[-1])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] > 0
+
+
+def test_graft_entry_single_chip():
+    r = run_py(
+        "import jax, __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "print('OK', jax.tree.map(lambda x: x.shape, out))\n",
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK" in r.stdout
+
+
+def test_graft_dryrun_multichip():
+    r = run_py(
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "print('DRYRUN OK')\n",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DRYRUN OK" in r.stdout

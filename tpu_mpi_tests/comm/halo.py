@@ -266,10 +266,15 @@ def stencil_fn(
     axis: int,
     ndim: int,
     scale: float,
+    kernel: str = "xla",
 ):
     """Per-shard stencil application over the ghosted-global layout:
     each shard's ghosted block yields its interior derivative
-    (out shard size = in shard size − 2·n_bnd along ``axis``)."""
+    (out shard size = in shard size − 2·n_bnd along ``axis``).
+
+    ``kernel="pallas"`` swaps in the hand-written strip-tiled kernel
+    (≅ running the SYCL implementation of the same benchmark,
+    ``mpi_stencil2d_sycl.cc``)."""
     from tpu_mpi_tests.kernels.stencil import stencil1d_5
 
     spec = [None] * ndim
@@ -277,9 +282,15 @@ def stencil_fn(
 
     @jax.jit
     @functools.partial(
-        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec)
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec),
+        # pallas_call outputs carry no vma annotation
+        check_vma=False,
     )
     def apply(z):
+        if kernel == "pallas":
+            from tpu_mpi_tests.kernels.pallas_kernels import stencil2d_pallas
+
+            return stencil2d_pallas(z, scale, dim=axis)
         return stencil1d_5(z, scale=scale, axis=axis)
 
     return apply

@@ -99,7 +99,9 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
     zg = block(zg)
     seconds = time.perf_counter() - t0
 
-    dz = block(H.stencil_fn(mesh, axis_name, dim, 2, d.scale)(zg))
+    dz = block(
+        H.stencil_fn(mesh, axis_name, dim, 2, d.scale, kernel=args.kernel)(zg)
+    )
     actual = C.shard_blocks(
         mesh,
         d.global_interior_shape,
@@ -300,6 +302,13 @@ def main(argv=None) -> int:
         action="store_true",
         help="use the hand-written pallas remote-DMA ring for every "
         "exchange (≅ running the SYCL hand-kernel variant of the matrix)",
+    )
+    p.add_argument(
+        "--kernel",
+        default="xla",
+        choices=["xla", "pallas"],
+        help="stencil compute implementation: XLA expression (≅ gtensor) "
+        "or hand-written pallas strips (≅ the SYCL kernel)",
     )
     p.add_argument(
         "--tol",
