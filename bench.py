@@ -46,10 +46,11 @@ def main() -> None:
     # official metric is the 8192 default on real hardware (the baseline
     # constant assumes it)
     n = int(os.environ.get("TPU_MPI_BENCH_N", 8192))
-    if os.environ.get("TPU_MPI_BENCH_FAKE_DEVICES"):
+    n_fake = int(os.environ.get("TPU_MPI_BENCH_FAKE_DEVICES", "0"))
+    if n_fake > 0:  # 0 = off, matching the drivers' --fake-devices default
         from tpu_mpi_tests.drivers._common import force_cpu_devices
 
-        force_cpu_devices(int(os.environ["TPU_MPI_BENCH_FAKE_DEVICES"]))
+        force_cpu_devices(n_fake)
     eps = 1e-6
     bootstrap()
     topo = topology()

@@ -15,8 +15,14 @@ REPO = Path(__file__).resolve().parent.parent
 def run_py(code: str, env_extra: dict | None = None):
     env = dict(os.environ)
     # a clean backend per subprocess; the conftest's fake-device setup must
-    # not leak in
+    # not leak in (both the platform pin and the fake-device count flag)
     env.pop("JAX_PLATFORMS", None)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(flags)
     env.update(env_extra or {})
     return subprocess.run(
         [sys.executable, "-c", code],
@@ -50,13 +56,16 @@ def test_bench_prints_one_json_line_smoke():
 
 
 def test_graft_entry_single_chip():
+    # force_cpu_devices: the env var alone is overridden by the image's
+    # sitecustomize, which would silently run this on the TPU tunnel
     r = run_py(
+        "from tpu_mpi_tests.drivers._common import force_cpu_devices\n"
+        "force_cpu_devices(1)\n"
         "import jax, __graft_entry__ as g\n"
         "fn, args = g.entry()\n"
         "out = jax.jit(fn)(*args)\n"
         "jax.block_until_ready(out)\n"
         "print('OK', jax.tree.map(lambda x: x.shape, out))\n",
-        {"JAX_PLATFORMS": "cpu"},
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "OK" in r.stdout
