@@ -54,6 +54,28 @@ def test_pallas_kernel_mode(capsys):
     assert deriv and all(float(e) < 1e-8 for e in deriv)
 
 
+def test_debug_dump(capsys):
+    rc = stencil2d.main(SMALL + ["--dtype", "float64", "--debug-dump"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DEBUG rank 0 lo ghost+edge:" in out
+    assert "DEBUG rank 7 hi ghost+edge:" in out
+
+
+def test_determinism_across_runs(capsys):
+    """Cross-run determinism assert — the framework's race-detector analog
+    (SURVEY §5.2): two identical distributed runs must emit identical
+    err/time-independent results."""
+    import re as _re
+
+    def errs():
+        rc = stencil2d.main(SMALL + ["--dtype", "float32"])
+        assert rc == 0
+        return _re.findall(r"err=([\d.e+-]+)", capsys.readouterr().out)
+
+    assert errs() == errs()
+
+
 def test_rejects_bad_sizes(capsys):
     import pytest
 

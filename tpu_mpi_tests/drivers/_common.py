@@ -37,6 +37,12 @@ def base_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument(
         "--verbose", action="store_true", help="extra per-device reporting"
     )
+    p.add_argument(
+        "--debug-nans",
+        action="store_true",
+        help="abort on NaN production (the framework's sanitizer axis, "
+        "SURVEY §5.2 — ≅ the correctness-by-construction DEBUG builds)",
+    )
     return p
 
 
@@ -71,6 +77,8 @@ def setup_platform(args) -> None:
         force_cpu_devices(args.fake_devices)
     if args.dtype == "float64":
         jax.config.update("jax_enable_x64", True)
+    if getattr(args, "debug_nans", False):
+        jax.config.update("jax_debug_nans", True)
 
 
 def jnp_dtype(args):

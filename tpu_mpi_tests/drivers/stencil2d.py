@@ -99,6 +99,23 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
     zg = block(zg)
     seconds = time.perf_counter() - t0
 
+    if args.debug_dump and zg.is_fully_addressable:
+        # ≅ the DEBUG halo dumps of mpi_stencil2d_sycl_oo.cc:636-659: print
+        # each logical rank's ghost rows and adjacent interior edge rows
+        zh = np.asarray(C.host_value(zg))
+        for r in range(world):
+            blk = np.split(zh, world, axis=dim)[r]
+            sl = [slice(None), slice(None)]
+            for label, lohi in (("lo", slice(0, 2 * d.n_bnd)),
+                                ("hi", slice(-2 * d.n_bnd, None))):
+                sl[dim] = lohi
+                edge = blk[tuple(sl)]
+                flat = np.array2string(
+                    edge[:, :4] if dim == 0 else edge[:4, :].T,
+                    precision=4, max_line_width=120,
+                )
+                rep.line(f"DEBUG rank {r} {label} ghost+edge:\n{flat}")
+
     dz = block(
         H.stencil_fn(mesh, axis_name, dim, 2, d.scale, kernel=args.kernel)(zg)
     )
@@ -309,6 +326,12 @@ def main(argv=None) -> int:
         choices=["xla", "pallas"],
         help="stencil compute implementation: XLA expression (≅ gtensor) "
         "or hand-written pallas strips (≅ the SYCL kernel)",
+    )
+    p.add_argument(
+        "--debug-dump",
+        action="store_true",
+        help="print per-rank ghost+edge rows after the exchange "
+        "(≅ the DEBUG halo dumps, mpi_stencil2d_sycl_oo.cc:636-659)",
     )
     p.add_argument(
         "--tol",
