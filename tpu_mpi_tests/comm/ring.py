@@ -1,0 +1,120 @@
+"""Ring communication primitives + ring attention: sequence/context
+parallelism as a first-class component.
+
+SURVEY.md §5.7: the reference's entire stencil pillar is the communication
+skeleton of ring attention — a 1-D process ring exchanging blocks with
+neighbors ±1, nonblocking sends overlapped with local compute
+(``mpi_stencil_gt.cc:83-122``). This module makes that explicit: the same
+``lax.ppermute`` ring that fills stencil ghosts (comm/halo.py) here rotates
+K/V blocks around the mesh axis while each shard accumulates its queries'
+attention online — long sequences scale across chips with O(L_local) memory
+per chip.
+
+Components:
+
+* :func:`ring_pass` — rotate a block one step around the ring (the
+  ``Isend/Irecv`` to rank±1 analog, periodic).
+* :func:`ring_scan` — fold a function over every rank's block as it rotates
+  (generic ring-reduce; the stencil halo is the 1-step special case).
+* :func:`ring_attention` / :func:`ring_attention_fn` — blockwise
+  numerically-stable softmax attention over a sequence sharded along a mesh
+  axis (the ring-attention primitive of Liu et al.; no attention exists in
+  the reference — this is the capability its halo skeleton was built to
+  carry, provided as a library component).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ring_pass(x, axis_name: str, shift: int = 1):
+    """Rotate ``x`` ``shift`` steps around the mesh-axis ring (periodic):
+    each rank receives the block of ``rank - shift``."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_scan(f, init, block, axis_name: str):
+    """Fold ``f(carry, block_j, j)`` over every rank's block ``j`` as blocks
+    rotate around the ring; after ``n`` steps each rank has seen all blocks.
+
+    ``f`` must keep carry shapes static. Step ``s`` on rank ``r`` sees the
+    block originally owned by rank ``(r - s) % n``.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    # the folded carry becomes device-varying (it mixes in this rank's
+    # blocks); mark the init accordingly or vma inference rejects the loop
+    init = jax.tree.map(
+        lambda x: lax.pcast(jnp.asarray(x), (axis_name,), to="varying"), init
+    )
+
+    def body(s, state):
+        carry, blk = state
+        src = lax.rem(r - s + n, jnp.int32(n))
+        carry = f(carry, blk, src)
+        # rotate for the next step (sent even on the last step; XLA drops
+        # nothing observable and the loop stays uniform)
+        return carry, ring_pass(blk, axis_name)
+
+    carry, _ = lax.fori_loop(0, n, body, (init, block))
+    return carry
+
+
+def ring_attention(q, k, v, axis_name: str, scale: float | None = None):
+    """Blockwise ring attention for one shard (call inside ``shard_map``).
+
+    ``q``/``k``/``v``: this rank's sequence blocks, shape (L_local, d).
+    K/V blocks rotate around the ring; the online-softmax carry
+    (running max ``m``, denominator ``l``, numerator ``acc``) is updated
+    per block, so no rank ever materializes the full attention matrix or
+    the full K/V — the long-context memory property.
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
+    l0 = jnp.zeros(q.shape[:-1], q.dtype)
+    acc0 = jnp.zeros_like(q)
+
+    def step(carry, kv_blk, src):
+        del src  # full (non-causal) attention; causal variants mask by src
+        m, l, acc = carry
+        k_blk, v_blk = kv_blk
+        s = (q @ k_blk.T) * scale  # (Lq, Lk_blk)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[:, None] + p @ v_blk
+        return m_new, l, acc
+
+    m, l, acc = ring_scan(step, (m0, l0, acc0), (k, v), axis_name)
+    return acc / l[:, None]
+
+
+@functools.lru_cache(maxsize=None)
+def ring_attention_fn(mesh: Mesh, axis_name: str):
+    """Jitted ring attention over a sequence sharded along ``axis_name``
+    (inputs (L_global, d) sharded on axis 0)."""
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name, None), P(axis_name, None)),
+        out_specs=P(axis_name, None),
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_attention(q, k, v, axis_name)
+
+    return attn
