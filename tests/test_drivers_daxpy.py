@@ -16,6 +16,21 @@ def test_mpi_daxpy(capsys):
     assert all(float(v) == 1024 * 1025 / 2 for _, v in sums)
 
 
+def test_mpi_daxpy_oversubscription(capsys):
+    """32 logical ranks over 8 devices (≅ ranks_per_device > 1,
+    mpi_daxpy.cc:49-51)."""
+    rc = mpi_daxpy.main(
+        ["--n-total", "131072", "--ranks", "32", "--dtype", "float64"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "32 logical ranks over 8 devices (4 ranks/device)" in out
+    sums = re.findall(r"(\d+)/32 SUM = ([\d.]+)", out)
+    assert len(sums) == 32
+    n = 131072 // 32
+    assert all(float(v) == n * (n + 1) / 2 for _, v in sums)
+
+
 def test_mpi_daxpy_nvtx_full_phase_structure(capsys):
     rc = mpi_daxpy_nvtx.main(
         ["--n-per-node", "65536", "--dtype", "float64", "--barrier"]

@@ -34,7 +34,9 @@ def run(args) -> int:
         make_mesh,
         topology,
     )
-    from tpu_mpi_tests.arrays.spaces import Space, meminfo, place
+    from tpu_mpi_tests.arrays.spaces import Space, ensure_device, meminfo, place
+    from tpu_mpi_tests.comm.mesh import ranks_per_device
+    from tpu_mpi_tests.utils import TpuMtError
     from tpu_mpi_tests.instrument import Reporter
     from tpu_mpi_tests.instrument.timers import block
 
@@ -42,10 +44,23 @@ def run(args) -> int:
     bootstrap()
     topo = topology()
     mesh = make_mesh()
-    world = topo.global_device_count
+    n_dev = topo.global_device_count
+    # oversubscription: logical world may exceed the device count
+    # (≅ ranks_per_device, mpi_daxpy.cc:49-51; each chip carries k logical
+    # ranks inside one program — SURVEY §7 hard part 5)
+    world = args.ranks or n_dev
+    if world < n_dev:
+        raise TpuMtError(
+            f"--ranks {world} < device count {n_dev}: undersubscription is "
+            "not emulated (shards must cover every device)"
+        )
+    k = ranks_per_device(world)
     n = check_divisible(args.n_total, world, "n_total over ranks")
 
     rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+    if k > 1:
+        rep.banner(f"{world} logical ranks over {n_dev} devices "
+                   f"({k} ranks/device)")
 
     # env probe (mpi_daxpy.cc:99-108)
     mb_per_core = os.environ.get("MEMORY_PER_CORE")
@@ -71,12 +86,18 @@ def run(args) -> int:
                         ("m_y", m_y)]:
             rep.line(f"MEMINFO {name}: {meminfo(a)}")
 
-    # kernel runs on the managed pair (mpi_daxpy.cc:140-141)
+    # kernel runs on the managed pair (mpi_daxpy.cc:140-141); managed
+    # arrays migrate to HBM on first device touch (arrays/spaces.py)
+    m_x, m_y = ensure_device(m_x), ensure_device(m_y)
     m_y = block(kd.daxpy(jnp.asarray(args.a, dtype), m_x, m_y))
 
     # per-rank checksums of the managed result (mpi_daxpy.cc:152-156);
     # computed as a collective so multi-host processes can all read them
-    sums = C.per_rank_sums(m_y, mesh).astype(np.float64).reshape(-1)
+    sums = (
+        C.per_rank_sums(m_y, mesh, groups_per_shard=k)
+        .astype(np.float64)
+        .reshape(-1)
+    )
     for r in range(world):
         rep.sum_line(sums[r], rank=r)
 
@@ -99,9 +120,18 @@ def main(argv=None) -> int:
         help="total elements across ranks (split evenly)",
     )
     p.add_argument("--a", type=float, default=2.0)
+    p.add_argument(
+        "--ranks",
+        type=int,
+        default=None,
+        help="logical rank count; > device count emulates oversubscription "
+        "(≅ more MPI ranks than GPUs, mpi_daxpy.cc:49-51)",
+    )
     args = p.parse_args(argv)
     if args.n_total < 1:
         p.error(f"--n-total must be positive, got {args.n_total}")
+    if args.ranks is not None and args.ranks < 1:
+        p.error(f"--ranks must be positive, got {args.ranks}")
     _common.setup_platform(args)
     return run(args)
 
