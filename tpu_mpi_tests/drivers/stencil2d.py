@@ -80,14 +80,26 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
         kind = host_memory_kind()
         if kind is not None:
             sharding = sharding.with_memory_kind(kind)
-    zg = C.shard_blocks(
-        mesh,
-        d.global_ghosted_shape,
-        dtype,
-        lambda r: d.init_shard(f, r, dtype),
-        axis=dim,
-        sharding=sharding,
-    )
+    if args.init == "device":
+        # compute the analytic field on chip; for managed space, land it in
+        # host memory afterwards (the managed twin starts host-resident)
+        zg = C.device_init(
+            mesh,
+            lambda r: d.init_shard_jax(f, r, dtype),
+            axis=dim,
+            sharding=sharding
+            if Space.parse(space) is not Space.DEVICE
+            else None,
+        )
+    else:
+        zg = C.shard_blocks(
+            mesh,
+            d.global_ghosted_shape,
+            dtype,
+            lambda r: d.init_shard(f, r, dtype),
+            axis=dim,
+            sharding=sharding,
+        )
 
     for _ in range(args.n_warmup):
         zg = H.halo_exchange(zg, mesh, axis=dim, staging=staging)
@@ -119,13 +131,18 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
     dz = block(
         H.stencil_fn(mesh, axis_name, dim, 2, d.scale, kernel=args.kernel)(zg)
     )
-    actual = C.shard_blocks(
-        mesh,
-        d.global_interior_shape,
-        dtype,
-        lambda r: d.interior_shard(df, r, np.float64),
-        axis=dim,
-    )
+    if args.init == "device":
+        actual = C.device_init(
+            mesh, lambda r: d.interior_shard_jax(df, r, dtype), axis=dim
+        )
+    else:
+        actual = C.shard_blocks(
+            mesh,
+            d.global_interior_shape,
+            dtype,
+            lambda r: d.interior_shard(df, r, np.float64),
+            axis=dim,
+        )
     per_rank = C.per_rank_err_norms(dz, actual, mesh, axis=dim)
     err_sum = float(per_rank.sum())
     # rank-summed time: every logical rank experiences the same wall clock
@@ -275,13 +292,23 @@ def run(args) -> int:
     )
 
     spaces = ["device"] + (["managed"] if args.managed else [])
+    only = None
+    if args.only:
+        only = {
+            (int(d), int(b))
+            for d, b in (pair.split(":") for pair in args.only.split(","))
+        }
     rc = 0
     with ProfilerGate(args.profile_dir):
         for dim in (0, 1):
             for buf in (True, False):
+                if only is not None and (dim, int(buf)) not in only:
+                    continue
                 for space in spaces:
                     rc |= _deriv_test(args, mesh, topo, rep, dim, space, buf)
         for dim in (0, 1):
+            if only is not None and not any(d == dim for d, _ in only):
+                continue
             for space in spaces:
                 rc |= _sum_test(args, mesh, topo, rep, dim, space)
     return rc
@@ -332,6 +359,22 @@ def main(argv=None) -> int:
         action="store_true",
         help="print per-rank ghost+edge rows after the exchange "
         "(≅ the DEBUG halo dumps, mpi_stencil2d_sycl_oo.cc:636-659)",
+    )
+    p.add_argument(
+        "--init",
+        default="device",
+        choices=["device", "host"],
+        help="compute initial fields on chip (default; host→device "
+        "transfer of multi-GB analytic data is the wrong tool) or on host "
+        "(≅ the reference's host init + H2D copy, mpi_stencil2d_gt.cc:508)",
+    )
+    p.add_argument(
+        "--only",
+        default=None,
+        help="run a subset of the matrix as 'dim:buf' pairs, e.g. "
+        "'0:0,1:0' (the reference edits main() for this; host-staged "
+        "buf:1 configs move whole shards through the host and can be "
+        "impractical at full size over a tunneled controller)",
     )
     p.add_argument(
         "--tol",
