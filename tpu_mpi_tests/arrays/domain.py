@@ -88,6 +88,32 @@ class Domain1D:
             out[-self.n_bnd :] = fn(xg[-self.n_bnd :])
         return out
 
+    def init_shard_jax(self, fn, rank, dtype):
+        """Traceable ghosted-shard init (device-side; ``rank`` may be a
+        traced index) — same layout as :meth:`init_shard`."""
+        import jax.numpy as jnp
+
+        start = jnp.asarray(rank, dtype) * (self.n_local * self.delta)
+        idx = jnp.arange(-self.n_bnd, self.n_local + self.n_bnd, dtype=dtype)
+        x = start + idx * self.delta
+        full = fn(x).astype(dtype)
+        i = jnp.arange(self.n_ghosted)
+        keep = (
+            ((i >= self.n_bnd) & (i < self.n_bnd + self.n_local))
+            | ((i < self.n_bnd) & (rank == 0))
+            | ((i >= self.n_bnd + self.n_local)
+               & (rank == self.n_shards - 1))
+        )
+        return jnp.where(keep, full, jnp.zeros((), dtype))
+
+    def interior_shard_jax(self, fn, rank, dtype):
+        """Traceable unghosted-shard field (device-side err references)."""
+        import jax.numpy as jnp
+
+        start = jnp.asarray(rank, dtype) * (self.n_local * self.delta)
+        idx = jnp.arange(self.n_local, dtype=dtype)
+        return fn(start + idx * self.delta).astype(dtype)
+
     def init_global(self, fn, dtype=np.float64) -> np.ndarray:
         """Ghosted-global concatenation of all shard blocks."""
         return np.concatenate(

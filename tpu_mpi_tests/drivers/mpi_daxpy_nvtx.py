@@ -77,12 +77,35 @@ def run(args) -> int:
 
     with timer.phase("total"):
         # ── allocateArrays / initializeArrays (+ copyInput if unmanaged) ──
-        with trace_range("initializeArrays"), timer.phase("init"):
-            # per-rank pattern (i+1)/n tiled across ranks (:207-217)
-            lx, ly = kd.init_xy_scaled_np(n, dtype)
-            h_x = np.tile(lx, world)
-            h_y = np.tile(ly, world)
-        if managed:
+        if args.init == "device":
+            # on-chip init: every shard computes its own (i+1)/n pattern
+            # (no host staging phases; for tunnel-bound controllers where
+            # H2D of 48Mi/node is slower than the whole benchmark)
+            with trace_range("initializeArrays"), timer.phase("init"):
+                d_x = block(
+                    C.device_init(
+                        mesh,
+                        lambda r: kd.init_xy_scaled_jax(n, dtype)[0],
+                        ndim=1,
+                    )
+                )
+                d_y = block(
+                    C.device_init(
+                        mesh,
+                        lambda r: kd.init_xy_scaled_jax(n, dtype)[1],
+                        ndim=1,
+                    )
+                )
+            h_x = h_y = None
+        else:
+            with trace_range("initializeArrays"), timer.phase("init"):
+                # per-rank pattern (i+1)/n tiled across ranks (:207-217)
+                lx, ly = kd.init_xy_scaled_np(n, dtype)
+                h_x = np.tile(lx, world)
+                h_y = np.tile(ly, world)
+        if args.init == "device":
+            pass
+        elif managed:
             # managed ≈ host-resident, device reads it implicitly (SURVEY
             # §2.3 memory-space row): place sharded into host memory kind
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -137,9 +160,13 @@ def run(args) -> int:
 
         # ── allSum global checksum (:293-310) ──
         with trace_range("allSum"), timer.phase("allSum"):
-            all_sum = float(
-                C.host_value(g_ally).astype(np.float64).sum()
-            )
+            if args.init == "device":
+                # device reduction (the gathered array never moves to host)
+                all_sum = float(jnp.sum(g_ally.astype(jnp.float32)))
+            else:
+                all_sum = float(
+                    C.host_value(g_ally).astype(np.float64).sum()
+                )
         rep.sum_line(all_sum, label="ALLSUM")
 
     gate.stop()
@@ -152,9 +179,19 @@ def run(args) -> int:
     expected_all = world * (n + 1) / 2
     tol = 0 if args.dtype == "float64" else max(1e-5 * abs(expected_all), 1.0)
     ok = abs(all_sum - expected_all) <= tol
-    if not np.array_equal(C.host_value(g_allx), h_x):
-        rep.line("GATHER PARITY FAIL: gathered x != filled buffer")
-        ok = False
+    if h_x is not None:
+        if not np.array_equal(C.host_value(g_allx), h_x):
+            rep.line("GATHER PARITY FAIL: gathered x != filled buffer")
+            ok = False
+    else:
+        # device-init path: in-place-gather parity via the x checksum
+        # (x sums to (n+1)/2 per rank, like y)
+        gx_sum = float(jnp.sum(g_allx.astype(jnp.float32)))
+        if abs(gx_sum - expected_all) > tol:
+            rep.line(
+                f"GATHER PARITY FAIL: x sum {gx_sum} != {expected_all}"
+            )
+            ok = False
     if not ok:
         rep.line(f"CHECKSUM FAIL: ALLSUM {all_sum} != {expected_all}")
         return 1
@@ -180,6 +217,14 @@ def main(argv=None) -> int:
         "--barrier",
         action="store_true",
         help="time an explicit barrier before the gather (≅ -DBARRIER)",
+    )
+    p.add_argument(
+        "--init",
+        default="host",
+        choices=["host", "device"],
+        help="host init + copy (reference phase semantics, the default) or "
+        "on-chip init + device reductions (for tunnel-bound controllers "
+        "at 48Mi+/node scale)",
     )
     args = p.parse_args(argv)
     if args.n_per_node < 1:

@@ -50,8 +50,13 @@ def run(args) -> int:
         f"n_local={d.n_local} dtype={args.dtype} staging={args.staging}"
     )
 
-    zg = C.shard_1d(jnp.asarray(d.init_global(f, dtype)), mesh)
-    zg = block(zg)
+    # shards materialize on their own devices (multi-GB host→device init
+    # transfer is the wrong tool at 32Mi+ scale — see collectives.device_init)
+    zg = block(
+        C.device_init(
+            mesh, lambda r: d.init_shard_jax(f, r, dtype), ndim=1
+        )
+    )
 
     staging = H.Staging.parse(args.staging)
     with ProfilerGate(args.profile_dir):
@@ -72,12 +77,12 @@ def run(args) -> int:
 
         deriv = block(H.stencil_fn(mesh, axis_name, 0, 1, d.scale)(zg))
 
-    # per-rank err norms vs analytic derivative
-    actual = d.interior_global(df, np.float64)
-    numeric = C.host_value(C.all_gather(deriv, mesh)).astype(np.float64)
-    per_rank_err = np.sqrt(
-        ((numeric - actual) ** 2).reshape(world, d.n_local).sum(axis=1)
+    # per-rank err norms vs analytic derivative, computed shard-local on
+    # device (the full global field never moves to host)
+    actual = C.device_init(
+        mesh, lambda r: d.interior_shard_jax(df, r, dtype), ndim=1
     )
+    per_rank_err = C.per_rank_err_norms(deriv, actual, mesh)
     kind = jax.devices()[0].device_kind
     if topo.process_index == 0:
         for r in range(world):
