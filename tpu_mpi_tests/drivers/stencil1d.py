@@ -94,7 +94,12 @@ def run(args) -> int:
     if args.tol is not None:
         tol = args.tol
     elif args.dtype == "float64":
-        tol = 1e-6
+        # rounding error grows with scale·√n like the f32 case (coordinate
+        # ulps amplified by 1/delta); a broken halo exceeds this by >10⁴
+        eps64 = 2.2e-16
+        tol = max(
+            128 * eps64 * d.length**3 * d.scale * np.sqrt(n_global), 1e-6
+        )
     else:
         # f32/bf16: cancellation error ≈ eps·max|y|·scale per point
         # (SURVEY §7 hard part 1); a broken halo exceeds this by >10³
