@@ -122,6 +122,27 @@ def run(args) -> int:
             rep.line(f"MEMINFO d_x: {meminfo(d_x)}")
             rep.line(f"MEMINFO d_y: {meminfo(d_y)}")
 
+        if args.warmup:
+            # compile outside the timed phases: the reference's binaries
+            # carry no JIT cost, so charging trace+compile (~1 s) to
+            # 'kernel'/'gather' would measure the compiler, not the op.
+            # Managed arrays must NOT be touched here (their migration into
+            # the kernel phase is the thing being measured) — warm on
+            # device-created dummies of the same shape.
+            with trace_range("compileWarmup"):
+                if managed:
+                    wx = C.device_init(
+                        mesh, lambda r: jnp.zeros(n, dtype), ndim=1
+                    )
+                    wy = C.device_init(
+                        mesh, lambda r: jnp.zeros(n, dtype), ndim=1
+                    )
+                else:
+                    wx, wy = d_x, d_y
+                block(kd.daxpy(jnp.asarray(args.a, dtype), wx, wy))
+                block(C.all_gather_inplace(jnp.copy(wx), mesh))
+                block(C.all_gather(wy, mesh))
+
         # ── kernel (:242-249) ──
         with trace_range("daxpy"), timer.phase("kernel"):
             # managed arrays migrate to HBM on first device touch (TPU has
@@ -225,6 +246,13 @@ def main(argv=None) -> int:
         help="host init + copy (reference phase semantics, the default) or "
         "on-chip init + device reductions (for tunnel-bound controllers "
         "at 48Mi+/node scale)",
+    )
+    p.add_argument(
+        "--no-warmup",
+        dest="warmup",
+        action="store_false",
+        help="charge XLA trace+compile to the timed phases (raw behavior; "
+        "default warms the compiled fns untimed first)",
     )
     args = p.parse_args(argv)
     if args.n_per_node < 1:
