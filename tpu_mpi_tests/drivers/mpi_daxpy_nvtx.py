@@ -75,6 +75,21 @@ def run(args) -> int:
     gate = ProfilerGate(args.profile_dir)
     gate.start()
 
+    if args.warmup:
+        # compile outside EVERY timed phase (including total): the
+        # reference's binaries carry no JIT cost, so charging trace+compile
+        # (~1 s) to any phase would measure the compiler, not the op.
+        # Device-created dummies of the real shapes/shardings hit the same
+        # compilation cache; the real (possibly managed) arrays are
+        # untouched so their timed first-touch migration is preserved.
+        with trace_range("compileWarmup"):
+            wx = C.device_init(mesh, lambda r: jnp.zeros(n, dtype), ndim=1)
+            wy = C.device_init(mesh, lambda r: jnp.zeros(n, dtype), ndim=1)
+            block(kd.daxpy(jnp.asarray(args.a, dtype), wx, wy))
+            block(C.all_gather_inplace(jnp.copy(wx), mesh))
+            block(C.all_gather(wy, mesh))
+            del wx, wy
+
     with timer.phase("total"):
         # ── allocateArrays / initializeArrays (+ copyInput if unmanaged) ──
         if args.init == "device":
@@ -121,27 +136,6 @@ def run(args) -> int:
         if args.verbose:
             rep.line(f"MEMINFO d_x: {meminfo(d_x)}")
             rep.line(f"MEMINFO d_y: {meminfo(d_y)}")
-
-        if args.warmup:
-            # compile outside the timed phases: the reference's binaries
-            # carry no JIT cost, so charging trace+compile (~1 s) to
-            # 'kernel'/'gather' would measure the compiler, not the op.
-            # Managed arrays must NOT be touched here (their migration into
-            # the kernel phase is the thing being measured) — warm on
-            # device-created dummies of the same shape.
-            with trace_range("compileWarmup"):
-                if managed:
-                    wx = C.device_init(
-                        mesh, lambda r: jnp.zeros(n, dtype), ndim=1
-                    )
-                    wy = C.device_init(
-                        mesh, lambda r: jnp.zeros(n, dtype), ndim=1
-                    )
-                else:
-                    wx, wy = d_x, d_y
-                block(kd.daxpy(jnp.asarray(args.a, dtype), wx, wy))
-                block(C.all_gather_inplace(jnp.copy(wx), mesh))
-                block(C.all_gather(wy, mesh))
 
         # ── kernel (:242-249) ──
         with trace_range("daxpy"), timer.phase("kernel"):
