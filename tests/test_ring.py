@@ -12,8 +12,11 @@ from tpu_mpi_tests.comm import ring as R
 from tpu_mpi_tests.comm.collectives import shard_1d
 
 
-def reference_attention(q, k, v):
+def reference_attention(q, k, v, causal=False):
     s = (q @ k.T) / np.sqrt(q.shape[-1])
+    if causal:
+        L = s.shape[0]
+        s = np.where(np.tril(np.ones((L, L), bool)), s, -np.inf)
     p = np.exp(s - s.max(axis=-1, keepdims=True))
     p /= p.sum(axis=-1, keepdims=True)
     return p @ v
@@ -63,14 +66,18 @@ def test_ring_scan_sums_all_blocks(mesh8):
     assert np.allclose(out, 120.0)  # every rank saw every block
 
 
-def test_ring_attention_matches_full(mesh8):
+import pytest
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(mesh8, causal):
     rng = np.random.default_rng(0)
     L, d = 8 * 16, 32
     q = rng.normal(size=(L, d)).astype(np.float32)
     k = rng.normal(size=(L, d)).astype(np.float32)
     v = rng.normal(size=(L, d)).astype(np.float32)
 
-    attn = R.ring_attention_fn(mesh8, "shard")
+    attn = R.ring_attention_fn(mesh8, "shard", causal=causal)
     got = np.asarray(
         attn(
             shard_1d(jnp.asarray(q), mesh8),
@@ -79,6 +86,10 @@ def test_ring_attention_matches_full(mesh8):
         )
     )
     ref = reference_attention(
-        q.astype(np.float64), k.astype(np.float64), v.astype(np.float64)
+        q.astype(np.float64),
+        k.astype(np.float64),
+        v.astype(np.float64),
+        causal=causal,
     )
+    assert np.isfinite(got).all()
     assert np.allclose(got, ref, atol=2e-5)

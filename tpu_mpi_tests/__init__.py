@@ -6,14 +6,15 @@ MPI_Init + set_rank_device, XLA collectives over ICI in place of CUDA-aware
 MPI, jnp + Pallas kernels in place of cuBLAS/gtensor/SYCL, XProf annotations
 in place of NVTX, and a real pytest suite in place of printf verification.
 
-Layer map (mirrors SURVEY.md §1, top to bottom):
+Layer map (mirrors SURVEY.md §1, top to bottom; tpu/ and native/ live at the
+repo root beside this package):
   tpu/          launch + aggregation            (≅ summit/, jlse/, avg.sh)
   drivers/      benchmark drivers               (≅ the per-binary main()s)
   instrument/   timers, trace ranges, reporting (≅ NVTX + MPI_Wtime)
-  comm/         mesh, collectives, halo         (≅ MPI layer)
-  kernels/      daxpy, stencil, pack, reduce    (≅ cuBLAS/gtensor/SYCL kernels)
+  comm/         mesh, collectives, halo, ring   (≅ MPI layer + seq-parallel)
+  kernels/      daxpy, stencil, pack, pallas    (≅ cuBLAS/gtensor/SYCL kernels)
   arrays/       spaces, domain decomposition    (≅ gtensor spaces + ghost math)
-  runtime/      native C++ support runtime      (≅ cuda_error.h + harness glue)
+  native/       C++ aggregator + timer lib      (≅ avg.sh + clock_gettime)
 """
 
 __version__ = "0.1.0"

@@ -74,6 +74,7 @@ def ring_attention(
     v,
     axis_name: str,
     scale: float | None = None,
+    causal: bool = False,
     precision=lax.Precision.HIGHEST,
 ):
     """Blockwise ring attention for one shard (call inside ``shard_map``).
@@ -97,14 +98,28 @@ def ring_attention(
     l0 = jnp.zeros(q.shape[:-1], q.dtype)
     acc0 = jnp.zeros_like(q)
 
+    lq = q.shape[0]
+    r = lax.axis_index(axis_name)
+
     def step(carry, kv_blk, src):
-        del src  # full (non-causal) attention; causal variants mask by src
         m, l, acc = carry
         k_blk, v_blk = kv_blk
         s = jnp.matmul(q, k_blk.T, precision=precision) * scale
+        if causal:
+            # global positions: query i lives at r·lq + i, key j of the
+            # block from rank `src` at src·lk + j; mask future keys
+            lk = k_blk.shape[0]
+            q_pos = r * lq + jnp.arange(lq)
+            k_pos = src * lk + jnp.arange(lk)
+            s = jnp.where(
+                q_pos[:, None] >= k_pos[None, :], s, -jnp.inf
+            )
         m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        corr = jnp.exp(m - m_new)
+        # all-masked blocks leave m_new at -inf; exp(s - m_safe) is then
+        # exp(-inf) = 0 with no -inf − -inf NaNs
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        corr = jnp.exp(m - m_safe)
         l = l * corr + p.sum(axis=-1)
         acc = acc * corr[:, None] + jnp.matmul(p, v_blk, precision=precision)
         return m_new, l, acc
@@ -114,7 +129,7 @@ def ring_attention(
 
 
 @functools.lru_cache(maxsize=None)
-def ring_attention_fn(mesh: Mesh, axis_name: str):
+def ring_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False):
     """Jitted ring attention over a sequence sharded along ``axis_name``
     (inputs (L_global, d) sharded on axis 0)."""
 
@@ -127,6 +142,6 @@ def ring_attention_fn(mesh: Mesh, axis_name: str):
         check_vma=False,
     )
     def attn(q, k, v):
-        return ring_attention(q, k, v, axis_name)
+        return ring_attention(q, k, v, axis_name, causal=causal)
 
     return attn
