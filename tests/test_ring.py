@@ -70,6 +70,36 @@ import pytest
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(mesh8, causal):
+    """The all-to-all (Ulysses) flavor must agree with full multi-head
+    attention — and with the ring flavor, per head."""
+    from tpu_mpi_tests.comm.alltoall import ulysses_attention_fn
+
+    rng = np.random.default_rng(3)
+    L, H, Dh = 8 * 8, 16, 8
+    q, k, v = (
+        rng.normal(size=(L, H, Dh)).astype(np.float32) for _ in range(3)
+    )
+    attn = ulysses_attention_fn(mesh8, "shard", causal=causal)
+    got = np.asarray(
+        attn(
+            shard_1d(jnp.asarray(q), mesh8),
+            shard_1d(jnp.asarray(k), mesh8),
+            shard_1d(jnp.asarray(v), mesh8),
+        )
+    )
+    assert got.shape == (L, H, Dh)
+    for h in range(H):
+        ref = reference_attention(
+            q[:, h].astype(np.float64),
+            k[:, h].astype(np.float64),
+            v[:, h].astype(np.float64),
+            causal=causal,
+        )
+        assert np.allclose(got[:, h], ref, atol=2e-5), h
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_full(mesh8, causal):
     rng = np.random.default_rng(0)
     L, d = 8 * 16, 32

@@ -1,0 +1,90 @@
+"""All-to-all sequence parallelism (Ulysses-style head/sequence resharding).
+
+The second canonical long-context strategy beside ring attention
+(comm/ring.py): instead of rotating K/V blocks around a ring, one
+``lax.all_to_all`` reshards the activations from sequence-sharded to
+head-sharded — every rank then holds the FULL sequence for its subset of
+heads and runs ordinary attention locally; a second all-to-all reshards
+back. Nothing attention-shaped exists in the reference (SURVEY.md §5.7);
+this provides the capability its communication layer was built to carry,
+using the same mesh-axis machinery as the collectives layer.
+
+Communication: 2 all-to-alls of the activations per call (vs the ring's
+n−1 K/V block rotations) — the classic DeepSpeed-Ulysses trade.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_mpi_tests.utils import check_divisible
+
+
+def seq_to_heads(x, axis_name: str):
+    """Reshard (L_local, H, Dh) sequence-sharded → (L_global, H_local, Dh)
+    head-sharded (call inside ``shard_map``)."""
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                          tiled=True)
+
+
+def heads_to_seq(x, axis_name: str):
+    """Inverse of :func:`seq_to_heads`."""
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+
+
+def _local_attention(q, k, v, causal: bool, precision):
+    """Full attention over (L, H_local, Dh) — heads vectorized."""
+    d = q.shape[-1]
+    s = jnp.einsum("qhd,khd->hqk", q, k, precision=precision) / (d**0.5)
+    if causal:
+        L = s.shape[-1]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask[None, :, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v, precision=precision)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    axis_name: str,
+    causal: bool = False,
+    precision=lax.Precision.HIGHEST,
+):
+    """Per-shard Ulysses attention (call inside ``shard_map``): inputs
+    (L_local, H, Dh) sequence-sharded; H must divide the mesh axis size."""
+    n = lax.axis_size(axis_name)
+    check_divisible(q.shape[1], n, "ulysses heads over mesh axis")
+    qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
+    out = _local_attention(qh, kh, vh, causal, precision)
+    return heads_to_seq(out, axis_name)
+
+
+@functools.lru_cache(maxsize=None)
+def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False):
+    """Jitted Ulysses attention over (L_global, H, Dh) arrays sharded along
+    the sequence (axis 0)."""
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(axis_name, None, None),
+            P(axis_name, None, None),
+            P(axis_name, None, None),
+        ),
+        out_specs=P(axis_name, None, None),
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ulysses_attention(q, k, v, axis_name, causal=causal)
+
+    return attn
