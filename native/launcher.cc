@@ -1,0 +1,83 @@
+// tpumt_run — native multi-process launcher (≅ mpirun/jsrun for this
+// framework's local multi-process mode; the shell twin is
+// tpu/run_local_multiproc.sh).
+//
+// Spawns N copies of a command with the jax.distributed coordination env
+// (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) wired per
+// child, waits for all, and returns nonzero if any child failed — the same
+// contract mpirun gives the reference's launch scripts
+// (/root/reference/jlse/run.sh:29-33).
+//
+// Usage: tpumt_run -n NPROCS [-p PORT] -- command [args...]
+
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+int main(int argc, char** argv) {
+  int nprocs = 0;
+  int port = 0;
+  int cmd_start = -1;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strcmp(argv[i], "-n") == 0 && i + 1 < argc) {
+      nprocs = std::atoi(argv[++i]);
+    } else if (std::strcmp(argv[i], "-p") == 0 && i + 1 < argc) {
+      port = std::atoi(argv[++i]);
+    } else if (std::strcmp(argv[i], "--") == 0) {
+      cmd_start = i + 1;
+      break;
+    } else {
+      std::fprintf(stderr, "tpumt_run: unknown arg %s\n", argv[i]);
+      return 2;
+    }
+  }
+  if (nprocs < 1 || cmd_start < 0 || cmd_start >= argc) {
+    std::fprintf(stderr,
+                 "usage: tpumt_run -n NPROCS [-p PORT] -- command [args...]\n");
+    return 2;
+  }
+  if (port == 0) {
+    port = 10000 + static_cast<int>(getpid() % 20000);
+  }
+  std::string coord = "localhost:" + std::to_string(port);
+
+  std::vector<pid_t> pids;
+  for (int rank = 0; rank < nprocs; ++rank) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      std::perror("tpumt_run: fork");
+      return 1;
+    }
+    if (pid == 0) {
+      setenv("JAX_COORDINATOR_ADDRESS", coord.c_str(), 1);
+      setenv("JAX_NUM_PROCESSES", std::to_string(nprocs).c_str(), 1);
+      setenv("JAX_PROCESS_ID", std::to_string(rank).c_str(), 1);
+      execvp(argv[cmd_start], &argv[cmd_start]);
+      std::perror("tpumt_run: execvp");
+      _exit(127);
+    }
+    pids.push_back(pid);
+  }
+
+  int rc = 0;
+  for (pid_t pid : pids) {
+    int status = 0;
+    if (waitpid(pid, &status, 0) < 0) {
+      std::perror("tpumt_run: waitpid");
+      rc = 1;
+    } else if (WIFEXITED(status) && WEXITSTATUS(status) != 0) {
+      rc = WEXITSTATUS(status);
+    } else if (WIFSIGNALED(status)) {
+      std::fprintf(stderr, "tpumt_run: child %d killed by signal %d\n",
+                   static_cast<int>(pid), WTERMSIG(status));
+      rc = 128 + WTERMSIG(status);
+    }
+  }
+  return rc;
+}

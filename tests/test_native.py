@@ -62,6 +62,43 @@ def test_native_aggregator_matches_python(outfiles):
     assert "out-a.txt 2 min=1.5 max=2.5 n=2" in r_native.stdout
 
 
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_launcher_wires_rank_env(tmp_path):
+    subprocess.run(
+        ["make", "-C", str(REPO / "native"), "tpumt_run"],
+        capture_output=True,
+        check=True,
+        timeout=120,
+    )
+    r = subprocess.run(
+        [
+            str(REPO / "native" / "tpumt_run"),
+            "-n", "3", "--",
+            "sh", "-c",
+            'echo "rank=$JAX_PROCESS_ID of $JAX_NUM_PROCESSES '
+            'coord=$JAX_COORDINATOR_ADDRESS"',
+        ],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    for rank in range(3):
+        assert f"rank={rank} of 3" in r.stdout
+    assert "coord=localhost:" in r.stdout
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_launcher_propagates_failure():
+    r = subprocess.run(
+        [str(REPO / "native" / "tpumt_run"), "-n", "2", "--",
+         "sh", "-c", 'exit "$JAX_PROCESS_ID"'],
+        capture_output=True,
+        timeout=60,
+    )
+    assert r.returncode == 1  # rank 1's nonzero exit surfaces
+
+
 def test_native_time_monotonic_and_slots():
     from tpu_mpi_tests.instrument import native_time as NT
 

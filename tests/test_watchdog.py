@@ -1,0 +1,45 @@
+"""Watchdog (hang failure-detection) tests."""
+
+import threading
+import time
+
+from tpu_mpi_tests.instrument.watchdog import Watchdog, deadline
+
+
+def test_deadline_noop_when_disabled():
+    with deadline(None):
+        pass
+    with deadline(0):
+        pass
+
+
+def test_deadline_completes_in_time():
+    with deadline(30, "fast-phase"):
+        time.sleep(0.01)
+    # completing cancels the timer; nothing fires afterwards
+    time.sleep(0.05)
+
+
+def test_watchdog_fires_on_timeout():
+    fired = threading.Event()
+    msgs = []
+
+    def on_timeout(msg):
+        msgs.append(msg)
+        fired.set()
+
+    wd = Watchdog(0.05, "hung-allgather", _on_timeout=on_timeout).start()
+    assert fired.wait(timeout=5.0)
+    wd.cancel()
+    assert "hung-allgather" in msgs[0]
+    assert "hung collective" in msgs[0]
+
+
+def test_watchdog_cancel_prevents_firing():
+    fired = threading.Event()
+    wd = Watchdog(
+        0.05, "p", _on_timeout=lambda m: fired.set()
+    ).start()
+    wd.cancel()
+    time.sleep(0.15)
+    assert not fired.is_set()

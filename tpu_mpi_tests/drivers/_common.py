@@ -43,7 +43,24 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         help="abort on NaN production (the framework's sanitizer axis, "
         "SURVEY §5.2 — ≅ the correctness-by-construction DEBUG builds)",
     )
+    p.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="S",
+        help="hang watchdog: hard-exit if the driver exceeds S seconds "
+        "(detects hung collectives from dead peers; ≅ the scheduler "
+        "walltime the reference relied on, made first-class)",
+    )
     return p
+
+
+def run_guarded(run, args) -> int:
+    """Run a driver body under the optional hang watchdog."""
+    from tpu_mpi_tests.instrument.watchdog import deadline
+
+    with deadline(args.deadline, "driver"):
+        return run(args)
 
 
 def force_cpu_devices(n: int) -> None:

@@ -74,7 +74,7 @@ def main(argv=None) -> int:
     if args.n < 1:
         p.error(f"--n must be positive, got {args.n}")
     _common.setup_platform(args)
-    return run(args)
+    return _common.run_guarded(run, args)
 
 
 if __name__ == "__main__":

@@ -53,7 +53,7 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
     _common.setup_platform(args)
-    return run(args)
+    return _common.run_guarded(run, args)
 
 
 if __name__ == "__main__":

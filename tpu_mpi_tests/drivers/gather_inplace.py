@@ -71,7 +71,7 @@ def main(argv=None) -> int:
     if args.n_per_rank < 1:
         p.error(f"--n-per-rank must be positive, got {args.n_per_rank}")
     _common.setup_platform(args)
-    return run(args)
+    return _common.run_guarded(run, args)
 
 
 if __name__ == "__main__":

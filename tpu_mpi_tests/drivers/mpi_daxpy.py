@@ -133,7 +133,7 @@ def main(argv=None) -> int:
     if args.ranks is not None and args.ranks < 1:
         p.error(f"--ranks must be positive, got {args.ranks}")
     _common.setup_platform(args)
-    return run(args)
+    return _common.run_guarded(run, args)
 
 
 if __name__ == "__main__":

@@ -252,7 +252,7 @@ def main(argv=None) -> int:
     if args.n_per_node < 1:
         p.error(f"--n-per-node must be positive, got {args.n_per_node}")
     _common.setup_platform(args)
-    return run(args)
+    return _common.run_guarded(run, args)
 
 
 if __name__ == "__main__":

@@ -147,7 +147,7 @@ def main(argv=None) -> int:
     if args.n_global < 1:
         p.error(f"global size must be positive, got {args.n_global}")
     _common.setup_platform(args)
-    return run(args)
+    return _common.run_guarded(run, args)
 
 
 if __name__ == "__main__":

@@ -389,7 +389,7 @@ def main(argv=None) -> int:
     if args.n_local < 5:
         p.error("--n-local must be >= 5 (stencil width)")
     _common.setup_platform(args)
-    return run(args)
+    return _common.run_guarded(run, args)
 
 
 if __name__ == "__main__":

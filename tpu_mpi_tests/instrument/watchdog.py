@@ -1,0 +1,70 @@
+"""Hang watchdog: deadline-based failure detection for distributed phases.
+
+The reference is fail-fast on *errors* (CHECK aborts,
+``cuda_error.h:29-41``) but has nothing for *hangs* — a peer dying mid
+``MPI_Allgather`` stalls every rank forever, and only the batch scheduler's
+walltime kills the job. Distributed XLA collectives hang the same way when
+a process drops out, so the framework provides the missing piece: a
+deadline that dumps a diagnosis and hard-exits the process, turning a
+silent multi-hour stall into an immediate, attributable failure
+(SURVEY.md §5.3 — elastic recovery stays out of scope; detection is in).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from contextlib import contextmanager
+
+
+class Watchdog:
+    """Arms a timer around a named phase; if the phase does not complete in
+    time, prints a diagnosis to stderr and hard-exits (``os._exit``) so a
+    hung collective cannot keep the process alive."""
+
+    def __init__(self, seconds: float, phase: str = "phase",
+                 exit_code: int = 9, _on_timeout=None):
+        self.seconds = seconds
+        self.phase = phase
+        self.exit_code = exit_code
+        self._on_timeout = _on_timeout  # test hook
+        self._timer: threading.Timer | None = None
+
+    def _fire(self):
+        msg = (
+            f"WATCHDOG: phase '{self.phase}' exceeded {self.seconds}s — "
+            f"likely a hung collective (dead peer / mismatched mesh); "
+            f"aborting pid {os.getpid()}\n"
+        )
+        if self._on_timeout is not None:
+            self._on_timeout(msg)
+            return
+        sys.stderr.write(msg)
+        sys.stderr.flush()
+        os._exit(self.exit_code)
+
+    def start(self):
+        self._timer = threading.Timer(self.seconds, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def cancel(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+
+@contextmanager
+def deadline(seconds: float | None, phase: str = "phase"):
+    """``with deadline(120, "allgather"): ...`` — no-op when ``seconds`` is
+    None/0 so drivers can thread an optional ``--deadline`` flag through."""
+    if not seconds:
+        yield
+        return
+    wd = Watchdog(seconds, phase).start()
+    try:
+        yield
+    finally:
+        wd.cancel()
