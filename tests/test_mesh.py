@@ -63,3 +63,12 @@ def test_device_report_smoke():
     s = M.device_report(verbose=True)
     assert "0/1 processes" in s
     assert "8 global" in s
+
+
+def test_make_mesh_2level():
+    from tpu_mpi_tests.comm.mesh import make_mesh_2level
+
+    m = make_mesh_2level()
+    assert m.axis_names == ("dcn", "ici")
+    # single-process test env: dcn=1, ici=all fake devices
+    assert dict(m.shape) == {"dcn": 1, "ici": 8}

@@ -193,6 +193,25 @@ def make_mesh(
     return Mesh(dev_array, axis_names=tuple(names))
 
 
+def make_mesh_2level(
+    ici_name: str = "ici", dcn_name: str = "dcn"
+) -> Mesh:
+    """Two-level mesh mapping the physical topology: the outer axis spans
+    processes (DCN / cross-host — ≅ the node axis from
+    ``MPI_Comm_split_type``, ``mpi_daxpy_nvtx.cc:72-82``) and the inner
+    axis spans each process's local devices (ICI). Collectives over
+    ``ici_name`` stay on-chip-interconnect; over ``dcn_name`` they cross
+    hosts — the layout rule that keeps bandwidth-hungry axes on ICI.
+    """
+    topo = topology()
+    # group devices by owning process so the outer axis is really DCN
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    return make_mesh(
+        {dcn_name: topo.process_count, ici_name: topo.local_device_count},
+        devices=devs,
+    )
+
+
 def ranks_per_device(world_size: int | None = None) -> int:
     """Oversubscription factor (reference ``ranks_per_device``,
     ``mpi_daxpy.cc:49-51``).
