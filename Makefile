@@ -1,0 +1,22 @@
+# Dev workflow (≅ the reference's root Makefile role).
+.PHONY: test native bench smoke clean
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py
+
+# CI-sized bench + entry-point checks on a 4-device CPU mesh
+smoke:
+	TPU_MPI_BENCH_N=128 TPU_MPI_BENCH_ITERS_SHORT=50 \
+	TPU_MPI_BENCH_ITERS_LONG=1050 TPU_MPI_BENCH_FAKE_DEVICES=4 \
+	python bench.py
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .pytest_cache tpu_mpi_tests/__pycache__
