@@ -16,7 +16,7 @@ def test_stencil1d_exact_f64(capsys):
 
 
 def test_stencil1d_all_stagings(capsys):
-    for staging in ("direct", "device", "host"):
+    for staging in ("direct", "device", "host", "pallas"):
         rc = stencil1d.main(
             ["--n-global", "4096", "--dtype", "float64", "--staging", staging]
         )

@@ -343,6 +343,18 @@ def ring_halo_pallas(
     ≅ the manual staged CUDA-aware-MPI path). Call *inside* ``shard_map``
     over ``axis_name``; ghost regions along ``axis`` are filled from ring
     neighbors, physical ghosts kept on non-periodic edges."""
+    if z.ndim == 1:
+        # 1-D ring (stencil1d): run as an (n, 1) column
+        out = ring_halo_pallas(
+            z.reshape(-1, 1),
+            axis_name=axis_name,
+            axis=0,
+            n_bnd=n_bnd,
+            periodic=periodic,
+            collective_id=collective_id,
+            interpret=interpret,
+        )
+        return out.reshape(-1)
     if axis == 0:
         comm_shape = (2, n_bnd, z.shape[1])
     else:
