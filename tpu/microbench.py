@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Microbenchmark runner: reproduces every measured row in BASELINE.md.
+
+Usage (from /root/repo):
+    python tpu/microbench.py [daxpy] [stencil] [iterate] [ceiling]
+
+Runs the selected groups (default: all) on whatever backend is active and
+prints one JSON line per measurement plus a summary table. Timing uses the
+sync-honest discipline of ``instrument/timers``: device-side chained loops
+with difference timing (``iterate``), or large-N dispatch differencing
+(``dispatch_rate``) for ops that cannot chain.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+
+def _emit(results, metric, value, unit, detail=""):
+    rec = {"metric": metric, "value": round(value, 3), "unit": unit}
+    if detail:
+        rec["detail"] = detail
+    print(json.dumps(rec), flush=True)
+    results.append(rec)
+
+
+def bench_daxpy(results):
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.instrument.timers import dispatch_rate
+    from tpu_mpi_tests.kernels import pallas_kernels as PK
+    from tpu_mpi_tests.kernels.daxpy import daxpy, init_xy
+
+    for logn in (24, 26):
+        n = 1 << logn
+        x, y = init_xy(n, jnp.float32)
+        gb = 3 * 4 * n / 1e9
+        t = dispatch_rate(
+            lambda a, b: daxpy(2.0, a, b), x, y, n_iter=1000, n_base=100
+        )
+        _emit(results, f"daxpy_xla_2^{logn}_gbps", gb / t, "GB/s")
+        t = dispatch_rate(
+            lambda a, b: PK.daxpy_pallas(2.0, a, b), x, y,
+            n_iter=1000, n_base=100,
+        )
+        _emit(results, f"daxpy_pallas_2^{logn}_gbps", gb / t, "GB/s")
+
+
+def bench_stencil(results):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.instrument.timers import dispatch_rate
+    from tpu_mpi_tests.kernels import pallas_kernels as PK
+    from tpu_mpi_tests.kernels.stencil import stencil2d_1d_5_jit
+
+    z = jnp.asarray(
+        np.random.default_rng(2)
+        .normal(size=(1028, 8192))
+        .astype(np.float32)
+    )
+    for dim in (0, 1):
+        out_elts = (1024 * 8192) if dim == 0 else (1028 * 8188)
+        gb = out_elts * 4 * 2 / 1e9  # 2-pass model
+        t = dispatch_rate(
+            lambda a: stencil2d_1d_5_jit(a, 3.0, dim=dim), z,
+            n_iter=500, n_base=50,
+        )
+        _emit(results, f"stencil_xla_d{dim}_eff_gbps", gb / t, "GB/s",
+              "1028x8192 f32, 2-pass traffic model")
+        t = dispatch_rate(
+            lambda a: PK.stencil2d_pallas(a, 3.0, dim=dim, tile=512), z,
+            n_iter=500, n_base=50,
+        )
+        _emit(results, f"stencil_pallas_d{dim}_eff_gbps", gb / t, "GB/s",
+              "1028x8192 f32, 2-pass traffic model")
+
+
+def bench_iterate(results):
+    import jax
+    import numpy as np
+
+    from tpu_mpi_tests.arrays.domain import Domain2D
+    from tpu_mpi_tests.comm.collectives import device_init
+    from tpu_mpi_tests.comm.halo import iterate_pallas_fn
+    from tpu_mpi_tests.comm.mesh import make_mesh, topology
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.kernels.stencil import analytic_pairs
+
+    n = 8192
+    topo = topology()
+    world = topo.global_device_count
+    if n % world:
+        return
+    mesh = make_mesh()
+    d = Domain2D(
+        n_local_deriv=n // world, n_global_other=n, n_shards=world, dim=1
+    )
+    f, _ = analytic_pairs()["2d_dim1"]
+
+    for dtype, bits in (("float32", 4), ("bfloat16", 2)):
+        import jax.numpy as jnp
+
+        dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
+        zg = device_init(
+            mesh, lambda r: d.init_shard_jax(f, r, dt), axis=1
+        )
+        run = iterate_pallas_fn(mesh, mesh.axis_names[0], d.n_bnd, 1e-6)
+        zg = block(run(zg, 3))
+        t0 = time.perf_counter()
+        zg = block(run(zg, 100))
+        t_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        zg = block(run(zg, 1100))
+        t_l = time.perf_counter() - t0
+        per = (t_l - t_s) / 1000
+        _emit(results, f"iterate_{dtype}_iters_per_s", 1 / per, "iter/s",
+              f"{n}x{n}, {n * n * bits * 2 / per / 1e9:.0f} GB/s")
+
+
+def bench_ceiling(results):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.instrument.timers import dispatch_rate
+
+    z = jnp.asarray(
+        np.random.default_rng(0)
+        .normal(size=(8192, 8192))
+        .astype(np.float32)
+    )
+    f = jax.jit(lambda a: a * 2.0 + a)
+    t = dispatch_rate(f, z, n_iter=500, n_base=50)
+    _emit(results, "hbm_ceiling_probe_gbps",
+          8192 * 8192 * 4 * 2 / t / 1e9, "GB/s",
+          "fused 2-op elementwise, 8192^2 f32")
+
+
+GROUPS = {
+    "daxpy": bench_daxpy,
+    "stencil": bench_stencil,
+    "iterate": bench_iterate,
+    "ceiling": bench_ceiling,
+}
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:]) or list(GROUPS)
+    unknown = [a for a in args if a not in GROUPS]
+    if unknown:
+        print(f"unknown groups {unknown}; valid: {list(GROUPS)}",
+              file=sys.stderr)
+        return 2
+    results = []
+    for g in args:
+        GROUPS[g](results)
+    width = max(len(r["metric"]) for r in results) if results else 0
+    print("-" * (width + 20))
+    for r in results:
+        print(f"{r['metric']:<{width}}  {r['value']:>10} {r['unit']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
