@@ -54,6 +54,19 @@ def test_mpi_daxpy_nvtx_managed_space(capsys):
     assert "ALLSUM" in out
 
 
+def test_mpi_daxpy_nvtx_device_init_f64(capsys):
+    # --init device + --dtype float64 accumulates checksums in f64 on chip
+    # (regression: f32 accumulation spuriously failed the tol gate)
+    rc = mpi_daxpy_nvtx.main(
+        ["--n-per-node", "65536", "--dtype", "float64", "--init", "device"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    n = 65536 // 8
+    assert f"ALLSUM = {8 * (n + 1) / 2:f}" in out
+    assert "FAIL" not in out
+
+
 def test_mpi_daxpy_nvtx_f32_tolerance(capsys):
     # float32 path: checksum gate uses tolerance, must still pass
     rc = mpi_daxpy_nvtx.main(["--n-per-node", "65536", "--dtype", "float32"])

@@ -51,7 +51,8 @@ def test_bench_prints_one_json_line_smoke():
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [l for l in r.stdout.splitlines() if l.strip()]
     rec = json.loads(lines[-1])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "vs_f64_reference_roofline"}
     assert rec["value"] > 0
 
 

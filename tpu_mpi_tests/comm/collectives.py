@@ -32,6 +32,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from tpu_mpi_tests.utils import TpuMtError, check_divisible
+
 
 def shard_1d(arr, mesh: Mesh, axis_name: str | None = None, axis: int = 0):
     """Place a global array sharded along ``axis`` over ``axis_name``
@@ -70,9 +72,24 @@ def shard_blocks(
         spec[axis] = axis_name
         sharding = NamedSharding(mesh, P(*spec))
     n_shards = mesh.shape[axis_name]
-    block_len = global_shape[axis] // n_shards
+    # fail fast, like the reference's early divisibility exits
+    # (mpi_stencil_gt.cc:141-145): a floor-divided block_len would silently
+    # misattribute ranks and mis-assemble the array
+    block_len = check_divisible(
+        global_shape[axis], n_shards, f"shard_blocks axis {axis}"
+    )
 
     def cb(index):
+        for d, sl in enumerate(index):
+            if d == axis:
+                continue
+            full = (sl.start or 0) == 0 and sl.stop in (None, global_shape[d])
+            if not full:
+                raise TpuMtError(
+                    "shard_blocks: sharding partitions dim "
+                    f"{d} but only the block axis {axis} may be decomposed "
+                    "(rank inference would be wrong)"
+                )
         start = index[axis].start or 0
         return np.asarray(block_fn(start // block_len), dtype=dtype)
 
@@ -297,14 +314,23 @@ def reduce_sum(values) -> float:
     ``values`` are this process's host-side partial scalars (e.g. per-logical-
     rank iteration times). Single-process: a plain sum. Multi-process: summed
     across processes via a device collective; every process returns the same
-    total (rank 0 is simply the one that prints)."""
-    total = float(np.sum(values))
+    total (rank 0 is simply the one that prints).
+
+    Full float64 end to end (the reference reduces times/errors as
+    ``MPI_DOUBLE``): the cross-process hop ships the raw 8-byte pattern as
+    two uint32 lanes — allgather moves bits, no arithmetic — so precision
+    survives even when ``jax_enable_x64`` is off (where a float64 device
+    array would silently downcast to f32)."""
+    total = float(np.sum(np.asarray(values, dtype=np.float64)))
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        total = float(
-            np.sum(multihost_utils.process_allgather(jnp.float32(total)))
-        )
+        bits = np.frombuffer(np.float64(total).tobytes(), np.uint32)
+        gathered = multihost_utils.process_allgather(jnp.asarray(bits))
+        vals = np.ascontiguousarray(
+            np.asarray(gathered, np.uint32).reshape(-1, 2)
+        ).view(np.float64)
+        total = float(np.sum(vals))
     return total
 
 
