@@ -165,8 +165,8 @@ def _exchange_pallas_fn(
         check_vma=False,
     )
     def exchange(z):
-        if mesh.shape[axis_name] == 1 and not periodic:
-            return z  # nothing to exchange; physical ghosts stand
+        # world=1 non-periodic still launches the kernel (no sends fire;
+        # ghosts ride the aliases) so single-chip runs exercise the real path
         return ring_halo_pallas(
             z,
             axis_name=axis_name,

@@ -126,6 +126,8 @@ class PhaseTimer:
     def __init__(self, skip_first: int = 0):
         self.seconds: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.mins: dict[str, float] = {}
+        self.maxs: dict[str, float] = {}
         self._entries: dict[str, int] = defaultdict(int)
         self.skip_first = skip_first
 
@@ -144,6 +146,8 @@ class PhaseTimer:
         if self._entries[name] > self.skip_first:
             self.seconds[name] += dt
             self.counts[name] += 1
+            self.mins[name] = min(self.mins.get(name, dt), dt)
+            self.maxs[name] = max(self.maxs.get(name, dt), dt)
 
     def timed(self, name: str, fn, *args, **kwargs):
         """Run ``fn`` and block on its result inside the phase bracket."""

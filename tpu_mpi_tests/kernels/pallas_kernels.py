@@ -249,31 +249,44 @@ def stencil2d_iterate_pallas(
 # ---------------------------------------------------------------------------
 
 
-def _ring_halo_kernel(z_ref, out_ref, comm, send_sem, recv_sem,
-                      *, axis_name, axis, n_bnd, periodic, use_barrier):
-    """Bidirectional neighbor exchange with explicit remote DMA
-    (≅ the ``MPI_Irecv``/``Isend``/``Waitall`` body of ``boundary_exchange``,
-    ``mpi_stencil_gt.cc:96-121``: post both directions, overlap, wait, then
-    write ghosts).
+def _ring_edge_kernel(cur_lo_ref, cur_hi_ref, lo_edge_ref, hi_edge_ref,
+                      new_lo_ref, new_hi_ref, send_sem, recv_sem,
+                      *, axis_name, periodic, use_barrier, symmetric):
+    """Pure-communication ring kernel: bidirectional neighbor exchange of
+    edge blocks with explicit remote DMA (≅ the ``MPI_Irecv``/``Isend``/
+    ``Waitall`` body of ``boundary_exchange``, ``mpi_stencil_gt.cc:96-121``:
+    post both directions, overlap, wait).
 
-    Symmetric form: every device sends both directions on the ring
-    (including the wrap-around pair), then non-periodic edge ranks simply
-    keep their original physical ghosts — identical masking to the XLA
-    ``ppermute`` path, and no conditional semaphore accounting to deadlock.
-    comm slot 0 ← left neighbor's hi edge; slot 1 ← right neighbor's lo
-    edge.
+    Operands are the small edge/ghost arrays only — the shard itself never
+    enters the kernel (Mosaic DMA slices must be tile-aligned, which
+    ``n_bnd``-wide rows/columns of a ghosted shard never are, so the
+    alignment-free XLA slice/update does the pack/unpack while this kernel
+    owns the wire). Full-ref DMA of whole operands needs no slicing, so any
+    shape/dtype works and traffic is 2·n_bnd·extent per call, independent
+    of shard size.
+
+    ``new_lo``/``new_hi`` are ALIASED to ``cur_lo``/``cur_hi`` (the current
+    ghost contents): ranks that receive nothing — non-periodic ring edges,
+    ≅ the reference's ``rank > 0`` / ``rank < world-1`` guards
+    (``mpi_stencil_gt.cc:96-107``) — hand back their physical ghosts
+    untouched, so the caller writes results back unconditionally.
+
+    ``symmetric=True`` (interpret mode) sends unconditionally, wrap-around
+    included: the interpreter emulates remote DMA with XLA collectives, so a
+    conditional send is a conditional collective — a rendezvous deadlock
+    when edge ranks skip it. The wrapper restores physical ghosts after.
     """
+    del cur_lo_ref, cur_hi_ref  # alias donors; their data is already in new_*
     n_dev = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     # idx is int32; keep the modulus int32 too (x64 would promote the int)
     right = jax.lax.rem(idx + 1, jnp.int32(n_dev))
     left = jax.lax.rem(idx - 1 + jnp.int32(n_dev), jnp.int32(n_dev))
-    size = z_ref.shape[axis]
 
     if use_barrier:
         # neighborhood barrier: both neighbors have entered this call, so
-        # their comm scratch is live and last call's reads are done (guide
-        # pattern; protects chained iterations). Hardware only — the
+        # their output buffers are live and last call's reads are done
+        # (guide pattern; protects chained iterations). Hardware only — the
         # interpreter serializes devices, so the hazard cannot occur there,
         # and remote signals are unimplemented in interpret mode.
         barrier = pltpu.get_barrier_semaphore()
@@ -283,49 +296,60 @@ def _ring_halo_kernel(z_ref, out_ref, comm, send_sem, recv_sem,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
-    def edge(lo, hi):
-        if axis == 0:
-            return z_ref.at[pl.ds(lo, hi - lo), :]
-        return z_ref.at[:, pl.ds(lo, hi - lo)]
-
-    # my hi edge travels right into their slot 0 ("from_left")
+    # my hi interior edge → right neighbor's lo ghost (slot 0)
     rdma_hi = pltpu.make_async_remote_copy(
-        src_ref=edge(size - 2 * n_bnd, size - n_bnd),
-        dst_ref=comm.at[0],
+        src_ref=hi_edge_ref,
+        dst_ref=new_lo_ref,
         send_sem=send_sem.at[0],
         recv_sem=recv_sem.at[0],
         device_id=right,
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
-    # my lo edge travels left into their slot 1 ("from_right")
+    # my lo interior edge → left neighbor's hi ghost (slot 1)
     rdma_lo = pltpu.make_async_remote_copy(
-        src_ref=edge(n_bnd, 2 * n_bnd),
-        dst_ref=comm.at[1],
+        src_ref=lo_edge_ref,
+        dst_ref=new_hi_ref,
         send_sem=send_sem.at[1],
         recv_sem=recv_sem.at[1],
         device_id=left,
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
-    rdma_hi.start()
-    rdma_lo.start()
-    rdma_hi.wait()
-    rdma_lo.wait()
+    if symmetric:
+        rdma_hi.start()
+        rdma_lo.start()
+        rdma_hi.wait()
+        rdma_lo.wait()
+        return
 
-    out_ref[:] = z_ref[:]
+    # send-right pair: I send iff I have a right neighbor; the matching
+    # arrival (into my lo ghost) happens iff I have a left neighbor
+    send_hi_ok = jnp.logical_or(bool(periodic), idx < n_dev - 1)
+    send_lo_ok = jnp.logical_or(bool(periodic), idx > 0)
 
-    @pl.when(jnp.logical_or(bool(periodic), idx > 0))
+    @pl.when(send_hi_ok)
     def _():
-        if axis == 0:
-            out_ref[pl.ds(0, n_bnd), :] = comm[0]
-        else:
-            out_ref[:, pl.ds(0, n_bnd)] = comm[0]
+        rdma_hi.start()
 
-    @pl.when(jnp.logical_or(bool(periodic), idx < n_dev - 1))
+    @pl.when(send_lo_ok)
     def _():
-        if axis == 0:
-            out_ref[pl.ds(size - n_bnd, n_bnd), :] = comm[1]
-        else:
-            out_ref[:, pl.ds(size - n_bnd, n_bnd)] = comm[1]
+        rdma_lo.start()
+
+    @pl.when(send_hi_ok)
+    def _():
+        rdma_hi.wait_send()
+
+    @pl.when(send_lo_ok)
+    def _():
+        rdma_lo.wait_send()
+
+    # recv waits mirror the neighbor's send predicates exactly
+    @pl.when(send_lo_ok)
+    def _():
+        rdma_hi.wait_recv()  # left's hi edge landed in my lo ghost
+
+    @pl.when(send_hi_ok)
+    def _():
+        rdma_lo.wait_recv()  # right's lo edge landed in my hi ghost
 
 
 def ring_halo_pallas(
@@ -340,9 +364,17 @@ def ring_halo_pallas(
 ):
     """Per-shard halo exchange with explicit inter-chip RDMA — the
     hand-tuned analog of ``exchange_shard``'s ``ppermute`` (SURVEY.md §5.8:
-    ≅ the manual staged CUDA-aware-MPI path). Call *inside* ``shard_map``
+    ≅ the manual CUDA-aware-MPI path). Call *inside* ``shard_map``
     over ``axis_name``; ghost regions along ``axis`` are filled from ring
-    neighbors, physical ghosts kept on non-periodic edges."""
+    neighbors, physical ghosts kept on non-periodic edges.
+
+    The shard never enters the kernel: XLA slices the two ``n_bnd``-wide
+    interior edges (edge-proportional traffic), the pallas kernel moves them
+    over ICI with explicit remote DMA, and XLA splices the received blocks
+    into the ghost regions. Works at reference scale (1028×512Ki ≈ 2.1 GB
+    shards) where a whole-shard VMEM formulation cannot, and at any
+    alignment — Mosaic tile-alignment rules apply only to sliced DMA, and
+    this kernel only ever DMAs full refs."""
     if z.ndim == 1:
         # 1-D ring (stencil1d): run as an (n, 1) column
         out = ring_halo_pallas(
@@ -355,33 +387,50 @@ def ring_halo_pallas(
             interpret=interpret,
         )
         return out.reshape(-1)
-    if axis == 0:
-        comm_shape = (2, n_bnd, z.shape[1])
-    else:
-        comm_shape = (2, z.shape[0], n_bnd)
     interp = _auto_interpret(interpret)
-    return pl.pallas_call(
+    size = z.shape[axis]
+    cur_lo = jax.lax.slice_in_dim(z, 0, n_bnd, axis=axis)
+    cur_hi = jax.lax.slice_in_dim(z, size - n_bnd, size, axis=axis)
+    lo_edge = jax.lax.slice_in_dim(z, n_bnd, 2 * n_bnd, axis=axis)
+    hi_edge = jax.lax.slice_in_dim(
+        z, size - 2 * n_bnd, size - n_bnd, axis=axis
+    )
+    edge_struct = jax.ShapeDtypeStruct(cur_lo.shape, z.dtype)
+    new_lo, new_hi = pl.pallas_call(
         functools.partial(
-            _ring_halo_kernel,
+            _ring_edge_kernel,
             axis_name=axis_name,
-            axis=axis,
-            n_bnd=n_bnd,
             periodic=periodic,
             use_barrier=not interp,
+            symmetric=interp,
         ),
-        out_shape=jax.ShapeDtypeStruct(z.shape, z.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=(edge_struct, edge_struct),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
         scratch_shapes=[
-            pltpu.VMEM(comm_shape, z.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
+        input_output_aliases={0: 0, 1: 1},
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interp,
-    )(z)
+    )(cur_lo, cur_hi, lo_edge, hi_edge)
+    if interp and not periodic:
+        # symmetric interpret mode sent the wrap-around pair too; put the
+        # physical ghosts back on the ring-edge ranks
+        n_dev = jax.lax.axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        new_lo = jnp.where(idx == 0, cur_lo, new_lo)
+        new_hi = jnp.where(idx == n_dev - 1, cur_hi, new_hi)
+    out = jax.lax.dynamic_update_slice_in_dim(z, new_lo, 0, axis=axis)
+    return jax.lax.dynamic_update_slice_in_dim(
+        out, new_hi, size - n_bnd, axis=axis
+    )
 
 
 # ---------------------------------------------------------------------------
