@@ -37,6 +37,42 @@ def test_matrix_f32_device_only(capsys):
     assert out.count("TEST dim:") == 4 + 2
 
 
+def test_iter_lines_report_periter_stats(capsys):
+    """Per-iteration accumulation past warmup (≅ mpi_stencil2d_gt.cc:512-526):
+    every TEST line gets an ITER twin with mean/min/max, and min <= mean <=
+    max with mean*n_iter ~ the rank-summed total / world."""
+    rc = stencil2d.main(SMALL + ["--dtype", "float32"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    iters = re.findall(
+        r"ITER dim:(\d), (device|managed)\s*, buf:(\d); exchange "
+        r"mean=([\d.e+-]+), min=([\d.e+-]+), max=([\d.e+-]+)",
+        out,
+    )
+    assert len(iters) == 4
+    for *_, mean, mn, mx in iters:
+        assert float(mn) <= float(mean) <= float(mx)
+        assert float(mn) > 0
+
+
+def test_fused_mode(capsys):
+    """--fused times exchange+stencil as one program (split-vs-fused A/B);
+    err gates must still pass from the fused derivative."""
+    rc = stencil2d.main(SMALL + ["--dtype", "float64", "--fused"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    deriv = re.findall(r"fused=([\d.]+), err=([\d.e+-]+)", out)
+    assert deriv and all(float(e) < 1e-8 for _, e in deriv)
+    assert "ITER dim:0" in out and "fused mean=" in out
+    # the HOST_STAGED config can't fuse and is skipped, not silently dropped
+    assert "SKIP dim:0, device, buf:1" in out
+
+    import pytest
+
+    with pytest.raises(SystemExit):
+        stencil2d.main(SMALL + ["--fused", "--kernel", "pallas"])
+
+
 def test_tight_tol_fails(capsys):
     rc = stencil2d.main(SMALL + ["--dtype", "float32", "--tol", "1e-14"])
     out = capsys.readouterr().out

@@ -24,10 +24,21 @@ Matrix semantics (staging ↔ the reference's ``buf`` flag):
   ``:696-728``): arrays start host-resident (pinned host memory kind) and
   migrate on first device use.
 
-Timing discipline: iterations are chained (each exchange consumes the
-previous result) and synchronized once at the end with a hard host-read
-sync; the reported seconds are multiplied by the logical world size to match
+Timing discipline (≅ the reference hot loop ``mpi_stencil2d_gt.cc:511-535``):
+each iteration hard-syncs, reads the clock around the exchange alone, then
+runs the 5-point stencil (untimed but executing, preserving the reference's
+exchange/compute iteration structure — note the end-of-iteration sync means
+the exchange starts from a drained device, exactly as the reference's
+``gt::synchronize`` at :534 drains before the next ``clock_gettime`` at
+:512). Warmup iterations run identically but are not accumulated. Per-iteration mean/min/max past warmup are reported on
+``ITER`` lines (a slow link shows up as max≫mean jitter); ``--fused`` times
+exchange+stencil as one compiled program instead, for the split-vs-fused A/B.
+The reported total seconds are multiplied by the logical world size to match
 the reference's ``MPI_Reduce(MPI_SUM)`` of per-rank times (``:562-566``).
+
+Over a high-latency controller link (the axon tunnel adds ~106 ms per hard
+sync) the per-iteration sync floor dominates; reduce ``--n-iter`` there, or
+use ``bench.py`` (device-side ``lax.fori_loop`` chaining) for throughput.
 """
 
 from __future__ import annotations
@@ -47,7 +58,7 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
     from tpu_mpi_tests.arrays.spaces import Space
     from tpu_mpi_tests.comm import collectives as C
     from tpu_mpi_tests.comm import halo as H
-    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.instrument.timers import PhaseTimer, block
     from tpu_mpi_tests.kernels.stencil import analytic_pairs
 
     dtype = _common.jnp_dtype(args)
@@ -109,15 +120,48 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
             sharding=sharding,
         )
 
-    for _ in range(args.n_warmup):
-        zg = H.halo_exchange(zg, mesh, axis=dim, staging=staging)
+    # Hot loop ≅ mpi_stencil2d_gt.cc:511-535: per-iteration clock reads
+    # around the exchange (:512-526), the stencil eval every iteration
+    # (untimed but executing, :529-533), and a device sync closing each
+    # iteration (:534). Warmup iterations run the same code but are not
+    # accumulated (skip_first ≅ the i >= n_warmup guard, :521-526).
+    fused = stencil = None
+    if args.fused:
+        if staging not in (H.Staging.DIRECT, H.Staging.DEVICE_STAGED):
+            rep.line(
+                f"SKIP dim:{dim}, {space}, buf:{int(buf)}: --fused supports "
+                "only DIRECT/DEVICE_STAGED exchanges"
+            )
+            return 0
+        fused = H.exchange_stencil_fused_fn(
+            mesh, axis_name, dim, 2, d.n_bnd, d.scale,
+            staged=staging is H.Staging.DEVICE_STAGED,
+        )
+    else:
+        stencil = H.stencil_fn(mesh, axis_name, dim, 2, d.scale,
+                               kernel=args.kernel)
+    timer = PhaseTimer(skip_first=args.n_warmup)
+    phase_name = "fused" if args.fused else "exchange"
     zg = block(zg)
-
-    t0 = time.perf_counter()
-    for _ in range(args.n_iter):
-        zg = H.halo_exchange(zg, mesh, axis=dim, staging=staging)
-    zg = block(zg)
-    seconds = time.perf_counter() - t0
+    dz = None
+    for _ in range(args.n_warmup + args.n_iter):
+        if fused is not None:
+            # split-vs-fused A/B (SURVEY §7 hard part 2): exchange + stencil
+            # compiled as ONE program, so the timed phase includes the
+            # overlapped compute XLA schedules against the ppermute DMA
+            with timer.phase(phase_name):
+                dz = block(fused(zg))
+        else:
+            with timer.phase(phase_name):
+                zg = block(H.halo_exchange(zg, mesh, axis=dim,
+                                           staging=staging))
+            dz = stencil(zg)
+            block(dz)
+    seconds = timer.seconds[phase_name]
+    if args.fused and args.debug_dump:
+        # the fused program never materializes exchanged ghosts; run one
+        # standalone exchange so the dump below has them
+        zg = block(H.halo_exchange(zg, mesh, axis=dim, staging=staging))
 
     if args.debug_dump and zg.is_fully_addressable:
         # ≅ the DEBUG halo dumps of mpi_stencil2d_sycl_oo.cc:636-659: print
@@ -136,9 +180,6 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
                 )
                 rep.line(f"DEBUG rank {r} {label} ghost+edge:\n{flat}")
 
-    dz = block(
-        H.stencil_fn(mesh, axis_name, dim, 2, d.scale, kernel=args.kernel)(zg)
-    )
     if args.init == "device":
         actual = C.device_init(
             mesh, lambda r: d.interior_shard_jax(df, r, dtype), axis=dim
@@ -154,7 +195,14 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
     per_rank = C.per_rank_err_norms(dz, actual, mesh, axis=dim)
     err_sum = float(per_rank.sum())
     # rank-summed time: every logical rank experiences the same wall clock
-    rep.test_line(dim, space, buf, seconds * world, err_sum)
+    rep.test_line(dim, space, buf, seconds * world, err_sum,
+                  extra_label="fused" if args.fused else None)
+    rep.iter_line(
+        dim, space, buf, phase_name,
+        timer.mean(phase_name),
+        timer.mins.get(phase_name, 0.0),
+        timer.maxs.get(phase_name, 0.0),
+    )
 
     tol = args.tol if args.tol is not None else _default_tol(args, d)
     if per_rank.max() > tol:
@@ -279,7 +327,8 @@ def _sum_test(args, mesh, topo, rep, dim: int, space: str) -> int:
     t_without = time.perf_counter() - t0
 
     seconds = max(t_with - t_without, 0.0)
-    rep.test_line(dim, space, 0, seconds * world, 0.0, extra_label="allreduce")
+    rep.test_line(dim, space, 0, seconds * world, 0.0,
+                  extra_label="allreduce", show_err=False)
     return 0
 
 
@@ -356,6 +405,14 @@ def main(argv=None) -> int:
         "exchange (≅ running the SYCL hand-kernel variant of the matrix)",
     )
     p.add_argument(
+        "--fused",
+        action="store_true",
+        help="time exchange+stencil compiled as ONE program per iteration "
+        "(the fused side of the split-vs-fused A/B, SURVEY §7 hard part 2); "
+        "default times the exchange alone with the stencil executing "
+        "untimed between iterations (≅ mpi_stencil2d_gt.cc:511-535)",
+    )
+    p.add_argument(
         "--kernel",
         default="xla",
         choices=["xla", "pallas"],
@@ -396,6 +453,9 @@ def main(argv=None) -> int:
             p.error(f"--{name.replace('_', '-')} must be positive")
     if args.n_local < 5:
         p.error("--n-local must be >= 5 (stencil width)")
+    if args.fused and args.kernel != "xla":
+        p.error("--fused compiles the XLA stencil into the exchange program; "
+                "it does not support --kernel pallas")
     _common.setup_platform(args)
     return _common.run_guarded(run, args)
 

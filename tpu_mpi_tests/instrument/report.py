@@ -67,11 +67,17 @@ class Reporter:
         )
 
     def test_line(self, dim: int, space: str, buf, seconds: float, err: float,
-                  extra_label: str | None = None):
+                  extra_label: str | None = None, show_err: bool = True):
         space_s = f"{space:7s}"
         if extra_label:
+            # labeled variants: `allreduce=<t>` keeps the reference shape
+            # (mpi_stencil2d_gt.cc:645-648, show_err=False); `fused=<t>,
+            # err=<e>` marks fused exchange+stencil totals so aggregation
+            # never conflates them with exchange-only TEST lines
             text = (f"TEST dim:{dim}, {space_s}, buf:{int(buf)}; "
                     f"{extra_label}={seconds:f}")
+            if show_err:
+                text += f", err={err:e}"
         else:
             text = (f"TEST dim:{dim}, {space_s}, buf:{int(buf)}; "
                     f"{seconds:f}, err={err:e}")
@@ -80,6 +86,20 @@ class Reporter:
             {"kind": "test", "dim": dim, "space": space, "buf": int(buf),
              "seconds": float(seconds), "err": float(err),
              "label": extra_label},
+        )
+
+    def iter_line(self, dim: int, space: str, buf, phase: str,
+                  mean_s: float, min_s: float, max_s: float):
+        """Per-iteration timing distribution past warmup (≅ the per-iter
+        ``clock_gettime`` accumulation of ``mpi_stencil2d_gt.cc:512-526``,
+        extended with min/max so a slow link shows up as jitter)."""
+        space_s = f"{space:7s}"
+        self.line(
+            f"ITER dim:{dim}, {space_s}, buf:{int(buf)}; {phase} "
+            f"mean={mean_s:e}, min={min_s:e}, max={max_s:e}",
+            {"kind": "iter", "dim": dim, "space": space, "buf": int(buf),
+             "phase": phase, "mean_s": float(mean_s),
+             "min_s": float(min_s), "max_s": float(max_s)},
         )
 
     def exchange_line(self, ms_per_iter: float, rank=None):
