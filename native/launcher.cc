@@ -8,8 +8,14 @@
 // contract mpirun gives the reference's launch scripts
 // (/root/reference/jlse/run.sh:29-33).
 //
-// Usage: tpumt_run -n NPROCS [-p PORT] -- command [args...]
+// Usage: tpumt_run -n NPROCS [-p PORT] [-o PREFIX] -- command [args...]
+//
+// -o PREFIX redirects each child's stdout+stderr to PREFIX<rank>.txt
+// (≅ the per-run `out-<tag>.txt` redirection of the reference's launch
+// scripts, /root/reference/summit/run.sh:31 — and what mpirun's
+// --output-filename gives; without it parallel children interleave lines).
 
+#include <fcntl.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -24,11 +30,14 @@ int main(int argc, char** argv) {
   int nprocs = 0;
   int port = 0;
   int cmd_start = -1;
+  const char* out_prefix = nullptr;
   for (int i = 1; i < argc; ++i) {
     if (std::strcmp(argv[i], "-n") == 0 && i + 1 < argc) {
       nprocs = std::atoi(argv[++i]);
     } else if (std::strcmp(argv[i], "-p") == 0 && i + 1 < argc) {
       port = std::atoi(argv[++i]);
+    } else if (std::strcmp(argv[i], "-o") == 0 && i + 1 < argc) {
+      out_prefix = argv[++i];
     } else if (std::strcmp(argv[i], "--") == 0) {
       cmd_start = i + 1;
       break;
@@ -38,8 +47,10 @@ int main(int argc, char** argv) {
     }
   }
   if (nprocs < 1 || cmd_start < 0 || cmd_start >= argc) {
-    std::fprintf(stderr,
-                 "usage: tpumt_run -n NPROCS [-p PORT] -- command [args...]\n");
+    std::fprintf(
+        stderr,
+        "usage: tpumt_run -n NPROCS [-p PORT] [-o PREFIX] -- command "
+        "[args...]\n");
     return 2;
   }
   if (port == 0) {
@@ -58,6 +69,18 @@ int main(int argc, char** argv) {
       setenv("JAX_COORDINATOR_ADDRESS", coord.c_str(), 1);
       setenv("JAX_NUM_PROCESSES", std::to_string(nprocs).c_str(), 1);
       setenv("JAX_PROCESS_ID", std::to_string(rank).c_str(), 1);
+      if (out_prefix != nullptr) {
+        std::string path = std::string(out_prefix) + std::to_string(rank) +
+                           ".txt";
+        int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+        if (fd < 0) {
+          std::perror("tpumt_run: open out file");
+          _exit(127);
+        }
+        dup2(fd, 1);
+        dup2(fd, 2);
+        if (fd > 2) close(fd);
+      }
       execvp(argv[cmd_start], &argv[cmd_start]);
       std::perror("tpumt_run: execvp");
       _exit(127);

@@ -1,0 +1,181 @@
+"""Automated multi-process (DCN-path) distributed tests.
+
+The reference validates its distributed backend only on real cluster
+allocations (``summit/``, ``jlse/``); round 1 of this framework validated the
+``jax.distributed`` bootstrap only by hand. These tests close that gap: each
+spawns a REAL multi-process world over localhost via the native launcher
+(``native/tpumt_run``, ≅ ``mpirun -np N`` in ``jlse/run.sh:29-33``), with one
+fake CPU device per process, and asserts the drivers' checksum/err_norm gates
+from the combined output — so the DCN bootstrap + cross-process collective
+path is green in ``make test`` with no hardware.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+LAUNCHER = REPO / "native" / "tpumt_run"
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain for tpumt_run"
+)
+
+
+@pytest.fixture(scope="module")
+def tpumt_run():
+    subprocess.run(
+        ["make", "-C", str(REPO / "native"), "tpumt_run"],
+        capture_output=True,
+        check=True,
+        timeout=120,
+    )
+    return str(LAUNCHER)
+
+
+def launch(tpumt_run, nprocs, *cmd, out_prefix=None, timeout=300):
+    """Run a command under the native launcher. With ``out_prefix``, each
+    rank's stdout+stderr lands in ``<out_prefix><rank>.txt`` (parallel
+    children interleave a shared pipe, which corrupts parsed values)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    args = [tpumt_run, "-n", str(nprocs)]
+    if out_prefix is not None:
+        args += ["-o", str(out_prefix)]
+    # own session + killpg on timeout: killing only the launcher leaves
+    # grandchild ranks holding the captured pipe, and communicate() would
+    # then hang forever — exactly in the distributed-deadlock case these
+    # tests exist to catch
+    proc = subprocess.Popen(
+        args + ["--", *cmd],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, 9)
+        stdout, stderr = proc.communicate()
+        pytest.fail(f"launcher timed out after {timeout}s; partial output:\n"
+                    f"{stdout}\n{stderr}")
+    return subprocess.CompletedProcess(
+        proc.args, proc.returncode, stdout, stderr
+    )
+
+
+def rank_outputs(out_prefix, nprocs):
+    return [Path(f"{out_prefix}{r}.txt").read_text() for r in range(nprocs)]
+
+
+def test_multiproc_daxpy_allgather_checksums(tpumt_run, tmp_path):
+    """2-process mpi_daxpy_nvtx: per-rank SUM, cross-process in-place
+    allgather, and the driver's internal ALLSUM/GATHER-PARITY gates
+    (≅ mpi_daxpy_nvtx.cc:251-310 semantics over a real 2-process world)."""
+    prefix = tmp_path / "out-daxpy-"
+    r = launch(
+        tpumt_run, 2, sys.executable, "-m",
+        "tpu_mpi_tests.drivers.mpi_daxpy_nvtx",
+        "--fake-devices", "1", "--n-per-node", "65536",
+        out_prefix=prefix,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    outs = rank_outputs(prefix, 2)
+    per_rank_sums, per_rank_allsums = [], []
+    for rank, out in enumerate(outs):
+        sums = re.findall(rf"{rank}/2 SUM = ([\d.]+)", out)
+        assert sums, out
+        per_rank_sums.append({float(v) for v in sums})
+        allsums = re.findall(rf"{rank}/2 ALLSUM = ([\d.]+)", out)
+        assert len(allsums) == 1, out
+        per_rank_allsums.append(float(allsums[0]))
+        assert out.count("TIME gather :") == 1
+    # identical shards → identical checksums on both ranks; the allgathered
+    # total spans both ranks' data
+    assert per_rank_sums[0] == per_rank_sums[1]
+    assert per_rank_allsums[0] == per_rank_allsums[1]
+    assert per_rank_allsums[0] > max(per_rank_sums[0])
+
+
+def test_multiproc_stencil1d_err_norm(tpumt_run, tmp_path):
+    """2-process 1-D stencil: the halo exchange crosses the process boundary
+    and the analytic err_norm gate passes on every rank
+    (≅ mpi_stencil_gt.cc:222-225 over a real distributed world)."""
+    prefix = tmp_path / "out-stencil-"
+    r = launch(
+        tpumt_run, 2, sys.executable, "-m",
+        "tpu_mpi_tests.drivers.stencil1d",
+        "--fake-devices", "1", "--n-global", "8192", "--dtype", "float64",
+        out_prefix=prefix,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # stencil1d reports all logical ranks from the controller process
+    out0 = rank_outputs(prefix, 2)[0]
+    errs = re.findall(r"(\d)/2 \[\w+\] err_norm = ([\d.e+-]+)", out0)
+    assert {rank for rank, _ in errs} == {"0", "1"}, out0
+    assert all(float(e) < 1e-8 for _, e in errs)
+
+
+def test_multiproc_2level_mesh_collectives(tpumt_run, tmp_path):
+    """make_mesh_2level over a real 2-process world: the outer (dcn) axis
+    spans processes, and psum over both axes reduces across the process
+    boundary (≅ node-axis collectives from MPI_Comm_split_type topology,
+    mpi_daxpy_nvtx.cc:72-82)."""
+    script = tmp_path / "two_level.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        # one CPU device per process (the parent test env may carry an
+        # 8-fake-device XLA_FLAGS; this world wants dcn=2 x ici=1)
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+        import functools
+        import jax
+        import numpy as np
+        from jax import lax, shard_map
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh_2level, topology
+
+        jax.config.update("jax_platforms", "cpu")
+        bootstrap()
+        topo = topology()
+        assert topo.process_count == 2, topo
+        mesh = make_mesh_2level()
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "dcn": 2, "ici": 1}, mesh
+
+        spec = P(("dcn", "ici"))  # vary over both axes so both psums are legal
+
+        @jax.jit
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=spec, out_specs=spec)
+        def rank_psum(x):
+            both = lax.psum(x, ("dcn", "ici"))
+            dcn_only = lax.psum(x, "dcn")
+            return both + dcn_only
+
+        full = np.arange(2, dtype=np.float32)  # dcn rank r holds [r]
+        x = jax.make_array_from_callback(
+            (2,), NamedSharding(mesh, spec), lambda idx: full[idx])
+        out = rank_psum(x)
+        # psum over all axes = 0+1 = 1 everywhere; the dcn-only psum (ici
+        # axis is size 1, so it reduces the same pair) adds another 1
+        local = np.asarray(out.addressable_shards[0].data)
+        assert float(local[0]) == 2.0, local
+        print(f"2LEVEL OK rank={topo.process_index}")
+    """))
+    r = launch(tpumt_run, 2, sys.executable, str(script))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "2LEVEL OK rank=0" in r.stdout
+    assert "2LEVEL OK rank=1" in r.stdout

@@ -149,12 +149,10 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
             # split-vs-fused A/B (SURVEY §7 hard part 2): exchange + stencil
             # compiled as ONE program, so the timed phase includes the
             # overlapped compute XLA schedules against the ppermute DMA
-            with timer.phase(phase_name):
-                dz = block(fused(zg))
+            dz = timer.timed(phase_name, fused, zg)
         else:
-            with timer.phase(phase_name):
-                zg = block(H.halo_exchange(zg, mesh, axis=dim,
-                                           staging=staging))
+            zg = timer.timed(phase_name, H.halo_exchange, zg, mesh,
+                             axis=dim, staging=staging)
             dz = stencil(zg)
             block(dz)
     seconds = timer.seconds[phase_name]
@@ -456,6 +454,9 @@ def main(argv=None) -> int:
     if args.fused and args.kernel != "xla":
         p.error("--fused compiles the XLA stencil into the exchange program; "
                 "it does not support --kernel pallas")
+    if args.fused and args.rdma:
+        p.error("--fused supports only DIRECT/DEVICE_STAGED exchanges; "
+                "combining it with --rdma would skip the whole matrix")
     _common.setup_platform(args)
     return _common.run_guarded(run, args)
 
