@@ -40,6 +40,23 @@ def test_daxpy_rejects_unaligned():
         PK.daxpy_pallas(2.0, x, x)
 
 
+def test_stream_scale_matches_xla():
+    x, _ = init_xy(64 * 1024, jnp.float32)
+    out = PK.stream_scale_pallas(1.5, x)
+    assert jnp.allclose(out, 1.5 * x)
+    out = PK.stream_scale_pallas(0.5, x, block_rows=64)
+    assert jnp.allclose(out, 0.5 * x)
+
+
+def test_stream_block_rows_fits_vmem():
+    # 3-buffer f32 → 4096 rows (12 MB double-buffered); 2-buffer f32 → 4096
+    # (power-of-two floor); f64 halves, bf16 doubles — always ≤ 12 MB
+    for itemsize, n_bufs in ((4, 3), (4, 2), (8, 3), (2, 3)):
+        rows = PK._stream_block_rows(itemsize, n_bufs)
+        assert rows & (rows - 1) == 0
+        assert n_bufs * 2 * rows * 128 * itemsize <= 12 * 2**20
+
+
 @pytest.mark.parametrize("dim", [0, 1])
 def test_stencil_matches_xla(dim):
     shape = (260, 256) if dim == 0 else (256, 260)

@@ -34,19 +34,23 @@ def bench_daxpy(results):
     from tpu_mpi_tests.kernels import pallas_kernels as PK
     from tpu_mpi_tests.kernels.daxpy import daxpy, init_xy
 
-    for logn in (24, 26):
+    for logn in (24, 26, 28):
         n = 1 << logn
         x, y = init_xy(n, jnp.float32)
         gb = 3 * 4 * n / 1e9
+        # fewer iters at 2^28 keeps device time ~2 s (plenty of signal)
+        iters = 1000 if logn < 28 else 500
         t = dispatch_rate(
-            lambda a, b: daxpy(2.0, a, b), x, y, n_iter=1000, n_base=100
+            lambda a, b: daxpy(2.0, a, b), x, y,
+            n_iter=iters, n_base=iters // 10,
         )
         _emit(results, f"daxpy_xla_2^{logn}_gbps", gb / t, "GB/s")
         t = dispatch_rate(
             lambda a, b: PK.daxpy_pallas(2.0, a, b), x, y,
-            n_iter=1000, n_base=100,
+            n_iter=iters, n_base=iters // 10,
         )
         _emit(results, f"daxpy_pallas_2^{logn}_gbps", gb / t, "GB/s")
+        del x, y
 
 
 def bench_stencil(results):
@@ -123,23 +127,55 @@ def bench_iterate(results):
 
 
 def bench_ceiling(results):
-    import numpy as np
+    """Practical HBM ceiling by two-point overhead fit.
 
-    import jax
+    A single raw streaming rate under-reports the ceiling: every kernel
+    launch carries a fixed overhead (~100 µs through the tunneled runtime)
+    charged to however few bytes that op moves, which is why round 1's small
+    fused-elementwise probe (600 GB/s) landed *below* measured daxpy. Fix:
+    measure two streams of different traffic at the same size — 2-pass scale
+    and 3-pass daxpy — and solve
+
+        t_daxpy = 3·b/B + τ,   t_scale = 2·b/B + τ
+
+    for the true stream bandwidth B and per-kernel overhead τ. B is the
+    ceiling every per-op row is compared against (raw rows sit below it by
+    exactly their launch-overhead share; larger arrays amortize toward it).
+    """
     import jax.numpy as jnp
 
     from tpu_mpi_tests.instrument.timers import dispatch_rate
+    from tpu_mpi_tests.kernels import pallas_kernels as PK
+    from tpu_mpi_tests.kernels.daxpy import init_xy
 
-    z = jnp.asarray(
-        np.random.default_rng(0)
-        .normal(size=(8192, 8192))
-        .astype(np.float32)
+    n = 1 << 26
+    b = 4 * n / 1e9  # GB per pass
+    x, y = init_xy(n, jnp.float32)
+    t3 = dispatch_rate(
+        lambda a, c: PK.daxpy_pallas(2.0, a, c), x, y,
+        n_iter=1000, n_base=100,
     )
-    f = jax.jit(lambda a: a * 2.0 + a)
-    t = dispatch_rate(f, z, n_iter=500, n_base=50)
-    _emit(results, "hbm_ceiling_probe_gbps",
-          8192 * 8192 * 4 * 2 / t / 1e9, "GB/s",
-          "fused 2-op elementwise, 8192^2 f32")
+    t2 = dispatch_rate(
+        lambda a: PK.stream_scale_pallas(2.0, a), x,
+        n_iter=1000, n_base=100,
+    )
+    _emit(results, "stream_daxpy_3pass_gbps", 3 * b / t3, "GB/s",
+          "raw 3-pass probe, 2^26 f32")
+    _emit(results, "stream_scale_2pass_gbps", 2 * b / t2, "GB/s",
+          "raw 2-pass probe, 2^26 f32")
+    raw3 = 3 * b / t3
+    bw = b / (t3 - t2) if t3 > t2 else float("inf")
+    # noise guard: t3 ~ t2 makes the fit blow up (5 us of jitter on the
+    # 0.27 GB delta would claim ~50 TB/s); a fit more than 2x the raw
+    # 3-pass rate (or a negative overhead) is measurement noise, not HBM
+    if t3 > t2 and bw <= 2 * raw3:
+        tau = t2 - 2 * b / bw
+        _emit(results, "hbm_ceiling_fit_gbps", bw, "GB/s",
+              f"two-point overhead fit; per-kernel overhead "
+              f"{tau * 1e6:.0f} us")
+    else:
+        _emit(results, "hbm_ceiling_fit_gbps", raw3, "GB/s",
+              "fit degenerate (t3 <= t2 or fit > 2x raw); raw 3-pass rate")
 
 
 GROUPS = {

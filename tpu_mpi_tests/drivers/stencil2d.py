@@ -324,9 +324,24 @@ def _sum_test(args, mesh, topo, rep, dim: int, space: str) -> int:
     block(s)
     t_without = time.perf_counter() - t0
 
+    # the headline keeps the reference's "allreduce cost" semantics, but the
+    # raw loop timings are reported too: the difference of two noisy loops
+    # can clamp to zero, and a clamped value is only diagnosable from the
+    # components (VERDICT r1 weak #7)
     seconds = max(t_with - t_without, 0.0)
+    if t_with < t_without:
+        rep.line(
+            f"NOTE dim:{dim} {space}: allreduce difference clamped to 0 "
+            f"(t_with={t_with:.6f} < t_without={t_without:.6f}; "
+            "loop noise exceeds the allreduce cost at this size)"
+        )
     rep.test_line(dim, space, 0, seconds * world, 0.0,
                   extra_label="allreduce", show_err=False)
+    rep.jsonl(
+        {"kind": "allreduce_raw", "dim": dim, "space": space,
+         "n_iter": args.n_iter, "t_with_s": t_with,
+         "t_without_s": t_without, "world": world}
+    )
     return 0
 
 

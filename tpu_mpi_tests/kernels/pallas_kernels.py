@@ -46,18 +46,32 @@ def _daxpy_kernel(a_ref, x_ref, y_ref, out_ref):
     out_ref[:] = a_ref[0] * x_ref[:] + y_ref[:]
 
 
+def _stream_block_rows(itemsize: int, n_bufs: int) -> int:
+    """Largest power-of-two block for an n_bufs-buffer streaming kernel that
+    keeps double-buffered tiles within ~12 MB of the ~16 MB VMEM: big tiles
+    are what saturate HBM (682 GB/s at 4096×128 f32 vs 620 at 512×128 on
+    v5e; 8192×128 OOMs)."""
+    budget = 12 * 2**20
+    rows = budget // (n_bufs * 2 * 128 * itemsize)
+    return 1 << (rows.bit_length() - 1)
+
+
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
-def daxpy_pallas(a, x, y, block_rows: int = 512, interpret: bool | None = None):
+def daxpy_pallas(a, x, y, block_rows: int | None = None,
+                 interpret: bool | None = None):
     """y ← a·x + y on 1-D arrays (≅ ``cublasDaxpy``).
 
     The array is viewed as (rows, 128) lanes and processed in
-    ``block_rows``-row VMEM tiles; n must be a multiple of 128 (driver sizes
-    are powers of two, like the reference's 48Mi-per-node sizing).
+    ``block_rows``-row VMEM tiles (default: dtype-dependent maximum, 4096
+    for f32); n must be a multiple of 128 (driver sizes are powers of two,
+    like the reference's 48Mi-per-node sizing).
     """
     n = x.shape[0]
     if n % 128 != 0:
         raise ValueError(f"daxpy_pallas needs n % 128 == 0, got {n}")
     rows = n // 128
+    if block_rows is None:
+        block_rows = _stream_block_rows(jnp.dtype(x.dtype).itemsize, 3)
     block_rows = min(block_rows, rows)
     x2 = x.reshape(rows, 128)
     y2 = y.reshape(rows, 128)
@@ -81,6 +95,46 @@ def daxpy_pallas(a, x, y, block_rows: int = 512, interpret: bool | None = None):
         ),
         interpret=_auto_interpret(interpret),
     )(a_arr, x2, y2)
+    return out.reshape(n)
+
+
+def _scale_kernel(a_ref, x_ref, out_ref):
+    out_ref[:] = a_ref[0] * x_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def stream_scale_pallas(a, x, block_rows: int | None = None,
+                        interpret: bool | None = None):
+    """out ← a·x: the minimal 2-pass (read + write) HBM stream.
+
+    This is the ceiling probe's second point: with daxpy (3 passes) it gives
+    two (bytes, seconds) samples whose linear fit separates true stream
+    bandwidth from the fixed per-kernel launch overhead — the roofline model
+    BASELINE.md uses (a raw small-op rate under-reports the ceiling because
+    the launch overhead is charged to too few bytes)."""
+    n = x.shape[0]
+    if n % 128 != 0:
+        raise ValueError(f"stream_scale_pallas needs n % 128 == 0, got {n}")
+    rows = n // 128
+    if block_rows is None:
+        block_rows = _stream_block_rows(jnp.dtype(x.dtype).itemsize, 2)
+    block_rows = min(block_rows, rows)
+    a_arr = jnp.asarray(a, x.dtype).reshape(1)
+    out = pl.pallas_call(
+        _scale_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, 128), x.dtype),
+        grid=(pl.cdiv(rows, block_rows),),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=_auto_interpret(interpret),
+    )(a_arr, x.reshape(rows, 128))
     return out.reshape(n)
 
 
