@@ -108,3 +108,26 @@ def test_daxpy_driver_checksum_gate(capsys):
     rc = drv.main(["--n", "64", "--a", "3.0", "--dtype", "float64"])
     assert rc == 1
     assert "CHECKSUM FAIL" in capsys.readouterr().out
+
+
+def test_daxpy_driver_catches_compensating_error(capsys, monkeypatch):
+    """A compensating per-element corruption (+1/−1) leaves the checksum
+    exact; the per-element verification must still fail it (≅ the
+    reference's element loop, daxpy.cu:82-87; VERDICT r1 missing #3)."""
+    import jax.numpy as jnp
+
+    import tpu_mpi_tests.kernels.daxpy as kd
+    from tpu_mpi_tests.drivers import daxpy as drv
+
+    real = kd.daxpy
+
+    def corrupted(a, x, y):
+        out = real(a, x, y)
+        return out.at[0].add(1.0).at[1].add(-1.0)
+
+    monkeypatch.setattr(kd, "daxpy", corrupted)
+    rc = drv.main(["--n", "64", "--dtype", "float64"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ELEMENT FAIL" in out
+    assert "CHECKSUM FAIL" not in out

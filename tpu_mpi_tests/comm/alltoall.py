@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_mpi_tests.comm.ring import online_softmax_update
 from tpu_mpi_tests.utils import check_divisible
 
 
@@ -82,12 +83,7 @@ def _local_attention(q, k, v, causal: bool, precision,
         if causal:
             valid = valid & (q_pos[:, None] >= k_pos[None, :])
         s = jnp.where(valid[None, :, :], s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1))  # (H, L)
-        # fully-masked rows keep m_new at -inf; exp(-inf) = 0, no NaNs
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe[:, :, None])
-        corr = jnp.exp(m - m_safe)
-        l = l * corr + p.sum(axis=-1)
+        m_new, l, p, corr = online_softmax_update(m, l, s)  # (H, L) carries
         acc = acc * jnp.swapaxes(corr, 0, 1)[:, :, None] + jnp.einsum(
             "hqk,khd->qhd", p, v_blk, precision=precision
         )

@@ -205,6 +205,16 @@ def halo_exchange(
             zg, mesh, axis_name, axis, n_bnd, periodic
         )
     if staging is Staging.PALLAS_RDMA:
+        # a wedged DMA semaphore / neighborhood barrier in the hand-written
+        # ring is a silent hang; record the dispatch so the watchdog can
+        # attribute it (instrument/watchdog.note_comm_op)
+        from tpu_mpi_tests.instrument.watchdog import note_comm_op
+
+        note_comm_op(
+            f"ring_halo_pallas(axis={axis}, n_bnd={n_bnd}, "
+            f"periodic={periodic}, world={mesh.shape[axis_name]}, "
+            f"shape={tuple(zg.shape)})"
+        )
         return _exchange_pallas_fn(
             mesh, axis_name, axis, zg.ndim, n_bnd, periodic
         )(zg)

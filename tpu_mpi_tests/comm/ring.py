@@ -33,6 +33,26 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def online_softmax_update(m, l, s):
+    """One block of the online-softmax recurrence shared by the ring and
+    Ulysses attention flavors: given running max ``m`` and denominator ``l``
+    (any leading batch shape) and this block's scores ``s`` (same shape +
+    a trailing key axis), returns ``(m_new, l_new, p, corr)`` where ``p``
+    are the block's unnormalized probabilities and ``corr`` rescales the
+    caller's numerator: ``acc_new = acc·corr[...,None] + p @ v_blk``.
+
+    All-masked blocks leave ``m_new`` at -inf; the ``m_safe`` guard makes
+    ``exp(s − m_safe) = exp(-inf) = 0`` with no −inf − −inf NaNs. Keeping
+    this in ONE place means a numerics fix cannot silently diverge between
+    the two attention flavors."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    corr = jnp.exp(m - m_safe)
+    l_new = l * corr + p.sum(axis=-1)
+    return m_new, l_new, p, corr
+
+
 def ring_pass(x, axis_name: str, shift: int = 1):
     """Rotate ``x`` ``shift`` steps around the mesh-axis ring (periodic):
     each rank receives the block of ``rank - shift``."""
@@ -114,13 +134,7 @@ def ring_attention(
             s = jnp.where(
                 q_pos[:, None] >= k_pos[None, :], s, -jnp.inf
             )
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # all-masked blocks leave m_new at -inf; exp(s - m_safe) is then
-        # exp(-inf) = 0 with no -inf − -inf NaNs
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_safe[:, None])
-        corr = jnp.exp(m - m_safe)
-        l = l * corr + p.sum(axis=-1)
+        m_new, l, p, corr = online_softmax_update(m, l, s)
         acc = acc * corr[:, None] + jnp.matmul(p, v_blk, precision=precision)
         return m_new, l, acc
 

@@ -52,6 +52,26 @@ def run(args) -> int:
     for phase, secs in timer.as_dict().items():
         rep.time_line(phase, secs)
 
+    # per-element verification (≅ the reference's per-element loop,
+    # daxpy.cu:82-87): a compensating-error bug passes a checksum, so with
+    # the reference's a=2 the analytic result y[i] = i+1 is asserted
+    # element-exactly wherever i+1 is representable in the dtype (up to
+    # 2²³ in f32; bf16's 2⁷ means the default n=1024 bf16 run falls back
+    # to the checksum). Other a / larger n fall back to the checksum alone
+    # — matching the reference, whose check is hardwired to its init
+    # (daxpy.cu:85).
+    exact_n = {"float64": 1 << 52, "float32": 1 << 23, "bfloat16": 1 << 7}
+    if a == 2.0 and n <= exact_n[args.dtype]:
+        h_want = np.arange(1, n + 1, dtype=np.float64).astype(dtype)
+        bad = np.flatnonzero(y != np.asarray(h_want))
+        if bad.size:
+            i = int(bad[0])
+            rep.line(
+                f"ELEMENT FAIL: {bad.size}/{n} mismatches, first at "
+                f"[{i}]: got {y[i]}, expected {np.asarray(h_want)[i]}"
+            )
+            return 1
+
     expected = kd.expected_checksum(n)
     # float32 accumulates rounding over large n; scale tolerance with n
     tol = 0 if args.dtype == "float64" else max(1e-6 * expected, 1.0)
