@@ -76,26 +76,34 @@ def test_stencil_ragged_strips(dim):
     assert jnp.allclose(got, stencil1d_5(z, 2.0, axis=dim), atol=1e-5)
 
 
-def test_iterate_inplace_step():
-    z0 = np.random.default_rng(0).normal(size=(64, 68)).astype(np.float32)
-    got = PK.stencil2d_iterate_pallas(jnp.asarray(z0), 0.5)
+@pytest.mark.parametrize("dim", [0, 1])
+def test_iterate_inplace_step(dim):
+    shape = (68, 64) if dim == 0 else (64, 68)
+    z0 = np.random.default_rng(0).normal(size=shape).astype(np.float32)
+    got = PK.stencil2d_iterate_pallas(jnp.asarray(z0), 0.5, dim=dim)
     ref = np.array(z0)
-    ref[:, 2:-2] += 0.5 * np.asarray(stencil1d_5(jnp.asarray(z0), 1.0, axis=1))
+    sl = (slice(2, -2), slice(None)) if dim == 0 else (slice(None),
+                                                      slice(2, -2))
+    ref[sl] += 0.5 * np.asarray(stencil1d_5(jnp.asarray(z0), 1.0, axis=dim))
     assert np.allclose(np.asarray(got), ref, atol=1e-5)
 
 
-def test_iterate_pallas_matches_fused_distributed(mesh8):
+@pytest.mark.parametrize("axis", [0, 1])
+def test_iterate_pallas_matches_fused_distributed(mesh8, axis):
     """The bench fast path (pallas in-place step + halo exchange, chained in
-    a device-side loop) must match the XLA iterate over 8 shards."""
+    a device-side loop) must match the XLA iterate over 8 shards — on both
+    decomposition axes (dim 1 = lane shifts, dim 0 = sublane shifts)."""
     from tpu_mpi_tests.comm.collectives import shard_1d
     from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
 
     rng_ = np.random.default_rng(1)
-    zg = rng_.normal(size=(32, 8 * 20)).astype(np.float32)
-    za = shard_1d(jnp.asarray(zg), mesh8, axis=1)
-    zb = shard_1d(jnp.asarray(zg), mesh8, axis=1)
-    fused = iterate_fused_fn(mesh8, "shard", 1, 2, 2, 10.0, 1e-3)
-    pallas = iterate_pallas_fn(mesh8, "shard", 2, 1e-2, interpret=True)
+    shape = (8 * 20, 32) if axis == 0 else (32, 8 * 20)
+    zg = rng_.normal(size=shape).astype(np.float32)
+    za = shard_1d(jnp.asarray(zg), mesh8, axis=axis)
+    zb = shard_1d(jnp.asarray(zg), mesh8, axis=axis)
+    fused = iterate_fused_fn(mesh8, "shard", axis, 2, 2, 10.0, 1e-3)
+    pallas = iterate_pallas_fn(mesh8, "shard", 2, 1e-2, axis=axis,
+                               interpret=True)
     ra = np.asarray(fused(za, 5))
     rb = np.asarray(pallas(zb, 5))
     assert np.allclose(ra, rb, atol=1e-5)

@@ -35,12 +35,19 @@ def test_watchdog_fires_on_timeout():
     assert "hung collective" in msgs[0]
 
 
-def test_watchdog_attributes_last_comm_op():
+def test_watchdog_attributes_last_comm_op(monkeypatch):
     """A wedged RDMA semaphore hangs silently; the watchdog names the last
     dispatched comm op so the hang is attributable (VERDICT r1 missing #4)."""
+    import time as _time
+
     from tpu_mpi_tests.instrument import watchdog as W
 
-    W.note_comm_op("ring_halo_pallas(axis=0, world=8)")
+    # set via monkeypatch so teardown restores prior state (note_comm_op's
+    # global is sticky by design)
+    monkeypatch.setattr(
+        W, "_last_comm_op", ("ring_halo_pallas(axis=0, world=8)",
+                             _time.time())
+    )
     fired = threading.Event()
     msgs = []
 

@@ -316,6 +316,8 @@ def iterate_fused_fn(
     scale: float,
     eps: float = 1e-6,
     staged: bool = False,
+    split: bool = False,
+    periodic: bool = False,
 ):
     """``n_iter`` fused exchange+stencil+update steps in ONE device-side loop.
 
@@ -332,6 +334,13 @@ def iterate_fused_fn(
 
     ``n_iter`` is a dynamic (traced) operand — one compilation serves every
     iteration count.
+
+    ``split=True`` places an ``optimization_barrier`` between the exchange
+    and the stencil, forbidding XLA from fusing them — the split side of the
+    split-vs-fused A/B (SURVEY §7 hard part 2), measured in-device where
+    per-dispatch timing would drown in controller jitter. ``periodic=True``
+    makes the exchange a real self-ring on a single chip (otherwise world=1
+    exchanges are no-ops and the A/B measures nothing).
     """
     from tpu_mpi_tests.kernels.stencil import stencil1d_5
 
@@ -354,8 +363,11 @@ def iterate_fused_fn(
                     axis_name=axis_name,
                     axis=axis,
                     n_bnd=n_bnd,
+                    periodic=periodic,
                     staged=staged,
                 )
+                if split:
+                    zz = lax.optimization_barrier(zz)
                 dz = stencil1d_5(zz, scale=scale, axis=axis)
                 new_int = (
                     lax.slice_in_dim(
@@ -380,16 +392,18 @@ def iterate_pallas_fn(
     axis_name: str,
     n_bnd: int,
     scale_eps: float,
+    axis: int = 1,
     interpret: bool | None = None,
 ):
     """Like :func:`iterate_fused_fn` but with the hand-written in-place
-    Pallas step (2 HBM passes/iter vs XLA's ~6) on a dim-1 decomposition —
-    the stencil axis rides the lane dimension where VMEM shifts are
-    register-cheap. This is the bench.py fast path: measured 1191 iter/s at
-    8192² f32 on v5e vs 258 for the XLA formulation."""
+    Pallas step (2 HBM passes/iter vs XLA's ~6). ``axis=1`` (default) puts
+    the stencil on the lane dimension where VMEM shifts are register-cheap —
+    the bench.py fast path (1212 iter/s at 8192² f32 on v5e vs ~258 for the
+    XLA formulation; bf16 2474 = 2.04× f32); ``axis=0`` runs the same
+    2-pass in-place step on a dim-0 (sublane-shift) decomposition."""
     from tpu_mpi_tests.kernels.pallas_kernels import stencil2d_iterate_pallas
 
-    spec = (None, axis_name)
+    spec = (axis_name, None) if axis == 0 else (None, axis_name)
 
     @functools.partial(jax.jit, donate_argnums=0)
     def run(z, n_iter):
@@ -403,10 +417,10 @@ def iterate_pallas_fn(
         def go(z, n):
             def body(_, zz):
                 zz = exchange_shard(
-                    zz, axis_name=axis_name, axis=1, n_bnd=n_bnd
+                    zz, axis_name=axis_name, axis=axis, n_bnd=n_bnd
                 )
                 return stencil2d_iterate_pallas(
-                    zz, scale_eps, interpret=interpret
+                    zz, scale_eps, dim=axis, interpret=interpret
                 )
 
             return lax.fori_loop(0, n[0], body, z)

@@ -54,14 +54,13 @@ def run(args) -> int:
 
     # per-element verification (≅ the reference's per-element loop,
     # daxpy.cu:82-87): a compensating-error bug passes a checksum, so with
-    # the reference's a=2 the analytic result y[i] = i+1 is asserted
-    # element-exactly wherever i+1 is representable in the dtype (up to
-    # 2²³ in f32; bf16's 2⁷ means the default n=1024 bf16 run falls back
-    # to the checksum). Other a / larger n fall back to the checksum alone
-    # — matching the reference, whose check is hardwired to its init
-    # (daxpy.cu:85).
-    exact_n = {"float64": 1 << 52, "float32": 1 << 23, "bfloat16": 1 << 7}
-    if a == 2.0 and n <= exact_n[args.dtype]:
+    # the reference's a=2 every element is asserted exactly. This holds for
+    # ANY n and dtype: x is stored as x̂ = dtype(i+1), the multiply by 2 is
+    # exact (power of two), and 2x̂ − x̂ = x̂ exactly (Sterbenz lemma), so
+    # the device result must bit-equal dtype(i+1) even where i+1 itself
+    # rounds. Other a values fall back to the checksum alone — matching the
+    # reference, whose check is hardwired to its init (daxpy.cu:85).
+    if a == 2.0:
         h_want = np.arange(1, n + 1, dtype=np.float64).astype(dtype)
         bad = np.flatnonzero(y != np.asarray(h_want))
         if bad.size:

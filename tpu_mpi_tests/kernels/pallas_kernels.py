@@ -240,59 +240,80 @@ def stencil2d_pallas(
     )(z, scale_arr)
 
 
-def _iterate_kernel_dim1(z_ref, scale_eps_ref, out_ref, *, mn):
+def _iterate_kernel(z_ref, scale_eps_ref, out_ref, *, mn, axis):
+    # axis 1: stencil taps ride the lane dim (register-cheap shifts);
+    # axis 0: sublane-dim shifts — costlier in the VPU, which is exactly
+    # what the dim-0 benchmark rows measure
     z = z_ref[:]
     acc = None
     for k, c in enumerate(STENCIL5.tolist()):
         if c == 0.0:
             continue
-        term = c * jax.lax.slice_in_dim(z, k, k + mn, axis=1)
+        term = c * jax.lax.slice_in_dim(z, k, k + mn, axis=axis)
         acc = term if acc is None else acc + term
     interior = (
-        jax.lax.slice_in_dim(z, N_BND, N_BND + mn, axis=1)
+        jax.lax.slice_in_dim(z, N_BND, N_BND + mn, axis=axis)
         + scale_eps_ref[0] * acc
     )
     out_ref[:] = jnp.concatenate(
         [
-            jax.lax.slice_in_dim(z, 0, N_BND, axis=1),
+            jax.lax.slice_in_dim(z, 0, N_BND, axis=axis),
             interior,
-            jax.lax.slice_in_dim(z, N_BND + mn, 2 * N_BND + mn, axis=1),
+            jax.lax.slice_in_dim(z, N_BND + mn, 2 * N_BND + mn, axis=axis),
         ],
-        axis=1,
+        axis=axis,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"),
+@functools.partial(jax.jit, static_argnames=("dim", "tile", "interpret"),
                    donate_argnums=0)
 def stencil2d_iterate_pallas(
-    z, scale_eps, tile: int = 64, interpret: bool | None = None
+    z, scale_eps, dim: int = 1, tile: int = 64, interpret: bool | None = None
 ):
     """One in-place Jacobi-style step: ``interior += scale_eps · stencil``
-    along dim 1, ghosts preserved — shape-preserving so iterations chain,
+    along ``dim``, ghosts preserved — shape-preserving so iterations chain,
     with the input buffer aliased to the output (true in-place; ≅ the
     reference updating ``d_dz`` from ``d_z`` each hot-loop iteration with
     persistent buffers, ``mpi_stencil2d_sycl.cc:218-239``).
 
     Two HBM passes per call (read z, write z) versus XLA's 6 (one per
-    stencil tap + writes) — the VMEM-staged shifts are register-cheap along
-    the lane dim. This is the bench.py fast path.
+    stencil tap + writes). ``dim=1`` puts the stencil taps on the lane dim,
+    where VMEM shifts are register-cheap — the bench.py fast path; ``dim=0``
+    shifts along sublanes (the reference's non-contiguous decomposition) at
+    the same 2-pass traffic, so the dim-0 vs dim-1 A/B isolates the shift
+    cost.
     """
     nx, ny = z.shape
-    mn = ny - 2 * N_BND
-    strip = _fit_strip(tile, nx, 2 * (ny + ny) * z.dtype.itemsize, min_strip=8)
+    if dim == 1:
+        mn = ny - 2 * N_BND
+        strip = _fit_strip(tile, nx, 2 * (ny + ny) * z.dtype.itemsize,
+                           min_strip=8)
+        grid = (pl.cdiv(nx, strip),)
+        block = (strip, ny)
+        index_map = lambda i: (i, 0)  # noqa: E731
+    else:
+        mn = nx - 2 * N_BND
+        # lane strips must be 128-multiples (Mosaic block rule) and the
+        # FULL ghosted height rides in VMEM, so nx+2·N_BND is bounded by
+        # ~14MB/(4·128·itemsize) — ≈6k rows f32; taller dim-0 domains
+        # need the XLA iterate (the reference's own dim-0 shard heights,
+        # n_local≈1024, fit easily)
+        tile0 = max(128, -(-tile // 128) * 128)
+        strip = _fit_strip(tile0, ny, 2 * (nx + nx) * z.dtype.itemsize,
+                           min_strip=128)
+        grid = (pl.cdiv(ny, strip),)
+        block = (nx, strip)
+        index_map = lambda j: (0, j)  # noqa: E731
     se = jnp.asarray(scale_eps, z.dtype).reshape(1)
     return pl.pallas_call(
-        functools.partial(_iterate_kernel_dim1, mn=mn),
+        functools.partial(_iterate_kernel, mn=mn, axis=dim),
         out_shape=jax.ShapeDtypeStruct((nx, ny), z.dtype),
-        grid=(pl.cdiv(nx, strip),),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec((strip, ny), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(block, index_map, memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (strip, ny), lambda i: (i, 0), memory_space=pltpu.VMEM
-        ),
+        out_specs=pl.BlockSpec(block, index_map, memory_space=pltpu.VMEM),
         input_output_aliases={0: 0},
         interpret=_auto_interpret(interpret),
     )(z, se)
