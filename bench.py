@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 V100_F32_ITERS_PER_S = 1006.0  # 810e9 / (3 * 4 * 8192**2), equal-width
 V100_F64_ITERS_PER_S = 503.0  # 810e9 / (3 * 8 * 8192**2), reference dtype
@@ -42,7 +41,7 @@ def main() -> None:
     from tpu_mpi_tests.comm.collectives import shard_blocks
     from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.instrument.timers import chain_rate
     from tpu_mpi_tests.kernels.stencil import analytic_pairs
     from tpu_mpi_tests.utils import check_divisible
 
@@ -79,16 +78,13 @@ def main() -> None:
     else:  # CPU smoke path: interpret-mode pallas is far too slow
         run = iterate_fused_fn(mesh, axis_name, 1, 2, d.n_bnd, d.scale, eps)
 
-    zg = block(run(zg, 3))  # compile + warm
     n_short = int(os.environ.get("TPU_MPI_BENCH_ITERS_SHORT", 100))
-    n_long = int(os.environ.get("TPU_MPI_BENCH_ITERS_LONG", 1100))
-    t0 = time.perf_counter()
-    zg = block(run(zg, n_short))
-    t_short = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    zg = block(run(zg, n_long))
-    t_long = time.perf_counter() - t0
-    iters_per_s = (n_long - n_short) / (t_long - t_short)
+    # 2100 (2000-iteration delta ≈ 1.7 s device time) keeps the shared
+    # tunnel chip's minute-scale contention noise to a few percent; the
+    # round-1 1100 default under-measured by ~4%
+    n_long = int(os.environ.get("TPU_MPI_BENCH_ITERS_LONG", 2100))
+    sec_per_iter, zg = chain_rate(run, zg, n_short=n_short, n_long=n_long)
+    iters_per_s = 1.0 / sec_per_iter
 
     print(
         json.dumps(

@@ -1,0 +1,177 @@
+"""Collective micro-benchmark sweep: per-collective bandwidth vs message size.
+
+The reference is, at heart, an MPI collective/neighbor-exchange
+micro-benchmark suite (Allgather ``mpi_daxpy_nvtx.cc:282-291``, in-place
+Allreduce ``mpi_stencil2d_gt.cc:609-648``, Isend/Irecv neighbor exchange
+``mpi_stencil_gt.cc:83-122``) at a handful of fixed sizes. This driver
+generalizes that into the OSU/nccl-tests-shaped sweep the reference never
+had: every mesh collective × a geometric ladder of message sizes, measured
+with device-side chained loops (``instrument.timers.chain_rate``) so the
+numbers survive shared-chip contention and async dispatch.
+
+Output per (collective, size)::
+
+    COLL <name> bytes=<per-shard-bytes> <us> us/iter  busbw=<GB/s>
+
+``busbw`` uses the standard ring-algorithm accounting (nccl-tests
+conventions) so numbers are comparable across collectives and world sizes:
+
+* ``allgather`` / ``alltoall``: moved = (w−1)/w · gathered_bytes
+* ``allreduce``: moved = 2·(w−1)/w · shard_bytes
+* ``ppermute``: moved = shard_bytes (pure neighbor shift, the halo pattern)
+
+On a 1-device world the collectives execute (XLA degenerate lowering) but
+move nothing; busbw is reported as 0 — the sweep is meaningful on ≥2
+devices (CPU fake-device meshes or real slices, where it rides ICI).
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+from tpu_mpi_tests.drivers import _common
+
+COLLECTIVES = ("allgather", "allreduce", "ppermute", "alltoall")
+
+
+def _loop_fn(mesh, axis_name: str, name: str, world: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def body_of(name):
+        if name == "allgather":
+            def body(_, x):
+                g = lax.all_gather(x, axis_name, axis=0, tiled=True)
+                # consume the NEIGHBOR's slice: slicing one's own shard is
+                # exactly what XLA's AllGatherDynamicSliceSimplifier cancels
+                # back to x, which would delete the collective and benchmark
+                # an empty loop
+                r = lax.axis_index(axis_name)
+                n = x.shape[0]
+                nbr = lax.rem(r + 1, jnp.int32(world))
+                return lax.dynamic_slice_in_dim(g, nbr * n, n) * 0.999 + 1e-7
+        elif name == "allreduce":
+            def body(_, x):
+                return lax.psum(x, axis_name) * (1.0 / world)
+        elif name == "ppermute":
+            perm = [(i, (i + 1) % world) for i in range(world)]
+            def body(_, x):
+                return lax.ppermute(x, axis_name, perm)
+        else:  # alltoall
+            def body(_, x):
+                y = x.reshape(world, x.shape[0] // world)
+                y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                                   tiled=False)
+                return y.reshape(x.shape) * 0.999 + 1e-7
+        return body
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(x, n_iter):
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(axis_name), P()),
+            out_specs=P(axis_name), check_vma=False,
+        )
+        def go(x, n):
+            return lax.fori_loop(0, n[0], body_of(name), x)
+
+        return go(x, jnp.asarray([n_iter], jnp.int32))
+
+    return run
+
+
+def _busbw_bytes(name: str, shard_bytes: int, world: int) -> float:
+    if world < 2:
+        return 0.0
+    if name == "allgather":
+        return (world - 1) * shard_bytes  # (w-1)/w of gathered = (w-1)*shard
+    if name == "allreduce":
+        return 2 * (world - 1) / world * shard_bytes
+    if name == "ppermute":
+        return float(shard_bytes)
+    return (world - 1) / world * shard_bytes  # alltoall
+
+
+def run(args) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.instrument import Reporter
+    from tpu_mpi_tests.instrument.timers import chain_rate
+    from tpu_mpi_tests.utils import check_divisible
+
+    bootstrap()
+    topo = topology()
+    mesh = make_mesh()
+    world = topo.global_device_count
+    axis_name = mesh.axis_names[0]
+
+    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+    rep.banner(
+        f"collbench: world={world} sizes_kib={args.sizes_kib} "
+        f"collectives={args.collectives} n_iter={args.n_iter}"
+    )
+
+    names = args.collectives.split(",")
+    for name in names:
+        if name not in COLLECTIVES:
+            rep.line(f"ERROR unknown collective {name!r}; "
+                     f"valid: {','.join(COLLECTIVES)}")
+            return 2
+
+    for name in names:
+        for kib in (int(s) for s in args.sizes_kib.split(",")):
+            shard_bytes = kib * 1024
+            n = shard_bytes // 4  # f32 elements per shard
+            if name == "alltoall":
+                # only the alltoall reshape (world, n/world) needs this
+                check_divisible(n, world, "alltoall elements per shard")
+            x = shard_1d(
+                jnp.ones((n * world,), jnp.float32), mesh, axis_name
+            )
+            run_fn = _loop_fn(mesh, axis_name, name, world)
+            sec, x = chain_rate(
+                run_fn, x, n_short=args.n_iter // 10 or 1, n_long=args.n_iter
+            )
+            moved = _busbw_bytes(name, shard_bytes, world)
+            busbw = moved / sec / 1e9
+            rep.line(
+                f"COLL {name} bytes={shard_bytes} {sec * 1e6:0.2f} us/iter"
+                f"  busbw={busbw:0.2f} GB/s",
+                {"kind": "coll", "collective": name,
+                 "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
+                 "busbw_gbps": busbw, "world": world},
+            )
+            del x
+    return 0
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--collectives",
+        default=",".join(COLLECTIVES),
+        help="comma list of collectives to sweep",
+    )
+    p.add_argument(
+        "--sizes-kib",
+        default="4,64,1024,16384",
+        help="comma list of per-shard payload sizes in KiB",
+    )
+    p.add_argument(
+        "--n-iter", type=int, default=500,
+        help="chained iterations per measurement",
+    )
+    args = p.parse_args(argv)
+    if args.n_iter < 10:
+        p.error("--n-iter must be >= 10")
+    _common.setup_platform(args)
+    return _common.run_guarded(run, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,29 @@ def dispatch_rate(f, *args, n_iter: int = 2000, n_base: int = 200) -> float:
     return max(t_full - t_base, 1e-12) / n_iter
 
 
+def chain_rate(run, state, n_short: int = 100, n_long: int = 2100):
+    """Seconds per iteration of a device-side chained loop.
+
+    ``run(state, n)`` must execute ``n`` data-dependent iterations on device
+    (``lax.fori_loop``) and return the new state. Two run lengths are
+    differenced to cancel the fixed dispatch + sync cost (≈106 ms controller
+    round-trip on the axon tunnel). This is the measurement primitive behind
+    every chained row in BASELINE.md — unlike per-dispatch timing it never
+    releases the device queue mid-measurement, so it is robust to the shared
+    chip's minute-scale contention (round-2 methodology note).
+
+    Returns ``(seconds_per_iter, final_state)``.
+    """
+    state = block(run(state, 3))  # compile + warm
+    t0 = time.perf_counter()
+    state = block(run(state, n_short))
+    t_short = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state = block(run(state, n_long))
+    t_long = time.perf_counter() - t0
+    return max(t_long - t_short, 1e-12) / (n_long - n_short), state
+
+
 class PhaseTimer:
     """Accumulating named phase timers (≅ the t_/k_/b_/g_ MPI_Wtime pairs of
     ``mpi_daxpy_nvtx.cc:168,242-291,327`` and the per-iteration

@@ -165,11 +165,13 @@ _VMEM_BUDGET_BYTES = 14 * 1024 * 1024
 
 def _fit_strip(tile: int, extent: int, rows_bytes: int, min_strip: int) -> int:
     """Largest strip ≤ tile fitting the VMEM budget (``rows_bytes`` = bytes
-    per unit strip: 2·(ghosted+interior)·itemsize). Ragged final blocks are
-    fine — pallas masks out-of-bounds loads/stores."""
+    per unit strip: 2·(ghosted+interior)·itemsize). Shrinking keeps strips
+    at multiples of ``min_strip`` — lane-dim strips must stay 128-multiples
+    (the Mosaic block rule) and sublane strips 8-multiples. Ragged final
+    blocks are fine — pallas masks out-of-bounds loads/stores."""
     strip = min(tile, extent)
     while strip > min_strip and strip * rows_bytes > _VMEM_BUDGET_BYTES:
-        strip //= 2
+        strip = max(min_strip, (strip // 2) // min_strip * min_strip)
     if strip * rows_bytes > _VMEM_BUDGET_BYTES:
         raise ValueError(
             f"stencil2d_pallas: even a {strip}-wide strip of extent "
@@ -200,10 +202,11 @@ def stencil2d_pallas(
     nx, ny = z.shape
     if dim == 0:
         mx, mn = nx - 2 * N_BND, ny  # out shape
-        # min_strip 64 lets very tall arrays still fit (lanes pad to 128 in
-        # the DMA then — a real bandwidth cost the A/B comparison surfaces)
+        # lane-dim strips must stay 128-multiples (Mosaic block rule);
+        # arrays too tall for even a 128-lane strip fall back to XLA via
+        # the _fit_strip error
         strip = _fit_strip(
-            tile, mn, 2 * (nx + mx) * z.dtype.itemsize, min_strip=64
+            tile, mn, 2 * (nx + mx) * z.dtype.itemsize, min_strip=128
         )
         grid = (pl.cdiv(mn, strip),)
         in_spec = pl.BlockSpec(
