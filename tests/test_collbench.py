@@ -14,7 +14,9 @@ def test_sweep_all_collectives(capsys, tmp_path):
     out = capsys.readouterr().out
     assert rc == 0
     rows = re.findall(
-        r"COLL (\w+) bytes=(\d+) ([\d.]+) us/iter  busbw=([\d.]+) GB/s", out
+        r"COLL (\w+) bytes=(\d+) ([\d.]+|nan) us/iter  "
+        r"busbw=([\d.]+|nan) GB/s",
+        out,
     )
     assert len(rows) == 4 * 2  # 4 collectives x 2 sizes
     assert {r[0] for r in rows} == set(collbench.COLLECTIVES)
@@ -22,10 +24,11 @@ def test_sweep_all_collectives(capsys, tmp_path):
 
     for name, nbytes, us, busbw in rows:
         # timing positivity is not assertable in CI (a loaded host can make
-        # the short/long differencing clamp to ~0) — assert structure and
-        # finiteness; hardware meaning comes from real-chip runs
-        assert math.isfinite(float(us)) and float(us) >= 0
-        assert math.isfinite(float(busbw)) and float(busbw) >= 0
+        # the short/long differencing go non-positive, which chain_rate
+        # surfaces as NaN) — assert structure: values are NaN or >= 0,
+        # never negative/inf; hardware meaning comes from real-chip runs
+        for v in (float(us), float(busbw)):
+            assert math.isnan(v) or (math.isfinite(v) and v >= 0)
     recs = [json.loads(line) for line in jl.read_text().splitlines()]
     coll = [r for r in recs if r.get("kind") == "coll"]
     assert len(coll) == 8 and all(r["world"] == 8 for r in coll)

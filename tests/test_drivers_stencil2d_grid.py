@@ -1,0 +1,61 @@
+"""2-D process-grid driver tests (8 fake devices → 2×4 / 4×2 / 8×1 grids)."""
+
+import re
+
+import pytest
+
+from tpu_mpi_tests.drivers import stencil2d_grid
+
+SMALL = ["--nx-local", "16", "--ny-local", "24", "--n-iter", "4",
+         "--n-warmup", "2"]
+
+
+def run_ok(capsys, extra):
+    rc = stencil2d_grid.main(SMALL + extra)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    m = re.search(
+        r"GRID TEST px:(\d) py:(\d); ([\d.]+), err_dx=([\d.e+-]+), "
+        r"err_dy=([\d.e+-]+)",
+        out,
+    )
+    assert m, out
+    return m
+
+
+def test_auto_mesh_f64(capsys):
+    m = run_ok(capsys, ["--dtype", "float64"])
+    assert (m.group(1), m.group(2)) == ("2", "4")
+    assert float(m.group(4)) < 1e-8 and float(m.group(5)) < 1e-8
+
+
+@pytest.mark.parametrize("mesh", ["4,2", "8,1", "1,8"])
+def test_explicit_meshes(capsys, mesh):
+    px, py = mesh.split(",")
+    m = run_ok(capsys, ["--dtype", "float64", "--mesh", mesh])
+    assert (m.group(1), m.group(2)) == (px, py)
+    assert float(m.group(4)) < 1e-8
+
+
+def test_f32_with_extent_tol(capsys):
+    m = run_ok(capsys, ["--dtype", "float32"])
+    assert float(m.group(4)) >= 0
+
+
+def test_tight_tol_fails(capsys):
+    rc = stencil2d_grid.main(SMALL + ["--dtype", "float32", "--tol", "1e-20"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ERR_NORM FAIL grid" in out
+
+
+def test_bad_mesh_shape(capsys):
+    rc = stencil2d_grid.main(SMALL + ["--mesh", "3,2"])
+    assert rc != 0
+
+
+def test_iter_line_emitted(capsys):
+    rc = stencil2d_grid.main(SMALL + ["--dtype", "float64"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "step mean=" in out

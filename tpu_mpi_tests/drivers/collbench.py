@@ -123,16 +123,16 @@ def run(args) -> int:
                      f"valid: {','.join(COLLECTIVES)}")
             return 2
 
+    dtype = _common.jnp_dtype(args)
+    itemsize = jnp.dtype(dtype).itemsize
     for name in names:
         for kib in (int(s) for s in args.sizes_kib.split(",")):
             shard_bytes = kib * 1024
-            n = shard_bytes // 4  # f32 elements per shard
+            n = shard_bytes // itemsize
             if name == "alltoall":
                 # only the alltoall reshape (world, n/world) needs this
                 check_divisible(n, world, "alltoall elements per shard")
-            x = shard_1d(
-                jnp.ones((n * world,), jnp.float32), mesh, axis_name
-            )
+            x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
             run_fn = _loop_fn(mesh, axis_name, name, world)
             sec, x = chain_rate(
                 run_fn, x, n_short=args.n_iter // 10 or 1, n_long=args.n_iter
@@ -142,7 +142,7 @@ def run(args) -> int:
             rep.line(
                 f"COLL {name} bytes={shard_bytes} {sec * 1e6:0.2f} us/iter"
                 f"  busbw={busbw:0.2f} GB/s",
-                {"kind": "coll", "collective": name,
+                {"kind": "coll", "collective": name, "dtype": args.dtype,
                  "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
                  "busbw_gbps": busbw, "world": world},
             )

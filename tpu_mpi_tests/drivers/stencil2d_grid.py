@@ -1,0 +1,177 @@
+"""2-D process-grid stencil driver: the full distributed step over PX×PY.
+
+The reference decomposes along ONE dim at a time (``mpi_stencil2d_gt.cc``
+runs dim 0 and dim 1 as separate tests); this driver runs the framework's
+generalization — a 2-D device mesh with the domain ghosted and decomposed
+along BOTH axes, per iteration: halo exchange on each axis (``ppermute``
+rings), both-dim 5-point derivatives, and a global residual ``psum`` over
+the whole mesh, compiled as ONE program (``comm/halo.step2d_fn`` — the
+"training step" analog the dry-run harness exercises). Reported lines::
+
+    GRID TEST px:<px> py:<py>; <seconds>, err_dx=<e>, err_dy=<e>
+    ITER  ... (per-iteration mean/min/max past warmup)
+
+Verification matches the reference's strategy (SURVEY §4.1): z = x³ + y²
+with analytic dz/dx = 3x², dz/dy = 2y; interior ghosts start ZERO so a
+broken exchange on either mesh axis explodes the error norm; physical
+ghosts are filled analytically on mesh-edge shards
+(``mpi_stencil2d_gt.cc:458-497``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from tpu_mpi_tests.drivers import _common
+
+
+def _init_block(dx, dy, rx: int, ry: int, px: int, py: int, fn, dtype):
+    """Ghosted (rx, ry) block: interior analytic, physical ghost bands on
+    mesh-edge shards, interior ghosts zero."""
+    x = dx.ghosted_coords(rx, np.float64)
+    y = dy.ghosted_coords(ry, np.float64)
+    full = fn(x[:, None], y[None, :]).astype(dtype)
+    out = np.zeros((dx.n_ghosted, dy.n_ghosted), dtype=dtype)
+    nb = dx.n_bnd
+    ix = slice(nb, nb + dx.n_local)
+    iy = slice(nb, nb + dy.n_local)
+    out[ix, iy] = full[ix, iy]
+    if rx == 0:
+        out[:nb, :] = full[:nb, :]
+    if rx == px - 1:
+        out[-nb:, :] = full[-nb:, :]
+    if ry == 0:
+        out[:, :nb] = full[:, :nb]
+    if ry == py - 1:
+        out[:, -nb:] = full[:, -nb:]
+    return out
+
+
+def run(args) -> int:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_mpi_tests.arrays.domain import Domain1D
+    from tpu_mpi_tests.comm.halo import step2d_fn
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.instrument import PhaseTimer, Reporter
+    from tpu_mpi_tests.kernels.stencil import N_BND, analytic_pairs
+
+    dtype = _common.jnp_dtype(args)
+    bootstrap()
+    topo = topology()
+    n_dev = topo.global_device_count
+
+    if args.mesh:
+        px, py = (int(v) for v in args.mesh.split(","))
+    else:
+        px = 1
+        for cand in range(int(n_dev**0.5), 0, -1):
+            if n_dev % cand == 0:
+                px = cand
+                break
+        py = n_dev // px
+    if px * py != n_dev:
+        print(f"ERROR --mesh {px},{py} needs {px * py} devices, "
+              f"have {n_dev}")
+        return 2
+    mesh = make_mesh({"x": px, "y": py})
+
+    rep = Reporter(rank=topo.process_index, size=n_dev, jsonl_path=args.jsonl)
+    rep.banner(
+        f"stencil2d_grid: mesh={px}x{py} nx_local={args.nx_local} "
+        f"ny_local={args.ny_local} n_iter={args.n_iter} dtype={args.dtype}"
+    )
+
+    dx = Domain1D(n_global=px * args.nx_local, n_shards=px)
+    dy = Domain1D(n_global=py * args.ny_local, n_shards=py)
+    zf, _ = analytic_pairs()["2d_dim0"]
+
+    gx, gy = px * dx.n_ghosted, py * dy.n_ghosted
+    zg_host = np.zeros((gx, gy), dtype=dtype)
+    for rx in range(px):
+        for ry in range(py):
+            zg_host[
+                rx * dx.n_ghosted:(rx + 1) * dx.n_ghosted,
+                ry * dy.n_ghosted:(ry + 1) * dy.n_ghosted,
+            ] = _init_block(dx, dy, rx, ry, px, py, zf, dtype)
+    zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
+
+    step = step2d_fn(mesh, "x", "y", N_BND, float(dx.scale), float(dy.scale))
+
+    timer = PhaseTimer(skip_first=args.n_warmup)
+    out = None
+    for _ in range(args.n_warmup + args.n_iter):
+        out = timer.timed("step", step, zs)
+    dz_dx, dz_dy, residual = out
+    seconds = timer.seconds["step"]
+
+    # err gates vs analytic derivatives over the global interior
+    rc = 0
+    if dz_dx.is_fully_addressable:
+        xs = np.arange(dx.n_global) * dx.delta
+        ys = np.arange(dy.n_global) * dy.delta
+        want_dx = (3.0 * xs[:, None] ** 2) + 0.0 * ys[None, :]
+        want_dy = 0.0 * xs[:, None] + 2.0 * ys[None, :]
+        got_dx = np.asarray(jax.device_get(dz_dx), np.float64)
+        got_dy = np.asarray(jax.device_get(dz_dy), np.float64)
+        err_dx = float(np.sqrt(np.mean((got_dx - want_dx) ** 2)))
+        err_dy = float(np.sqrt(np.mean((got_dy - want_dy) ** 2)))
+    else:  # multi-host: residual finiteness is the (weaker) gate
+        err_dx = err_dy = float("nan")
+    rep.line(
+        f"GRID TEST px:{px} py:{py}; {seconds:f}, "
+        f"err_dx={err_dx:e}, err_dy={err_dy:e}",
+        {"kind": "grid_test", "px": px, "py": py, "seconds": seconds,
+         "err_dx": err_dx, "err_dy": err_dy,
+         "residual": float(residual)},
+    )
+    rep.iter_line(0, "device", 0, "step", timer.mean("step"),
+                  timer.mins.get("step", 0.0), timer.maxs.get("step", 0.0))
+
+    if not np.isfinite(float(residual)):
+        rep.line(f"RESIDUAL FAIL: {residual}")
+        return 1
+    tol = args.tol if args.tol is not None else _default_tol(args, dx, dy)
+    if np.isfinite(err_dx) and max(err_dx, err_dy) > tol:
+        rep.line(
+            f"ERR_NORM FAIL grid: dx={err_dx:.8g} dy={err_dy:.8g} > "
+            f"tol {tol:.8g}"
+        )
+        rc = 1
+    return rc
+
+
+def _default_tol(args, dx, dy) -> float:
+    if args.dtype == "float64":
+        return 1e-5
+    eps = 7.8e-3 if args.dtype == "bfloat16" else 1.2e-7
+    zmax = dx.length**3 + dy.length**2
+    return 8 * eps * zmax * max(dx.scale, dy.scale)
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument("--mesh", default=None,
+                   help="process grid as 'PX,PY' (default: auto-factor)")
+    p.add_argument("--nx-local", type=int, default=64,
+                   help="per-shard interior rows")
+    p.add_argument("--ny-local", type=int, default=64,
+                   help="per-shard interior cols")
+    p.add_argument("--n-iter", type=int, default=100)
+    p.add_argument("--n-warmup", type=int, default=5)
+    p.add_argument("--tol", type=float, default=None)
+    args = p.parse_args(argv)
+    for name in ("nx_local", "ny_local", "n_iter"):
+        if getattr(args, name) < 1:
+            p.error(f"--{name.replace('_', '-')} must be positive")
+    if min(args.nx_local, args.ny_local) < 5:
+        p.error("--nx-local/--ny-local must be >= 5 (stencil width)")
+    _common.setup_platform(args)
+    return _common.run_guarded(run, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

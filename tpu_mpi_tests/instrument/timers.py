@@ -124,7 +124,10 @@ def chain_rate(run, state, n_short: int = 100, n_long: int = 2100):
     releases the device queue mid-measurement, so it is robust to the shared
     chip's minute-scale contention (round-2 methodology note).
 
-    Returns ``(seconds_per_iter, final_state)``.
+    Returns ``(seconds_per_iter, final_state)``. A non-positive delta
+    (possible on a heavily contended host where timer noise exceeds the
+    device work) returns NaN rather than a sign-masked absurd rate — an
+    invalid measurement must look invalid downstream.
     """
     state = block(run(state, 3))  # compile + warm
     t0 = time.perf_counter()
@@ -133,7 +136,10 @@ def chain_rate(run, state, n_short: int = 100, n_long: int = 2100):
     t0 = time.perf_counter()
     state = block(run(state, n_long))
     t_long = time.perf_counter() - t0
-    return max(t_long - t_short, 1e-12) / (n_long - n_short), state
+    delta = t_long - t_short
+    if delta <= 0:
+        return float("nan"), state
+    return delta / (n_long - n_short), state
 
 
 class PhaseTimer:

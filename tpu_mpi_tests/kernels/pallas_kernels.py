@@ -202,9 +202,11 @@ def stencil2d_pallas(
     nx, ny = z.shape
     if dim == 0:
         mx, mn = nx - 2 * N_BND, ny  # out shape
-        # lane-dim strips must stay 128-multiples (Mosaic block rule);
-        # arrays too tall for even a 128-lane strip fall back to XLA via
-        # the _fit_strip error
+        # lane-dim strips must stay 128-multiples (Mosaic block rule) —
+        # rounded up here AND preserved by _fit_strip's shrinking; arrays
+        # too tall for even a 128-lane strip fall back to XLA via the
+        # _fit_strip error
+        tile = max(128, -(-tile // 128) * 128)
         strip = _fit_strip(
             tile, mn, 2 * (nx + mx) * z.dtype.itemsize, min_strip=128
         )
