@@ -2,7 +2,7 @@
 """Microbenchmark runner: reproduces every measured row in BASELINE.md.
 
 Usage (from /root/repo):
-    python tpu/microbench.py [daxpy] [stencil] [iterate] [ceiling]
+    python tpu/microbench.py [daxpy] [stencil] [iterate] [splitfused] [ceiling]
 
 Runs the selected groups (default: all) on whatever backend is active and
 prints one JSON line per measurement plus a summary table. Timing uses the
@@ -16,7 +16,6 @@ from __future__ import annotations
 import functools
 import json
 import sys
-import time
 
 
 def _emit(results, metric, value, unit, detail=""):
@@ -84,46 +83,105 @@ def bench_stencil(results):
               "1028x8192 f32, 2-pass traffic model")
 
 
-def bench_iterate(results):
-    import jax
-    import numpy as np
+def _iterate_setup(n: int = 8192, dim: int = 1, n_local: int | None = None):
+    """Shared mesh/domain/init plumbing for the chained benchmark groups.
 
+    Returns ``(mesh, ax, d, make_z)`` or None when the domain does not
+    divide over the available devices; ``make_z(dtype)`` builds a freshly
+    device-initialized ghosted sharded array."""
     from tpu_mpi_tests.arrays.domain import Domain2D
     from tpu_mpi_tests.comm.collectives import device_init
-    from tpu_mpi_tests.comm.halo import iterate_pallas_fn
     from tpu_mpi_tests.comm.mesh import make_mesh, topology
-    from tpu_mpi_tests.instrument.timers import block
     from tpu_mpi_tests.kernels.stencil import analytic_pairs
 
-    n = 8192
-    topo = topology()
-    world = topo.global_device_count
-    if n % world:
-        return
+    world = topology().global_device_count
+    if n_local is None:
+        if n % world:
+            return None
+        n_local = n // world
     mesh = make_mesh()
     d = Domain2D(
-        n_local_deriv=n // world, n_global_other=n, n_shards=world, dim=1
+        n_local_deriv=n_local, n_global_other=n, n_shards=world, dim=dim
     )
-    f, _ = analytic_pairs()["2d_dim1"]
+    f, _ = analytic_pairs()[f"2d_dim{dim}"]
 
-    for dtype, bits in (("float32", 4), ("bfloat16", 2)):
-        import jax.numpy as jnp
-
-        dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
-        zg = device_init(
-            mesh, lambda r: d.init_shard_jax(f, r, dt), axis=1
+    def make_z(dtype):
+        return device_init(
+            mesh, lambda r: d.init_shard_jax(f, r, dtype), axis=dim
         )
-        run = iterate_pallas_fn(mesh, mesh.axis_names[0], d.n_bnd, 1e-6)
-        zg = block(run(zg, 3))
-        t0 = time.perf_counter()
-        zg = block(run(zg, 100))
-        t_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        zg = block(run(zg, 1100))
-        t_l = time.perf_counter() - t0
-        per = (t_l - t_s) / 1000
-        _emit(results, f"iterate_{dtype}_iters_per_s", 1 / per, "iter/s",
-              f"{n}x{n}, {n * n * bits * 2 / per / 1e9:.0f} GB/s")
+
+    return mesh, mesh.axis_names[0], d, make_z
+
+
+def bench_iterate(results):
+    """Chained in-place iterate rows — the kernel-only BASELINE metrics
+    (robust to shared-chip contention; round-2 methodology note)."""
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
+    from tpu_mpi_tests.instrument.timers import chain_rate
+
+    n = 8192
+    setup = _iterate_setup(n, dim=1)
+    if setup is None:
+        return
+    mesh, ax, d1, make_z1 = setup
+    # dim 1 (lane shifts), pallas f32/bf16 + XLA f32 — 8192² domain
+    for dtype, bits in (("float32", 4), ("bfloat16", 2)):
+        dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
+        zg = make_z1(dt)
+        run = iterate_pallas_fn(mesh, ax, d1.n_bnd, 1e-6)
+        per, zg = chain_rate(run, zg)
+        _emit(results, f"iterate_d1_pallas_{dtype}_iters_per_s", 1 / per,
+              "iter/s", f"{n}x{n}, {n * n * bits * 2 / per / 1e9:.0f} GB/s")
+        del zg
+    zg = make_z1(jnp.float32)
+    per, zg = chain_rate(
+        iterate_fused_fn(mesh, ax, 1, 2, d1.n_bnd, 1.0, 1e-6), zg
+    )
+    _emit(results, "iterate_d1_xla_float32_iters_per_s", 1 / per, "iter/s",
+          f"{n}x{n}, {n * n * 4 * 2 / per / 1e9:.0f} GB/s")
+    del zg
+
+    # dim 0 (sublane shifts) at the reference shard geometry 1028×8192
+    mesh, ax, d0, make_z0 = _iterate_setup(n, dim=0, n_local=1024)
+    elts = (1024 + 4) * n
+    for name, mk in (
+        ("pallas", lambda: iterate_pallas_fn(mesh, ax, d0.n_bnd, 1e-6,
+                                             axis=0)),
+        ("xla", lambda: iterate_fused_fn(mesh, ax, 0, 2, d0.n_bnd, 1.0,
+                                         1e-6)),
+    ):
+        zg = make_z0(jnp.float32)
+        per, zg = chain_rate(mk(), zg)
+        _emit(results, f"iterate_d0_{name}_float32_iters_per_s", 1 / per,
+              "iter/s",
+              f"1028x{n}, {elts * 4 * 2 / per / 1e9:.0f} GB/s")
+        del zg
+
+
+def bench_splitfused(results):
+    """Split-vs-fused A/B (SURVEY §7 hard part 2): exchange + stencil with
+    and without an optimization_barrier at the phase boundary, periodic
+    self-ring so the exchange moves real data on one chip."""
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.comm.halo import iterate_fused_fn
+    from tpu_mpi_tests.instrument.timers import chain_rate
+
+    n = 8192
+    setup = _iterate_setup(n, dim=1)
+    if setup is None:
+        return
+    mesh, ax, d, make_z = setup
+    for label, kw in (("fused", {}), ("split", {"split": True})):
+        zg = make_z(jnp.float32)
+        run = iterate_fused_fn(mesh, ax, 1, 2, d.n_bnd, 1.0, 1e-6,
+                               periodic=True, **kw)
+        per, zg = chain_rate(run, zg)
+        _emit(results, f"exchange_stencil_{label}_us_per_iter", per * 1e6,
+              "us/iter", f"{n}x{n} f32, periodic self-ring")
+        del zg
 
 
 def bench_ceiling(results):
@@ -183,6 +241,7 @@ GROUPS = {
     "daxpy": bench_daxpy,
     "stencil": bench_stencil,
     "iterate": bench_iterate,
+    "splitfused": bench_splitfused,
     "ceiling": bench_ceiling,
 }
 

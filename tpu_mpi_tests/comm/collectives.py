@@ -206,6 +206,39 @@ def _all_gather_inplace_fn(mesh: Mesh, axis_name: str, axis: int, ndim: int):
     return gather
 
 
+@functools.lru_cache(maxsize=None)
+def _all_gather_rdma_fn(mesh: Mesh, axis_name: str, ndim: int,
+                        interpret: bool | None):
+    from tpu_mpi_tests.kernels.pallas_kernels import ring_allgather_pallas
+
+    spec = [None] * ndim
+    spec[0] = axis_name
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(),
+        check_vma=False,
+    )
+    def gather(x):
+        return ring_allgather_pallas(
+            x, axis_name=axis_name, interpret=interpret
+        )
+
+    return gather
+
+
+def all_gather_rdma(x_sharded, mesh: Mesh, axis_name: str | None = None,
+                    interpret: bool | None = None):
+    """Hand-tier ``all_gather`` (axis 0, tiled): the explicit-RDMA ring
+    twin of :func:`all_gather`, completing the dual-tier pattern for the
+    collective pillar (≅ hand-writing the ``MPI_Allgather`` of
+    ``mpi_daxpy_nvtx.cc:285-288`` as w−1 ring hops; SURVEY §5.8)."""
+    axis_name = axis_name or mesh.axis_names[0]
+    return _all_gather_rdma_fn(
+        mesh, axis_name, x_sharded.ndim, interpret
+    )(x_sharded)
+
+
 def all_gather_inplace(allx_sharded, mesh: Mesh, axis_name: str | None = None,
                        axis: int = 0):
     """``MPI_Allgather(MPI_IN_PLACE)`` parity: input is the full-size global

@@ -65,7 +65,11 @@ def run(args) -> int:
     n_dev = topo.global_device_count
 
     if args.mesh:
-        px, py = (int(v) for v in args.mesh.split(","))
+        try:
+            px, py = (int(v) for v in args.mesh.split(","))
+        except ValueError:
+            print(f"ERROR --mesh must be 'PX,PY', got {args.mesh!r}")
+            return 2
     else:
         px = 1
         for cand in range(int(n_dev**0.5), 0, -1):

@@ -513,6 +513,115 @@ def ring_halo_pallas(
     )
 
 
+def _ring_allgather_kernel(x_ref, out_ref, copy_sem, send_sem, recv_sem,
+                           *, axis_name, use_barrier):
+    """Ring all-gather with explicit remote DMA (≅ a hand-written
+    ``MPI_Allgather`` over the ring, the device-pointer gather of
+    ``mpi_daxpy_nvtx.cc:282-291`` done as w−1 neighbor hops instead of one
+    library call). Step ``s`` forwards the out-region received at step
+    ``s−1`` (step 0: the own block) straight out of ``out_ref`` to the
+    right neighbor's identical region — every region is written by exactly
+    ONE incoming DMA and forwarded only after our own recv wait, so there
+    is no buffer-slot reuse and hence no write-after-read hazard to
+    handshake away (the double-buffered-comm formulation needs receiver
+    backpressure this schedule makes unnecessary). Each step fully waits
+    (send read done + recv landed) before the next, so one send/recv
+    semaphore pair serves all steps."""
+    n_dev = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, jnp.int32(n_dev))
+    left = jax.lax.rem(my - 1 + jnp.int32(n_dev), jnp.int32(n_dev))
+    n = x_ref.shape[0]
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    own = pltpu.make_async_copy(
+        x_ref, out_ref.at[pl.ds(my * n, n)], copy_sem
+    )
+    own.start()
+    own.wait()
+
+    for step in range(n_dev - 1):
+        # region forwarded this step: own block at step 0, then whatever
+        # landed last step
+        src = jax.lax.rem(
+            my - jnp.int32(step) + jnp.int32(n_dev * n_dev),
+            jnp.int32(n_dev),
+        )
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref.at[pl.ds(src * n, n)],
+            dst_ref=out_ref.at[pl.ds(src * n, n)],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+
+def ring_allgather_pallas(
+    x,
+    *,
+    axis_name: str,
+    collective_id: int = 9,
+    interpret: bool | None = None,
+):
+    """Per-shard ring all-gather along axis 0 with explicit inter-chip RDMA
+    — the hand-tuned twin of ``lax.all_gather(tiled=True)`` for the
+    COLLECTIVE pillar, completing the dual-tier pattern the halo layer has
+    (``ring_halo_pallas`` vs ``ppermute``). Call *inside* ``shard_map``.
+
+    ``x`` is this shard's (n, m) block; returns the (w·n, m) gathered array.
+    Everything stays HBM-resident (shard-size independent); the only
+    alignment requirement is that the dynamic row offsets of the out-region
+    DMAs stay sublane-tile-aligned: n must be a multiple of the dtype's
+    sublane tile (8 rows f32/f64, 16 bf16, 32 int8).
+    """
+    if x.ndim == 1:
+        return ring_allgather_pallas(
+            x.reshape(-1, 1),
+            axis_name=axis_name,
+            collective_id=collective_id,
+            interpret=interpret,
+        ).reshape(-1)
+    n = x.shape[0]
+    sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
+    if n % sublane != 0:
+        raise ValueError(
+            f"ring_allgather_pallas needs shard rows % {sublane} == 0 for "
+            f"{jnp.dtype(x.dtype).name} (sublane tile), got {n}"
+        )
+    interp = _auto_interpret(interpret)
+    n_dev = jax.lax.axis_size(axis_name)
+    out_struct = jax.ShapeDtypeStruct((n_dev * n, *x.shape[1:]), x.dtype)
+    return pl.pallas_call(
+        functools.partial(
+            _ring_allgather_kernel,
+            axis_name=axis_name,
+            use_barrier=not interp,
+        ),
+        out_shape=out_struct,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interp,
+    )(x)
+
+
 # ---------------------------------------------------------------------------
 # Halo pack/unpack staging kernels
 # ---------------------------------------------------------------------------
