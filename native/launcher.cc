@@ -8,14 +8,25 @@
 // contract mpirun gives the reference's launch scripts
 // (/root/reference/jlse/run.sh:29-33).
 //
-// Usage: tpumt_run -n NPROCS [-p PORT] [-o PREFIX] -- command [args...]
+// Usage: tpumt_run -n NPROCS [-p PORT] [-o PREFIX] [-t SECONDS] -- command
+//        [args...]
 //
 // -o PREFIX redirects each child's stdout+stderr to PREFIX<rank>.txt
 // (≅ the per-run `out-<tag>.txt` redirection of the reference's launch
 // scripts, /root/reference/summit/run.sh:31 — and what mpirun's
 // --output-filename gives; without it parallel children interleave lines).
+//
+// -t SECONDS arms a launcher-level deadline: if any rank is still running
+// when it expires, every child is killed (SIGKILL to the process group) and
+// the launcher exits 124 — the batch-scheduler walltime role
+// (≅ job.lsf/job.pbs walltime limits) for local runs, so a rank hung in a
+// dead collective cannot wedge the launcher forever. Pairs with the
+// in-process Python watchdog (instrument/watchdog.py), which attributes the
+// hang; this is the backstop when a process is too wedged to self-report.
 
+#include <cerrno>
 #include <fcntl.h>
+#include <signal.h>
 #include <sys/types.h>
 #include <sys/wait.h>
 #include <unistd.h>
@@ -26,9 +37,26 @@
 #include <string>
 #include <vector>
 
+namespace {
+pid_t g_pids[4096];
+int g_npids = 0;
+volatile sig_atomic_t g_timed_out = 0;
+
+void on_alarm(int) {
+  g_timed_out = 1;
+  for (int i = 0; i < g_npids; ++i) {
+    pid_t pid = g_pids[i];
+    if (pid <= 0) continue;    // already reaped; pid may be recycled
+    kill(-pid, SIGKILL);       // whole process group (async-signal-safe)
+    kill(pid, SIGKILL);        // fallback if the child hadn't setpgid yet
+  }
+}
+}  // namespace
+
 int main(int argc, char** argv) {
   int nprocs = 0;
   int port = 0;
+  int timeout_s = 0;
   int cmd_start = -1;
   const char* out_prefix = nullptr;
   for (int i = 1; i < argc; ++i) {
@@ -38,6 +66,15 @@ int main(int argc, char** argv) {
       port = std::atoi(argv[++i]);
     } else if (std::strcmp(argv[i], "-o") == 0 && i + 1 < argc) {
       out_prefix = argv[++i];
+    } else if (std::strcmp(argv[i], "-t") == 0 && i + 1 < argc) {
+      char* end = nullptr;
+      long v = std::strtol(argv[++i], &end, 10);
+      if (end == argv[i] || *end != '\0' || v < 1 || v > 86400 * 365) {
+        std::fprintf(stderr, "tpumt_run: -t wants seconds >= 1, got %s\n",
+                     argv[i]);
+        return 2;
+      }
+      timeout_s = static_cast<int>(v);
     } else if (std::strcmp(argv[i], "--") == 0) {
       cmd_start = i + 1;
       break;
@@ -46,11 +83,11 @@ int main(int argc, char** argv) {
       return 2;
     }
   }
-  if (nprocs < 1 || cmd_start < 0 || cmd_start >= argc) {
+  if (nprocs < 1 || nprocs > 4096 || cmd_start < 0 || cmd_start >= argc) {
     std::fprintf(
         stderr,
-        "usage: tpumt_run -n NPROCS [-p PORT] [-o PREFIX] -- command "
-        "[args...]\n");
+        "usage: tpumt_run -n NPROCS [-p PORT] [-o PREFIX] [-t SECONDS] -- "
+        "command [args...]\n");
     return 2;
   }
   if (port == 0) {
@@ -63,9 +100,17 @@ int main(int argc, char** argv) {
     pid_t pid = fork();
     if (pid < 0) {
       std::perror("tpumt_run: fork");
+      // already-forked ranks would otherwise run orphaned forever, blocked
+      // waiting for peers that will never arrive — kill their groups
+      for (pid_t p : pids) {
+        kill(-p, SIGKILL);
+        kill(p, SIGKILL);
+        waitpid(p, nullptr, 0);
+      }
       return 1;
     }
     if (pid == 0) {
+      setpgid(0, 0);  // own group, so the deadline can kill grandchildren
       setenv("JAX_COORDINATOR_ADDRESS", coord.c_str(), 1);
       setenv("JAX_NUM_PROCESSES", std::to_string(nprocs).c_str(), 1);
       setenv("JAX_PROCESS_ID", std::to_string(rank).c_str(), 1);
@@ -86,12 +131,24 @@ int main(int argc, char** argv) {
       _exit(127);
     }
     pids.push_back(pid);
+    g_pids[g_npids++] = pid;
+  }
+
+  if (timeout_s > 0) {
+    signal(SIGALRM, on_alarm);
+    alarm(static_cast<unsigned>(timeout_s));
   }
 
   int rc = 0;
-  for (pid_t pid : pids) {
+  for (size_t i = 0; i < pids.size(); ++i) {
+    pid_t pid = pids[i];
     int status = 0;
-    if (waitpid(pid, &status, 0) < 0) {
+    pid_t r;
+    do {  // SIGALRM interrupts waitpid; retry so every child is reaped
+      r = waitpid(pid, &status, 0);
+    } while (r < 0 && errno == EINTR);
+    g_pids[i] = -1;  // reaped: the pid may be recycled, never signal it
+    if (r < 0) {
       std::perror("tpumt_run: waitpid");
       rc = 1;
     } else if (WIFEXITED(status) && WEXITSTATUS(status) != 0) {
@@ -101,6 +158,13 @@ int main(int argc, char** argv) {
                    static_cast<int>(pid), WTERMSIG(status));
       rc = 128 + WTERMSIG(status);
     }
+  }
+  alarm(0);
+  if (g_timed_out) {
+    std::fprintf(stderr,
+                 "tpumt_run: deadline of %d s exceeded; killed all ranks\n",
+                 timeout_s);
+    return 124;
   }
   return rc;
 }

@@ -82,6 +82,18 @@ def test_gather_inplace_parity(capsys):
     assert "7/8 lsum=16384.0 asum=73728.0" in out
 
 
+def test_gather_inplace_rdma_tier(capsys):
+    """The hand-written RDMA ring gather passes the same exact parity gate
+    as the lax tier (≅ validating a hand MPI_Allgather end to end)."""
+    rc = gather_inplace.main(
+        ["--n-per-rank", "1024", "--dtype", "float32", "--rdma"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "PARITY FAIL" not in out
+    assert "asum=36864.0" in out  # 1024 * 8*9/2
+
+
 def test_envprobe(capsys, monkeypatch):
     monkeypatch.setenv("MEMORY_PER_CORE", "1024")
     rc = envprobe.main([])

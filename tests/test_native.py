@@ -62,17 +62,24 @@ def test_native_aggregator_matches_python(outfiles):
     assert "out-a.txt 2 min=1.5 max=2.5 n=2" in r_native.stdout
 
 
-@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
-def test_native_launcher_wires_rank_env(tmp_path):
+@pytest.fixture(scope="module")
+def launcher_bin():
+    """Build tpumt_run from the current sources so no test runs a stale
+    binary that predates the flag it exercises."""
     subprocess.run(
         ["make", "-C", str(REPO / "native"), "tpumt_run"],
         capture_output=True,
         check=True,
         timeout=120,
     )
+    return str(REPO / "native" / "tpumt_run")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_launcher_wires_rank_env(launcher_bin):
     r = subprocess.run(
         [
-            str(REPO / "native" / "tpumt_run"),
+            launcher_bin,
             "-n", "3", "--",
             "sh", "-c",
             'echo "rank=$JAX_PROCESS_ID of $JAX_NUM_PROCESSES '
@@ -89,9 +96,47 @@ def test_native_launcher_wires_rank_env(tmp_path):
 
 
 @pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
-def test_native_launcher_propagates_failure():
+def test_native_launcher_deadline_kills_hung_ranks(launcher_bin):
+    """-t arms the batch-walltime backstop: hung ranks are killed and the
+    launcher exits 124 instead of wedging forever (§5.3 failure detection
+    at the launcher layer, ≅ job.lsf/job.pbs walltime). The hung rank is a
+    shell with a background grandchild — the whole process group must die,
+    not just the direct child."""
+    sentinel = "31256.5"  # unique duration so the ps grep can't match
+    t0 = time.time()
     r = subprocess.run(
-        [str(REPO / "native" / "tpumt_run"), "-n", "2", "--",
+        [launcher_bin, "-n", "2", "-t", "1", "--",
+         "sh", "-c", f"sleep {sentinel} & wait"],
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    assert r.returncode == 124
+    assert "deadline of 1 s exceeded" in r.stderr
+    assert time.time() - t0 < 10
+    # no orphaned grandchild survives the group kill
+    ps = subprocess.run(
+        ["ps", "-eo", "args"], capture_output=True, text=True
+    ).stdout
+    assert f"sleep {sentinel}" not in ps
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_launcher_rejects_bad_timeout(launcher_bin):
+    r = subprocess.run(
+        [launcher_bin, "-n", "1", "-t", "bogus", "--", "true"],
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    assert r.returncode == 2
+    assert "-t wants seconds" in r.stderr
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_launcher_propagates_failure(launcher_bin):
+    r = subprocess.run(
+        [launcher_bin, "-n", "2", "--",
          "sh", "-c", 'exit "$JAX_PROCESS_ID"'],
         capture_output=True,
         timeout=60,

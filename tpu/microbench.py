@@ -15,7 +15,13 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
+
+# runnable from any cwd (the package lives beside this file's parent dir)
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def _emit(results, metric, value, unit, detail=""):
@@ -74,13 +80,15 @@ def bench_stencil(results):
             n_iter=500, n_base=50,
         )
         _emit(results, f"stencil_xla_d{dim}_eff_gbps", gb / t, "GB/s",
-              "1028x8192 f32, 2-pass traffic model")
+              "1028x8192 f32, 2-pass model; PER-DISPATCH — contention-noisy "
+              "on shared chips, prefer the chained iterate rows")
         t = dispatch_rate(
             lambda a: PK.stencil2d_pallas(a, 3.0, dim=dim, tile=512), z,
             n_iter=500, n_base=50,
         )
         _emit(results, f"stencil_pallas_d{dim}_eff_gbps", gb / t, "GB/s",
-              "1028x8192 f32, 2-pass traffic model")
+              "1028x8192 f32, 2-pass model; PER-DISPATCH — contention-noisy "
+              "on shared chips, prefer the chained iterate rows")
 
 
 def _iterate_setup(n: int = 8192, dim: int = 1, n_local: int | None = None):

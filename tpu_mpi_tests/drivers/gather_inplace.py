@@ -42,7 +42,12 @@ def run(args) -> int:
     allx = C.shard_1d(jnp.asarray(fill.astype(dtype)), mesh)
     local_sums = [(r + 1) * n for r in range(world)]
 
-    g = block(C.all_gather_inplace(allx, mesh))
+    if args.rdma:
+        # hand-written RDMA ring tier (≅ hand-coding the MPI_Allgather);
+        # shard rows must meet the sublane-tile alignment
+        g = block(C.all_gather_rdma(allx, mesh))
+    else:
+        g = block(C.all_gather_inplace(allx, mesh))
     asum = float(np.asarray(g, dtype=np.float64).sum())
 
     for r in range(world):
@@ -66,6 +71,12 @@ def main(argv=None) -> int:
         type=int,
         default=1 << 20,
         help="elements per rank (reference: 128Mi doubles)",
+    )
+    p.add_argument(
+        "--rdma",
+        action="store_true",
+        help="gather through the hand-written RDMA ring "
+        "(collectives.all_gather_rdma) instead of lax.all_gather",
     )
     args = p.parse_args(argv)
     if args.n_per_rank < 1:
