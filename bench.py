@@ -49,6 +49,11 @@ def main() -> None:
     # official metric is the 8192 default on real hardware (the baseline
     # constant assumes it)
     n = int(os.environ.get("TPU_MPI_BENCH_N", 8192))
+    # temporal blocking: k timesteps per HBM pass over deep (k·2-wide)
+    # halos — interior-identical to per-step exchange (tested in
+    # tests/test_pallas.py::test_iterate_multistep_*); the exchanged volume
+    # per timestep is unchanged, messages drop k-fold
+    steps = int(os.environ.get("TPU_MPI_BENCH_STEPS", 4))
     n_fake = int(os.environ.get("TPU_MPI_BENCH_FAKE_DEVICES", "0"))
     if n_fake > 0:  # 0 = off, matching the drivers' --fake-devices default
         from tpu_mpi_tests.drivers._common import force_cpu_devices
@@ -62,8 +67,16 @@ def main() -> None:
     axis_name = mesh.axis_names[0]
 
     check_divisible(n, world, "bench domain over devices")
+    if topo.platform != "tpu":
+        steps = 1  # CPU smoke path uses the XLA iterate (shallow halos)
+    from tpu_mpi_tests.kernels.stencil import N_BND
+
     d = Domain2D(
-        n_local_deriv=n // world, n_global_other=n, n_shards=world, dim=1
+        n_local_deriv=n // world,
+        n_global_other=n,
+        n_shards=world,
+        dim=1,
+        n_bnd=N_BND * steps,
     )
     f, _ = analytic_pairs()["2d_dim1"]
     zg = shard_blocks(
@@ -74,17 +87,22 @@ def main() -> None:
         axis=1,
     )
     if topo.platform == "tpu":
-        run = iterate_pallas_fn(mesh, axis_name, d.n_bnd, eps * d.scale)
+        run = iterate_pallas_fn(
+            mesh, axis_name, d.n_bnd, eps * d.scale, steps=steps
+        )
     else:  # CPU smoke path: interpret-mode pallas is far too slow
         run = iterate_fused_fn(mesh, axis_name, 1, 2, d.n_bnd, d.scale, eps)
 
     n_short = int(os.environ.get("TPU_MPI_BENCH_ITERS_SHORT", 100))
     # 2100 (2000-iteration delta ≈ 1.7 s device time) keeps the shared
     # tunnel chip's minute-scale contention noise to a few percent; the
-    # round-1 1100 default under-measured by ~4%
+    # round-1 1100 default under-measured by ~4%. Counts are in TIMESTEPS;
+    # the outer chain length divides by `steps` (each call advances k).
     n_long = int(os.environ.get("TPU_MPI_BENCH_ITERS_LONG", 2100))
-    sec_per_iter, zg = chain_rate(run, zg, n_short=n_short, n_long=n_long)
-    iters_per_s = 1.0 / sec_per_iter
+    n_short = max(1, n_short // steps)
+    n_long = max(n_short + 1, n_long // steps)
+    sec_per_call, zg = chain_rate(run, zg, n_short=n_short, n_long=n_long)
+    iters_per_s = steps / sec_per_call
 
     print(
         json.dumps(

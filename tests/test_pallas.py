@@ -88,6 +88,107 @@ def test_iterate_inplace_step(dim):
     assert np.allclose(np.asarray(got), ref, atol=1e-5)
 
 
+@pytest.mark.parametrize("dim", [0, 1])
+@pytest.mark.parametrize("steps", [2, 3])
+@pytest.mark.parametrize("flags", ["static", "dynamic"])
+def test_iterate_multistep_matches_repeated_single(dim, steps, flags):
+    """Temporal blocking (k steps per HBM pass over a deep ghost band) must
+    reproduce k single-step calls exactly. Single shard, both sides
+    physical (fixed band, ≅ the per-step scheme's Dirichlet ghosts)."""
+    K = steps * 2
+    m, other = 40, 24
+    shape = (m + 2 * K, other) if dim == 0 else (other, m + 2 * K)
+    z_deep = rng(steps, shape)
+    z0 = np.asarray(z_deep)  # host copy: the kernel donates its input
+    # the narrow (ghost-width-2) layout is the inner slice of the deep one
+    sl = [slice(None), slice(None)]
+    sl[dim] = slice(K - 2, K - 2 + m + 4)
+    z_narrow = jnp.asarray(z0[tuple(sl)])
+
+    phys_kw = (
+        {"phys_static": (1, 1)}
+        if flags == "static"
+        else {"phys": jnp.asarray([1, 1])}
+    )
+    got = PK.stencil2d_iterate_pallas(
+        z_deep, 0.25, dim=dim, steps=steps, **phys_kw
+    )
+    ref = z_narrow
+    for _ in range(steps):
+        ref = PK.stencil2d_iterate_pallas(ref, 0.25, dim=dim)
+
+    interior = [slice(None), slice(None)]
+    interior[dim] = slice(K, K + m)
+    ref_interior = [slice(None), slice(None)]
+    ref_interior[dim] = slice(2, 2 + m)
+    np.testing.assert_allclose(
+        np.asarray(got[tuple(interior)]),
+        np.asarray(ref[tuple(ref_interior)]),
+        atol=1e-6,
+    )
+    # the deep call must also leave its own physical band untouched
+    lo = [slice(None), slice(None)]
+    lo[dim] = slice(0, K)
+    np.testing.assert_array_equal(np.asarray(got[tuple(lo)]), z0[tuple(lo)])
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("periodic", [False, True])
+def test_iterate_multistep_distributed(mesh8, axis, periodic):
+    """Deep-halo k-step iterate over 8 shards == per-step-exchange XLA
+    iterate, on the true interior (the layouts differ only in ghost width).
+    Covers exchange-fed sides (span shrink per step) and, non-periodic,
+    physical edge shards (fixed band)."""
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
+
+    steps, outer = 2, 3
+    K, nloc, other = 2 * steps, 16, 32
+    rng_ = np.random.default_rng(7 + axis)
+    deep_blocks = [
+        rng_.normal(size=(nloc + 2 * K, other)).astype(np.float32)
+        for _ in range(8)
+    ]
+    narrow_blocks = [b[K - 2 : K - 2 + nloc + 4] for b in deep_blocks]
+    if axis == 1:
+        deep_blocks = [b.T for b in deep_blocks]
+        narrow_blocks = [b.T for b in narrow_blocks]
+    z_deep = shard_1d(
+        jnp.asarray(np.concatenate(deep_blocks, axis=axis)), mesh8, axis=axis
+    )
+    z_narrow = shard_1d(
+        jnp.asarray(np.concatenate(narrow_blocks, axis=axis)),
+        mesh8,
+        axis=axis,
+    )
+
+    fused = iterate_fused_fn(
+        mesh8, "shard", axis, 2, 2, 10.0, 1e-3, periodic=periodic
+    )
+    deep = iterate_pallas_fn(
+        mesh8, "shard", K, 1e-2, axis=axis, interpret=True, steps=steps,
+        periodic=periodic,
+    )
+    ra = np.split(np.asarray(fused(z_narrow, steps * outer)), 8, axis=axis)
+    rb = np.split(np.asarray(deep(z_deep, outer)), 8, axis=axis)
+    sl_n = [slice(None), slice(None)]
+    sl_n[axis] = slice(2, 2 + nloc)
+    sl_d = [slice(None), slice(None)]
+    sl_d[axis] = slice(K, K + nloc)
+    for a, b in zip(ra, rb):
+        np.testing.assert_allclose(
+            a[tuple(sl_n)], b[tuple(sl_d)], atol=1e-5
+        )
+
+
+def test_iterate_pallas_fn_rejects_mismatched_ghost_width(mesh8):
+    from tpu_mpi_tests.comm.halo import iterate_pallas_fn
+    from tpu_mpi_tests.utils import TpuMtError
+
+    with pytest.raises(TpuMtError, match="deep halos"):
+        iterate_pallas_fn(mesh8, "shard", 2, 1e-2, steps=2)
+
+
 @pytest.mark.parametrize("axis", [0, 1])
 def test_iterate_pallas_matches_fused_distributed(mesh8, axis):
     """The bench fast path (pallas in-place step + halo exchange, chained in

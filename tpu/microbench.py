@@ -91,7 +91,8 @@ def bench_stencil(results):
               "on shared chips, prefer the chained iterate rows")
 
 
-def _iterate_setup(n: int = 8192, dim: int = 1, n_local: int | None = None):
+def _iterate_setup(n: int = 8192, dim: int = 1, n_local: int | None = None,
+                   n_bnd: int = 2):
     """Shared mesh/domain/init plumbing for the chained benchmark groups.
 
     Returns ``(mesh, ax, d, make_z)`` or None when the domain does not
@@ -109,7 +110,8 @@ def _iterate_setup(n: int = 8192, dim: int = 1, n_local: int | None = None):
         n_local = n // world
     mesh = make_mesh()
     d = Domain2D(
-        n_local_deriv=n_local, n_global_other=n, n_shards=world, dim=dim
+        n_local_deriv=n_local, n_global_other=n, n_shards=world, dim=dim,
+        n_bnd=n_bnd,
     )
     f, _ = analytic_pairs()[f"2d_dim{dim}"]
 
@@ -143,6 +145,30 @@ def bench_iterate(results):
         _emit(results, f"iterate_d1_pallas_{dtype}_iters_per_s", 1 / per,
               "iter/s", f"{n}x{n}, {n * n * bits * 2 / per / 1e9:.0f} GB/s")
         del zg
+    # temporal blocking (steps timesteps per HBM pass over deep halos):
+    # the bench.py headline path
+    from tpu_mpi_tests.kernels.stencil import N_BND
+
+    steps = 4
+    setup_k = _iterate_setup(n, dim=1, n_bnd=N_BND * steps)
+    if setup_k is not None:
+        mesh_k, ax_k, dk, make_zk = setup_k
+        for dtype, bits in (("float32", 4), ("bfloat16", 2)):
+            dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
+            zg = make_zk(dt)
+            run = iterate_pallas_fn(mesh_k, ax_k, dk.n_bnd, 1e-6,
+                                    steps=steps)
+            per, zg = chain_rate(run, zg, n_short=25, n_long=525)
+            per /= steps
+            _emit(
+                results,
+                f"iterate_d1_pallas_{dtype}_k{steps}_iters_per_s",
+                1 / per, "iter/s",
+                f"{n}x{n}, {steps}-step temporal blocking, "
+                f"{n * n * bits * 2 / steps / per / 1e9:.0f} GB/s "
+                "effective",
+            )
+            del zg
     zg = make_z1(jnp.float32)
     per, zg = chain_rate(
         iterate_fused_fn(mesh, ax, 1, 2, d1.n_bnd, 1.0, 1e-6), zg

@@ -394,14 +394,33 @@ def iterate_pallas_fn(
     scale_eps: float,
     axis: int = 1,
     interpret: bool | None = None,
+    steps: int = 1,
+    periodic: bool = False,
 ):
     """Like :func:`iterate_fused_fn` but with the hand-written in-place
     Pallas step (2 HBM passes/iter vs XLA's ~6). ``axis=1`` (default) puts
     the stencil on the lane dimension where VMEM shifts are register-cheap —
     the bench.py fast path (1212 iter/s at 8192² f32 on v5e vs ~258 for the
     XLA formulation; bf16 2474 = 2.04× f32); ``axis=0`` runs the same
-    2-pass in-place step on a dim-0 (sublane-shift) decomposition."""
+    2-pass in-place step on a dim-0 (sublane-shift) decomposition.
+
+    ``steps=k`` enables communication-avoiding temporal blocking: the array
+    must carry deep ghosts (``n_bnd = k · stencil radius``), exchanged once
+    per k timesteps, and the Pallas kernel advances k steps per HBM pass —
+    the interior sequence is identical to per-step exchange (tested), HBM
+    traffic per timestep drops toward 2/k passes, and the exchange message
+    count drops k-fold at the same total volume. ``n_iter`` then counts
+    OUTER loop bodies (= n_iter·k timesteps)."""
     from tpu_mpi_tests.kernels.pallas_kernels import stencil2d_iterate_pallas
+    from tpu_mpi_tests.kernels.stencil import N_BND as RADIUS
+    from tpu_mpi_tests.utils import TpuMtError
+
+    if n_bnd != steps * RADIUS:
+        raise TpuMtError(
+            f"iterate_pallas_fn: ghost width n_bnd={n_bnd} must equal "
+            f"steps({steps}) x stencil radius({RADIUS}) — deep halos carry "
+            f"one radius per fused timestep"
+        )
 
     spec = (axis_name, None) if axis == 0 else (None, axis_name)
 
@@ -415,12 +434,40 @@ def iterate_pallas_fn(
             check_vma=False,
         )
         def go(z, n):
+            world = mesh.shape[axis_name]  # static at trace time
+            # static flags compile to static update spans (no per-element
+            # mask): every shard of a periodic ring, and the only shard of
+            # a world=1 mesh (both sides physical) — the bench fast path
+            if periodic:
+                phys_kw = {"phys_static": (0, 0)}
+            elif world == 1:
+                phys_kw = {"phys_static": (1, 1)}
+            else:
+                idx = lax.axis_index(axis_name)
+                phys_kw = {
+                    "phys": jnp.stack(
+                        [
+                            (idx == 0).astype(jnp.int32),
+                            (idx == world - 1).astype(jnp.int32),
+                        ]
+                    )
+                }
+
             def body(_, zz):
                 zz = exchange_shard(
-                    zz, axis_name=axis_name, axis=axis, n_bnd=n_bnd
+                    zz,
+                    axis_name=axis_name,
+                    axis=axis,
+                    n_bnd=n_bnd,
+                    periodic=periodic,
                 )
                 return stencil2d_iterate_pallas(
-                    zz, scale_eps, dim=axis, interpret=interpret
+                    zz,
+                    scale_eps,
+                    dim=axis,
+                    interpret=interpret,
+                    steps=steps,
+                    **phys_kw,
                 )
 
             return lax.fori_loop(0, n[0], body, z)
