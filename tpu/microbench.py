@@ -2,7 +2,8 @@
 """Microbenchmark runner: reproduces every measured row in BASELINE.md.
 
 Usage (from /root/repo):
-    python tpu/microbench.py [daxpy] [stencil] [iterate] [splitfused] [ceiling]
+    python tpu/microbench.py [daxpy] [stencil] [iterate] [splitfused]
+                             [ceiling] [attention]
 
 Runs the selected groups (default: all) on whatever backend is active and
 prints one JSON line per measurement plus a summary table. Timing uses the
@@ -271,12 +272,64 @@ def bench_ceiling(results):
               "fit degenerate (noise outside [raw, 2x raw]); raw 3-pass rate")
 
 
+def bench_attention(results):
+    """Flash-vs-XLA local attention (the long-context building block,
+    SURVEY §5.7): softmax(q·kᵀ/√d)·v at L=8192, d=128, chained with the
+    output fed back as the next query so iterations are data-dependent."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_mpi_tests.instrument.timers import chain_rate
+    from tpu_mpi_tests.kernels.pallas_kernels import flash_attention_pallas
+
+    L, d = 8192, 128
+    flops = 4.0 * L * L * d  # two L×L×d matmuls per iteration
+
+    def xla_attn(q, k, v):
+        s = jnp.matmul(q, k.T) / (d**0.5)
+        return jnp.matmul(jax.nn.softmax(s, axis=-1), v)
+
+    for dtype in ("float32", "bfloat16"):
+        dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
+        key = jax.random.PRNGKey(0)
+        q, k, v = (
+            jax.random.normal(kk, (L, d), dt)
+            for kk in jax.random.split(key, 3)
+        )
+        # both tiers at MXU-native (DEFAULT) matmul precision — the
+        # throughput configuration; correctness tests use HIGHEST
+        for name, attn in (
+            ("flash", lambda q, k, v: flash_attention_pallas(
+                q, k, v, precision=jax.lax.Precision.DEFAULT)),
+            ("xla", xla_attn),
+        ):
+            @functools.partial(jax.jit, donate_argnums=0)
+            def run(state, n_iter, attn=attn):
+                def body(_, st):
+                    qq, k, v = st
+                    return attn(qq, k, v), k, v
+
+                return lax.fori_loop(
+                    0, jnp.asarray(n_iter, jnp.int32), body, state
+                )
+
+            per, state = chain_rate(run, (q, k, v), n_short=40, n_long=440)
+            q, k, v = state
+            _emit(results, f"attention_{name}_{dtype}_tflops", flops / per
+                  / 1e12, "TFLOP/s", f"L={L} d={d} softmax(qk^T)v")
+        del q, k, v
+
+
 GROUPS = {
     "daxpy": bench_daxpy,
     "stencil": bench_stencil,
     "iterate": bench_iterate,
     "splitfused": bench_splitfused,
     "ceiling": bench_ceiling,
+    "attention": bench_attention,
 }
 
 

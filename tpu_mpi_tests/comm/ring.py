@@ -33,23 +33,26 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def online_softmax_update(m, l, s):
-    """One block of the online-softmax recurrence shared by the ring and
-    Ulysses attention flavors: given running max ``m`` and denominator ``l``
-    (any leading batch shape) and this block's scores ``s`` (same shape +
-    a trailing key axis), returns ``(m_new, l_new, p, corr)`` where ``p``
-    are the block's unnormalized probabilities and ``corr`` rescales the
-    caller's numerator: ``acc_new = acc·corr[...,None] + p @ v_blk``.
+def online_softmax_update(m, l, s, keepdims: bool = False):
+    """One block of the online-softmax recurrence shared by ALL attention
+    tiers (ring, Ulysses, and the Pallas flash kernel): given running max
+    ``m`` and denominator ``l`` (any leading batch shape; a trailing
+    length-1 axis instead when ``keepdims``) and this block's scores ``s``
+    (batch shape + a trailing key axis), returns ``(m_new, l_new, p, corr)``
+    where ``p`` are the block's unnormalized probabilities and ``corr``
+    rescales the caller's numerator: ``acc_new = acc·corr[...,None] + p @
+    v_blk`` (no ``[...,None]`` under ``keepdims``).
 
     All-masked blocks leave ``m_new`` at -inf; the ``m_safe`` guard makes
     ``exp(s − m_safe) = exp(-inf) = 0`` with no −inf − −inf NaNs. Keeping
     this in ONE place means a numerics fix cannot silently diverge between
-    the two attention flavors."""
-    m_new = jnp.maximum(m, s.max(axis=-1))
+    the attention tiers (``keepdims=True`` exists because Mosaic prefers
+    2-D (qt, 1) carries over 1-D vectors)."""
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=keepdims))
     m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.exp(s - (m_safe if keepdims else m_safe[..., None]))
     corr = jnp.exp(m - m_safe)
-    l_new = l * corr + p.sum(axis=-1)
+    l_new = l * corr + p.sum(axis=-1, keepdims=keepdims)
     return m_new, l_new, p, corr
 
 
@@ -96,6 +99,10 @@ def ring_attention(
     scale: float | None = None,
     causal: bool = False,
     precision=lax.Precision.HIGHEST,
+    flash: bool = False,
+    interpret: bool | None = None,
+    q_tile: int = 256,
+    k_tile: int = 512,
 ):
     """Blockwise ring attention for one shard (call inside ``shard_map``).
 
@@ -109,17 +116,46 @@ def ring_attention(
     default to bf16 accumulation (~3e-3 relative error), and this framework
     verifies against exact references. Pass ``lax.Precision.DEFAULT`` to
     trade accuracy for MXU throughput.
+
+    ``flash=True`` swaps the per-block XLA matmul pipeline (which
+    materializes an (L_local × L_local) scores block in HBM each ring step)
+    for the hand-written Pallas flash kernel
+    (``kernels.pallas_kernels.flash_attention_block_pallas``): scores live
+    only in VMEM tiles, the carry is f32 and updated in place. Same
+    recurrence, same masking — the tiers are interchangeable per test.
     """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d**0.5)
 
+    lq = q.shape[0]
+    r = lax.axis_index(axis_name)
+
+    if flash:
+        from tpu_mpi_tests.kernels.pallas_kernels import (
+            flash_attention_block_pallas,
+        )
+
+        m0 = jnp.full((lq, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((lq, 1), jnp.float32)
+        acc0 = jnp.zeros((lq, d), jnp.float32)
+
+        def step(carry, kv_blk, src):
+            k_blk, v_blk = kv_blk
+            m, l, acc = flash_attention_block_pallas(
+                q, k_blk, v_blk, *carry,
+                r * lq, src * k_blk.shape[0],
+                scale=float(scale), causal=causal, interpret=interpret,
+                precision=precision, q_tile=q_tile, k_tile=k_tile,
+            )
+            return m, l, acc
+
+        m, l, acc = ring_scan(step, (m0, l0, acc0), (k, v), axis_name)
+        return (acc / l).astype(q.dtype)
+
     m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
     l0 = jnp.zeros(q.shape[:-1], q.dtype)
     acc0 = jnp.zeros_like(q)
-
-    lq = q.shape[0]
-    r = lax.axis_index(axis_name)
 
     def step(carry, kv_blk, src):
         m, l, acc = carry
@@ -143,9 +179,19 @@ def ring_attention(
 
 
 @functools.lru_cache(maxsize=None)
-def ring_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False):
+def ring_attention_fn(
+    mesh: Mesh,
+    axis_name: str,
+    causal: bool = False,
+    flash: bool = False,
+    interpret: bool | None = None,
+    q_tile: int = 256,
+    k_tile: int = 512,
+):
     """Jitted ring attention over a sequence sharded along ``axis_name``
-    (inputs (L_global, d) sharded on axis 0)."""
+    (inputs (L_global, d) sharded on axis 0). ``flash=True`` uses the
+    Pallas flash kernel for the local blocks (tiles auto-shrink to divisors
+    of the shard length; ``q_tile``/``k_tile`` set the ceilings)."""
 
     @jax.jit
     @functools.partial(
@@ -156,6 +202,9 @@ def ring_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False):
         check_vma=False,
     )
     def attn(q, k, v):
-        return ring_attention(q, k, v, axis_name, causal=causal)
+        return ring_attention(
+            q, k, v, axis_name, causal=causal, flash=flash,
+            interpret=interpret, q_tile=q_tile, k_tile=k_tile,
+        )
 
     return attn

@@ -134,17 +134,27 @@ def run(args) -> int:
                 check_divisible(n, world, "alltoall elements per shard")
             x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
             run_fn = _loop_fn(mesh, axis_name, name, world)
+            # scale the chain length inversely with payload so small
+            # messages accumulate enough device time to clear host-timer
+            # noise (a fixed count yields NaN/garbage under ~ms jitter:
+            # 500 x 15 us is invisible next to a 100 ms tunnel round-trip);
+            # the actual count is reported per row (no silent config drift)
+            n_eff = min(
+                max(args.n_iter, 100_000),
+                max(args.n_iter, args.n_iter * (1 << 20)
+                    // max(shard_bytes, 1)),
+            )
             sec, x = chain_rate(
-                run_fn, x, n_short=args.n_iter // 10 or 1, n_long=args.n_iter
+                run_fn, x, n_short=n_eff // 10 or 1, n_long=n_eff
             )
             moved = _busbw_bytes(name, shard_bytes, world)
             busbw = moved / sec / 1e9
             rep.line(
                 f"COLL {name} bytes={shard_bytes} {sec * 1e6:0.2f} us/iter"
-                f"  busbw={busbw:0.2f} GB/s",
+                f"  busbw={busbw:0.2f} GB/s  n={n_eff}",
                 {"kind": "coll", "collective": name, "dtype": args.dtype,
                  "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
-                 "busbw_gbps": busbw, "world": world},
+                 "busbw_gbps": busbw, "world": world, "n_iter": n_eff},
             )
             del x
     return 0
@@ -164,7 +174,10 @@ def main(argv=None) -> int:
     )
     p.add_argument(
         "--n-iter", type=int, default=500,
-        help="chained iterations per measurement",
+        help="chained iterations per measurement at 1 MiB payloads; "
+        "smaller payloads scale the count up inversely (capped at 100k) "
+        "so device time clears host-timer noise — the actual count is "
+        "reported per row as n=",
     )
     args = p.parse_args(argv)
     if args.n_iter < 10:

@@ -770,3 +770,154 @@ def unpack_ghosts_pallas(z, lo_ghost, hi_ghost, axis: int = 0,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=_auto_interpret(interpret),
     )(z, lo_ghost, hi_ghost)
+
+
+# ---------------------------------------------------------------------------
+# Flash attention (long-context pillar, SURVEY §5.7)
+# ---------------------------------------------------------------------------
+
+
+def _fit_divisor(n: int, want: int) -> int:
+    """Largest tile ≤ ``want`` that divides ``n`` (≥ 1 always exists)."""
+    t = min(want, n)
+    while n % t:
+        t -= 1
+    return t
+
+
+def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
+                        m_out, l_out, acc_out, *, scale, causal, k_tile,
+                        precision):
+    """One q tile against a full K/V block with the online-softmax carry.
+
+    The scores tile (q_tile × k_tile) lives only in VMEM/registers — the
+    (L×Lk) matrix is never materialized (the XLA formulation's weakness:
+    HBM round-trips per ring step). Both matmuls ride the MXU with f32
+    accumulation; the recurrence matches ``comm.ring.online_softmax_update``
+    exactly so the flash and XLA tiers cannot diverge numerically beyond
+    reassociation.
+    """
+    from tpu_mpi_tests.comm.ring import online_softmax_update
+
+    q = q_ref[:]                                        # (qt, d)
+    m, l, acc = m_ref[:], l_ref[:], acc_ref[:]          # (qt,1)(qt,1)(qt,d)
+    qt = q.shape[0]
+    n_kt = k_ref.shape[0] // k_tile
+    q_pos = (
+        off_ref[0] + pl.program_id(0) * qt
+        + jax.lax.broadcasted_iota(jnp.int32, (qt, 1), 0)
+    )
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb = k_ref[pl.ds(i * k_tile, k_tile), :]        # (kt, d)
+        vb = v_ref[pl.ds(i * k_tile, k_tile), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        ) * scale                                       # (qt, kt)
+        if causal:
+            k_pos = (
+                off_ref[1] + i * k_tile
+                + jax.lax.broadcasted_iota(jnp.int32, (1, k_tile), 1)
+            )
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_new, l_new, p, corr = online_softmax_update(m, l, s, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_kt, body, (m, l, acc))
+    m_out[:], l_out[:], acc_out[:] = m, l, acc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "scale", "causal", "q_tile", "k_tile", "interpret", "precision"
+    ),
+    donate_argnums=(3, 4, 5),
+)
+def flash_attention_block_pallas(
+    q, k, v, m, l, acc, q_off, k_off, *,
+    scale: float, causal: bool = False,
+    q_tile: int = 256, k_tile: int = 512,
+    interpret: bool | None = None,
+    precision=jax.lax.Precision.HIGHEST,
+):
+    """Flash-attention step: fold one K/V block into the online-softmax
+    carry ``(m, l, acc)`` (shapes (L,1), (L,1), (L,d), float32; donated and
+    aliased in place). ``q_off``/``k_off`` are the global sequence positions
+    of ``q[0]``/``k[0]`` (traced scalars — causal masking works across ring
+    steps, where the K block's origin rotates). The ring-attention inner
+    step (``comm.ring.ring_attention(flash=True)``); calling it once with
+    offsets 0 is plain single-block flash attention. ``precision`` defaults
+    to HIGHEST like the XLA tier (f32 MXU passes; TPU matmul default
+    truncates f32 to bf16 lanes, ~7e-3 abs error at L=1024 d=128) — pass
+    ``jax.lax.Precision.DEFAULT`` to trade accuracy for MXU throughput."""
+    L, d = q.shape
+    Lk = k.shape[0]
+    # shrink requested tiles to the largest divisor of the block length so
+    # any shard length works (the XLA tier accepts arbitrary L; the tiers
+    # must stay interchangeable) — odd lengths degrade tile width, they
+    # don't fail
+    q_tile = _fit_divisor(L, q_tile)
+    k_tile = _fit_divisor(Lk, k_tile)
+    grid = (L // q_tile,)
+    off = jnp.stack(
+        [jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)]
+    )
+    qspec = pl.BlockSpec((q_tile, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((Lk, d), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    mlspec = pl.BlockSpec((q_tile, 1), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
+    carry = jax.ShapeDtypeStruct((L, 1), jnp.float32)
+    return pl.pallas_call(
+        functools.partial(
+            _flash_block_kernel, scale=scale, causal=causal, k_tile=k_tile,
+            precision=precision,
+        ),
+        out_shape=(carry, carry, jax.ShapeDtypeStruct((L, d), jnp.float32)),
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec, mlspec, mlspec, qspec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=(mlspec, mlspec, qspec),
+        input_output_aliases={3: 0, 4: 1, 5: 2},
+        interpret=_auto_interpret(interpret),
+    )(q, k, v, m.astype(jnp.float32), l.astype(jnp.float32),
+      acc.astype(jnp.float32), off)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "scale", "causal", "q_tile", "k_tile", "interpret", "precision"
+    ),
+)
+def flash_attention_pallas(
+    q, k, v, *, scale: float | None = None, causal: bool = False,
+    q_tile: int = 256, k_tile: int = 512, interpret: bool | None = None,
+    precision=jax.lax.Precision.HIGHEST,
+):
+    """Single-device flash attention: softmax(q·kᵀ·scale)·v without ever
+    materializing the L×L score matrix (O(L·d) memory). The local-compute
+    building block of both sequence-parallel flavors (ring: rotate K/V and
+    fold this per block; Ulysses: per-head local attention after the
+    all-to-all reshard)."""
+    L, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    m = jnp.full((L, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((L, 1), jnp.float32)
+    acc = jnp.zeros((L, d), jnp.float32)
+    m, l, acc = flash_attention_block_pallas(
+        q, k, v, m, l, acc, 0, 0, scale=float(scale), causal=causal,
+        q_tile=q_tile, k_tile=k_tile, interpret=interpret,
+        precision=precision,
+    )
+    return (acc / l).astype(q.dtype)
