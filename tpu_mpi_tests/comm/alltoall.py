@@ -97,6 +97,20 @@ def _local_attention(q, k, v, causal: bool, precision,
     return acc / jnp.swapaxes(l, 0, 1)[:, :, None]
 
 
+def _local_attention_flash(q, k, v, causal, interpret, precision,
+                           q_tile, k_tile):
+    """Per-head Pallas flash local attention over (L, H_local, Dh):
+    the single-head kernel vmapped over the head axis (pallas_call carries
+    a batching rule, so the grid gains a head dimension)."""
+    from tpu_mpi_tests.kernels.pallas_kernels import flash_attention_pallas
+
+    f = functools.partial(
+        flash_attention_pallas, causal=causal, interpret=interpret,
+        precision=precision, q_tile=q_tile, k_tile=k_tile,
+    )
+    return jax.vmap(f, in_axes=1, out_axes=1)(q, k, v)
+
+
 def ulysses_attention(
     q,
     k,
@@ -105,24 +119,37 @@ def ulysses_attention(
     causal: bool = False,
     precision=lax.Precision.HIGHEST,
     block_keys: int = 512,
+    flash: bool = False,
+    interpret: bool | None = None,
 ):
     """Per-shard Ulysses attention (call inside ``shard_map``): inputs
     (L_local, H, Dh) sequence-sharded; H must divide the mesh axis size.
     The local attention is blockwise (``block_keys``-wide key tiles), so
-    sequence length is bounded by activations, not an L² score matrix."""
+    sequence length is bounded by activations, not an L² score matrix.
+    ``flash=True`` swaps in the Pallas flash kernel per head (same carry
+    as the ring flavor's hand tier); its key-tile width is ``block_keys``
+    (shrunk to a divisor of the gathered length), so the tiling knob means
+    the same thing on both tiers."""
     n = lax.axis_size(axis_name)
     check_divisible(q.shape[1], n, "ulysses heads over mesh axis")
     qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
-    out = _local_attention(qh, kh, vh, causal, precision,
-                           block_keys=block_keys)
+    if flash:
+        out = _local_attention_flash(qh, kh, vh, causal, interpret,
+                                     precision, q_tile=256,
+                                     k_tile=block_keys)
+    else:
+        out = _local_attention(qh, kh, vh, causal, precision,
+                               block_keys=block_keys)
     return heads_to_seq(out, axis_name)
 
 
 @functools.lru_cache(maxsize=None)
 def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
-                         block_keys: int = 512):
+                         block_keys: int = 512, flash: bool = False,
+                         interpret: bool | None = None):
     """Jitted Ulysses attention over (L_global, H, Dh) arrays sharded along
-    the sequence (axis 0)."""
+    the sequence (axis 0). ``flash=True`` uses the Pallas flash kernel for
+    the per-head local attention."""
 
     @jax.jit
     @functools.partial(
@@ -138,6 +165,7 @@ def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
     )
     def attn(q, k, v):
         return ulysses_attention(q, k, v, axis_name, causal=causal,
-                                 block_keys=block_keys)
+                                 block_keys=block_keys, flash=flash,
+                                 interpret=interpret)
 
     return attn

@@ -67,8 +67,13 @@ def _hard_sync_leaf(x) -> None:
     # addressable shard must be read — devices finish independently
     reads = []
     for shard in x.addressable_shards:
-        data = shard.data
-        reads.append(data[(0,) * data.ndim] if data.ndim else data)
+        r = shard.data
+        # index one axis at a time: a multi-axis dynamic-slice is rejected
+        # by the AOT path for host-memory-space (pinned_host) buffers
+        # ("Async slice only supports slicing in 1 dimension")
+        while r.ndim:
+            r = r[0]
+        reads.append(r)
     for r in reads:
         np.asarray(r)
 
