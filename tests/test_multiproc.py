@@ -179,3 +179,29 @@ def test_multiproc_2level_mesh_collectives(tpumt_run, tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert "2LEVEL OK rank=0" in r.stdout
     assert "2LEVEL OK rank=1" in r.stdout
+
+
+def test_multiproc_collbench_busbw(tpumt_run, tmp_path):
+    """2-process collective bandwidth sweep: every collective in the ladder
+    crosses the process boundary and reports a finite nonzero busbw
+    (≅ running an OSU-style sweep under mpirun; the NaN guard in
+    chain_rate must not trip on a healthy world)."""
+    prefix = tmp_path / "out-coll-"
+    r = launch(
+        tpumt_run, 2, sys.executable, "-m",
+        "tpu_mpi_tests.drivers.collbench",
+        "--fake-devices", "1", "--sizes-kib", "64", "--n-iter", "50",
+        out_prefix=prefix,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out0 = rank_outputs(prefix, 2)[0]
+    rows = re.findall(
+        r"COLL (\w+) bytes=65536 ([\d.a-z]+) us/iter  busbw=([\d.a-z]+)",
+        out0,
+    )
+    assert {name for name, _, _ in rows} == {
+        "allgather", "allreduce", "ppermute", "alltoall"
+    }, out0
+    for name, us, busbw in rows:
+        assert us != "nan" and float(us) > 0, (name, us)
+        assert busbw != "nan" and float(busbw) > 0, (name, busbw)
