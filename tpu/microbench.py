@@ -193,6 +193,20 @@ def bench_iterate(results):
               "iter/s",
               f"1028x{n}, {elts * 4 * 2 / per / 1e9:.0f} GB/s")
         del zg
+    # dim-0 temporal blocking (deep sublane-axis ghosts); explicit n_local
+    # means _iterate_setup cannot return None here
+    mesh0, ax0, d0k, make_z0k = _iterate_setup(
+        n, dim=0, n_local=1024, n_bnd=N_BND * steps
+    )
+    zg = make_z0k(jnp.float32)
+    run = iterate_pallas_fn(mesh0, ax0, d0k.n_bnd, 1e-6, axis=0, steps=steps)
+    per, zg = chain_rate(run, zg, n_short=25, n_long=525)
+    per /= steps
+    _emit(results, f"iterate_d0_pallas_float32_k{steps}_iters_per_s",
+          1 / per, "iter/s",
+          f"(1024+{2 * N_BND * steps})x{n}, {steps}-step temporal blocking, "
+          f"{1024 * n * 4 * 2 / steps / per / 1e9:.0f} GB/s effective")
+    del zg
 
 
 def bench_splitfused(results):
