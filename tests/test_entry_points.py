@@ -46,14 +46,22 @@ def test_bench_prints_one_json_line_smoke():
             "TPU_MPI_BENCH_ITERS_SHORT": "50",
             "TPU_MPI_BENCH_ITERS_LONG": "1050",
             "TPU_MPI_BENCH_FAKE_DEVICES": "4",
+            # 2 samples: covers the samples-list schema + median bound at
+            # two-thirds the cost of the real-run default of 3
+            "TPU_MPI_BENCH_SAMPLES": "2",
         },
     )
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [l for l in r.stdout.splitlines() if l.strip()]
     rec = json.loads(lines[-1])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "vs_f64_reference_roofline"}
+                        "vs_f64_reference_roofline", "samples"}
     assert rec["value"] > 0
+    # the reported value is the median of the recorded (finite) samples;
+    # both are independently rounded to 2 dp, so allow half-step slack
+    finite = [s for s in rec["samples"] if s is not None]
+    assert finite
+    assert min(finite) - 0.01 <= rec["value"] <= max(finite) + 0.01
 
 
 def test_graft_entry_single_chip():
