@@ -520,6 +520,70 @@ def step2d_fn(
 
 
 @functools.lru_cache(maxsize=None)
+def heat_step2d_fn(
+    mesh: Mesh,
+    axis_x: str,
+    axis_y: str,
+    n_bnd: int,
+    cx: float,
+    cy: float,
+):
+    """``n_steps`` explicit-Euler heat-equation steps on a periodic 2-D
+    process grid, chained device-side: per step, halo exchange along both
+    mesh axes then ``interior += cx·δ²x + cy·δ²y`` (the 5-point discrete
+    Laplacian; ``c = ν·dt/Δ²``). Shape-preserving and donated, so the time
+    loop is one ``lax.fori_loop`` — the mini-app analog of the reference's
+    hot loop (``mpi_stencil2d_gt.cc:511-535``) integrating an actual PDE
+    instead of re-timing one exchange.
+
+    On a periodic grid, ``sin(kx·x)·sin(ky·y)`` is an exact eigenvector of
+    this update with factor ``g = 1 − cx·(2−2cos kxΔx) − cy·(2−2cos kyΔy)``
+    per step, which the heat2d driver uses as a roundoff-exact gate: a
+    broken exchange or kernel destroys the eigenstructure immediately.
+    """
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(z, n_steps):
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(axis_x, axis_y), P()),
+            out_specs=P(axis_x, axis_y),
+            check_vma=False,
+        )
+        def go(z, n):
+            def body(_, zz):
+                zz = exchange_shard(
+                    zz, axis_name=axis_x, axis=0, n_bnd=n_bnd, periodic=True
+                )
+                zz = exchange_shard(
+                    zz, axis_name=axis_y, axis=1, n_bnd=n_bnd, periodic=True
+                )
+                nx, ny = zz.shape
+                ix = slice(n_bnd, nx - n_bnd)
+                iy = slice(n_bnd, ny - n_bnd)
+                mid = zz[ix, iy]
+                d2x = (
+                    zz[n_bnd + 1:nx - n_bnd + 1, iy]
+                    + zz[n_bnd - 1:nx - n_bnd - 1, iy]
+                    - 2.0 * mid
+                )
+                d2y = (
+                    zz[ix, n_bnd + 1:ny - n_bnd + 1]
+                    + zz[ix, n_bnd - 1:ny - n_bnd - 1]
+                    - 2.0 * mid
+                )
+                new = mid + zz.dtype.type(cx) * d2x + zz.dtype.type(cy) * d2y
+                return lax.dynamic_update_slice(zz, new, (n_bnd, n_bnd))
+
+            return lax.fori_loop(0, n[0], body, z)
+
+        return go(z, jnp.asarray([n_steps], jnp.int32))
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
 def exchange_stencil_fused_fn(
     mesh: Mesh,
     axis_name: str,

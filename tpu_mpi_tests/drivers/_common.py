@@ -106,3 +106,32 @@ def jnp_dtype(args):
         "float64": jnp.float64,
         "bfloat16": jnp.bfloat16,
     }[args.dtype]
+
+
+def parse_grid_mesh(spec: "str | None", n_dev: int):
+    """Resolve a 'PX,PY' process-grid spec (or auto-factor ``n_dev`` into
+    the squarest grid when None) → ``(px, py)``. Returns None after
+    printing an ERROR line when the spec is malformed, non-positive, or
+    does not multiply to the device count — shared by every 2-D-grid
+    driver so a hardening fix cannot miss one of them."""
+    if spec:
+        try:
+            px, py = (int(v) for v in spec.split(","))
+        except ValueError:
+            print(f"ERROR --mesh must be 'PX,PY', got {spec!r}")
+            return None
+        if px < 1 or py < 1:
+            print(f"ERROR --mesh factors must be positive, got {px},{py}")
+            return None
+    else:
+        px = 1
+        for cand in range(int(n_dev**0.5), 0, -1):
+            if n_dev % cand == 0:
+                px = cand
+                break
+        py = n_dev // px
+    if px * py != n_dev:
+        print(f"ERROR --mesh {px},{py} needs {px * py} devices, "
+              f"have {n_dev}")
+        return None
+    return px, py

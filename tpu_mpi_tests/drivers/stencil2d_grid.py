@@ -64,23 +64,10 @@ def run(args) -> int:
     topo = topology()
     n_dev = topo.global_device_count
 
-    if args.mesh:
-        try:
-            px, py = (int(v) for v in args.mesh.split(","))
-        except ValueError:
-            print(f"ERROR --mesh must be 'PX,PY', got {args.mesh!r}")
-            return 2
-    else:
-        px = 1
-        for cand in range(int(n_dev**0.5), 0, -1):
-            if n_dev % cand == 0:
-                px = cand
-                break
-        py = n_dev // px
-    if px * py != n_dev:
-        print(f"ERROR --mesh {px},{py} needs {px * py} devices, "
-              f"have {n_dev}")
+    grid = _common.parse_grid_mesh(args.mesh, n_dev)
+    if grid is None:
         return 2
+    px, py = grid
     mesh = make_mesh({"x": px, "y": py})
 
     rep = Reporter(rank=topo.process_index, size=n_dev, jsonl_path=args.jsonl)
