@@ -63,22 +63,22 @@ class Staging(enum.Enum):
             ) from None
 
 
-def exchange_shard(
+def _receive_neighbors(
     z,
     *,
     axis_name: str,
-    axis: int = 0,
-    n_bnd: int = 2,
-    periodic: bool = False,
+    axis: int,
+    n_bnd: int,
+    periodic: bool,
     staged: bool = False,
 ):
-    """Per-shard halo exchange, for use *inside* ``shard_map``.
-
-    ``z`` is one ghosted local block. Sends the interior edge slices to
-    neighbors ±1 on the ring and writes received blocks into the ghost
-    regions. On non-periodic edge ranks the existing (physical) ghosts are
-    kept. Returns the updated block.
-    """
+    """Ring-receive half of the halo exchange: pack interior edges, rotate
+    them ±1, and return ``(from_left, from_right)`` — what belongs in this
+    shard's ghost bands. Non-periodic edge ranks get their CURRENT
+    (physical) ghosts back. Returns ``(None, None)`` on a 1-shard
+    non-periodic ring, where nothing moves. Shared by ``exchange_shard``
+    and ``iterate_overlap_fn`` so the subtle ring logic (partial
+    permutation pairs, edge-rank masking) exists once."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     lo_edge, hi_edge = pack_edges(z, axis=axis, n_bnd=n_bnd)
@@ -90,8 +90,8 @@ def exchange_shard(
 
     if n == 1:
         if periodic:
-            return unpack_ghosts(z, hi_edge, lo_edge, axis=axis, n_bnd=n_bnd)
-        return z
+            return hi_edge, lo_edge
+        return None, None
 
     fwd = [(i, (i + 1) % n) for i in range(n if periodic else n - 1)]
     bwd = [((i + 1) % n, i) for i in range(n if periodic else n - 1)]
@@ -109,6 +109,31 @@ def exchange_shard(
         )
         from_left = jnp.where(idx == 0, cur_lo, from_left)
         from_right = jnp.where(idx == n - 1, cur_hi, from_right)
+    return from_left, from_right
+
+
+def exchange_shard(
+    z,
+    *,
+    axis_name: str,
+    axis: int = 0,
+    n_bnd: int = 2,
+    periodic: bool = False,
+    staged: bool = False,
+):
+    """Per-shard halo exchange, for use *inside* ``shard_map``.
+
+    ``z`` is one ghosted local block. Sends the interior edge slices to
+    neighbors ±1 on the ring and writes received blocks into the ghost
+    regions. On non-periodic edge ranks the existing (physical) ghosts are
+    kept. Returns the updated block.
+    """
+    from_left, from_right = _receive_neighbors(
+        z, axis_name=axis_name, axis=axis, n_bnd=n_bnd, periodic=periodic,
+        staged=staged,
+    )
+    if from_left is None:  # 1-shard non-periodic: nothing moves
+        return z
     return unpack_ghosts(z, from_left, from_right, axis=axis, n_bnd=n_bnd)
 
 
@@ -517,6 +542,129 @@ def step2d_fn(
         return dz_dx, dz_dy, lax.psum(residual, (axis_x, axis_y))
 
     return step
+
+
+@functools.lru_cache(maxsize=None)
+def iterate_overlap_fn(
+    mesh: Mesh,
+    axis_name: str,
+    n_bnd: int,
+    scale_eps: float,
+    axis: int = 1,
+    interpret: bool | None = None,
+    periodic: bool = False,
+):
+    """Per-step iterate with explicit communication/compute OVERLAP — the
+    reference's hand pattern (post ``MPI_Irecv``/``Isend``, compute the
+    interior, ``MPI_Waitall``, then fill boundary cells;
+    ``mpi_stencil2d_gt.cc:136-255`` + stencil :529) expressed in XLA
+    scheduling terms:
+
+    1. edge slices start their ``ppermute`` flights;
+    2. the core region — every cell whose stencil touches no fresh ghost —
+       is updated by the in-place Pallas kernel, DEPENDING ONLY on old
+       data, so XLA's latency-hiding scheduler runs it between
+       collective-permute-start and -done;
+    3. the two boundary strips are patched with the arrived ghosts;
+    4. reassembly preserves the exchanged-ghost layout exactly like
+       ``exchange_shard`` + ``stencil2d_iterate_pallas``.
+
+    Semantically identical to the sequential form (tested). **Measured
+    result: on TPU this transcription LOSES** — 1897 µs/iter vs the
+    sequential form's 947 at 8192² f32 on a periodic self-ring (v5e). The
+    merge step is the killer: the arrived ghosts and strips are narrow
+    lane bands (2 wide) that Mosaic DMA cannot scatter in place
+    (tile-alignment), so XLA merges them with a full-array copy — one
+    extra HBM pass that outweighs the ~228 µs exchange it hides. The
+    sequential form's exchange-writes-then-aliased-kernel chain is already
+    optimal on this hardware; the reference's Irecv/compute/Waitall
+    overlap is a GPU+MPI idiom that does not transfer. Kept (with its
+    equivalence tests) as the measured A/B documenting exactly that.
+    """
+    from tpu_mpi_tests.kernels.pallas_kernels import stencil2d_iterate_pallas
+    from tpu_mpi_tests.kernels.stencil import N_BND as RADIUS, stencil1d_5
+    from tpu_mpi_tests.utils import TpuMtError
+
+    if n_bnd != RADIUS:
+        raise TpuMtError(
+            f"iterate_overlap_fn: n_bnd={n_bnd} must equal the stencil "
+            f"radius ({RADIUS}) — strip windows are 3·radius wide"
+        )
+
+    spec = (axis_name, None) if axis == 0 else (None, axis_name)
+
+    def strip_update(window):
+        """Update the middle ``n_bnd`` cells of a ``3·n_bnd``-wide window."""
+        dz = stencil1d_5(window, scale=1.0, axis=axis)
+        mid = lax.slice_in_dim(window, n_bnd, 2 * n_bnd, axis=axis)
+        return mid + jnp.asarray(scale_eps, window.dtype) * dz
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(z, n_iter):
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(*spec), P()),
+            out_specs=P(*spec),
+            check_vma=False,
+        )
+        def go(z, n):
+            def body(_, zz):
+                N = zz.shape[axis]
+                from_left, from_right = _receive_neighbors(
+                    zz, axis_name=axis_name, axis=axis, n_bnd=n_bnd,
+                    periodic=periodic,
+                )
+                if from_left is None:  # 1-shard non-periodic ring
+                    from_left = lax.slice_in_dim(zz, 0, n_bnd, axis=axis)
+                    from_right = lax.slice_in_dim(
+                        zz, N - n_bnd, N, axis=axis
+                    )
+
+                # small old-value windows the strips need, sliced out
+                # before the in-place kernel consumes the buffer
+                lo_win = lax.slice_in_dim(zz, n_bnd, 3 * n_bnd, axis=axis)
+                hi_win = lax.slice_in_dim(
+                    zz, N - 3 * n_bnd, N - n_bnd, axis=axis
+                )
+
+                # core: the full in-place step depends only on OLD data
+                # (its ghost reads are stale), so it runs while the edge
+                # ppermutes fly; the 2·n_bnd boundary strips it computes
+                # with stale ghosts are overwritten below — wasted work
+                # O(n_bnd/N), far cheaper than slicing the core out (a
+                # lane-offset slice of the whole array costs full extra
+                # HBM passes: measured 4204 vs 947 µs/iter, 4.4× slower)
+                out = stencil2d_iterate_pallas(
+                    zz, scale_eps, dim=axis, interpret=interpret
+                )
+
+                # patch: arrived ghosts + correctly-computed strips, as
+                # small in-place updates on the kernel's aliased buffer
+                lo_strip = strip_update(
+                    jnp.concatenate([from_left, lo_win], axis=axis)
+                )
+                hi_strip = strip_update(
+                    jnp.concatenate([hi_win, from_right], axis=axis)
+                )
+                out = unpack_ghosts(
+                    out, from_left.astype(out.dtype),
+                    from_right.astype(out.dtype), axis=axis, n_bnd=n_bnd,
+                )
+                for patch, pos in (
+                    (lo_strip, n_bnd),
+                    (hi_strip, N - 2 * n_bnd),
+                ):
+                    out = lax.dynamic_update_slice_in_dim(
+                        out, patch.astype(out.dtype), pos, axis=axis
+                    )
+                return out
+
+            return lax.fori_loop(0, n[0], body, z)
+
+        return go(z, jnp.asarray([n_iter], jnp.int32))
+
+    return run
 
 
 @functools.lru_cache(maxsize=None)
