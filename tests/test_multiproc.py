@@ -205,3 +205,22 @@ def test_multiproc_collbench_busbw(tpumt_run, tmp_path):
     for name, us, busbw in rows:
         assert us != "nan" and float(us) > 0, (name, us)
         assert busbw != "nan" and float(busbw) > 0, (name, busbw)
+
+
+def test_multiproc_heat2d_grid(tpumt_run, tmp_path):
+    """2-process heat mini-app: the process-grid x-axis spans the process
+    boundary, so every time step's halo exchange crosses DCN; the driver
+    must complete and report steps/s (the eigen gate needs addressable
+    shards and is skipped multi-host — finiteness gates instead)."""
+    prefix = tmp_path / "out-heat-"
+    r = launch(
+        tpumt_run, 2, sys.executable, "-m",
+        "tpu_mpi_tests.drivers.heat2d",
+        "--fake-devices", "1", "--mesh", "2,1", "--nx-local", "16",
+        "--ny-local", "32", "--n-steps", "40", "--dtype", "float64",
+        out_prefix=prefix,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out0 = rank_outputs(prefix, 2)[0]
+    assert re.search(r"HEAT mesh:2x1 n:32x32; steps=40 [\d.]+ steps/s", out0)
+    assert "HEAT FAIL" not in out0
