@@ -121,22 +121,24 @@ def ulysses_attention(
     block_keys: int = 512,
     flash: bool = False,
     interpret: bool | None = None,
+    k_tile: int = 2048,
 ):
     """Per-shard Ulysses attention (call inside ``shard_map``): inputs
     (L_local, H, Dh) sequence-sharded; H must divide the mesh axis size.
     The local attention is blockwise (``block_keys``-wide key tiles), so
     sequence length is bounded by activations, not an L² score matrix.
     ``flash=True`` swaps in the Pallas flash kernel per head (same carry
-    as the ring flavor's hand tier); its key-tile width is ``block_keys``
-    (shrunk to a divisor of the gathered length), so the tiling knob means
-    the same thing on both tiers."""
+    as the ring flavor's hand tier) at the kernel's tuned key-tile width
+    (``k_tile``, default 2048 — the per-k-tile carry rescale makes narrow
+    tiles ~2× slower, BASELINE.md); pass ``k_tile`` to override.
+    ``block_keys`` governs only the non-flash blockwise path, whose
+    narrower default bounds its O(L·block·H) score memory."""
     n = lax.axis_size(axis_name)
     check_divisible(q.shape[1], n, "ulysses heads over mesh axis")
     qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
     if flash:
         out = _local_attention_flash(qh, kh, vh, causal, interpret,
-                                     precision, q_tile=256,
-                                     k_tile=block_keys)
+                                     precision, q_tile=256, k_tile=k_tile)
     else:
         out = _local_attention(qh, kh, vh, causal, precision,
                                block_keys=block_keys)
@@ -146,10 +148,11 @@ def ulysses_attention(
 @functools.lru_cache(maxsize=None)
 def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
                          block_keys: int = 512, flash: bool = False,
-                         interpret: bool | None = None):
+                         interpret: bool | None = None,
+                         k_tile: int = 2048):
     """Jitted Ulysses attention over (L_global, H, Dh) arrays sharded along
     the sequence (axis 0). ``flash=True`` uses the Pallas flash kernel for
-    the per-head local attention."""
+    the per-head local attention at its tuned ``k_tile``."""
 
     @jax.jit
     @functools.partial(
@@ -166,6 +169,6 @@ def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
     def attn(q, k, v):
         return ulysses_attention(q, k, v, axis_name, causal=causal,
                                  block_keys=block_keys, flash=flash,
-                                 interpret=interpret)
+                                 interpret=interpret, k_tile=k_tile)
 
     return attn

@@ -102,7 +102,7 @@ def ring_attention(
     flash: bool = False,
     interpret: bool | None = None,
     q_tile: int = 256,
-    k_tile: int = 512,
+    k_tile: int = 2048,
 ):
     """Blockwise ring attention for one shard (call inside ``shard_map``).
 
@@ -186,7 +186,7 @@ def ring_attention_fn(
     flash: bool = False,
     interpret: bool | None = None,
     q_tile: int = 256,
-    k_tile: int = 512,
+    k_tile: int = 2048,
 ):
     """Jitted ring attention over a sequence sharded along ``axis_name``
     (inputs (L_global, d) sharded on axis 0). ``flash=True`` uses the

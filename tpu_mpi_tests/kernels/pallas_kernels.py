@@ -845,7 +845,7 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
 def flash_attention_block_pallas(
     q, k, v, m, l, acc, q_off, k_off, *,
     scale: float, causal: bool = False,
-    q_tile: int = 256, k_tile: int = 512,
+    q_tile: int = 256, k_tile: int = 2048,
     interpret: bool | None = None,
     precision=jax.lax.Precision.HIGHEST,
 ):
@@ -901,7 +901,7 @@ def flash_attention_block_pallas(
 )
 def flash_attention_pallas(
     q, k, v, *, scale: float | None = None, causal: bool = False,
-    q_tile: int = 256, k_tile: int = 512, interpret: bool | None = None,
+    q_tile: int = 256, k_tile: int = 2048, interpret: bool | None = None,
     precision=jax.lax.Precision.HIGHEST,
 ):
     """Single-device flash attention: softmax(q·kᵀ·scale)·v without ever
