@@ -330,7 +330,9 @@ def bench_attention(results):
                     0, jnp.asarray(n_iter, jnp.int32), body, state
                 )
 
-            per, state = chain_rate(run, (q, k, v), n_short=40, n_long=440)
+            # 1000-iteration delta: at the tuned kernel's ~0.26 ms/iter the
+            # older 400-iter delta (~0.1 s) barely cleared host-timer noise
+            per, state = chain_rate(run, (q, k, v), n_short=100, n_long=1100)
             q, k, v = state
             _emit(results, f"attention_{name}_{dtype}_tflops", flops / per
                   / 1e12, "TFLOP/s", f"L={L} d={d} softmax(qk^T)v")
