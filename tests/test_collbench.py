@@ -49,3 +49,45 @@ def test_rejects_unknown_collective(capsys):
     out = capsys.readouterr().out
     assert rc == 2
     assert "unknown collective" in out
+
+
+class TestAttnbench:
+    """Attention benchmark driver (same chained-measurement pattern as
+    collbench; correctness of the tiers is gated in test_ring.py)."""
+
+    def test_tiers_run_and_report(self, capsys):
+        from tpu_mpi_tests.drivers import attnbench
+
+        rc = attnbench.main([
+            "--fake-devices", "8", "--seq-len", "128", "--head-dim", "16",
+            "--tiers", "xla,flash,ring,ulysses", "--n-iter", "20",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        for tier in ("xla", "flash", "ring", "ulysses"):
+            assert f"ATTN {tier} L=128 d=16 float32 " in out
+        assert "FAIL" not in out
+
+    def test_unknown_tier_rejected(self, capsys):
+        from tpu_mpi_tests.drivers import attnbench
+
+        rc = attnbench.main([
+            "--fake-devices", "8", "--seq-len", "64", "--head-dim", "8",
+            "--tiers", "bogus", "--n-iter", "20",
+        ])
+        assert rc == 2
+        assert "unknown tier" in capsys.readouterr().out
+
+    def test_indivisible_sequence_fails_fast(self):
+        import pytest as _pytest
+
+        from tpu_mpi_tests.drivers import attnbench
+        from tpu_mpi_tests.utils import TpuMtError
+
+        # 100 % 8 != 0 → the fail-fast divisibility exception propagates
+        # (the framework's CHECK-abort analog, PARITY §2.2 #13)
+        with _pytest.raises(TpuMtError, match="not evenly divisible"):
+            attnbench.main([
+                "--fake-devices", "8", "--seq-len", "100", "--head-dim",
+                "8", "--tiers", "ring", "--n-iter", "20",
+            ])

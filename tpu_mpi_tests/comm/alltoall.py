@@ -149,7 +149,8 @@ def ulysses_attention(
 def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
                          block_keys: int = 512, flash: bool = False,
                          interpret: bool | None = None,
-                         k_tile: int = 2048):
+                         k_tile: int = 2048,
+                         precision=lax.Precision.HIGHEST):
     """Jitted Ulysses attention over (L_global, H, Dh) arrays sharded along
     the sequence (axis 0). ``flash=True`` uses the Pallas flash kernel for
     the per-head local attention at its tuned ``k_tile``."""
@@ -169,6 +170,7 @@ def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
     def attn(q, k, v):
         return ulysses_attention(q, k, v, axis_name, causal=causal,
                                  block_keys=block_keys, flash=flash,
-                                 interpret=interpret, k_tile=k_tile)
+                                 interpret=interpret, k_tile=k_tile,
+                                 precision=precision)
 
     return attn

@@ -187,6 +187,7 @@ def ring_attention_fn(
     interpret: bool | None = None,
     q_tile: int = 256,
     k_tile: int = 2048,
+    precision=lax.Precision.HIGHEST,
 ):
     """Jitted ring attention over a sequence sharded along ``axis_name``
     (inputs (L_global, d) sharded on axis 0). ``flash=True`` uses the
@@ -205,6 +206,7 @@ def ring_attention_fn(
         return ring_attention(
             q, k, v, axis_name, causal=causal, flash=flash,
             interpret=interpret, q_tile=q_tile, k_tile=k_tile,
+            precision=precision,
         )
 
     return attn

@@ -135,3 +135,17 @@ def parse_grid_mesh(spec: "str | None", n_dev: int):
               f"have {n_dev}")
         return None
     return px, py
+
+
+def parse_choice_list(spec: str, valid, what: str = "entries"):
+    """Split a comma list and validate each entry against ``valid``.
+    Returns the list, or None after printing an ERROR line — shared by the
+    sweep drivers (collbench, attnbench) so a hardening fix cannot miss
+    one of them."""
+    names = [s.strip() for s in spec.split(",") if s.strip()]
+    bad = [n for n in names if n not in valid]
+    if bad or not names:
+        print(f"ERROR unknown {what} {bad or [spec]}; "
+              f"valid: {','.join(valid)}")
+        return None
+    return names

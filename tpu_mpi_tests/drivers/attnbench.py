@@ -1,0 +1,164 @@
+"""Attention-tier benchmark driver: flash vs XLA local attention, plus the
+sequence-parallel flavors, at CLI-selectable shapes.
+
+Nothing attention-shaped exists in the reference (SURVEY.md §5.7) — this
+driver benchmarks the capability its communication skeleton was built to
+carry: ``softmax(q·kᵀ/√d)·v`` locally (the building block), and the ring /
+Ulysses distributed flavors across the mesh. Output per configuration::
+
+    ATTN <tier> L=<L> d=<D> <dtype> <tflops> TFLOP/s
+
+Tiers: ``xla`` (materialized scores), ``flash`` (Pallas VMEM-tiled,
+``kernels.pallas_kernels.flash_attention_pallas``), ``ring``/``ulysses``
+(distributed; flash local compute, sequence sharded over the mesh axis).
+Iterations chain device-side with the output fed back as the next query
+(data-dependent, contention-robust; ``instrument.timers.chain_rate``).
+FLOP accounting is the standard 4·L²·d per attention (2 matmuls), counted
+globally for the distributed tiers. Correctness of every tier is gated by
+``tests/test_ring.py`` against exact references; this driver measures.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+from tpu_mpi_tests.drivers import _common
+
+TIERS = ("xla", "flash", "ring", "ulysses")
+
+
+def run(args) -> int:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_mpi_tests.comm.alltoall import ulysses_attention_fn
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.comm.ring import ring_attention_fn
+    from tpu_mpi_tests.instrument import Reporter
+    from tpu_mpi_tests.instrument.timers import chain_rate
+    from tpu_mpi_tests.kernels.pallas_kernels import flash_attention_pallas
+    from tpu_mpi_tests.utils import check_divisible
+
+    dtype = _common.jnp_dtype(args)
+    bootstrap()
+    topo = topology()
+    world = topo.global_device_count
+    mesh = make_mesh()
+    axis_name = mesh.axis_names[0]
+
+    rep = Reporter(rank=topo.process_index, size=world,
+                   jsonl_path=args.jsonl)
+    rep.banner(
+        f"attnbench: L={args.seq_len} d={args.head_dim} tiers={args.tiers} "
+        f"dtype={args.dtype} causal={args.causal} n_iter={args.n_iter} "
+        f"world={world}"
+    )
+
+    L, d = args.seq_len, args.head_dim
+    # causal computes only the lower triangle — half the matmul work
+    # (flash-attn benchmark convention)
+    flops = (2.0 if args.causal else 4.0) * L * L * d
+    tiers = _common.parse_choice_list(args.tiers, TIERS, "tier")
+    if tiers is None:
+        return 2
+
+    prec = lax.Precision.DEFAULT if args.fast else lax.Precision.HIGHEST
+
+    def xla_attn(q, k, v):
+        s = jnp.matmul(q, k.T, precision=prec) / (d**0.5)
+        if args.causal:
+            s = jnp.where(
+                jnp.tril(jnp.ones((L, L), bool)), s, -jnp.inf
+            )
+        return jnp.matmul(jax.nn.softmax(s, axis=-1), v, precision=prec)
+
+    rc = 0
+    for tier in tiers:
+        key = jax.random.PRNGKey(0)
+        if tier in ("ring", "ulysses"):
+            check_divisible(L, world, "sequence over mesh axis")
+            shape = (L, world, d) if tier == "ulysses" else (L, d)
+            q, k, v = (
+                shard_1d(jax.random.normal(kk, shape, dtype), mesh)
+                for kk in jax.random.split(key, 3)
+            )
+            if tier == "ring":
+                attn = ring_attention_fn(
+                    mesh, axis_name, causal=args.causal, flash=True,
+                    precision=prec,
+                )
+            else:
+                attn = ulysses_attention_fn(
+                    mesh, axis_name, causal=args.causal, flash=True,
+                    precision=prec,
+                )
+        else:
+            q, k, v = (
+                jax.random.normal(kk, (L, d), dtype)
+                for kk in jax.random.split(key, 3)
+            )
+            if tier == "flash":
+                attn = functools.partial(
+                    flash_attention_pallas, causal=args.causal,
+                    precision=prec,
+                )
+            else:
+                attn = xla_attn
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def loop(state, n, attn=attn):
+            def body(_, st):
+                qq, kk, vv = st
+                return attn(qq, kk, vv), kk, vv
+
+            return lax.fori_loop(0, jnp.asarray(n, jnp.int32), body, state)
+
+        sec, state = chain_rate(
+            loop, (q, k, v), n_short=args.n_iter // 10 or 1,
+            n_long=args.n_iter,
+        )
+        del state
+        tflops = flops / sec / 1e12
+        heads = world if tier == "ulysses" else 1
+        rep.line(
+            f"ATTN {tier} L={L} d={d} {args.dtype} "
+            f"{tflops * heads:0.1f} TFLOP/s",
+            {"kind": "attn", "tier": tier, "L": L, "d": d,
+             "dtype": args.dtype, "causal": args.causal,
+             "tflops": tflops * heads, "us_per_iter": sec * 1e6,
+             "world": world},
+        )
+        if not (tflops > 0):
+            rep.line(f"ATTN FAIL {tier}: non-positive rate {tflops}")
+            rc = 1
+    return rc
+
+
+def main(argv=None) -> int:
+    p = _common.base_parser(__doc__)
+    p.add_argument("--seq-len", type=int, default=8192)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--tiers", default="xla,flash",
+                   help=f"comma list from {','.join(TIERS)}")
+    p.add_argument("--causal", action="store_true")
+    p.add_argument(
+        "--fast", action="store_true",
+        help="MXU-native (DEFAULT) matmul precision instead of HIGHEST "
+        "(the throughput configuration BASELINE.md quotes)",
+    )
+    p.add_argument("--n-iter", type=int, default=1100,
+                   help="chained iterations (delta = n_iter - n_iter/10)")
+    args = p.parse_args(argv)
+    if args.seq_len < 8 or args.head_dim < 1:
+        p.error("--seq-len must be >= 8 and --head-dim >= 1")
+    if args.n_iter < 10:
+        p.error("--n-iter must be >= 10")
+    _common.setup_platform(args)
+    return _common.run_guarded(run, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,12 +116,11 @@ def run(args) -> int:
         f"collectives={args.collectives} n_iter={args.n_iter}"
     )
 
-    names = args.collectives.split(",")
-    for name in names:
-        if name not in COLLECTIVES:
-            rep.line(f"ERROR unknown collective {name!r}; "
-                     f"valid: {','.join(COLLECTIVES)}")
-            return 2
+    names = _common.parse_choice_list(
+        args.collectives, COLLECTIVES, "collective"
+    )
+    if names is None:
+        return 2
 
     dtype = _common.jnp_dtype(args)
     itemsize = jnp.dtype(dtype).itemsize
