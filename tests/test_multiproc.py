@@ -224,3 +224,22 @@ def test_multiproc_heat2d_grid(tpumt_run, tmp_path):
     out0 = rank_outputs(prefix, 2)[0]
     assert re.search(r"HEAT mesh:2x1 n:32x32; steps=40 [\d.]+ steps/s", out0)
     assert "HEAT FAIL" not in out0
+
+
+def test_multiproc_stencil2d_rdma_tier(tpumt_run, tmp_path):
+    """2-process stencil2d through the hand-written RDMA-ring exchange
+    tier: in interpret mode the ring kernel's remote DMA is emulated with
+    XLA collectives, which cross the process boundary like any other —
+    so the hand tier's semantics get DCN CI coverage too (err gate)."""
+    prefix = tmp_path / "out-rdma-"
+    r = launch(
+        tpumt_run, 2, sys.executable, "-m",
+        "tpu_mpi_tests.drivers.stencil2d",
+        "--fake-devices", "1", "--n-local", "16", "--n-other", "32",
+        "--n-iter", "3", "--rdma", "--only", "0:0",
+        out_prefix=prefix,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out0 = rank_outputs(prefix, 2)[0]
+    assert re.search(r"TEST dim:0, device , buf:0; [\d.]+, err=", out0)
+    assert "ERR_NORM FAIL" not in out0
