@@ -319,3 +319,26 @@ def test_iterate_overlap_matches_sequential(mesh8, axis, periodic):
     ra = np.asarray(seq(za, 5))
     rb = np.asarray(ovl(zb, 5))
     np.testing.assert_allclose(ra, rb, atol=1e-5)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("periodic", [False, True])
+def test_iterate_rdma_matches_ppermute_tier(mesh8, axis, periodic):
+    """The 100%-hand-tier hot loop (RDMA ring exchange + in-place kernel,
+    chained) must match the ppermute-exchange tier over 8 shards —
+    including the periodic self-ring configuration BASELINE.md times."""
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.halo import iterate_pallas_fn
+
+    rng_ = np.random.default_rng(21 + axis)
+    shape = (8 * 16, 16) if axis == 0 else (16, 8 * 16)
+    zg = rng_.normal(size=shape).astype(np.float32)
+    za = shard_1d(jnp.asarray(zg), mesh8, axis=axis)
+    zb = shard_1d(jnp.asarray(zg), mesh8, axis=axis)
+    pp = iterate_pallas_fn(mesh8, "shard", 2, 1e-2, axis=axis,
+                           interpret=True, periodic=periodic)
+    hand = iterate_pallas_fn(mesh8, "shard", 2, 1e-2, axis=axis,
+                             interpret=True, periodic=periodic, rdma=True)
+    np.testing.assert_allclose(
+        np.asarray(pp(za, 4)), np.asarray(hand(zb, 4)), atol=1e-6
+    )

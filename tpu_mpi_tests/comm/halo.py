@@ -421,6 +421,7 @@ def iterate_pallas_fn(
     interpret: bool | None = None,
     steps: int = 1,
     periodic: bool = False,
+    rdma: bool = False,
 ):
     """Like :func:`iterate_fused_fn` but with the hand-written in-place
     Pallas step (2 HBM passes/iter vs XLA's ~6). ``axis=1`` (default) puts
@@ -435,8 +436,17 @@ def iterate_pallas_fn(
     the interior sequence is identical to per-step exchange (tested), HBM
     traffic per timestep drops toward 2/k passes, and the exchange message
     count drops k-fold at the same total volume. ``n_iter`` then counts
-    OUTER loop bodies (= n_iter·k timesteps)."""
-    from tpu_mpi_tests.kernels.pallas_kernels import stencil2d_iterate_pallas
+    OUTER loop bodies (= n_iter·k timesteps).
+
+    ``rdma=True`` swaps the ppermute exchange for the hand-written RDMA
+    ring (``ring_halo_pallas``), making the whole hot loop 100% hand-tier
+    — explicit inter-chip DMA feeding the in-place VMEM kernel, the
+    reference's fully-manual pipeline (``mpi_stencil2d_sycl.cc``) chained
+    device-side."""
+    from tpu_mpi_tests.kernels.pallas_kernels import (
+        ring_halo_pallas,
+        stencil2d_iterate_pallas,
+    )
     from tpu_mpi_tests.kernels.stencil import N_BND as RADIUS
     from tpu_mpi_tests.utils import TpuMtError
 
@@ -478,8 +488,14 @@ def iterate_pallas_fn(
                     )
                 }
 
+            exch = (
+                functools.partial(ring_halo_pallas, interpret=interpret)
+                if rdma
+                else exchange_shard
+            )
+
             def body(_, zz):
-                zz = exchange_shard(
+                zz = exch(
                     zz,
                     axis_name=axis_name,
                     axis=axis,
@@ -499,7 +515,23 @@ def iterate_pallas_fn(
 
         return go(z, jnp.asarray([n_iter], jnp.int32))
 
-    return run
+    if not rdma:
+        return run
+
+    def run_attributed(z, n_iter):
+        # a wedged DMA semaphore / neighborhood barrier in the hand ring
+        # is a silent hang; record the dispatch so the watchdog can
+        # attribute it (parity with halo_exchange's PALLAS_RDMA path)
+        from tpu_mpi_tests.instrument.watchdog import note_comm_op
+
+        note_comm_op(
+            f"iterate_pallas_fn(rdma=True, axis={axis}, n_bnd={n_bnd}, "
+            f"periodic={periodic}, steps={steps}, "
+            f"world={mesh.shape[axis_name]}, n_iter={n_iter})"
+        )
+        return run(z, n_iter)
+
+    return run_attributed
 
 
 @functools.lru_cache(maxsize=None)
