@@ -426,8 +426,9 @@ def iterate_pallas_fn(
     """Like :func:`iterate_fused_fn` but with the hand-written in-place
     Pallas step (2 HBM passes/iter vs XLA's ~6). ``axis=1`` (default) puts
     the stencil on the lane dimension where VMEM shifts are register-cheap —
-    the bench.py fast path (1212 iter/s at 8192² f32 on v5e vs ~258 for the
-    XLA formulation; bf16 2474 = 2.04× f32); ``axis=0`` runs the same
+    the bench.py fast path (~1210 iter/s per-step at 8192² f32 on v5e vs
+    ~260 for the XLA formulation; 2000–2180 with ``steps=4`` temporal
+    blocking — BASELINE.md); ``axis=0`` runs the same
     2-pass in-place step on a dim-0 (sublane-shift) decomposition.
 
     ``steps=k`` enables communication-avoiding temporal blocking: the array
