@@ -64,3 +64,27 @@ def test_unstable_dt_fails_gate(capsys):
     )
     assert rc == 1
     assert "HEAT FAIL" in out
+
+
+@pytest.mark.parametrize("halo_steps", [2, 4])
+def test_temporal_blocking_keeps_eigen_gate(capsys, halo_steps):
+    """k Euler steps fused per dual-axis exchange over k-deep ghosts must
+    stay eigenstructure-exact — stale values creep only within the ghost
+    band the next deep exchange overwrites (2-D validity argument)."""
+    rc, out = run_driver(
+        capsys, "--mesh", "2,4", "--nx-local", "16", "--ny-local", "12",
+        "--n-steps", "48", "--halo-steps", str(halo_steps),
+        "--dtype", "float64",
+    )
+    assert rc == 0, out
+    rel = float(re.search(r"HEAT ERR rel=([\d.e+-]+)", out).group(1))
+    assert rel < 1e-13
+
+
+def test_halo_steps_must_divide(capsys):
+    with pytest.raises(SystemExit) as exc:
+        heat2d.main([
+            "--fake-devices", "8", "--n-steps", "50", "--halo-steps", "4",
+        ])
+    assert exc.value.code == 2
+    assert "must be a multiple" in capsys.readouterr().err

@@ -708,20 +708,36 @@ def heat_step2d_fn(
     n_bnd: int,
     cx: float,
     cy: float,
+    steps: int = 1,
 ):
-    """``n_steps`` explicit-Euler heat-equation steps on a periodic 2-D
-    process grid, chained device-side: per step, halo exchange along both
-    mesh axes then ``interior += cx·δ²x + cy·δ²y`` (the 5-point discrete
-    Laplacian; ``c = ν·dt/Δ²``). Shape-preserving and donated, so the time
-    loop is one ``lax.fori_loop`` — the mini-app analog of the reference's
-    hot loop (``mpi_stencil2d_gt.cc:511-535``) integrating an actual PDE
-    instead of re-timing one exchange.
+    """``n_steps`` outer bodies of explicit-Euler heat-equation integration
+    on a periodic 2-D process grid, chained device-side: per body, halo
+    exchange along both mesh axes then ``steps`` updates of
+    ``interior += cx·δ²x + cy·δ²y`` (the 5-point discrete Laplacian;
+    ``c = ν·dt/Δ²``). Shape-preserving and donated, so the time loop is one
+    ``lax.fori_loop`` — the mini-app analog of the reference's hot loop
+    (``mpi_stencil2d_gt.cc:511-535``) integrating an actual PDE instead of
+    re-timing one exchange.
+
+    ``steps=k`` is temporal blocking on the 2-D update: ghost width must be
+    ``k`` (one Laplacian radius per fused timestep), BOTH axes exchange
+    once per k steps (1/k the messages at the same volume), and each
+    in-between update covers the maximal span — stale values creep inward
+    one cell per step but only within the ghost band, which the next deep
+    exchange overwrites, so the true interior is update-for-update
+    identical to per-step exchange (same validity argument as the 1-D
+    k-step kernel; proved by the heat2d eigen gate at k>1).
 
     On a periodic grid, ``sin(kx·x)·sin(ky·y)`` is an exact eigenvector of
     this update with factor ``g = 1 − cx·(2−2cos kxΔx) − cy·(2−2cos kyΔy)``
     per step, which the heat2d driver uses as a roundoff-exact gate: a
     broken exchange or kernel destroys the eigenstructure immediately.
     """
+    if n_bnd < steps:
+        raise ValueError(
+            f"heat_step2d_fn: ghost width n_bnd={n_bnd} must be >= "
+            f"steps={steps} (one Laplacian radius per fused timestep)"
+        )
 
     @functools.partial(jax.jit, donate_argnums=0)
     def run(z, n_steps):
@@ -741,21 +757,19 @@ def heat_step2d_fn(
                     zz, axis_name=axis_y, axis=1, n_bnd=n_bnd, periodic=True
                 )
                 nx, ny = zz.shape
-                ix = slice(n_bnd, nx - n_bnd)
-                iy = slice(n_bnd, ny - n_bnd)
-                mid = zz[ix, iy]
-                d2x = (
-                    zz[n_bnd + 1:nx - n_bnd + 1, iy]
-                    + zz[n_bnd - 1:nx - n_bnd - 1, iy]
-                    - 2.0 * mid
-                )
-                d2y = (
-                    zz[ix, n_bnd + 1:ny - n_bnd + 1]
-                    + zz[ix, n_bnd - 1:ny - n_bnd - 1]
-                    - 2.0 * mid
-                )
-                new = mid + zz.dtype.type(cx) * d2x + zz.dtype.type(cy) * d2y
-                return lax.dynamic_update_slice(zz, new, (n_bnd, n_bnd))
+                for _ in range(steps):
+                    ix = slice(1, nx - 1)
+                    iy = slice(1, ny - 1)
+                    mid = zz[ix, iy]
+                    d2x = zz[2:nx, iy] + zz[0:nx - 2, iy] - 2.0 * mid
+                    d2y = zz[ix, 2:ny] + zz[ix, 0:ny - 2] - 2.0 * mid
+                    new = (
+                        mid
+                        + zz.dtype.type(cx) * d2x
+                        + zz.dtype.type(cy) * d2y
+                    )
+                    zz = lax.dynamic_update_slice(zz, new, (1, 1))
+                return zz
 
             return lax.fori_loop(0, n[0], body, z)
 

@@ -70,9 +70,12 @@ def run(args) -> int:
 
     # ghosted-per-shard layout, interior = sin(kx x)·sin(ky y), ghosts zero
     # (the first exchange fills them — periodic, so no physical bands).
-    # Ghost width 1 = the 5-point Laplacian's radius: the exchange moves
-    # exactly the bytes the kernel reads (N_BND=2 would double comm volume)
-    nb = 1
+    # Ghost width = halo_steps × the 5-point Laplacian's radius (1): the
+    # exchange moves exactly the bytes the fused timesteps read; at the
+    # default halo_steps=1 that is the minimal per-step exchange, and
+    # --halo-steps k trades k-deep ghosts for 1/k the exchanges (temporal
+    # blocking, interior-identical — the eigen gate proves it at k>1)
+    nb = args.halo_steps
     gxs, gys = args.nx_local + 2 * nb, args.ny_local + 2 * nb
     zg_host = np.zeros((px * gxs, py * gys), dtype=dtype)
     xs = np.arange(nx, dtype=np.float64) * dx
@@ -90,13 +93,18 @@ def run(args) -> int:
             ] = blk.astype(dtype)
     zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
 
-    step = heat_step2d_fn(mesh, "x", "y", nb, float(cx), float(cy))
-    zs = block(step(zs, 1))  # compile + warm (1 real step, counted below)
+    step = heat_step2d_fn(
+        mesh, "x", "y", nb, float(cx), float(cy), steps=args.halo_steps
+    )
+    outer_total = args.n_steps // args.halo_steps
+    # compile + warm: 1 outer body = halo_steps real timesteps, counted
+    zs = block(step(zs, 1))
 
     t0 = time.perf_counter()
-    zs = block(step(zs, args.n_steps - 1))
+    zs = block(step(zs, outer_total - 1))
     seconds = time.perf_counter() - t0
-    steps_per_s = (args.n_steps - 1) / seconds if seconds > 0 else float("inf")
+    timed_steps = (outer_total - 1) * args.halo_steps
+    steps_per_s = timed_steps / seconds if seconds > 0 else float("inf")
     rep.line(
         f"HEAT mesh:{px}x{py} n:{nx}x{ny}; steps={args.n_steps} "
         f"{steps_per_s:0.1f} steps/s",
@@ -169,12 +177,21 @@ def main(argv=None) -> int:
     p.add_argument("--kx", type=int, default=1)
     p.add_argument("--ky", type=int, default=1)
     p.add_argument("--tol", type=float, default=None)
+    p.add_argument(
+        "--halo-steps", type=int, default=1,
+        help="temporal blocking: fuse this many Euler steps per dual-axis "
+        "exchange over equally-deep ghosts (1/k the messages; "
+        "interior-identical, gated by the same eigen check)",
+    )
     args = p.parse_args(argv)
-    for name in ("nx_local", "ny_local", "n_steps", "kx", "ky"):
+    for name in ("nx_local", "ny_local", "n_steps", "kx", "ky",
+                 "halo_steps"):
         if getattr(args, name) < 1:
             p.error(f"--{name.replace('_', '-')} must be positive")
-    if min(args.nx_local, args.ny_local) < 3:
-        p.error("--nx-local/--ny-local must be >= 3 (Laplacian radius)")
+    if args.n_steps % args.halo_steps:
+        p.error("--n-steps must be a multiple of --halo-steps")
+    if min(args.nx_local, args.ny_local) < 2 * args.halo_steps + 1:
+        p.error("--nx-local/--ny-local must exceed 2x the fused halo depth")
     _common.setup_platform(args)
     return _common.run_guarded(run, args)
 
