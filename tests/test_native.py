@@ -113,7 +113,9 @@ def test_native_launcher_deadline_kills_hung_ranks(launcher_bin):
     )
     assert r.returncode == 124
     assert "deadline of 1 s exceeded" in r.stderr
-    assert time.time() - t0 < 10
+    # generous bound: the semantic claim is "did not wait out the sleep";
+    # tight wall-clock bounds flake on loaded CI hosts
+    assert time.time() - t0 < 25
     # no orphaned grandchild survives the group kill
     ps = subprocess.run(
         ["ps", "-eo", "args"], capture_output=True, text=True
