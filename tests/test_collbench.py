@@ -13,11 +13,7 @@ def test_sweep_all_collectives(capsys, tmp_path):
     )
     out = capsys.readouterr().out
     assert rc == 0
-    rows = re.findall(
-        r"COLL (\w+) bytes=(\d+) ([\d.]+|nan) us/iter  "
-        r"busbw=([\d.]+|nan) GB/s",
-        out,
-    )
+    rows = [m[:4] for m in re.findall(collbench.COLL_LINE_RE, out)]
     assert len(rows) == 4 * 2  # 4 collectives x 2 sizes
     assert {r[0] for r in rows} == set(collbench.COLLECTIVES)
     import math

@@ -198,10 +198,13 @@ def test_multiproc_collbench_busbw(tpumt_run, tmp_path):
     )
     assert r.returncode == 0, r.stdout + r.stderr
     out0 = rank_outputs(prefix, 2)[0]
-    rows = re.findall(
-        r"COLL (\w+) bytes=65536 ([\d.a-z]+) us/iter  busbw=([\d.a-z]+)",
-        out0,
-    )
+    from tpu_mpi_tests.drivers.collbench import COLL_LINE_RE
+
+    rows = [
+        (m[0], m[2], m[3])
+        for m in re.findall(COLL_LINE_RE, out0)
+        if m[1] == "65536"
+    ]
     assert {name for name, _, _ in rows} == {
         "allgather", "allreduce", "ppermute", "alltoall"
     }, out0

@@ -34,6 +34,13 @@ from tpu_mpi_tests.drivers import _common
 
 COLLECTIVES = ("allgather", "allreduce", "ppermute", "alltoall")
 
+# the COLL line's parse pattern lives NEXT TO its format string (below) so
+# a format change is a one-site edit; both test files import this
+COLL_LINE_RE = (
+    r"COLL (\w+) bytes=(\d+) ([\d.e+-]+|nan) us/iter  "
+    r"busbw=([\d.e+-]+|nan) GB/s  n=(\d+)"
+)
+
 
 def _loop_fn(mesh, axis_name: str, name: str, world: int):
     import jax
@@ -149,8 +156,11 @@ def run(args) -> int:
             moved = _busbw_bytes(name, shard_bytes, world)
             busbw = moved / sec / 1e9
             rep.line(
+                # %.4g, not %.2f: a loaded host can push busbw below
+                # 0.005 GB/s, which fixed-point floors to a misleading
+                # "0.00" (a positive measurement must print positive)
                 f"COLL {name} bytes={shard_bytes} {sec * 1e6:0.2f} us/iter"
-                f"  busbw={busbw:0.2f} GB/s  n={n_eff}",
+                f"  busbw={busbw:0.4g} GB/s  n={n_eff}",
                 {"kind": "coll", "collective": name, "dtype": args.dtype,
                  "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
                  "busbw_gbps": busbw, "world": world, "n_iter": n_eff},
