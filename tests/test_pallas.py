@@ -370,3 +370,13 @@ def test_iterate_multistep_fuzz_shapes():
             flags=rng_.choice(["static", "dynamic"]),
             seed=100 + trial,
         )
+
+
+def test_daxpy_inplace_alias_matches():
+    """inplace=True (output aliased onto y — cuBLAS's real semantics, and
+    required for chained loops per the BASELINE A/B) computes the same
+    values as the out-of-place form."""
+    x, y = init_xy(64 * 1024, jnp.float32)
+    want = np.asarray(PK.daxpy_pallas(2.0, x, y))
+    got = np.asarray(PK.daxpy_pallas(2.0, x, y, inplace=True))
+    np.testing.assert_array_equal(got, want)

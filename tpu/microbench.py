@@ -58,6 +58,40 @@ def bench_daxpy(results):
         _emit(results, f"daxpy_pallas_2^{logn}_gbps", gb / t, "GB/s")
         del x, y
 
+    # chained (fori_loop-carried) A/B: sustained streaming REQUIRES the
+    # output aliased onto y — the out-of-place form churns a fresh carry
+    # buffer per iteration (BASELINE.md aliasing-requirement row)
+    import functools
+
+    import jax
+    from jax import lax
+
+    from tpu_mpi_tests.instrument.timers import chain_rate
+
+    n = 1 << 26
+    gb = 3 * 4 * n / 1e9
+    for inplace in (False, True):
+        x, y = init_xy(n, jnp.float32)
+
+        @functools.partial(jax.jit, donate_argnums=1)
+        def run(xx, yy, n_iter, inplace=inplace):
+            def body(_, cur):
+                return PK.daxpy_pallas(1e-7, xx, cur, inplace=inplace)
+
+            return lax.fori_loop(
+                0, jnp.asarray(n_iter, jnp.int32), body, yy
+            )
+
+        per, _ = chain_rate(
+            functools.partial(run, x), y, n_short=100, n_long=1100
+        )
+        _emit(
+            results,
+            f"daxpy_chained_{'aliased' if inplace else 'outofplace'}_gbps",
+            gb / per, "GB/s", "2^26 f32, 1000-iter fori_loop carry",
+        )
+        del x, y
+
 
 def bench_stencil(results):
     import numpy as np

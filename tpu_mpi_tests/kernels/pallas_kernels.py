@@ -57,15 +57,28 @@ def _stream_block_rows(itemsize: int, n_bufs: int) -> int:
     return 1 << (rows.bit_length() - 1)
 
 
-@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "interpret", "inplace")
+)
 def daxpy_pallas(a, x, y, block_rows: int | None = None,
-                 interpret: bool | None = None):
+                 interpret: bool | None = None, inplace: bool = False):
     """y ← a·x + y on 1-D arrays (≅ ``cublasDaxpy``).
 
     The array is viewed as (rows, 128) lanes and processed in
     ``block_rows``-row VMEM tiles (default: dtype-dependent maximum, 4096
     for f32); n must be a multiple of 128 (driver sizes are powers of two,
     like the reference's 48Mi-per-node sizing).
+
+    ``inplace=True`` aliases the output onto ``y`` — cuBLAS's actual
+    in-place semantics, and REQUIRED for chained loops: a measured A/B
+    (BASELINE.md; reproduced by ``tpu/microbench.py daxpy`` chained rows)
+    shows the non-aliased form collapses to 398 GB/s inside a
+    ``fori_loop`` (per-iteration output-buffer churn) while the aliased
+    form holds the standalone 685 GB/s. The alias pays off only when the
+    CALLER owns the buffer — inside an outer jit that carries ``y`` (e.g.
+    a ``fori_loop`` body) or a top-level call whose outer jit donates it;
+    called standalone on a live entry array, XLA must insert a defensive
+    copy (entry params are immutable), costing a 4th pass.
     """
     n = x.shape[0]
     if n % 128 != 0:
@@ -94,6 +107,7 @@ def daxpy_pallas(a, x, y, block_rows: int | None = None,
         out_specs=pl.BlockSpec(
             (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
+        input_output_aliases=({2: 0} if inplace else {}),
         interpret=_auto_interpret(interpret),
     )(a_arr, x2, y2)
     return out.reshape(n)
