@@ -290,10 +290,22 @@ def test_ring_allgather_rdma_matches_lax(mesh8):
 def test_ring_allgather_rdma_1d(mesh8):
     from tpu_mpi_tests.comm import collectives as C
 
-    full = np.arange(8 * 32, dtype=np.float32)
+    # 1024 elements/shard: the minimum 1-D unit (128 lanes × 8 sublanes
+    # f32) — the lane-aligned fold that real-TPU Mosaic DMA requires (a
+    # (n, 1) view compiled nowhere but interpret mode; round-2 bug)
+    full = np.arange(8 * 1024, dtype=np.float32)
     xs = C.shard_1d(jnp.asarray(full), mesh8)
     got = np.asarray(C.all_gather_rdma(xs, mesh8, interpret=True))
     assert np.array_equal(got, full)
+
+
+def test_ring_allgather_rdma_1d_rejects_subtile():
+    from tpu_mpi_tests.kernels import pallas_kernels as PK
+
+    with pytest.raises(ValueError, match="n % 1024 == 0"):
+        PK.ring_allgather_pallas(
+            jnp.ones((96,)), axis_name="shard", interpret=True
+        )
 
 
 def test_ring_allgather_rejects_unaligned_rows():

@@ -679,20 +679,30 @@ def ring_allgather_pallas(
     (``ring_halo_pallas`` vs ``ppermute``). Call *inside* ``shard_map``.
 
     ``x`` is this shard's (n, m) block; returns the (w·n, m) gathered array.
-    Everything stays HBM-resident (shard-size independent); the only
-    alignment requirement is that the dynamic row offsets of the out-region
-    DMAs stay sublane-tile-aligned: n must be a multiple of the dtype's
-    sublane tile (8 rows f32/f64, 16 bf16, 32 int8).
+    Everything stays HBM-resident (shard-size independent); the alignment
+    requirement is that the dynamic row offsets of the out-region DMAs stay
+    sublane-tile-aligned: 2-D shards need n rows ≡ 0 mod the dtype's
+    sublane tile (8 f32/f64, 16 bf16, 32 int8); 1-D shards are folded into
+    128-lane rows (Mosaic sliced DMA needs full lane tiles — a (n, 1) view
+    compiles nowhere but interpret mode), so they need
+    n ≡ 0 mod 128·sublane (1024 f32, 2048 bf16).
     """
+    sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
     if x.ndim == 1:
+        unit = 128 * sublane
+        if x.shape[0] % unit != 0:
+            raise ValueError(
+                f"ring_allgather_pallas: 1-D shards need n % {unit} == 0 "
+                f"for {jnp.dtype(x.dtype).name} (128 lanes × {sublane} "
+                f"sublanes per DMA tile), got {x.shape[0]}"
+            )
         return ring_allgather_pallas(
-            x.reshape(-1, 1),
+            x.reshape(-1, 128),
             axis_name=axis_name,
             collective_id=collective_id,
             interpret=interpret,
         ).reshape(-1)
     n = x.shape[0]
-    sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
     if n % sublane != 0:
         raise ValueError(
             f"ring_allgather_pallas needs shard rows % {sublane} == 0 for "
