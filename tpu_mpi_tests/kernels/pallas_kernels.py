@@ -809,6 +809,41 @@ def _fit_divisor(n: int, want: int) -> int:
     return t
 
 
+def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile):
+    """Shrink requested flash tiles until the kernel's VMEM live set fits.
+
+    Live model (matches the Mosaic stack-OOM sizes observed on v5e): the
+    full K/V blocks (2·Lk·d·itemsize) + the scores tile in f32 and its
+    dtype-cast copy (q_tile·k_tile·(4+itemsize)) + q/acc/m/l tiles
+    (q_tile·(d·(itemsize+4)+8)). Oversized requests (e.g. 512×4096 bf16 at
+    L=8192 d=128 = 16.5 MB) otherwise die in an opaque scoped-vmem OOM."""
+    budget = _VMEM_BUDGET_BYTES
+
+    def live(qt, kt):
+        return (
+            2 * Lk * d * itemsize
+            + qt * kt * (4 + itemsize)
+            + qt * (d * (itemsize + 4) + 8)
+        )
+
+    while live(q_tile, k_tile) > budget and k_tile > 256:
+        k_tile //= 2
+    while live(q_tile, k_tile) > budget and q_tile > 64:
+        q_tile //= 2
+    if live(q_tile, k_tile) > budget:
+        # tile-independent K/V residency alone exceeds VMEM — no tiling
+        # can save this block length; fail with the actual constraint
+        # instead of the opaque Mosaic scoped-vmem OOM
+        raise ValueError(
+            f"flash attention block too large for VMEM: K/V blocks of "
+            f"Lk={Lk}, d={d} ({2 * Lk * d * itemsize / 2**20:.1f} MiB) "
+            f"exceed the ~{budget / 2**20:.0f} MiB budget even at minimum "
+            f"tiles; shard the sequence (ring attention rotates "
+            f"Lk-per-shard blocks) or reduce d"
+        )
+    return _fit_divisor(L, q_tile), _fit_divisor(Lk, k_tile)
+
+
 def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
                         m_out, l_out, acc_out, *, scale, causal, k_tile,
                         precision):
@@ -885,12 +920,14 @@ def flash_attention_block_pallas(
     ``jax.lax.Precision.DEFAULT`` to trade accuracy for MXU throughput."""
     L, d = q.shape
     Lk = k.shape[0]
-    # shrink requested tiles to the largest divisor of the block length so
-    # any shard length works (the XLA tier accepts arbitrary L; the tiers
-    # must stay interchangeable) — odd lengths degrade tile width, they
-    # don't fail
-    q_tile = _fit_divisor(L, q_tile)
-    k_tile = _fit_divisor(Lk, k_tile)
+    # shrink requested tiles to (a) the VMEM live-set budget and (b) the
+    # largest divisor of the block length, so any shard length and any
+    # requested tiling works (the XLA tier accepts arbitrary L; the tiers
+    # must stay interchangeable) — oversized/odd requests degrade tile
+    # width, they don't fail
+    q_tile, k_tile = _fit_flash_tiles(
+        L, Lk, d, jnp.dtype(q.dtype).itemsize, q_tile, k_tile
+    )
     grid = (L // q_tile,)
     off = jnp.stack(
         [jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)]
