@@ -297,3 +297,39 @@ def test_flash_attention_fuzz_shapes():
             got, ref, atol=5e-5,
             err_msg=f"L={L} d={d} causal={causal} qt={qt} kt={kt}",
         )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_streaming_kv_path(causal, monkeypatch):
+    """When full K/V residency exceeds the VMEM budget the kernel falls
+    back to streaming K/V tiles over a 2-D grid (accumulators resident
+    across the inner dimension) — unbounded sequence length on one chip
+    (verified at L=32768 d=128 on real hardware, BASELINE.md). Forced
+    here by shrinking the budget so small shapes take the streaming path;
+    L=1024 with the 256-key tile floor gives 4 inner grid steps, so the
+    j>0 carry fold (the kernel's novel logic) actually executes."""
+    from tpu_mpi_tests.kernels import pallas_kernels as PK
+
+    # the budget is read at TRACE time: clear the jit caches so earlier
+    # resident-path traces of the same signature can't mask the patch
+    # (and streaming-path traces can't leak to later tests)
+    PK.flash_attention_pallas.clear_cache()
+    PK.flash_attention_block_pallas.clear_cache()
+    monkeypatch.setattr(PK, "_VMEM_BUDGET_BYTES", 450_000)
+    rng = np.random.default_rng(5)
+    L, d = 1024, 64
+    q, k, v = (rng.normal(size=(L, d)).astype(np.float32) for _ in range(3))
+    try:
+        got = np.asarray(PK.flash_attention_pallas(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal,
+            interpret=True,
+        ))
+    finally:
+        PK.flash_attention_pallas.clear_cache()
+        PK.flash_attention_block_pallas.clear_cache()
+    ref = reference_attention(
+        q.astype(np.float64), k.astype(np.float64), v.astype(np.float64),
+        causal=causal,
+    )
+    assert np.isfinite(got).all()
+    np.testing.assert_allclose(got, ref, atol=5e-5)

@@ -809,15 +809,27 @@ def _fit_divisor(n: int, want: int) -> int:
     return t
 
 
-def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile):
-    """Shrink requested flash tiles until the kernel's VMEM live set fits.
-
-    Live model (matches the Mosaic stack-OOM sizes observed on v5e): the
-    full K/V blocks (2·Lk·d·itemsize) + the scores tile in f32 and its
-    dtype-cast copy (q_tile·k_tile·(4+itemsize)) + q/acc/m/l tiles
-    (q_tile·(d·(itemsize+4)+8)). Oversized requests (e.g. 512×4096 bf16 at
-    L=8192 d=128 = 16.5 MB) otherwise die in an opaque scoped-vmem OOM."""
+def _shrink_tiles_to_budget(live, L, Lk, q_tile, k_tile):
+    """Shared shrink policy for the flash kernels: halve k_tile (floor 256)
+    then q_tile (floor 64) until ``live(qt, kt)`` fits the VMEM budget,
+    then snap both to divisors of the block lengths. Returns None when even
+    minimum tiles don't fit (the caller decides the fallback/failure)."""
     budget = _VMEM_BUDGET_BYTES
+    while live(q_tile, k_tile) > budget and k_tile > 256:
+        k_tile //= 2
+    while live(q_tile, k_tile) > budget and q_tile > 64:
+        q_tile //= 2
+    if live(q_tile, k_tile) > budget:
+        return None
+    return _fit_divisor(L, q_tile), _fit_divisor(Lk, k_tile)
+
+
+def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile):
+    """Tile fit for the resident-K/V flash kernel. Live model (matches the
+    Mosaic stack-OOM sizes observed on v5e): the full K/V blocks
+    (2·Lk·d·itemsize) + the scores tile in f32 and its dtype-cast copy
+    (q_tile·k_tile·(4+itemsize)) + q/acc/m/l tiles. Returns None when K/V
+    residency alone exceeds VMEM — the caller takes the streaming kernel."""
 
     def live(qt, kt):
         return (
@@ -826,22 +838,32 @@ def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile):
             + qt * (d * (itemsize + 4) + 8)
         )
 
-    while live(q_tile, k_tile) > budget and k_tile > 256:
-        k_tile //= 2
-    while live(q_tile, k_tile) > budget and q_tile > 64:
-        q_tile //= 2
-    if live(q_tile, k_tile) > budget:
-        # tile-independent K/V residency alone exceeds VMEM — no tiling
-        # can save this block length; fail with the actual constraint
-        # instead of the opaque Mosaic scoped-vmem OOM
-        raise ValueError(
-            f"flash attention block too large for VMEM: K/V blocks of "
-            f"Lk={Lk}, d={d} ({2 * Lk * d * itemsize / 2**20:.1f} MiB) "
-            f"exceed the ~{budget / 2**20:.0f} MiB budget even at minimum "
-            f"tiles; shard the sequence (ring attention rotates "
-            f"Lk-per-shard blocks) or reduce d"
+    return _shrink_tiles_to_budget(live, L, Lk, q_tile, k_tile)
+
+
+def _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile):
+    """Tile fit for the streaming-K/V kernel: K/V tiles are grid-blocked
+    (double-buffered by the pipeline), so only tiles — never full blocks —
+    are resident and any Lk fits. Unsatisfiable only for huge d, which no
+    tiling can fix — raise the constraint instead of the opaque Mosaic
+    scoped-vmem OOM."""
+
+    def live(qt, kt):
+        return (
+            4 * kt * d * itemsize           # k+v tiles, double-buffered
+            + qt * kt * (4 + itemsize)      # scores f32 + dtype-cast copy
+            + qt * (d * (itemsize + 4) + 8)
         )
-    return _fit_divisor(L, q_tile), _fit_divisor(Lk, k_tile)
+
+    fit = _shrink_tiles_to_budget(live, L, Lk, q_tile, k_tile)
+    if fit is None:
+        raise ValueError(
+            f"flash attention head dim too large for VMEM: d={d} needs "
+            f"{live(64, 256) / 2**20:.1f} MiB at minimum tiles vs the "
+            f"~{_VMEM_BUDGET_BYTES / 2**20:.0f} MiB budget; split the head "
+            f"dimension"
+        )
+    return fit
 
 
 def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
@@ -894,6 +916,56 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
     m_out[:], l_out[:], acc_out[:] = m, l, acc
 
 
+def _flash_stream_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
+                         m_out, l_out, acc_out, *, scale, causal,
+                         k_tile, precision):
+    """Streaming-K/V flash step: 2-D grid (q tiles × k tiles), K/V tiles
+    DMA'd per inner step instead of resident — unbounded sequence length on
+    one chip, at the cost of re-streaming K/V once per q tile. The
+    accumulators live in the output blocks, which pallas keeps VMEM-resident
+    across the inner (same-index) grid dimension: initialized from the
+    aliased carry at j=0, folded per k tile, flushed after the last."""
+    from tpu_mpi_tests.comm.ring import online_softmax_update
+
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_out[:] = m_ref[:]
+        l_out[:] = l_ref[:]
+        acc_out[:] = acc_ref[:]
+
+    q = q_ref[:]                                        # (qt, d)
+    kb = k_ref[:]                                       # (kt, d)
+    vb = v_ref[:]
+    s = jax.lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    ) * scale
+    if causal:
+        qt = q.shape[0]
+        q_pos = (
+            off_ref[0] + i * qt
+            + jax.lax.broadcasted_iota(jnp.int32, (qt, 1), 0)
+        )
+        k_pos = (
+            off_ref[1] + j * k_tile
+            + jax.lax.broadcasted_iota(jnp.int32, (1, k_tile), 1)
+        )
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    m_new, l_new, p, corr = online_softmax_update(
+        m_out[:], l_out[:], s, keepdims=True
+    )
+    acc_out[:] = acc_out[:] * corr + jax.lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+    m_out[:] = m_new
+    l_out[:] = l_new
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -924,34 +996,64 @@ def flash_attention_block_pallas(
     # largest divisor of the block length, so any shard length and any
     # requested tiling works (the XLA tier accepts arbitrary L; the tiers
     # must stay interchangeable) — oversized/odd requests degrade tile
-    # width, they don't fail
-    q_tile, k_tile = _fit_flash_tiles(
-        L, Lk, d, jnp.dtype(q.dtype).itemsize, q_tile, k_tile
-    )
-    grid = (L // q_tile,)
+    # width, they don't fail. When even minimum tiles cannot hold the full
+    # K/V blocks resident, fall back to the streaming-K/V kernel (K/V
+    # tiles grid-blocked per inner step): slower per call (~re-streams K/V
+    # once per q tile) but unbounded in Lk.
+    itemsize = jnp.dtype(q.dtype).itemsize
+    fit = _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile)
     off = jnp.stack(
         [jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)]
     )
-    qspec = pl.BlockSpec((q_tile, d), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM)
-    kvspec = pl.BlockSpec((Lk, d), lambda i: (0, 0), memory_space=pltpu.VMEM)
-    mlspec = pl.BlockSpec((q_tile, 1), lambda i: (i, 0),
-                          memory_space=pltpu.VMEM)
     carry = jax.ShapeDtypeStruct((L, 1), jnp.float32)
+    operands = (
+        q, k, v, m.astype(jnp.float32), l.astype(jnp.float32),
+        acc.astype(jnp.float32), off,
+    )
+    out_shape = (carry, carry, jax.ShapeDtypeStruct((L, d), jnp.float32))
+
+    if fit is not None:
+        q_tile, k_tile = fit
+        qspec = pl.BlockSpec((q_tile, d), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        kvspec = pl.BlockSpec((Lk, d), lambda i: (0, 0),
+                              memory_space=pltpu.VMEM)
+        mlspec = pl.BlockSpec((q_tile, 1), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            functools.partial(
+                _flash_block_kernel, scale=scale, causal=causal,
+                k_tile=k_tile, precision=precision,
+            ),
+            out_shape=out_shape,
+            grid=(L // q_tile,),
+            in_specs=[qspec, kvspec, kvspec, mlspec, mlspec, qspec,
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=(mlspec, mlspec, qspec),
+            input_output_aliases={3: 0, 4: 1, 5: 2},
+            interpret=_auto_interpret(interpret),
+        )(*operands)
+
+    q_tile, k_tile = _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile)
+    qspec = pl.BlockSpec((q_tile, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((k_tile, d), lambda i, j: (j, 0),
+                          memory_space=pltpu.VMEM)
+    mlspec = pl.BlockSpec((q_tile, 1), lambda i, j: (i, 0),
+                          memory_space=pltpu.VMEM)
     return pl.pallas_call(
         functools.partial(
-            _flash_block_kernel, scale=scale, causal=causal, k_tile=k_tile,
-            precision=precision,
+            _flash_stream_kernel, scale=scale, causal=causal,
+            k_tile=k_tile, precision=precision,
         ),
-        out_shape=(carry, carry, jax.ShapeDtypeStruct((L, d), jnp.float32)),
-        grid=grid,
+        out_shape=out_shape,
+        grid=(L // q_tile, Lk // k_tile),
         in_specs=[qspec, kvspec, kvspec, mlspec, mlspec, qspec,
                   pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=(mlspec, mlspec, qspec),
         input_output_aliases={3: 0, 4: 1, 5: 2},
         interpret=_auto_interpret(interpret),
-    )(q, k, v, m.astype(jnp.float32), l.astype(jnp.float32),
-      acc.astype(jnp.float32), off)
+    )(*operands)
 
 
 @functools.partial(
