@@ -38,6 +38,23 @@ def test_busbw_accounting():
     assert collbench._busbw_bytes("ppermute", b, 8) == b
     assert collbench._busbw_bytes("alltoall", b, 8) == 7 / 8 * b
     assert collbench._busbw_bytes("allreduce", b, 1) == 0.0
+    # hand ring twins move the same bytes as their XLA counterparts
+    assert collbench._busbw_bytes("allgather_rdma", b, 8) == 7 * b
+    assert collbench._busbw_bytes("allreduce_rdma", b, 8) == 2 * 7 / 8 * b
+
+
+def test_rdma_tier_sweep_reports_rows_and_alignment_skip(capsys):
+    rc = collbench.main([
+        "--collectives", "allgather_rdma,allreduce_rdma",
+        "--sizes-kib", "4,64", "--n-iter", "20",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    names = [m[0] for m in re.findall(collbench.COLL_LINE_RE, out)]
+    assert "allgather_rdma" in names and "allreduce_rdma" in names
+    # 4 KiB f32 shards (1024 elts) sit below the 8-ring allreduce floor of
+    # w x 128 x 8 = 8192 elements: skipped visibly, not silently
+    assert "COLL-SKIP allreduce_rdma bytes=4096" in out
 
 
 def test_rejects_unknown_collective(capsys):

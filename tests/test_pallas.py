@@ -318,6 +318,176 @@ def test_ring_allgather_rejects_unaligned_rows():
         )
 
 
+def test_ring_allreduce_rdma_matches_psum(mesh8):
+    """The hand ring allreduce (reduce-scatter + all-gather RDMA) must
+    equal lax.psum — integer-valued f32 so ring vs library summation order
+    cannot differ (≅ validating a hand MPI_Allreduce)."""
+    from tpu_mpi_tests.comm import collectives as C
+
+    rng_ = np.random.default_rng(11)
+    L = 8 * 1024  # minimum 1-D ring unit on 8 devices (w·128·8 f32)
+    per_rank = rng_.integers(-50, 50, size=(8, L)).astype(np.float32)
+    xs = C.shard_1d(jnp.asarray(per_rank), mesh8)
+    got = np.asarray(C.allreduce_rdma(xs, mesh8, interpret=True))
+    want = np.asarray(
+        C.allreduce_sum(C.shard_1d(jnp.asarray(per_rank), mesh8), mesh8)
+    )
+    assert got.shape == per_rank.shape
+    assert np.array_equal(got, want)
+    assert np.array_equal(got[0], per_rank.sum(axis=0))
+
+
+def test_ring_reduce_scatter_2d(mesh8):
+    """2-D path: rank r must own chunk r of the sum (psum_scatter order),
+    exercising the multi-tile VMEM accumulate loop."""
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_mpi_tests.comm import collectives as C
+
+    mesh = mesh8
+    rows = 8 * 8 * 8  # per-shard rows: w(8) × sublane(8) × 8 tiles
+    per_rank = np.arange(8 * rows * 16, dtype=np.float32).reshape(
+        8, rows, 16
+    ) % 97
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def rs(x):
+        # tile_rows=16 forces the multi-tile VMEM accumulate loop (4 tiles
+        # per 64-row chunk) that auto-fit would only hit at multi-GB shards
+        return PK.ring_reduce_scatter_pallas(
+            x[0], axis_name="shard", interpret=True, tile_rows=16
+        )[None]
+
+    xs = C.shard_1d(jnp.asarray(per_rank), mesh)
+    got = np.asarray(rs(xs))  # (8, rows/8, 16): rank r's chunk r
+    want = per_rank.sum(axis=0).reshape(8, rows // 8, 16)
+    assert np.array_equal(got, want)
+
+
+def test_ring_allreduce_single_device():
+    """w=1 ring degenerates to a copy (loops empty, copy path)."""
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    x = np.arange(1024, dtype=np.float32)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+        check_vma=False,
+    )
+    def ar(x):
+        return PK.ring_allreduce_pallas(
+            x, axis_name="shard", interpret=True
+        )
+
+    assert np.array_equal(np.asarray(ar(jnp.asarray(x))), x)
+
+
+def test_ring_reduce_scatter_self_ring():
+    """self_ring=k on one device must return the sum of the shard's own k
+    chunks — the schedule's result when every virtual rank holds the same
+    data (this is the mode that lets ONE real chip execute the full loop
+    body: sliced DMA, self-RDMA, VMEM accumulate, handshake)."""
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    x = (np.arange(4 * 16 * 8, dtype=np.float32).reshape(4 * 16, 8) % 23)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False,
+    )
+    def rs(x):
+        return PK.ring_reduce_scatter_pallas(
+            x, axis_name="shard", interpret=True, self_ring=4
+        )
+
+    got = np.asarray(rs(jnp.asarray(x)))
+    want = x.reshape(4, 16, 8).sum(axis=0)
+    assert np.array_equal(got, want)
+
+
+def test_ring_reduce_scatter_self_ring_rejects_multi_device(mesh8):
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def rs(x):
+        return PK.ring_reduce_scatter_pallas(
+            x, axis_name="shard", interpret=True, self_ring=2
+        )
+
+    with pytest.raises(Exception, match="single-device validation"):
+        rs(jnp.ones((8 * 16, 8), jnp.float32))
+
+
+def test_ring_allreduce_rejects_unaligned(mesh8):
+    from tpu_mpi_tests.comm import collectives as C
+
+    with pytest.raises(Exception, match="n % 8192"):
+        # 8-ring f32: L must be a multiple of 8·128·8 = 8192
+        C.allreduce_rdma(
+            C.shard_1d(jnp.ones((8, 1024), jnp.float32), mesh8),
+            mesh8, interpret=True,
+        )
+
+
+def test_ring_reduce_scatter_rejects_vmem_blowout(mesh8):
+    """A minor dim so wide that one sublane-tile row per accumulate buffer
+    exceeds VMEM must fail with the explicit budget error (flash-kernel
+    convention), not an opaque Mosaic allocation failure."""
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def rs(x):
+        return PK.ring_reduce_scatter_pallas(
+            x[0], axis_name="shard", interpret=True
+        )[None]
+
+    wide = jax.ShapeDtypeStruct((8, 8 * 8, 2**20), jnp.float32)
+    with pytest.raises(Exception, match="VMEM budget"):
+        jax.eval_shape(rs, wide)
+
+
+def test_allreduce_rdma_rejects_bad_shape(mesh8):
+    from tpu_mpi_tests.comm import collectives as C
+
+    with pytest.raises(ValueError, match="n_ranks=8"):
+        C.allreduce_rdma(jnp.ones((4, 8192), jnp.float32), mesh8)
+
+
 @pytest.mark.parametrize("axis", [0, 1])
 @pytest.mark.parametrize("periodic", [False, True])
 def test_iterate_overlap_matches_sequential(mesh8, axis, periodic):

@@ -33,6 +33,10 @@ import sys
 from tpu_mpi_tests.drivers import _common
 
 COLLECTIVES = ("allgather", "allreduce", "ppermute", "alltoall")
+# hand-tier explicit-RDMA ring twins (kernels/pallas_kernels.py) — opt-in
+# rather than default because their lane-alignment rules skip the smallest
+# ladder sizes (the skip is reported, not silent)
+COLLECTIVES_RDMA = ("allgather_rdma", "allreduce_rdma")
 
 # the COLL line's parse pattern lives NEXT TO its format string (below) so
 # a format change is a one-site edit; both test files import this
@@ -48,18 +52,22 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int):
     from jax import lax, shard_map
     from jax.sharding import PartitionSpec as P
 
+    def consume_neighbor(gathered, x):
+        # consume the NEIGHBOR's slice: slicing one's own shard is
+        # exactly what XLA's AllGatherDynamicSliceSimplifier cancels
+        # back to x, which would delete the collective and benchmark
+        # an empty loop (shared by both allgather tiers so the
+        # CSE-defeat trick cannot drift between them)
+        r = lax.axis_index(axis_name)
+        n = x.shape[0]
+        nbr = lax.rem(r + 1, jnp.int32(world))
+        return lax.dynamic_slice_in_dim(gathered, nbr * n, n) * 0.999 + 1e-7
+
     def body_of(name):
         if name == "allgather":
             def body(_, x):
                 g = lax.all_gather(x, axis_name, axis=0, tiled=True)
-                # consume the NEIGHBOR's slice: slicing one's own shard is
-                # exactly what XLA's AllGatherDynamicSliceSimplifier cancels
-                # back to x, which would delete the collective and benchmark
-                # an empty loop
-                r = lax.axis_index(axis_name)
-                n = x.shape[0]
-                nbr = lax.rem(r + 1, jnp.int32(world))
-                return lax.dynamic_slice_in_dim(g, nbr * n, n) * 0.999 + 1e-7
+                return consume_neighbor(g, x)
         elif name == "allreduce":
             def body(_, x):
                 return lax.psum(x, axis_name) * (1.0 / world)
@@ -67,6 +75,23 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int):
             perm = [(i, (i + 1) % world) for i in range(world)]
             def body(_, x):
                 return lax.ppermute(x, axis_name, perm)
+        elif name == "allgather_rdma":
+            from tpu_mpi_tests.kernels.pallas_kernels import (
+                ring_allgather_pallas,
+            )
+
+            def body(_, x):
+                g = ring_allgather_pallas(x, axis_name=axis_name)
+                return consume_neighbor(g, x)
+        elif name == "allreduce_rdma":
+            from tpu_mpi_tests.kernels.pallas_kernels import (
+                ring_allreduce_pallas,
+            )
+
+            def body(_, x):
+                return ring_allreduce_pallas(
+                    x, axis_name=axis_name
+                ) * (1.0 / world)
         else:  # alltoall
             def body(_, x):
                 y = x.reshape(world, x.shape[0] // world)
@@ -90,6 +115,7 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int):
 
 
 def _busbw_bytes(name: str, shard_bytes: int, world: int) -> float:
+    name = name.removesuffix("_rdma")  # ring twins move the same bytes
     if world < 2:
         return 0.0
     if name == "allgather":
@@ -124,7 +150,7 @@ def run(args) -> int:
     )
 
     names = _common.parse_choice_list(
-        args.collectives, COLLECTIVES, "collective"
+        args.collectives, COLLECTIVES + COLLECTIVES_RDMA, "collective"
     )
     if names is None:
         return 2
@@ -138,8 +164,26 @@ def run(args) -> int:
             if name == "alltoall":
                 # only the alltoall reshape (world, n/world) needs this
                 check_divisible(n, world, "alltoall elements per shard")
-            x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
             run_fn = _loop_fn(mesh, axis_name, name, world)
+            if name in COLLECTIVES_RDMA:
+                # ring kernels have lane-alignment floors (e.g. w·128·
+                # sublane elements for the 1-D allreduce); probe at trace
+                # time (no execution, no donation) and report the skip
+                # instead of failing the sweep or hiding the row
+                import jax
+
+                try:
+                    jax.eval_shape(
+                        run_fn,
+                        jax.ShapeDtypeStruct((n * world,), dtype),
+                        1,
+                    )
+                except ValueError as e:
+                    rep.line(
+                        f"COLL-SKIP {name} bytes={shard_bytes} ({e})"
+                    )
+                    continue
+            x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
             # scale the chain length inversely with payload so small
             # messages accumulate enough device time to clear host-timer
             # noise (a fixed count yields NaN/garbage under ~ms jitter:
@@ -174,7 +218,10 @@ def main(argv=None) -> int:
     p.add_argument(
         "--collectives",
         default=",".join(COLLECTIVES),
-        help="comma list of collectives to sweep",
+        help="comma list of collectives to sweep; beyond the default XLA "
+        f"tier, {'/'.join(COLLECTIVES_RDMA)} select the hand-written "
+        "RDMA ring twins (sizes below their lane-alignment floor are "
+        "reported as COLL-SKIP)",
     )
     p.add_argument(
         "--sizes-kib",

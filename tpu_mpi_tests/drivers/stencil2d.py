@@ -296,10 +296,30 @@ def _sum_test(args, mesh, topo, rep, dim: int, space: str) -> int:
         out_specs=P(axis_name),
         check_vma=False,
     )
-    def allreduce(s):
+    def psum_allreduce(s):
         from jax import lax
 
         return lax.psum(s, axis_name)
+
+    allreduce = psum_allreduce
+    if args.rdma:
+        # hand tier: explicit-RDMA ring reduce-scatter + all-gather instead
+        # of lax.psum (≅ hand-writing the in-place MPI_Allreduce the
+        # reference times, mpi_stencil2d_gt.cc:615-625). The ring kernels
+        # have a lane-alignment floor (w·128·sublane elements); below it
+        # fall back to the XLA tier with a visible NOTE, never silently.
+        def rdma_allreduce(s):
+            return C.allreduce_rdma(s, mesh, axis_name)
+
+        try:
+            jax.eval_shape(rdma_allreduce, jax.ShapeDtypeStruct(
+                (world, d.n_global_other), dtype))
+            allreduce = rdma_allreduce
+        except ValueError as e:
+            rep.line(
+                f"NOTE dim:{dim} {space}: rdma allreduce below alignment "
+                f"floor, using psum ({e})"
+            )
 
     expected = np.full(d.n_global_other, np.pi * args.n_local)
 

@@ -732,6 +732,260 @@ def ring_allgather_pallas(
     )(x)
 
 
+def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
+                                acc_a, acc_b, copy_sem, copy_sem2,
+                                send_sem, recv_sem, ready_sem,
+                                *, axis_name, w, tile_rows, use_barrier,
+                                use_handshake, loopback):
+    """Ring reduce-scatter with explicit remote DMA: w−1 hops, each
+    forwarding a running partial sum one chunk to the right; rank ``r``
+    ends owning chunk ``r`` fully reduced (``lax.psum_scatter`` ordering,
+    so :func:`_ring_allgather_kernel` composes into a full allreduce — the
+    hand twin of the in-place device ``MPI_Allreduce(MPI_SUM)`` of
+    ``mpi_stencil2d_gt.cc:615-625``). Step ``s`` sends chunk
+    ``(r − s − 1) mod w``: the received partial is folded with the local
+    chunk tile-by-tile through VMEM (ANY-space refs cannot feed the VPU
+    directly) into the next step's send buffer — or, at the last step,
+    into the owned output chunk.
+
+    All remote writes land in the single-slot ``comm_ref``; on hardware a
+    receiver-backpressure handshake (``ready_sem``, remote-signaled by the
+    consumer) keeps step ``s+1``'s incoming DMA from overrunning step
+    ``s``'s unconsumed data. The interpreter serializes devices, so the
+    handshake (and the entry barrier) are hardware-only.
+
+    ``loopback`` runs the full ``w``-step schedule with both neighbors
+    mapped to this device (the self-ring validation trick): one chip then
+    executes every code path — sliced dynamic DMA, remote self-DMA, the
+    VMEM accumulate, the semaphore handshake — and the result is the sum
+    of the shard's own ``w`` chunks, checkable on host."""
+    my = jax.lax.axis_index(axis_name)
+    if loopback:
+        right = left = my
+    else:
+        right = jax.lax.rem(my + 1, jnp.int32(w))
+        left = jax.lax.rem(my - 1 + jnp.int32(w), jnp.int32(w))
+    cn = comm_ref.shape[0]
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    if w == 1:
+        own = pltpu.make_async_copy(x_ref, out_ref, copy_sem)
+        own.start()
+        own.wait()
+        return
+
+    wrap = jnp.int32(w * w)  # keeps every modulus operand positive
+
+    # step-0 payload: my chunk (my − 1), verbatim
+    c0 = jax.lax.rem(my - 1 + wrap, jnp.int32(w))
+    seed = pltpu.make_async_copy(
+        x_ref.at[pl.ds(c0 * cn, cn)], send_ref, copy_sem
+    )
+    seed.start()
+    seed.wait()
+
+    for s in range(w - 1):
+        if use_handshake and s > 0:
+            # right consumed my previous payload; its comm slot is free
+            pltpu.semaphore_wait(ready_sem, 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=send_ref,
+            dst_ref=comm_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        # comm_ref holds the (s+1)-rank partial of chunk (my − s − 2);
+        # fold in my contribution
+        c = jax.lax.rem(my - jnp.int32(s) - 2 + wrap, jnp.int32(w))
+        dst = out_ref if s == w - 2 else send_ref
+        for j in range(cn // tile_rows):
+            ca = pltpu.make_async_copy(
+                comm_ref.at[pl.ds(j * tile_rows, tile_rows)], acc_a, copy_sem
+            )
+            cb = pltpu.make_async_copy(
+                x_ref.at[pl.ds(c * cn + j * tile_rows, tile_rows)],
+                acc_b, copy_sem2,
+            )
+            ca.start()
+            cb.start()
+            ca.wait()
+            cb.wait()
+            acc_a[:] = acc_a[:] + acc_b[:]
+            cw = pltpu.make_async_copy(
+                acc_a, dst.at[pl.ds(j * tile_rows, tile_rows)], copy_sem
+            )
+            cw.start()
+            cw.wait()
+        if use_handshake and s < w - 2:
+            # tell left its next write into my comm slot may proceed (the
+            # last step signals nothing: nobody sends again)
+            pltpu.semaphore_signal(ready_sem, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def ring_reduce_scatter_pallas(
+    x,
+    *,
+    axis_name: str,
+    collective_id: int = 10,
+    interpret: bool | None = None,
+    tile_rows: int | None = None,
+    self_ring: int | None = None,
+):
+    """Per-shard ring reduce-scatter along axis 0 with explicit inter-chip
+    RDMA; rank ``r`` returns chunk ``r`` of the elementwise sum (shape
+    ``(n/w, …)``). Call *inside* ``shard_map``. Alignment mirrors
+    :func:`ring_allgather_pallas`, with the extra factor ``w`` from
+    chunking: 2-D shards need rows ≡ 0 mod ``w·sublane``; 1-D shards fold
+    into 128-lane rows and need ``n ≡ 0 mod w·128·sublane``.
+
+    ``self_ring=k`` (single-device validation mode, ≅ the periodic
+    self-ring the halo benchmarks use): run the full ``k``-step schedule
+    with all neighbors mapped to this one device, returning the sum of the
+    shard's own ``k`` chunks — so real hardware exercises every loop-body
+    code path without a multi-chip slice."""
+    sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
+    w = jax.lax.axis_size(axis_name)
+    if self_ring is not None:
+        if w != 1 or self_ring < 2:
+            raise ValueError(
+                f"self_ring={self_ring} is a single-device validation mode "
+                f"(needs axis size 1 and self_ring >= 2, got w={w})"
+            )
+        w = self_ring
+    if x.ndim == 1:
+        unit = w * 128 * sublane
+        if x.shape[0] % unit != 0:
+            raise ValueError(
+                f"ring_reduce_scatter_pallas: 1-D shards need n % {unit} "
+                f"== 0 for {jnp.dtype(x.dtype).name} on a {w}-ring (w × "
+                f"128 lanes × {sublane} sublanes), got {x.shape[0]}"
+            )
+        return ring_reduce_scatter_pallas(
+            x.reshape(-1, 128),
+            axis_name=axis_name,
+            collective_id=collective_id,
+            interpret=interpret,
+            tile_rows=tile_rows,
+            self_ring=self_ring,
+        ).reshape(-1)
+    n = x.shape[0]
+    if n % (w * sublane) != 0:
+        raise ValueError(
+            f"ring_reduce_scatter_pallas needs shard rows % {w * sublane} "
+            f"== 0 for {jnp.dtype(x.dtype).name} on a {w}-ring "
+            f"(w × sublane tile), got {n}"
+        )
+    interp = _auto_interpret(interpret)
+    cn = n // w
+    itemsize = jnp.dtype(x.dtype).itemsize
+    minor = int(np.prod(x.shape[1:]))
+    # VMEM accumulate tile: ≤ ~2 MB per buffer, a sublane-multiple divisor
+    # of the chunk rows (so every sliced DMA stays tile-aligned); the
+    # explicit override exists so tests can force the multi-tile loop at
+    # small shapes
+    if tile_rows is None:
+        tile_rows = cn
+        budget_rows = max(sublane, (2 << 20) // max(minor * itemsize, 1))
+        if tile_rows > budget_rows:
+            tile_rows = sublane * _fit_divisor(
+                cn // sublane, budget_rows // sublane
+            )
+    elif cn % tile_rows or tile_rows % sublane:
+        raise ValueError(
+            f"tile_rows={tile_rows} must divide chunk rows {cn} and be a "
+            f"multiple of the {sublane}-row sublane tile"
+        )
+    if 2 * tile_rows * minor * itemsize > _VMEM_BUDGET_BYTES:
+        # even one sublane-tile row per buffer can blow VMEM at very wide
+        # minor dims; fail with the explicit error the flash kernels use
+        # rather than an opaque Mosaic allocation failure
+        raise ValueError(
+            f"ring_reduce_scatter_pallas: accumulate tiles "
+            f"2 × {tile_rows} × {minor} × {itemsize} B exceed the "
+            f"{_VMEM_BUDGET_BYTES // 2**20} MB VMEM budget; reshape the "
+            f"shard so rows × row-width shrinks (row width ≤ "
+            f"{_VMEM_BUDGET_BYTES // (2 * sublane * itemsize)} elements)"
+        )
+    chunk = jax.ShapeDtypeStruct((cn, *x.shape[1:]), x.dtype)
+    out, _, _ = pl.pallas_call(
+        functools.partial(
+            _ring_reduce_scatter_kernel,
+            axis_name=axis_name,
+            w=w,
+            tile_rows=tile_rows,
+            use_barrier=not interp,
+            use_handshake=not interp,
+            loopback=self_ring is not None,
+        ),
+        out_shape=(chunk, chunk, chunk),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
+        scratch_shapes=[
+            pltpu.VMEM((tile_rows, *x.shape[1:]), x.dtype),
+            pltpu.VMEM((tile_rows, *x.shape[1:]), x.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interp,
+    )(x)
+    return out
+
+
+def ring_allreduce_pallas(
+    x,
+    *,
+    axis_name: str,
+    collective_id: int = 10,
+    interpret: bool | None = None,
+):
+    """Hand-tier ring allreduce: reduce-scatter (w−1 hops) + ring
+    all-gather (w−1 hops) — the bandwidth-optimal 2(w−1)/w·n schedule and
+    the explicit-RDMA twin of ``lax.psum``, completing the hand collective
+    trio (halo / allgather / allreduce ≅ the reference's Isend-Irecv /
+    ``MPI_Allgather`` / ``MPI_Allreduce`` pillars). Call *inside*
+    ``shard_map``; every rank returns the full elementwise sum.
+
+    Phase ordering between the two kernels needs no global barrier: the
+    all-gather kernel's entry neighborhood barrier already guarantees both
+    neighbors finished their reduce-scatter before any gather DMA lands.
+    Alignment follows :func:`ring_reduce_scatter_pallas`."""
+    rs = ring_reduce_scatter_pallas(
+        x,
+        axis_name=axis_name,
+        collective_id=collective_id,
+        interpret=interpret,
+    )
+    if jax.lax.axis_size(axis_name) == 1:
+        return rs
+    # the reduce-scatter's n % w·128·sublane floor implies the allgather's
+    # n % 128·sublane, so the chunk always re-enters cleanly (1-D included:
+    # the allgather does its own lane fold)
+    return ring_allgather_pallas(
+        rs,
+        axis_name=axis_name,
+        collective_id=collective_id + 1,
+        interpret=interpret,
+    )
+
+
 # ---------------------------------------------------------------------------
 # Halo pack/unpack staging kernels
 # ---------------------------------------------------------------------------
