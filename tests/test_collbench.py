@@ -14,7 +14,7 @@ def test_sweep_all_collectives(capsys, tmp_path):
     out = capsys.readouterr().out
     assert rc == 0
     rows = [m[:4] for m in re.findall(collbench.COLL_LINE_RE, out)]
-    assert len(rows) == 4 * 2  # 4 collectives x 2 sizes
+    assert len(rows) == len(collbench.COLLECTIVES) * 2  # x 2 sizes
     assert {r[0] for r in rows} == set(collbench.COLLECTIVES)
     import math
 
@@ -27,7 +27,8 @@ def test_sweep_all_collectives(capsys, tmp_path):
             assert math.isnan(v) or (math.isfinite(v) and v >= 0)
     recs = [json.loads(line) for line in jl.read_text().splitlines()]
     coll = [r for r in recs if r.get("kind") == "coll"]
-    assert len(coll) == 8 and all(r["world"] == 8 for r in coll)
+    assert len(coll) == len(collbench.COLLECTIVES) * 2
+    assert all(r["world"] == 8 for r in coll)
 
 
 def test_busbw_accounting():
@@ -35,6 +36,7 @@ def test_busbw_accounting():
     b = 1 << 20
     assert collbench._busbw_bytes("allgather", b, 8) == 7 * b
     assert collbench._busbw_bytes("allreduce", b, 8) == 2 * 7 / 8 * b
+    assert collbench._busbw_bytes("reducescatter", b, 8) == 7 / 8 * b
     assert collbench._busbw_bytes("ppermute", b, 8) == b
     assert collbench._busbw_bytes("alltoall", b, 8) == 7 / 8 * b
     assert collbench._busbw_bytes("allreduce", b, 1) == 0.0

@@ -284,6 +284,44 @@ def allreduce_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
 
 
 @functools.lru_cache(maxsize=None)
+def _reduce_scatter_fn(mesh: Mesh, axis_name: str, ndim: int):
+    spec = [axis_name] + [None] * (ndim - 1)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec),
+        check_vma=False,
+    )
+    def scatter(x):
+        return lax.psum_scatter(
+            x[0], axis_name, scatter_dimension=0, tiled=True
+        )[None]
+
+    return scatter
+
+
+def reduce_scatter_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
+    """XLA-tier reduce-scatter (``lax.psum_scatter``): rank ``r`` gets
+    chunk ``r`` of the elementwise sum — the library twin of
+    :func:`~tpu_mpi_tests.kernels.pallas_kernels.ring_reduce_scatter_pallas`
+    and the first half of the ring-allreduce decomposition
+    (≅ ``MPI_Reduce_scatter_block``, the collective MPI composes
+    ``MPI_Allreduce`` from). ``per_rank`` has shape ``(n_ranks, L)``
+    sharded on axis 0 with ``L % n_ranks == 0``; returns ``(n_ranks,
+    L/n_ranks)`` with the same sharding, row ``r`` = chunk ``r`` of the
+    sum."""
+    axis_name = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis_name]
+    if per_rank.ndim != 2 or per_rank.shape[0] != n:
+        raise ValueError(
+            f"reduce_scatter_sum: need shape (n_ranks={n}, L), got "
+            f"{per_rank.shape}"
+        )
+    check_divisible(per_rank.shape[1], n, "reduce_scatter_sum chunking")
+    return _reduce_scatter_fn(mesh, axis_name, per_rank.ndim)(per_rank)
+
+
+@functools.lru_cache(maxsize=None)
 def _allreduce_rdma_fn(mesh: Mesh, axis_name: str,
                        interpret: bool | None):
     from tpu_mpi_tests.kernels.pallas_kernels import ring_allreduce_pallas

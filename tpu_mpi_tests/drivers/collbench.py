@@ -18,7 +18,11 @@ conventions) so numbers are comparable across collectives and world sizes:
 
 * ``allgather`` / ``alltoall``: moved = (w−1)/w · gathered_bytes
 * ``allreduce``: moved = 2·(w−1)/w · shard_bytes
+* ``reducescatter``: moved = (w−1)/w · shard_bytes
 * ``ppermute``: moved = shard_bytes (pure neighbor shift, the halo pattern)
+* ``allgather_rdma`` / ``allreduce_rdma`` (hand ring twins, opt-in): same
+  bytes as their XLA counterparts — the ring schedule moves exactly the
+  accounted volume
 
 On a 1-device world the collectives execute (XLA degenerate lowering) but
 move nothing; busbw is reported as 0 — the sweep is meaningful on ≥2
@@ -32,7 +36,9 @@ import sys
 
 from tpu_mpi_tests.drivers import _common
 
-COLLECTIVES = ("allgather", "allreduce", "ppermute", "alltoall")
+COLLECTIVES = (
+    "allgather", "allreduce", "reducescatter", "ppermute", "alltoall"
+)
 # hand-tier explicit-RDMA ring twins (kernels/pallas_kernels.py) — opt-in
 # rather than default because their lane-alignment rules skip the smallest
 # ladder sizes (the skip is reported, not silent)
@@ -71,6 +77,15 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int):
         elif name == "allreduce":
             def body(_, x):
                 return lax.psum(x, axis_name) * (1.0 / world)
+        elif name == "reducescatter":
+            def body(_, x):
+                rs = lax.psum_scatter(
+                    x, axis_name, scatter_dimension=0, tiled=True
+                )
+                # re-expand so the chain stays shape-stable; the tile adds
+                # one local HBM write per iter on top of the collective
+                # (small next to the (w-1)/w network bytes it measures)
+                return jnp.tile(rs, world) * (1.0 / world)
         elif name == "ppermute":
             perm = [(i, (i + 1) % world) for i in range(world)]
             def body(_, x):
@@ -122,6 +137,8 @@ def _busbw_bytes(name: str, shard_bytes: int, world: int) -> float:
         return (world - 1) * shard_bytes  # (w-1)/w of gathered = (w-1)*shard
     if name == "allreduce":
         return 2 * (world - 1) / world * shard_bytes
+    if name == "reducescatter":
+        return (world - 1) / world * shard_bytes
     if name == "ppermute":
         return float(shard_bytes)
     return (world - 1) / world * shard_bytes  # alltoall
@@ -161,9 +178,10 @@ def run(args) -> int:
         for kib in (int(s) for s in args.sizes_kib.split(",")):
             shard_bytes = kib * 1024
             n = shard_bytes // itemsize
-            if name == "alltoall":
-                # only the alltoall reshape (world, n/world) needs this
-                check_divisible(n, world, "alltoall elements per shard")
+            if name in ("alltoall", "reducescatter"):
+                # the alltoall reshape and the psum_scatter chunking both
+                # split the shard w ways
+                check_divisible(n, world, f"{name} elements per shard")
             run_fn = _loop_fn(mesh, axis_name, name, world)
             if name in COLLECTIVES_RDMA:
                 # ring kernels have lane-alignment floors (e.g. w·128·
