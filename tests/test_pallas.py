@@ -190,6 +190,78 @@ def test_iterate_multistep_distributed(mesh8, axis, periodic):
         )
 
 
+@pytest.mark.parametrize("steps", [1, 2, 4])
+@pytest.mark.parametrize("flags", ["static11", "static00", "dynamic"])
+def test_iterate_stream0_matches_fullheight(steps, flags):
+    """The row-streaming dim-0 kernel must reproduce the full-height strip
+    kernel exactly — same spans, same ghost-band behavior — across physical
+    and exchange-fed flags, masked edge blocks and unmasked interior
+    blocks, and a ragged last row block (stream_tile_rows=16 forces many
+    blocks at test size; in production streaming engages only above the
+    VMEM height limit)."""
+    K = 2 * steps
+    nx = 70 + 2 * K  # 70 % 16 != 0 → ragged last block
+    z0 = np.random.default_rng(steps).normal(size=(nx, 24)).astype(
+        np.float32
+    )
+    phys_kw = {
+        "static11": {"phys_static": (1, 1)},
+        "static00": {"phys_static": (0, 0)},
+        "dynamic": {"phys": jnp.asarray([1, 0])},
+    }[flags]
+    full = PK.stencil2d_iterate_pallas(
+        jnp.asarray(z0), 0.25, dim=0, steps=steps, stream=False, **phys_kw
+    )
+    streamed = PK.stencil2d_iterate_pallas(
+        jnp.asarray(z0), 0.25, dim=0, steps=steps, stream=True,
+        stream_tile_rows=16, **phys_kw
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed), np.asarray(full), atol=1e-6,
+        err_msg=f"steps={steps} flags={flags}"
+    )
+
+
+def test_iterate_stream0_distributed(mesh8):
+    """Streaming dim-0 k-step over 8 shards (non-periodic: real dynamic
+    phys flags on edge shards) == per-step XLA iterate on the interior."""
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
+
+    steps, outer = 2, 2
+    K, nloc, other = 2 * steps, 24, 16
+    rng_ = np.random.default_rng(3)
+    deep_blocks = [
+        rng_.normal(size=(nloc + 2 * K, other)).astype(np.float32)
+        for _ in range(8)
+    ]
+    narrow_blocks = [b[K - 2: K - 2 + nloc + 4] for b in deep_blocks]
+    z_deep = shard_1d(
+        jnp.asarray(np.concatenate(deep_blocks, axis=0)), mesh8, axis=0
+    )
+    z_narrow = shard_1d(
+        jnp.asarray(np.concatenate(narrow_blocks, axis=0)), mesh8, axis=0
+    )
+    fused = iterate_fused_fn(mesh8, "shard", 0, 2, 2, 10.0, 1e-3)
+    deep = iterate_pallas_fn(
+        mesh8, "shard", K, 1e-2, axis=0, interpret=True, steps=steps,
+        stream=True,
+    )
+    ra = np.split(np.asarray(fused(z_narrow, steps * outer)), 8, axis=0)
+    rb = np.split(np.asarray(deep(z_deep, outer)), 8, axis=0)
+    for a, b in zip(ra, rb):
+        np.testing.assert_allclose(
+            a[2: 2 + nloc], b[K: K + nloc], atol=1e-5
+        )
+
+
+def test_iterate_stream_rejects_dim1():
+    with pytest.raises(ValueError, match="dim=0 only"):
+        PK.stencil2d_iterate_pallas(
+            jnp.ones((32, 32), jnp.float32), 0.1, dim=1, stream=True
+        )
+
+
 def test_iterate_pallas_fn_rejects_mismatched_ghost_width(mesh8):
     from tpu_mpi_tests.comm.halo import iterate_pallas_fn
     from tpu_mpi_tests.utils import TpuMtError

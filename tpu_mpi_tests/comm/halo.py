@@ -422,6 +422,7 @@ def iterate_pallas_fn(
     steps: int = 1,
     periodic: bool = False,
     rdma: bool = False,
+    stream: bool | None = None,
 ):
     """Like :func:`iterate_fused_fn` but with the hand-written in-place
     Pallas step (2 HBM passes/iter vs XLA's ~6). ``axis=1`` (default) puts
@@ -443,7 +444,11 @@ def iterate_pallas_fn(
     ring (``ring_halo_pallas``), making the whole hot loop 100% hand-tier
     — explicit inter-chip DMA feeding the in-place VMEM kernel, the
     reference's fully-manual pipeline (``mpi_stencil2d_sycl.cc``) chained
-    device-side."""
+    device-side.
+
+    ``stream`` forwards the dim-0 row-streaming selector of
+    :func:`~tpu_mpi_tests.kernels.pallas_kernels.stencil2d_iterate_pallas`
+    (None = auto: stream only when the full ghosted height exceeds VMEM)."""
     from tpu_mpi_tests.kernels.pallas_kernels import (
         ring_halo_pallas,
         stencil2d_iterate_pallas,
@@ -509,6 +514,7 @@ def iterate_pallas_fn(
                     dim=axis,
                     interpret=interpret,
                     steps=steps,
+                    stream=stream,
                     **phys_kw,
                 )
 

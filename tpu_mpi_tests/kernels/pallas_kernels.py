@@ -283,6 +283,26 @@ def _step5(z, lo, span, axis, se):
     return zs(0) + se * acc
 
 
+def _masked_step(window, lo, hi, axis, se, abs0, dlo, dhi):
+    """One masked in-window step, shared by the whole-shard kernel's
+    dynamic-flag path and the row-streaming kernel: update [lo, hi),
+    keeping rows whose ABSOLUTE index (window position + ``abs0``) falls
+    outside [dlo, dhi) at their previous value, and stitch the window."""
+    upd = _step5(window, lo, hi - lo, axis, se)
+    old = jax.lax.slice_in_dim(window, lo, hi, axis=axis)
+    io = jax.lax.broadcasted_iota(jnp.int32, upd.shape, axis) + lo + abs0
+    upd = jnp.where((io >= dlo) & (io < dhi), upd, old)
+    W = window.shape[axis]
+    return jnp.concatenate(
+        [
+            jax.lax.slice_in_dim(window, 0, lo, axis=axis),
+            upd,
+            jax.lax.slice_in_dim(window, hi, W, axis=axis),
+        ],
+        axis=axis,
+    )
+
+
 def _iterate_kernel(
     z_ref, scale_eps_ref, *rest, axis, steps, phys_static
 ):
@@ -314,28 +334,172 @@ def _iterate_kernel(
             lo_b = K if phys_static[0] else s * N_BND
             hi_b = N - (K if phys_static[1] else s * N_BND)
             upd = _step5(z, lo_b, hi_b - lo_b, axis, se)
+            z = jnp.concatenate(
+                [
+                    jax.lax.slice_in_dim(z, 0, lo_b, axis=axis),
+                    upd,
+                    jax.lax.slice_in_dim(z, hi_b, N, axis=axis),
+                ],
+                axis=axis,
+            )
         else:
-            lo_b, hi_b = N_BND, N - N_BND  # maximal span; mask the rest
-            old = jax.lax.slice_in_dim(z, lo_b, hi_b, axis=axis)
-            upd = _step5(z, lo_b, hi_b - lo_b, axis, se)
             dlo = jnp.where(phys_ref[0] != 0, K, s * N_BND)
             dhi = jnp.where(phys_ref[1] != 0, N - K, N - s * N_BND)
-            io = jax.lax.broadcasted_iota(jnp.int32, upd.shape, axis) + N_BND
-            upd = jnp.where((io >= dlo) & (io < dhi), upd, old)
-        z = jnp.concatenate(
-            [
-                jax.lax.slice_in_dim(z, 0, lo_b, axis=axis),
-                upd,
-                jax.lax.slice_in_dim(z, hi_b, N, axis=axis),
-            ],
-            axis=axis,
-        )
+            z = _masked_step(z, N_BND, N - N_BND, axis, se, 0, dlo, dhi)
     out_ref[:] = z
+
+
+def _iterate_stream0_kernel(z_ref, top_ref, bot_ref, scale_eps_ref, *rest,
+                            steps, B, K, R, i_lo_mask, i_hi_mask,
+                            phys_static):
+    """Row-streaming dim-0 k-step update for domains too tall to hold the
+    full ghosted height in VMEM. Each grid cell (i, j) advances one
+    (B, P) row×column block k timesteps on a (B+2K, P) window assembled
+    from the block plus K-row neighbor edges (separate gathered operands —
+    blocked specs mean Mosaic pipelines all the fetches; no manual DMA, so
+    no tile-alignment constraints on K or B beyond the usual block rules).
+
+    The per-step maximal span [s·N, W−s·N) is EXACTLY the influence cone
+    of the output rows (K = steps·N), so interior blocks need no masking
+    at all; only blocks whose window reaches the global lo/hi bands take
+    the masked branch (``lax.cond`` on the row-block id), keeping the VPU
+    cost of the hot path at the short-shard kernel's 5 ops/elt/step."""
+    if phys_static is None:
+        phys_ref, out_ref = rest
+        phys_lo = phys_ref[0] != 0
+        phys_hi = phys_ref[1] != 0
+    else:
+        (out_ref,) = rest
+        phys_lo, phys_hi = bool(phys_static[0]), bool(phys_static[1])
+    se = scale_eps_ref[0]
+    i = pl.program_id(0)
+    window = jnp.concatenate([top_ref[0], z_ref[:], bot_ref[0]], axis=0)
+    W = window.shape[0]  # B + 2K
+    N = N_BND
+    abs0 = i * B - K  # absolute (ghosted) row index of window position 0
+
+    def advance(window, masked):
+        for s in range(1, steps + 1):
+            lo = s * N
+            hi = W - s * N
+            if masked:
+                if phys_static is not None:
+                    dlo = K if phys_lo else lo
+                    dhi = R - (K if phys_hi else lo)
+                else:
+                    dlo = jnp.where(phys_lo, K, lo)
+                    dhi = jnp.where(phys_hi, R - K, R - lo)
+                window = _masked_step(window, lo, hi, 0, se, abs0, dlo, dhi)
+            else:
+                upd = _step5(window, lo, hi - lo, 0, se)
+                window = jnp.concatenate(
+                    [
+                        jax.lax.slice_in_dim(window, 0, lo, axis=0),
+                        upd,
+                        jax.lax.slice_in_dim(window, hi, W, axis=0),
+                    ],
+                    axis=0,
+                )
+        return window
+
+    needs_mask = (i < i_lo_mask) | (i >= i_hi_mask)
+    window = jax.lax.cond(
+        needs_mask,
+        functools.partial(advance, masked=True),
+        functools.partial(advance, masked=False),
+        window,
+    )
+    out_ref[:] = jax.lax.slice_in_dim(window, K, K + B, axis=0)
+
+
+def _fit_stream0_blocks(ny: int, K: int, itemsize: int, sub: int):
+    """(B, P) for the streaming dim-0 kernel: ~8 live (window-sized)
+    buffers within the VMEM budget — window + per-step temps + pipelined
+    in/out blocks; measured on v5e: the 6-buffer model OOMed the Mosaic
+    stack by ~4% at (512+24)x1024 f32, so 8 keeps real headroom. B starts
+    at 256: the 8192² k=4 sweep measured 128–256-row blocks fastest
+    (2090–2180 iter/s) and 512 slowest (1940–2295 across windows) — small
+    blocks keep the pipeline deep without starving the VPU."""
+    P = min(-(-ny // 128) * 128, 1024)
+    B = 256
+    while B > sub and 8 * (B + 2 * K) * P * itemsize > _VMEM_BUDGET_BYTES:
+        B = max(sub, (B // 2) // sub * sub)
+    while P > 128 and 8 * (B + 2 * K) * P * itemsize > _VMEM_BUDGET_BYTES:
+        P //= 2
+    if 8 * (B + 2 * K) * P * itemsize > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"stencil2d streaming dim-0: even a ({B}+2·{K})×{P} window "
+            f"exceeds the VMEM budget"
+        )
+    return B, P
+
+
+def _iterate_stream0(z, se, steps, phys, phys_static, interpret,
+                     tile_rows):
+    """Streaming dim-0 path of :func:`stencil2d_iterate_pallas` (tall
+    domains): grid over row blocks × column panels; K-row top/bottom
+    neighbor edges ride as gathered side operands."""
+    nx, ny = z.shape
+    K = steps * N_BND
+    sub = max(8, 8 * 4 // jnp.dtype(z.dtype).itemsize)
+    B, P = _fit_stream0_blocks(ny, K, jnp.dtype(z.dtype).itemsize, sub)
+    if tile_rows is not None:
+        if tile_rows % sub:
+            raise ValueError(
+                f"stream_tile_rows={tile_rows} must be a multiple of the "
+                f"{sub}-row sublane tile"
+            )
+        B = min(B, tile_rows)
+    nb = pl.cdiv(nx, B)
+    # per-block static masking decision (see kernel docstring): block i is
+    # mask-free iff its window stays inside the worst-case update bands
+    # [2K−N, R−2K+N) at every step
+    i_lo_mask = -(-(2 * K - N_BND) // B)
+    i_hi_mask = (nx - B - 2 * K + N_BND) // B + 1
+    rows = jnp.arange(nb, dtype=jnp.int32) * B
+    karange = jnp.arange(K, dtype=jnp.int32)
+    top = z[jnp.clip(rows[:, None] - K + karange[None, :], 0, nx - 1)]
+    bot = z[jnp.clip(rows[:, None] + B + karange[None, :], 0, nx - 1)]
+    in_specs = [
+        pl.BlockSpec((B, P), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, K, P), lambda i, j: (i, 0, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, K, P), lambda i, j: (i, 0, j),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    operands = [z, top, bot, se]
+    if phys_static is None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(jnp.asarray(phys, jnp.int32).reshape(2))
+    return pl.pallas_call(
+        functools.partial(
+            _iterate_stream0_kernel,
+            steps=steps,
+            B=B,
+            K=K,
+            R=nx,
+            i_lo_mask=i_lo_mask,
+            i_hi_mask=i_hi_mask,
+            phys_static=phys_static,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nx, ny), z.dtype),
+        grid=(nb, pl.cdiv(ny, P)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (B, P), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        ),
+        input_output_aliases={0: 0},
+        interpret=_auto_interpret(interpret),
+    )(*operands)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("dim", "tile", "interpret", "steps", "phys_static"),
+    static_argnames=(
+        "dim", "tile", "interpret", "steps", "phys_static", "stream",
+        "stream_tile_rows",
+    ),
     donate_argnums=0,
 )
 def stencil2d_iterate_pallas(
@@ -347,12 +511,22 @@ def stencil2d_iterate_pallas(
     steps: int = 1,
     phys=None,
     phys_static: "tuple[int, int] | None" = None,
+    stream: bool | None = None,
+    stream_tile_rows: int | None = None,
 ):
     """``steps`` in-place Jacobi-style steps: ``interior += scale_eps ·
     stencil`` along ``dim``, ghosts preserved — shape-preserving so calls
     chain, with the input buffer aliased to the output (true in-place; ≅ the
     reference updating ``d_dz`` from ``d_z`` each hot-loop iteration with
     persistent buffers, ``mpi_stencil2d_sycl.cc:218-239``).
+
+    ``stream`` (dim-0 only): ``None`` auto-selects — the full-ghosted-height
+    strip path when it fits VMEM, else the row-streaming kernel
+    (``_iterate_stream0_kernel``), which removes the round-2 height limit
+    (~6k f32 rows); ``True``/``False`` force a path (tests A/B them).
+    ``stream_tile_rows`` caps the streaming row block below the auto-fit
+    (its own knob — ``tile`` is the dim-1/strip lane width and does not
+    leak into the streaming geometry).
 
     Two HBM passes per call (read z, write z) versus XLA's 6 (one per
     stencil tap + writes). ``dim=1`` puts the stencil taps on the lane dim,
@@ -376,11 +550,18 @@ def stencil2d_iterate_pallas(
     nx, ny = z.shape
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    if stream and dim != 0:
+        raise ValueError("stream=True applies to dim=0 only (dim-1 strips "
+                         "already stream along the non-stencil axis)")
     if z.shape[dim] <= 2 * steps * N_BND:
         raise ValueError(
             f"extent {z.shape[dim]} along dim {dim} too small for "
             f"{steps}-step ghost width {2 * steps * N_BND}"
         )
+    se = jnp.asarray(scale_eps, z.dtype).reshape(1)
+    if steps == 1 or (phys is None and phys_static is None):
+        phys_static = (0, 0)  # spans coincide at s=1, flags irrelevant
+        phys = None
     if dim == 1:
         strip = _fit_strip(tile, nx, 2 * (ny + ny) * z.dtype.itemsize,
                            min_strip=8)
@@ -391,18 +572,24 @@ def stencil2d_iterate_pallas(
         # lane strips must be 128-multiples (Mosaic block rule) and the
         # FULL ghosted height rides in VMEM, so nx+2·K is bounded by
         # ~14MB/(4·128·itemsize) — ≈6k rows f32; taller dim-0 domains
-        # need the XLA iterate (the reference's own dim-0 shard heights,
-        # n_local≈1024, fit easily)
+        # stream row blocks instead (round-2's height limit, removed)
+        if stream is None:
+            try:
+                _fit_strip(128, ny, 2 * (nx + nx) * z.dtype.itemsize,
+                           min_strip=128)
+            except ValueError:
+                stream = True
+        if stream:
+            return _iterate_stream0(
+                z, se, steps, phys, phys_static, interpret,
+                stream_tile_rows,
+            )
         tile0 = max(128, -(-tile // 128) * 128)
         strip = _fit_strip(tile0, ny, 2 * (nx + nx) * z.dtype.itemsize,
                            min_strip=128)
         grid = (pl.cdiv(ny, strip),)
         block = (nx, strip)
         index_map = lambda j: (0, j)  # noqa: E731
-    se = jnp.asarray(scale_eps, z.dtype).reshape(1)
-    if steps == 1 or (phys is None and phys_static is None):
-        phys_static = (0, 0)  # spans coincide at s=1, flags irrelevant
-        phys = None
     in_specs = [
         pl.BlockSpec(block, index_map, memory_space=pltpu.VMEM),
         pl.BlockSpec(memory_space=pltpu.SMEM),
