@@ -255,6 +255,25 @@ def test_iterate_stream0_distributed(mesh8):
         )
 
 
+def test_stencil2d_pallas_stream0_matches_strip():
+    """The streaming dim-0 derivative path (forced via _stencil_stream0)
+    must equal the full-height strip kernel and the XLA stencil."""
+    # 1000 out rows = 3 full 256-row blocks + a ragged 232-row last block
+    z0 = np.random.default_rng(5).normal(size=(1004, 24)).astype(np.float32)
+    scale = 0.75
+    full = PK.stencil2d_pallas(jnp.asarray(z0), scale, dim=0)
+    streamed = PK._stencil_stream0(
+        jnp.asarray(z0), jnp.asarray([scale], jnp.float32), interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed), np.asarray(full), atol=1e-6
+    )
+    ref = np.asarray(
+        stencil1d_5(jnp.asarray(z0), scale, axis=0)
+    )
+    np.testing.assert_allclose(np.asarray(streamed), ref, atol=1e-5)
+
+
 def test_iterate_stream_rejects_dim1():
     with pytest.raises(ValueError, match="dim=0 only"):
         PK.stencil2d_iterate_pallas(

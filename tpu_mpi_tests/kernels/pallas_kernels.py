@@ -210,21 +210,28 @@ def stencil2d_pallas(
     Tiling: the grid walks the NON-derivative dim in ``tile``-wide strips;
     each strip holds the full ghosted derivative extent in VMEM (Mosaic
     requires HBM slices 8-sublane-aligned, which ghosted interiors never
-    are, so the halo travels with the strip). The derivative extent is
-    therefore VMEM-bounded (strips auto-shrink to fit the ~14 MiB budget);
-    ragged final strips are masked by the pallas pipeline.
+    are, so the halo travels with the strip). Strips auto-shrink to the
+    ~14 MiB budget; ragged final strips are masked by the pallas pipeline.
+    ``dim=0`` extents too tall for even a minimum strip stream row blocks
+    instead (``_stencil_stream0`` — no height limit); ``dim=1`` extents
+    that wide still raise (use the XLA stencil there).
     """
     nx, ny = z.shape
     if dim == 0:
         mx, mn = nx - 2 * N_BND, ny  # out shape
         # lane-dim strips must stay 128-multiples (Mosaic block rule) —
         # rounded up here AND preserved by _fit_strip's shrinking; arrays
-        # too tall for even a 128-lane strip fall back to XLA via the
-        # _fit_strip error
+        # too tall for even a 128-lane strip stream row blocks instead
+        # (round 2 removed the fall-back-to-XLA height limit)
         tile = max(128, -(-tile // 128) * 128)
-        strip = _fit_strip(
-            tile, mn, 2 * (nx + mx) * z.dtype.itemsize, min_strip=128
-        )
+        try:
+            strip = _fit_strip(
+                tile, mn, 2 * (nx + mx) * z.dtype.itemsize, min_strip=128
+            )
+        except ValueError:
+            return _stencil_stream0(
+                z, jnp.asarray(scale, z.dtype).reshape(1), interpret
+            )
         grid = (pl.cdiv(mn, strip),)
         in_spec = pl.BlockSpec(
             (nx, strip), lambda j: (0, j), memory_space=pltpu.VMEM
@@ -258,6 +265,53 @@ def stencil2d_pallas(
         out_specs=out_spec,
         interpret=_auto_interpret(interpret),
     )(z, scale_arr)
+
+
+def _stencil_stream0_kernel(z_ref, bot_ref, scale_ref, out_ref, *, B):
+    """Row-streaming dim-0 derivative block: the (B, P) output needs input
+    rows [i·B, i·B+B+2·N_BND) — its own block plus a 2·N_BND-row bottom
+    edge riding as a gathered side operand (same trick as
+    ``_iterate_stream0_kernel``, one-sided because the derivative output
+    is offset by the lo ghost already)."""
+    window = jnp.concatenate([z_ref[:], bot_ref[0]], axis=0)
+    acc = None
+    for k, c in enumerate(STENCIL5.tolist()):
+        if c == 0.0:
+            continue
+        term = c * jax.lax.slice_in_dim(window, k, k + B, axis=0)
+        acc = term if acc is None else acc + term
+    out_ref[:] = acc * scale_ref[0]
+
+
+def _stencil_stream0(z, scale_arr, interpret):
+    """Streaming dim-0 path of :func:`stencil2d_pallas` for domains whose
+    full ghosted height exceeds VMEM (the round-2 fallback-to-XLA case)."""
+    nx, ny = z.shape
+    mx = nx - 2 * N_BND
+    E = 2 * N_BND
+    itemsize = jnp.dtype(z.dtype).itemsize
+    sub = max(8, 8 * 4 // itemsize)
+    # window rows = B + E = B + 2·K at K=N_BND — the iterate fit applies
+    B, P = _fit_stream0_blocks(ny, N_BND, itemsize, sub)
+    nb = pl.cdiv(mx, B)
+    rows = jnp.arange(nb, dtype=jnp.int32) * B + B
+    bot = z[jnp.clip(rows[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :],
+                     0, nx - 1)]
+    return pl.pallas_call(
+        functools.partial(_stencil_stream0_kernel, B=B),
+        out_shape=jax.ShapeDtypeStruct((mx, ny), z.dtype),
+        grid=(nb, pl.cdiv(ny, P)),
+        in_specs=[
+            pl.BlockSpec((B, P), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, E, P), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((B, P), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        interpret=_auto_interpret(interpret),
+    )(z, bot, scale_arr)
 
 
 # STENCIL5 is antisymmetric (central first derivative): emit the 2-difference
