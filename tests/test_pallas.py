@@ -274,6 +274,27 @@ def test_stencil2d_pallas_stream0_matches_strip():
     np.testing.assert_allclose(np.asarray(streamed), ref, atol=1e-5)
 
 
+def test_iterate_stream0_edge_wider_than_block():
+    """G = steps·N_BND wider than the row block (K=10 > B=8) must still be
+    exact — the edge builder chunks wide edges over ⌈G/B⌉ strided passes."""
+    steps = 5
+    K = 2 * steps
+    z0 = np.random.default_rng(42).normal(
+        size=(40 + 2 * K, 16)
+    ).astype(np.float32)
+    full = PK.stencil2d_iterate_pallas(
+        jnp.asarray(z0), 0.25, dim=0, steps=steps, stream=False,
+        phys_static=(0, 0),
+    )
+    streamed = PK.stencil2d_iterate_pallas(
+        jnp.asarray(z0), 0.25, dim=0, steps=steps, stream=True,
+        stream_tile_rows=8, phys_static=(0, 0),
+    )
+    np.testing.assert_allclose(
+        np.asarray(streamed), np.asarray(full), atol=1e-6
+    )
+
+
 def test_iterate_stream_rejects_dim1():
     with pytest.raises(ValueError, match="dim=0 only"):
         PK.stencil2d_iterate_pallas(

@@ -715,6 +715,8 @@ def heat_step2d_fn(
     cx: float,
     cy: float,
     steps: int = 1,
+    kernel: str = "xla",
+    interpret: bool | None = None,
 ):
     """``n_steps`` outer bodies of explicit-Euler heat-equation integration
     on a periodic 2-D process grid, chained device-side: per body, halo
@@ -738,12 +740,20 @@ def heat_step2d_fn(
     this update with factor ``g = 1 − cx·(2−2cos kxΔx) − cy·(2−2cos kyΔy)``
     per step, which the heat2d driver uses as a roundoff-exact gate: a
     broken exchange or kernel destroys the eigenstructure immediately.
+
+    ``kernel="pallas"`` swaps the XLA update body for the in-place
+    row-streaming Pallas kernel
+    (:func:`~tpu_mpi_tests.kernels.pallas_kernels.heat2d_pallas`) — the
+    same recurrence update-for-update, at ~2 HBM passes per k-step call
+    instead of ~6 per step.
     """
     if n_bnd < steps:
         raise ValueError(
             f"heat_step2d_fn: ghost width n_bnd={n_bnd} must be >= "
             f"steps={steps} (one Laplacian radius per fused timestep)"
         )
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"heat_step2d_fn: unknown kernel {kernel!r}")
 
     @functools.partial(jax.jit, donate_argnums=0)
     def run(z, n_steps):
@@ -762,6 +772,15 @@ def heat_step2d_fn(
                 zz = exchange_shard(
                     zz, axis_name=axis_y, axis=1, n_bnd=n_bnd, periodic=True
                 )
+                if kernel == "pallas":
+                    from tpu_mpi_tests.kernels.pallas_kernels import (
+                        heat2d_pallas,
+                    )
+
+                    return heat2d_pallas(
+                        zz, cx, cy, steps=steps, n_bnd=n_bnd,
+                        interpret=interpret,
+                    )
                 nx, ny = zz.shape
                 for _ in range(steps):
                     ix = slice(1, nx - 1)

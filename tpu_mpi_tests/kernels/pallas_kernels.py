@@ -294,9 +294,7 @@ def _stencil_stream0(z, scale_arr, interpret):
     # window rows = B + E = B + 2·K at K=N_BND — the iterate fit applies
     B, P = _fit_stream0_blocks(ny, N_BND, itemsize, sub)
     nb = pl.cdiv(mx, B)
-    rows = jnp.arange(nb, dtype=jnp.int32) * B + B
-    bot = z[jnp.clip(rows[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :],
-                     0, nx - 1)]
+    _, bot = _row_block_edges(z, B, E, nb)
     return pl.pallas_call(
         functools.partial(_stencil_stream0_kernel, B=B),
         out_shape=jax.ShapeDtypeStruct((mx, ny), z.dtype),
@@ -510,10 +508,7 @@ def _iterate_stream0(z, se, steps, phys, phys_static, interpret,
     # [2K−N, R−2K+N) at every step
     i_lo_mask = -(-(2 * K - N_BND) // B)
     i_hi_mask = (nx - B - 2 * K + N_BND) // B + 1
-    rows = jnp.arange(nb, dtype=jnp.int32) * B
-    karange = jnp.arange(K, dtype=jnp.int32)
-    top = z[jnp.clip(rows[:, None] - K + karange[None, :], 0, nx - 1)]
-    bot = z[jnp.clip(rows[:, None] + B + karange[None, :], 0, nx - 1)]
+    top, bot = _row_block_edges(z, B, K, nb)
     in_specs = [
         pl.BlockSpec((B, P), lambda i, j: (i, j), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, K, P), lambda i, j: (i, 0, j),
@@ -663,6 +658,147 @@ def stencil2d_iterate_pallas(
         input_output_aliases={0: 0},
         interpret=_auto_interpret(interpret),
     )(*operands)
+
+
+def _row_block_edges(z, B: int, G: int, nb: int):
+    """(nb, G, ny) top and bottom G-row neighbor edges for each B-row
+    block of ``z``, built with shift+pad+reshape slicing — any G, any B.
+    (The obvious clamped-index row gather lowers to a serial per-row loop
+    on TPU — measured 30 ms/call at 4096², collapsing heat2d from ~10000
+    to 263 steps/s.) Rows that fall outside ``z`` (block 0's top, the
+    last block's bottom) carry arbitrary values; every caller's
+    influence-cone masking makes them unreachable."""
+    nx, ny = z.shape
+    total = nb * B
+
+    def strided(src, width):
+        # blocks of `width` rows at stride B over `src`:
+        # result[i, j] = src[i·B + j]
+        s = jnp.pad(src, ((0, max(total - src.shape[0], 0)), (0, 0)))[:total]
+        return s.reshape(nb, B, ny)[:, :width]
+
+    # position q of the shifted top source must hold z[q−G] for EVERY q
+    # with 0 ≤ q−G < nx — including q ≥ nx (blocks whose padded position
+    # passes the array end while the source row still exists), so the
+    # shift prepends G rows rather than truncating the tail. Edge widths
+    # beyond one block (G > B) are built in ⌈G/B⌉ strided chunks.
+    z_top = jnp.concatenate([z[:G], z], axis=0)  # [q] = z[q − G]
+    tops, bots = [], []
+    for c0 in range(0, G, B):
+        w = min(B, G - c0)
+        tops.append(strided(z_top[c0:], w))
+        bots.append(strided(z[min(B + c0, nx):], w))
+    top = tops[0] if len(tops) == 1 else jnp.concatenate(tops, axis=1)
+    bot = bots[0] if len(bots) == 1 else jnp.concatenate(bots, axis=1)
+    return top, bot
+
+
+def _heat_stream0_kernel(z_ref, top_ref, bot_ref, coef_ref, out_ref, *,
+                         steps, B, G, R):
+    """Row-streaming 2-D heat (5-point Laplacian) k-step block: per step,
+    ``interior += cx·δ²x + cy·δ²y`` over the maximal span — the exact
+    recurrence of ``heat_step2d_fn``'s XLA body (stale creep within the
+    ghost band included), so the two tiers are update-for-update
+    identical. Column taps stay in-window (full shard width rides in the
+    block); row windows carry G-row gathered edges, and a row at edge
+    distance d is correct through step d, so G ≥ steps makes the output
+    block's influence cone exact (same argument as the 1-D iterate).
+
+    Formulation note (Mosaic constraints): the update is computed at EVERY
+    window position from full-extent shifted copies (row shifts are
+    full-lane-width concats along the sublane dim, col shifts concats
+    along the lane dim — both legal; a col-sliced interior stitch is not,
+    because `tpu.concatenate` rejects lane-offset mismatches on non-concat
+    dims, and `dynamic_update_slice` has no TPU lowering at all), then
+    border/ghost positions keep their old value via one precomputed
+    2-D mask — scalar row bounds fold the per-block absolute-row clip, so
+    no per-block branch is needed."""
+    cx = coef_ref[0]
+    cy = coef_ref[1]
+    i = pl.program_id(0)
+    window = jnp.concatenate([top_ref[0], z_ref[:], bot_ref[0]], axis=0)
+    W = window.shape[0]
+    ny = window.shape[1]
+    abs0 = i * B - G  # absolute shard row of window position 0
+    w_iota = jax.lax.broadcasted_iota(jnp.int32, (W, ny), 0)
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (W, ny), 1)
+    lo_r = jnp.maximum(1, 1 - abs0)          # window-pos row bounds with
+    hi_r = jnp.minimum(W - 1, R - 1 - abs0)  # the absolute clip folded in
+    ok = ((w_iota >= lo_r) & (w_iota < hi_r)
+          & (c_iota >= 1) & (c_iota < ny - 1))
+    for _ in range(steps):
+        up = jnp.concatenate([window[1:W], window[W - 1:W]], axis=0)
+        down = jnp.concatenate([window[0:1], window[0:W - 1]], axis=0)
+        right = jnp.concatenate(
+            [window[:, 1:ny], window[:, ny - 1:ny]], axis=1
+        )
+        left = jnp.concatenate(
+            [window[:, 0:1], window[:, 0:ny - 1]], axis=1
+        )
+        new = (window + cx * (up + down - 2.0 * window)
+               + cy * (left + right - 2.0 * window))
+        window = jnp.where(ok, new, window)
+    out_ref[:] = jax.lax.slice_in_dim(window, G, G + B, axis=0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("steps", "n_bnd", "interpret", "tile_rows"),
+    donate_argnums=0,
+)
+def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
+                  interpret: bool | None = None,
+                  tile_rows: int | None = None):
+    """Hand tier of the heat mini-app's update (``heat_step2d_fn``):
+    ``steps`` explicit-Euler 5-point-Laplacian steps on a both-dims-ghosted
+    shard, in place (aliased), 2 HBM passes per call vs the XLA body's ~6
+    per step. Full shard width rides in each block (column ghosts are
+    in-window); rows stream with gathered G-row edges, so height is
+    unbounded. Raises when the width alone exceeds the VMEM budget (the
+    XLA body is the fallback there)."""
+    nx, ny = z.shape
+    G = n_bnd
+    if steps > G:
+        raise ValueError(f"heat2d_pallas: steps={steps} > ghost width {G}")
+    itemsize = jnp.dtype(z.dtype).itemsize
+    sub = max(8, 8 * 4 // itemsize)
+    B = 256
+    while B > sub and 8 * (B + 2 * G) * ny * itemsize > _VMEM_BUDGET_BYTES:
+        B = max(sub, (B // 2) // sub * sub)
+    if 8 * (B + 2 * G) * ny * itemsize > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"heat2d_pallas: width {ny} exceeds the VMEM budget even at "
+            f"{B}-row blocks; use the XLA body"
+        )
+    if tile_rows is not None:
+        if tile_rows % sub:
+            raise ValueError(
+                f"tile_rows={tile_rows} must be a multiple of the "
+                f"{sub}-row sublane tile"
+            )
+        B = min(B, tile_rows)  # test hook: force multi-block at small nx
+    nb = pl.cdiv(nx, B)
+    top, bot = _row_block_edges(z, B, G, nb)
+    coef = jnp.asarray([cx, cy], z.dtype)
+    return pl.pallas_call(
+        functools.partial(
+            _heat_stream0_kernel, steps=steps, B=B, G=G, R=nx,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nx, ny), z.dtype),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((B, ny), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, G, ny), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, G, ny), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((B, ny), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        input_output_aliases={0: 0},
+        interpret=_auto_interpret(interpret),
+    )(z, top, bot, coef)
 
 
 # ---------------------------------------------------------------------------
