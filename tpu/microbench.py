@@ -3,7 +3,7 @@
 
 Usage (from /root/repo):
     python tpu/microbench.py [daxpy] [stencil] [iterate] [splitfused]
-                             [ceiling] [attention]
+                             [ceiling] [attention] [heat]
 
 Runs the selected groups (default: all) on whatever backend is active and
 prints one JSON line per measurement plus a summary table. Timing uses the
@@ -373,6 +373,44 @@ def bench_attention(results):
         del q, k, v
 
 
+def bench_heat(results):
+    """heat2d mini-app update tiers (BASELINE heat2d row): XLA body vs the
+    in-place row-streaming Pallas Laplacian, k ∈ {1, 4, 8} at 2048²."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from tpu_mpi_tests.comm.halo import heat_step2d_fn
+    from tpu_mpi_tests.instrument.timers import block, chain_rate
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("x", "y"))
+    n = 2048
+    for kernel in ("xla", "pallas"):
+        for k in (1, 4, 8):
+            z0 = np.random.default_rng(0).normal(
+                size=(n + 2 * k, n + 2 * k)
+            ).astype(np.float32) / 10
+            run = heat_step2d_fn(
+                mesh, "x", "y", k, 0.05, 0.05, steps=k, kernel=kernel
+            )
+            z = jnp.asarray(z0)
+            # two warm calls: the axon tunnel charges a one-time ~0.9 s
+            # post-compile cost to the SECOND dispatch of an executable,
+            # which chain_rate's single built-in warm call would otherwise
+            # eat inside its short measurement (flipping the delta
+            # negative → NaN)
+            z = block(run(z, 1))
+            z = block(run(z, 1))
+            sec, z = chain_rate(
+                run, z, n_short=max(1, 40 // k), n_long=max(2, 2000 // k)
+            )
+            _emit(results, f"heat2d_{kernel}_k{k}_2048_steps_per_s",
+                  k / sec, "steps/s")
+            del z
+
+
 GROUPS = {
     "daxpy": bench_daxpy,
     "stencil": bench_stencil,
@@ -380,6 +418,7 @@ GROUPS = {
     "splitfused": bench_splitfused,
     "ceiling": bench_ceiling,
     "attention": bench_attention,
+    "heat": bench_heat,
 }
 
 

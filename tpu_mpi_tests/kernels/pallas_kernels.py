@@ -464,21 +464,44 @@ def _iterate_stream0_kernel(z_ref, top_ref, bot_ref, scale_eps_ref, *rest,
     out_ref[:] = jax.lax.slice_in_dim(window, K, K + B, axis=0)
 
 
-def _fit_stream0_blocks(ny: int, K: int, itemsize: int, sub: int):
-    """(B, P) for the streaming dim-0 kernel: ~8 live (window-sized)
-    buffers within the VMEM budget — window + per-step temps + pipelined
-    in/out blocks; measured on v5e: the 6-buffer model OOMed the Mosaic
-    stack by ~4% at (512+24)x1024 f32, so 8 keeps real headroom. B starts
-    at 256: the 8192² k=4 sweep measured 128–256-row blocks fastest
-    (2090–2180 iter/s) and 512 slowest (1940–2295 across windows) — small
-    blocks keep the pipeline deep without starving the VPU."""
-    P = min(-(-ny // 128) * 128, 1024)
+def _stream_live_bytes(B: int, halo: int, width: int, itemsize: int) -> int:
+    """The row-streaming kernels' shared VMEM live-set model: ~8
+    window-sized buffers (window + per-step temps + pipelined in/out
+    blocks); measured on v5e: a 6-buffer model OOMed the Mosaic stack by
+    ~4% at (512+24)x1024 f32, so 8 keeps real headroom."""
+    return 8 * (B + 2 * halo) * width * itemsize
+
+
+def _fit_block_rows(width: int, halo: int, itemsize: int, sub: int) -> int:
+    """Largest sublane-multiple row block ≤ 256 whose live set fits VMEM
+    (floor: one sublane tile). B starts at 256: the 8192² k=4 sweep
+    measured 128–256-row blocks fastest (2090–2180 iter/s) and 512
+    slowest — small blocks keep the pipeline deep without starving the
+    VPU."""
     B = 256
-    while B > sub and 8 * (B + 2 * K) * P * itemsize > _VMEM_BUDGET_BYTES:
+    while B > sub and _stream_live_bytes(B, halo, width, itemsize) > \
+            _VMEM_BUDGET_BYTES:
         B = max(sub, (B // 2) // sub * sub)
-    while P > 128 and 8 * (B + 2 * K) * P * itemsize > _VMEM_BUDGET_BYTES:
+    return B
+
+
+def _validate_tile_rows(tile_rows: int, sub: int) -> None:
+    if tile_rows % sub:
+        raise ValueError(
+            f"tile_rows={tile_rows} must be a multiple of the "
+            f"{sub}-row sublane tile"
+        )
+
+
+def _fit_stream0_blocks(ny: int, K: int, itemsize: int, sub: int):
+    """(B, P) for the streaming dim-0 stencil kernels (shared live-set
+    model above; columns panel down to 128 lanes before giving up)."""
+    P = min(-(-ny // 128) * 128, 1024)
+    B = _fit_block_rows(P, K, itemsize, sub)
+    while P > 128 and _stream_live_bytes(B, K, P, itemsize) > \
+            _VMEM_BUDGET_BYTES:
         P //= 2
-    if 8 * (B + 2 * K) * P * itemsize > _VMEM_BUDGET_BYTES:
+    if _stream_live_bytes(B, K, P, itemsize) > _VMEM_BUDGET_BYTES:
         raise ValueError(
             f"stencil2d streaming dim-0: even a ({B}+2·{K})×{P} window "
             f"exceeds the VMEM budget"
@@ -496,11 +519,7 @@ def _iterate_stream0(z, se, steps, phys, phys_static, interpret,
     sub = max(8, 8 * 4 // jnp.dtype(z.dtype).itemsize)
     B, P = _fit_stream0_blocks(ny, K, jnp.dtype(z.dtype).itemsize, sub)
     if tile_rows is not None:
-        if tile_rows % sub:
-            raise ValueError(
-                f"stream_tile_rows={tile_rows} must be a multiple of the "
-                f"{sub}-row sublane tile"
-            )
+        _validate_tile_rows(tile_rows, sub)
         B = min(B, tile_rows)
     nb = pl.cdiv(nx, B)
     # per-block static masking decision (see kernel docstring): block i is
@@ -761,20 +780,14 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
         raise ValueError(f"heat2d_pallas: steps={steps} > ghost width {G}")
     itemsize = jnp.dtype(z.dtype).itemsize
     sub = max(8, 8 * 4 // itemsize)
-    B = 256
-    while B > sub and 8 * (B + 2 * G) * ny * itemsize > _VMEM_BUDGET_BYTES:
-        B = max(sub, (B // 2) // sub * sub)
-    if 8 * (B + 2 * G) * ny * itemsize > _VMEM_BUDGET_BYTES:
+    B = _fit_block_rows(ny, G, itemsize, sub)
+    if _stream_live_bytes(B, G, ny, itemsize) > _VMEM_BUDGET_BYTES:
         raise ValueError(
             f"heat2d_pallas: width {ny} exceeds the VMEM budget even at "
             f"{B}-row blocks; use the XLA body"
         )
     if tile_rows is not None:
-        if tile_rows % sub:
-            raise ValueError(
-                f"tile_rows={tile_rows} must be a multiple of the "
-                f"{sub}-row sublane tile"
-            )
+        _validate_tile_rows(tile_rows, sub)
         B = min(B, tile_rows)  # test hook: force multi-block at small nx
     nb = pl.cdiv(nx, B)
     top, bot = _row_block_edges(z, B, G, nb)
