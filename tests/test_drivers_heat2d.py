@@ -154,3 +154,16 @@ def test_heat_step2d_rejects_unknown_kernel():
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("x", "y"))
     with pytest.raises(ValueError, match="unknown kernel"):
         heat_step2d_fn(mesh, "x", "y", 1, 0.1, 0.1, kernel="bogus")
+
+
+def test_pallas_width_limit_falls_back_to_xla(capsys):
+    """Above the pallas body's VMEM width limit the driver must fall back
+    to the XLA tier with a visible NOTE (and still pass the eigen gate),
+    never crash or silently switch."""
+    rc, out = run_driver(
+        capsys, "--mesh", "2,4", "--nx-local", "16", "--ny-local", "23040",
+        "--n-steps", "2", "--kernel", "pallas", "--dtype", "float64",
+    )
+    assert rc == 0, out
+    assert "NOTE pallas kernel unavailable, using xla" in out
+    assert "HEAT FAIL" not in out

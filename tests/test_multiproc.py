@@ -213,17 +213,21 @@ def test_multiproc_collbench_busbw(tpumt_run, tmp_path):
         assert busbw != "nan" and float(busbw) > 0, (name, busbw)
 
 
-def test_multiproc_heat2d_grid(tpumt_run, tmp_path):
+@pytest.mark.parametrize("kernel", ["xla", "pallas"])
+def test_multiproc_heat2d_grid(tpumt_run, tmp_path, kernel):
     """2-process heat mini-app: the process-grid x-axis spans the process
     boundary, so every time step's halo exchange crosses DCN; the driver
     must complete and report steps/s (the eigen gate needs addressable
-    shards and is skipped multi-host — finiteness gates instead)."""
-    prefix = tmp_path / "out-heat-"
+    shards and is skipped multi-host — finiteness gates instead). Both
+    update-body tiers run — the pallas row-streaming Laplacian consumes
+    the same DCN-exchanged ghosts."""
+    prefix = tmp_path / f"out-heat-{kernel}-"
     r = launch(
         tpumt_run, 2, sys.executable, "-m",
         "tpu_mpi_tests.drivers.heat2d",
         "--fake-devices", "1", "--mesh", "2,1", "--nx-local", "16",
         "--ny-local", "32", "--n-steps", "40", "--dtype", "float64",
+        "--kernel", kernel,
         out_prefix=prefix,
     )
     assert r.returncode == 0, r.stdout + r.stderr

@@ -93,9 +93,28 @@ def run(args) -> int:
             ] = blk.astype(dtype)
     zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
 
+    kernel = args.kernel
+    if kernel == "pallas":
+        # the pallas body carries the full shard width per block; above
+        # its VMEM width limit fall back to the XLA body with a visible
+        # NOTE (trace-time probe, no execution), never silently
+        try:
+            jax.eval_shape(
+                heat_step2d_fn(
+                    mesh, "x", "y", nb, float(cx), float(cy),
+                    steps=args.halo_steps, kernel="pallas",
+                ),
+                jax.ShapeDtypeStruct(zs.shape, zs.dtype),
+                1,
+            )
+        except ValueError as e:
+            if "VMEM budget" not in str(e):
+                raise  # only the documented width limit falls back
+            rep.line(f"NOTE pallas kernel unavailable, using xla ({e})")
+            kernel = "xla"
     step = heat_step2d_fn(
         mesh, "x", "y", nb, float(cx), float(cy), steps=args.halo_steps,
-        kernel=args.kernel,
+        kernel=kernel,
     )
     outer_total = args.n_steps // args.halo_steps
     # compile + warm: 1 outer body = halo_steps real timesteps, counted
@@ -111,7 +130,7 @@ def run(args) -> int:
         f"{steps_per_s:0.1f} steps/s",
         {"kind": "heat", "px": px, "py": py, "nx": nx, "ny": ny,
          "steps": args.n_steps, "steps_per_s": steps_per_s,
-         "nu": args.nu, "dt": dt},
+         "nu": args.nu, "dt": dt, "kernel": kernel},
     )
 
     rc = 0

@@ -485,10 +485,11 @@ def _fit_block_rows(width: int, halo: int, itemsize: int, sub: int) -> int:
     return B
 
 
-def _validate_tile_rows(tile_rows: int, sub: int) -> None:
+def _validate_tile_rows(tile_rows: int, sub: int,
+                        name: str = "tile_rows") -> None:
     if tile_rows % sub:
         raise ValueError(
-            f"tile_rows={tile_rows} must be a multiple of the "
+            f"{name}={tile_rows} must be a multiple of the "
             f"{sub}-row sublane tile"
         )
 
@@ -519,7 +520,7 @@ def _iterate_stream0(z, se, steps, phys, phys_static, interpret,
     sub = max(8, 8 * 4 // jnp.dtype(z.dtype).itemsize)
     B, P = _fit_stream0_blocks(ny, K, jnp.dtype(z.dtype).itemsize, sub)
     if tile_rows is not None:
-        _validate_tile_rows(tile_rows, sub)
+        _validate_tile_rows(tile_rows, sub, name="stream_tile_rows")
         B = min(B, tile_rows)
     nb = pl.cdiv(nx, B)
     # per-block static masking decision (see kernel docstring): block i is
