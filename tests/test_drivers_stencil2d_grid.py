@@ -59,3 +59,23 @@ def test_iter_line_emitted(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "step mean=" in out
+
+
+def test_pallas_kernel_tier(capsys):
+    """The streamed dual-derivative Pallas tier must pass the same
+    analytic error gates as the XLA tier on the 2x4 grid."""
+    m = run_ok(capsys, ["--dtype", "float64", "--kernel", "pallas"])
+    assert float(m.group(4)) < 1e-8 and float(m.group(5)) < 1e-8
+
+
+def test_pallas_width_limit_falls_back_to_xla(capsys):
+    """Above the pallas tier's VMEM width limit the driver must fall back
+    to XLA with a visible NOTE and still pass the analytic gates."""
+    rc = stencil2d_grid.main([
+        "--fake-devices", "8", "--mesh", "2,4", "--nx-local", "16",
+        "--ny-local", "23040", "--n-iter", "1", "--n-warmup", "0",
+        "--dtype", "float64", "--kernel", "pallas",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "NOTE pallas kernel unavailable, using xla" in out

@@ -674,3 +674,26 @@ def test_daxpy_inplace_alias_matches():
     want = np.asarray(PK.daxpy_pallas(2.0, x, y))
     got = np.asarray(PK.daxpy_pallas(2.0, x, y, inplace=True))
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("tile_rows", [None, 16])
+def test_dual_dim_step_pallas_matches_xla(tile_rows):
+    """The streamed dual-derivative kernel must match dual_dim_step on
+    both derivatives and (to summation rounding) the residual; tile_rows
+    forces multi-block streaming with a ragged last block."""
+    from tpu_mpi_tests.kernels.stencil import N_BND, dual_dim_step
+
+    z = rng(31, (4 + 2 * N_BND + 66, 52 + 2 * N_BND))
+    ax, ay, ar = dual_dim_step(z, N_BND, 1.5, 0.75)
+    bx, by, br = PK.dual_dim_step_pallas(
+        z, N_BND, 1.5, 0.75, interpret=True, tile_rows=tile_rows
+    )
+    np.testing.assert_allclose(np.asarray(bx), np.asarray(ax), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(by), np.asarray(ay), atol=1e-5)
+    assert abs(float(br) - float(ar)) <= 1e-3 * max(1.0, abs(float(ar)))
+
+
+def test_dual_dim_step_pallas_rejects_bad_nbnd():
+    with pytest.raises(ValueError, match="n_bnd"):
+        PK.dual_dim_step_pallas(jnp.ones((32, 32)), 3, 1.0, 1.0,
+                                interpret=True)

@@ -549,6 +549,8 @@ def step2d_fn(
     n_bnd: int,
     scale_x: float,
     scale_y: float,
+    kernel: str = "xla",
+    interpret: bool | None = None,
 ):
     """Full 2-D-decomposed step over a 2-D mesh — the framework's "training
     step" analog: halo exchange along BOTH decomposed axes, stencil
@@ -563,8 +565,16 @@ def step2d_fn(
     The input is ghosted along both axes and sharded ``P(axis_x, axis_y)``;
     returns ``(dz_dx, dz_dy, residual)`` with the derivatives sharded the
     same way and the residual replicated.
+
+    ``kernel="pallas"`` computes the per-shard pipeline with
+    :func:`~tpu_mpi_tests.kernels.pallas_kernels.dual_dim_step_pallas`
+    (both derivatives + residual partials from one streamed window read,
+    vs the XLA tier's per-tap re-reads).
     """
     from tpu_mpi_tests.kernels.stencil import dual_dim_step
+
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(f"step2d_fn: unknown kernel {kernel!r}")
 
     @jax.jit
     @functools.partial(
@@ -577,7 +587,18 @@ def step2d_fn(
     def step(z):
         z = exchange_shard(z, axis_name=axis_x, axis=0, n_bnd=n_bnd)
         z = exchange_shard(z, axis_name=axis_y, axis=1, n_bnd=n_bnd)
-        dz_dx, dz_dy, residual = dual_dim_step(z, n_bnd, scale_x, scale_y)
+        if kernel == "pallas":
+            from tpu_mpi_tests.kernels.pallas_kernels import (
+                dual_dim_step_pallas,
+            )
+
+            dz_dx, dz_dy, residual = dual_dim_step_pallas(
+                z, n_bnd, scale_x, scale_y, interpret=interpret
+            )
+        else:
+            dz_dx, dz_dy, residual = dual_dim_step(
+                z, n_bnd, scale_x, scale_y
+            )
         return dz_dx, dz_dy, lax.psum(residual, (axis_x, axis_y))
 
     return step

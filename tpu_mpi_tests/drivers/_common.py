@@ -149,3 +149,23 @@ def parse_choice_list(spec: str, valid, what: str = "entries"):
               f"valid: {','.join(valid)}")
         return None
     return names
+
+
+def pick_kernel_tier(build, probe_args, kernel: str, rep):
+    """Return ``(step, effective_kernel)`` for drivers with an XLA/pallas
+    update-body choice. The pallas tier is probed at trace time (no
+    execution); only the documented "VMEM budget" width limit falls back
+    to XLA — with a visible NOTE, never silently — and the probed step is
+    reused, not rebuilt. Any other trace error still raises."""
+    import jax
+
+    if kernel == "pallas":
+        step = build("pallas")
+        try:
+            jax.eval_shape(step, *probe_args)
+            return step, "pallas"
+        except ValueError as e:
+            if "VMEM budget" not in str(e):
+                raise
+            rep.line(f"NOTE pallas kernel unavailable, using xla ({e})")
+    return build("xla"), "xla"

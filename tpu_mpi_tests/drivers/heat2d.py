@@ -93,28 +93,14 @@ def run(args) -> int:
             ] = blk.astype(dtype)
     zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
 
-    kernel = args.kernel
-    if kernel == "pallas":
-        # the pallas body carries the full shard width per block; above
-        # its VMEM width limit fall back to the XLA body with a visible
-        # NOTE (trace-time probe, no execution), never silently
-        try:
-            jax.eval_shape(
-                heat_step2d_fn(
-                    mesh, "x", "y", nb, float(cx), float(cy),
-                    steps=args.halo_steps, kernel="pallas",
-                ),
-                jax.ShapeDtypeStruct(zs.shape, zs.dtype),
-                1,
-            )
-        except ValueError as e:
-            if "VMEM budget" not in str(e):
-                raise  # only the documented width limit falls back
-            rep.line(f"NOTE pallas kernel unavailable, using xla ({e})")
-            kernel = "xla"
-    step = heat_step2d_fn(
-        mesh, "x", "y", nb, float(cx), float(cy), steps=args.halo_steps,
-        kernel=kernel,
+    step, kernel = _common.pick_kernel_tier(
+        lambda k: heat_step2d_fn(
+            mesh, "x", "y", nb, float(cx), float(cy),
+            steps=args.halo_steps, kernel=k,
+        ),
+        (jax.ShapeDtypeStruct(zs.shape, zs.dtype), 1),
+        args.kernel,
+        rep,
     )
     outer_total = args.n_steps // args.halo_steps
     # compile + warm: 1 outer body = halo_steps real timesteps, counted

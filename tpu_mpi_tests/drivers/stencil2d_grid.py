@@ -90,7 +90,15 @@ def run(args) -> int:
             ] = _init_block(dx, dy, rx, ry, px, py, zf, dtype)
     zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
 
-    step = step2d_fn(mesh, "x", "y", N_BND, float(dx.scale), float(dy.scale))
+    step, kernel = _common.pick_kernel_tier(
+        lambda k: step2d_fn(
+            mesh, "x", "y", N_BND, float(dx.scale), float(dy.scale),
+            kernel=k,
+        ),
+        (jax.ShapeDtypeStruct(zs.shape, zs.dtype),),
+        args.kernel,
+        rep,
+    )
 
     timer = PhaseTimer(skip_first=args.n_warmup)
     out = None
@@ -117,7 +125,7 @@ def run(args) -> int:
         f"err_dx={err_dx:e}, err_dy={err_dy:e}",
         {"kind": "grid_test", "px": px, "py": py, "seconds": seconds,
          "err_dx": err_dx, "err_dy": err_dy,
-         "residual": float(residual)},
+         "residual": float(residual), "kernel": kernel},
     )
     rep.iter_line(0, "device", 0, "step", timer.mean("step"),
                   timer.mins.get("step", 0.0), timer.maxs.get("step", 0.0))
@@ -154,6 +162,12 @@ def main(argv=None) -> int:
     p.add_argument("--n-iter", type=int, default=100)
     p.add_argument("--n-warmup", type=int, default=5)
     p.add_argument("--tol", type=float, default=None)
+    p.add_argument(
+        "--kernel", choices=("xla", "pallas"), default="xla",
+        help="per-shard pipeline tier: XLA expressions or the streamed "
+        "Pallas dual-derivative kernel (one window read for both "
+        "derivatives + residual)",
+    )
     args = p.parse_args(argv)
     for name in ("nx_local", "ny_local", "n_iter"):
         if getattr(args, name) < 1:
