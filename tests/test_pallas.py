@@ -89,7 +89,7 @@ def test_iterate_inplace_step(dim):
 
 
 def _check_multistep_vs_repeated(dim, steps, m, other, dtype, flags,
-                                 seed=0):
+                                 seed=0, stream=False):
     """Shared gate: a deep-halo ``steps``-step call must reproduce ``steps``
     single-step calls on the interior (both-sides-physical Dirichlet band)
     and leave the physical band untouched. One copy of the layout algebra
@@ -105,8 +105,13 @@ def _check_multistep_vs_repeated(dim, steps, m, other, dtype, flags,
         if flags == "static"
         else {"phys": jnp.asarray([1, 1])}
     )
+    extra = (
+        {"stream": True, "stream_tile_rows": 16}
+        if stream and dim == 0
+        else {}
+    )
     got = PK.stencil2d_iterate_pallas(
-        jnp.asarray(z0), 0.25, dim=dim, steps=steps, **phys_kw
+        jnp.asarray(z0), 0.25, dim=dim, steps=steps, **extra, **phys_kw
     )
     ref = jnp.asarray(z0[tuple(sl)])
     for _ in range(steps):
@@ -666,6 +671,25 @@ def test_iterate_multistep_fuzz_shapes():
         )
 
 
+def test_iterate_stream0_fuzz_shapes():
+    """Property sweep for the row-streaming dim-0 path: random shapes
+    (down to 1-wide interiors), dtypes, step counts, and flag modes, with
+    16-row blocks forcing multi-block streaming + ragged last blocks —
+    must match k single steps on the interior like the full-height path."""
+    rng_ = np.random.default_rng(1)
+    for trial in range(10):
+        _check_multistep_vs_repeated(
+            dim=0,
+            steps=int(rng_.integers(1, 5)),
+            m=int(rng_.integers(1, 90)),
+            other=int(rng_.integers(1, 70)),
+            dtype=rng_.choice([np.float32, np.float64]),
+            flags=rng_.choice(["static", "dynamic"]),
+            seed=200 + trial,
+            stream=True,
+        )
+
+
 def test_daxpy_inplace_alias_matches():
     """inplace=True (output aliased onto y — cuBLAS's real semantics, and
     required for chained loops per the BASELINE A/B) computes the same
@@ -696,4 +720,10 @@ def test_dual_dim_step_pallas_matches_xla(tile_rows):
 def test_dual_dim_step_pallas_rejects_bad_nbnd():
     with pytest.raises(ValueError, match="n_bnd"):
         PK.dual_dim_step_pallas(jnp.ones((32, 32)), 3, 1.0, 1.0,
+                                interpret=True)
+
+
+def test_dual_dim_step_pallas_rejects_too_small():
+    with pytest.raises(ValueError, match=">= 5 points"):
+        PK.dual_dim_step_pallas(jnp.ones((4, 60)), 2, 1.0, 1.0,
                                 interpret=True)

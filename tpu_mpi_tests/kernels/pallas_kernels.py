@@ -495,10 +495,10 @@ def _validate_tile_rows(tile_rows: int, sub: int,
 
 
 def _stream_fit(z, halo: int, kernel_name: str,
-                tile_rows: "int | None"):
-    """Shared full-width streaming preamble: sublane tile, fitted row
-    block (with the VMEM-budget raise callers' fallbacks match on), and
-    the optional test-hook clamp. Returns ``(sub, B)``."""
+                tile_rows: "int | None") -> int:
+    """Shared full-width streaming preamble: fitted row block ``B`` (with
+    the VMEM-budget raise callers' fallbacks match on) and the optional
+    test-hook clamp."""
     width = z.shape[1]
     itemsize = jnp.dtype(z.dtype).itemsize
     sub = max(8, 8 * 4 // itemsize)
@@ -511,7 +511,7 @@ def _stream_fit(z, halo: int, kernel_name: str,
     if tile_rows is not None:
         _validate_tile_rows(tile_rows, sub)
         B = min(B, tile_rows)
-    return sub, B
+    return B
 
 
 def _fit_stream0_blocks(ny: int, K: int, itemsize: int, sub: int):
@@ -799,7 +799,7 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
     G = n_bnd
     if steps > G:
         raise ValueError(f"heat2d_pallas: steps={steps} > ghost width {G}")
-    _, B = _stream_fit(z, G, "heat2d_pallas", tile_rows)
+    B = _stream_fit(z, G, "heat2d_pallas", tile_rows)
     nb = pl.cdiv(nx, B)
     top, bot = _row_block_edges(z, B, G, nb)
     coef = jnp.asarray([cx, cy], z.dtype)
@@ -887,8 +887,13 @@ def dual_dim_step_pallas(z, n_bnd: int, scale_x: float, scale_y: float,
         )
     nx, ny = z.shape
     G = n_bnd
+    if min(nx, ny) < 2 * G + 1:
+        raise ValueError(
+            f"dual_dim_step_pallas: both dims need >= {2 * G + 1} points "
+            f"(2·n_bnd ghosts + interior), got {z.shape}"
+        )
     mx, my = nx - 2 * G, ny - 2 * G
-    _, B = _stream_fit(z, G, "dual_dim_step_pallas", tile_rows)
+    B = _stream_fit(z, G, "dual_dim_step_pallas", tile_rows)
     nb = pl.cdiv(mx, B)
     _, bot = _row_block_edges(z, B, 2 * G, nb)
     coef = jnp.asarray([scale_x, scale_y], z.dtype)
