@@ -717,6 +717,33 @@ def test_dual_dim_step_pallas_matches_xla(tile_rows):
     assert abs(float(br) - float(ar)) <= 1e-3 * max(1.0, abs(float(ar)))
 
 
+def test_dual_dim_step_pallas_reference_shard_geometry():
+    """1028-row shard (the reference's n_local+ghosts geometry): the fast
+    edge path must source the last block's bottom edge from the real
+    trailing ghost rows even though the output blocking covers fewer rows
+    than z (regression: a negative pad crashed here, and a wrapped roll
+    would silently corrupt the last block's taps)."""
+    from tpu_mpi_tests.kernels.stencil import N_BND, dual_dim_step
+
+    z = rng(77, (1028, 512))
+    ax, ay, ar = dual_dim_step(z, N_BND, 2.0, 0.5)
+    bx, by, br = PK.dual_dim_step_pallas(z, N_BND, 2.0, 0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(bx), np.asarray(ax), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(by), np.asarray(ay), atol=1e-5)
+    assert abs(float(br) - float(ar)) <= 1e-3 * max(1.0, abs(float(ar)))
+
+
+def test_stencil_stream0_blocking_shorter_than_input():
+    """_stencil_stream0 blocks over the ghost-stripped output; heights
+    where nb·B < nx (e.g. 1028 rows at B=256) must still be exact."""
+    z = rng(78, (1028, 40))
+    got = PK._stencil_stream0(
+        z, jnp.asarray([1.25], jnp.float32), interpret=True
+    )
+    ref = stencil1d_5(z, 1.25, axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
 def test_dual_dim_step_pallas_rejects_bad_nbnd():
     with pytest.raises(ValueError, match="n_bnd"):
         PK.dual_dim_step_pallas(jnp.ones((32, 32)), 3, 1.0, 1.0,

@@ -703,13 +703,34 @@ def stencil2d_iterate_pallas(
 def _row_block_edges(z, B: int, G: int, nb: int):
     """(nb, G, ny) top and bottom G-row neighbor edges for each B-row
     block of ``z``, built with shift+pad+reshape slicing — any G, any B.
-    (The obvious clamped-index row gather lowers to a serial per-row loop
-    on TPU — measured 30 ms/call at 4096², collapsing heat2d from ~10000
-    to 263 steps/s.) Rows that fall outside ``z`` (block 0's top, the
-    last block's bottom) carry arbitrary values; every caller's
-    influence-cone masking makes them unreachable."""
+    (Chosen over the obvious clamped-index row gather by a same-window
+    in-kernel A/B on v5e: equal for the iterate, 19% faster for heat;
+    see BASELINE.md for the measurement history.) Rows that fall outside
+    ``z`` (block 0's top, the last block's bottom) carry arbitrary
+    values; every caller's influence-cone masking makes them
+    unreachable."""
     nx, ny = z.shape
     total = nb * B
+    if G <= B:
+        # fast path: ONE shared end-pad of z, then both edges are small
+        # slices of the (nb2, B, ny) view rolled one block — top_i =
+        # tails[i−1] = z[iB−G : iB], bot_i = heads[i+1] = z[iB+B : iB+B+G].
+        # (An earlier formulation built each edge from its own full-array
+        # concat+pad+reshape chain; XLA materialized those as whole-array
+        # passes — the streaming iterate measured 1800 vs 2900 iter/s
+        # same-window at 4096×8192 before/after this form, which touches
+        # z once and otherwise only the small slices.) nb2 covers ALL of
+        # z, not just nb·B rows: derivative callers block over the
+        # ghost-stripped output (nb·B < nx), and their LAST block's
+        # bottom edge must come from the real trailing ghost rows — the
+        # extra virtual block supplies exactly those before [:nb] trims.
+        nb2 = max(nb, -(-nx // B))
+        zp = (z if nb2 * B == nx
+              else jnp.pad(z, ((0, nb2 * B - nx), (0, 0))))
+        zr = zp.reshape(nb2, B, ny)
+        top = jnp.roll(zr[:, B - G:], 1, axis=0)[:nb]
+        bot = jnp.roll(zr[:, :G], -1, axis=0)[:nb]
+        return top, bot
 
     def strided(src, width):
         # blocks of `width` rows at stride B over `src`:
@@ -717,11 +738,12 @@ def _row_block_edges(z, B: int, G: int, nb: int):
         s = jnp.pad(src, ((0, max(total - src.shape[0], 0)), (0, 0)))[:total]
         return s.reshape(nb, B, ny)[:, :width]
 
-    # position q of the shifted top source must hold z[q−G] for EVERY q
-    # with 0 ≤ q−G < nx — including q ≥ nx (blocks whose padded position
-    # passes the array end while the source row still exists), so the
-    # shift prepends G rows rather than truncating the tail. Edge widths
-    # beyond one block (G > B) are built in ⌈G/B⌉ strided chunks.
+    # wide edges (G > B — reachable only through the test-hook tile
+    # clamps) in ⌈G/B⌉ strided chunks; position q of the shifted top
+    # source must hold z[q−G] for EVERY q with 0 ≤ q−G < nx — including
+    # q ≥ nx (blocks whose padded position passes the array end while the
+    # source row still exists), so the shift prepends G rows rather than
+    # truncating the tail
     z_top = jnp.concatenate([z[:G], z], axis=0)  # [q] = z[q − G]
     tops, bots = [], []
     for c0 in range(0, G, B):
