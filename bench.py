@@ -102,11 +102,11 @@ def main() -> None:
     n_long = int(os.environ.get("TPU_MPI_BENCH_ITERS_LONG", 2100))
     n_short = max(1, n_short // steps)
     n_long = max(n_short + 1, n_long // steps)
-    # median of 3 chained measurements: the shared chip's contention
-    # windows spread single samples ~±5% (BASELINE.md round-2 note); the
-    # compiled fn and state are reused, so the extra samples cost only
-    # device time
-    n_samples = int(os.environ.get("TPU_MPI_BENCH_SAMPLES", 3))
+    # median of 5 chained measurements: the shared chip's contention
+    # windows spread single samples ~±5-8% (BASELINE.md round-2 note);
+    # the compiled fn and state are reused, so the extra samples cost
+    # only device time (~2 s each)
+    n_samples = int(os.environ.get("TPU_MPI_BENCH_SAMPLES", 5))
     samples = []
     for _ in range(max(1, n_samples)):
         sec_per_call, zg = chain_rate(run, zg, n_short=n_short, n_long=n_long)
