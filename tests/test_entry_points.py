@@ -55,7 +55,8 @@ def test_bench_prints_one_json_line_smoke():
     lines = [l for l in r.stdout.splitlines() if l.strip()]
     rec = json.loads(lines[-1])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "vs_f64_reference_roofline", "samples"}
+                        "vs_f64_reference_roofline", "samples",
+                        "schedule", "steps"}
     assert rec["value"] > 0
     # the reported value is the median of the recorded (finite) samples;
     # both are independently rounded to 2 dp, so allow half-step slack

@@ -754,3 +754,52 @@ def test_dual_dim_step_pallas_rejects_too_small():
     with pytest.raises(ValueError, match=">= 5 points"):
         PK.dual_dim_step_pallas(jnp.ones((4, 60)), 2, 1.0, 1.0,
                                 interpret=True)
+
+
+@pytest.mark.parametrize("n_blocks", [2, 3])
+def test_iterate_blocks_matches_fused(n_blocks):
+    """The resident-block single-chip schedule (split → k-step with
+    per-k-group inter-block ghost refresh → merge) must reproduce the
+    per-step-exchange XLA iterate on the interior, including the physical
+    top/bottom bands — the bench.py fast-path gate."""
+    import jax
+    from jax.sharding import Mesh
+
+    from tpu_mpi_tests.comm.halo import (
+        iterate_fused_fn,
+        iterate_pallas_blocks_fn,
+        merge_blocks,
+        split_blocks,
+    )
+
+    steps, outer = 2, 3
+    K = 2 * steps
+    H, W = n_blocks * 12, 24
+    z0 = np.random.default_rng(41).normal(
+        size=(H + 2 * K, W)
+    ).astype(np.float32)
+    # deep-ghost layout: physical bands at both ends (world=1 semantics)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    fused = iterate_fused_fn(mesh1, "shard", 0, 2, 2, 10.0, 1e-3)
+    narrow = jnp.asarray(z0[K - 2: K - 2 + H + 4])
+    want = np.asarray(fused(narrow, steps * outer))
+
+    run = iterate_pallas_blocks_fn(
+        n_blocks, K, 1e-2, steps=steps, interpret=True  # = scale·eps
+    )
+    state = split_blocks(jnp.asarray(z0), n_blocks, K)
+    state = run(state, outer)
+    got = np.asarray(merge_blocks(state, K))
+    np.testing.assert_allclose(
+        got[K:K + H], want[2:2 + H], atol=1e-5
+    )
+
+
+def test_split_merge_blocks_roundtrip():
+    from tpu_mpi_tests.comm.halo import merge_blocks, split_blocks
+
+    z = rng(9, (4 * 10 + 8, 16))
+    st = split_blocks(z, 4, 4)
+    assert all(b.shape == (18, 16) for b in st)
+    np.testing.assert_array_equal(np.asarray(merge_blocks(st, 4)),
+                                  np.asarray(z))
