@@ -86,7 +86,8 @@ def main() -> None:
         topo.platform == "tpu" and world == 1 and steps > 1
         and n_blocks >= 2 and (n % n_blocks) == 0
     )
-    if n_blocks >= 2 and not use_blocks:
+    if "TPU_MPI_BENCH_BLOCKS" in os.environ and n_blocks >= 2 \
+            and not use_blocks:
         # never silently mis-attribute a schedule: a requested block count
         # that fails the gate is reported (stderr — stdout stays the one
         # JSON line) and the JSON records the schedule that actually ran

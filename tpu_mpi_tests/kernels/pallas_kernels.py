@@ -179,11 +179,16 @@ _VMEM_BUDGET_BYTES = 14 * 1024 * 1024
 
 
 def _fit_strip(tile: int, extent: int, rows_bytes: int, min_strip: int) -> int:
-    """Largest strip ≤ tile fitting the VMEM budget (``rows_bytes`` = bytes
-    per unit strip: 2·(ghosted+interior)·itemsize). Shrinking keeps strips
-    at multiples of ``min_strip`` — lane-dim strips must stay 128-multiples
-    (the Mosaic block rule) and sublane strips 8-multiples. Ragged final
-    blocks are fine — pallas masks out-of-bounds loads/stores."""
+    """Largest strip ≤ tile fitting the VMEM budget. ``rows_bytes`` is the
+    caller's REAL live-set bytes per unit strip — the one-step derivative
+    kernel's 2·(ghosted+interior)·itemsize, but the k-step iterate needs
+    3·(...) because its per-step concat temps push the Mosaic stack to
+    ~1.7× the in+out pair (a 2-buffer model OOMed at 2746-tall dim-0
+    strips: modeled 11.3 MB, real 18.8 MB vs the 16 MB limit). Shrinking
+    keeps strips at multiples of ``min_strip`` — lane-dim strips must stay
+    128-multiples (the Mosaic block rule) and sublane strips 8-multiples.
+    Ragged final blocks are fine — pallas masks out-of-bounds
+    loads/stores."""
     strip = min(tile, extent)
     while strip > min_strip and strip * rows_bytes > _VMEM_BUDGET_BYTES:
         strip = max(min_strip, (strip // 2) // min_strip * min_strip)
@@ -651,8 +656,12 @@ def stencil2d_iterate_pallas(
     if steps == 1 or (phys is None and phys_static is None):
         phys_static = (0, 0)  # spans coincide at s=1, flags irrelevant
         phys = None
+    # 3 live strip-sized buffers, not 2: the k-step body's per-step
+    # concat temps push the real Mosaic stack to ~1.7x the in+out pair
+    # (measured OOM: 2746-tall dim-0 strips at the 2-buffer model's
+    # strip=256 hit 18.8 MB against the 16 MB limit)
     if dim == 1:
-        strip = _fit_strip(tile, nx, 2 * (ny + ny) * z.dtype.itemsize,
+        strip = _fit_strip(tile, nx, 3 * (ny + ny) * z.dtype.itemsize,
                            min_strip=8)
         grid = (pl.cdiv(nx, strip),)
         block = (strip, ny)
@@ -664,7 +673,7 @@ def stencil2d_iterate_pallas(
         # stream row blocks instead (round-2's height limit, removed)
         if stream is None:
             try:
-                _fit_strip(128, ny, 2 * (nx + nx) * z.dtype.itemsize,
+                _fit_strip(128, ny, 3 * (nx + nx) * z.dtype.itemsize,
                            min_strip=128)
             except ValueError:
                 stream = True
@@ -674,7 +683,7 @@ def stencil2d_iterate_pallas(
                 stream_tile_rows,
             )
         tile0 = max(128, -(-tile // 128) * 128)
-        strip = _fit_strip(tile0, ny, 2 * (nx + nx) * z.dtype.itemsize,
+        strip = _fit_strip(tile0, ny, 3 * (nx + nx) * z.dtype.itemsize,
                            min_strip=128)
         grid = (pl.cdiv(ny, strip),)
         block = (nx, strip)
