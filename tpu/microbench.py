@@ -3,7 +3,7 @@
 
 Usage (from /root/repo):
     python tpu/microbench.py [daxpy] [stencil] [iterate] [splitfused]
-                             [ceiling] [attention] [heat]
+                             [ceiling] [attention] [heat] [blocks]
 
 Runs the selected groups (default: all) on whatever backend is active and
 prints one JSON line per measurement plus a summary table. Timing uses the
@@ -373,6 +373,53 @@ def bench_attention(results):
         del q, k, v
 
 
+def bench_blocks(results):
+    """The bench.py headline schedule in isolation: S=2 resident-block
+    dim-0 k-step vs the dim-1 single-buffer kernel, same process/window
+    (BASELINE headline row)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from tpu_mpi_tests.comm.halo import (
+        iterate_pallas_blocks_fn,
+        iterate_pallas_fn,
+        split_blocks,
+    )
+    from tpu_mpi_tests.instrument.timers import block, chain_rate
+    from tpu_mpi_tests.kernels.stencil import N_BND
+
+    steps, n, S = 4, 8192, 2
+    K = N_BND * steps
+    zf = np.random.default_rng(0).normal(
+        size=(n + 2 * K, n)
+    ).astype(np.float32) / 10
+    run = iterate_pallas_blocks_fn(S, K, 1e-4, steps=steps)
+    st = split_blocks(jnp.asarray(zf), S, K)
+    # one explicit warm dispatch: the tunnel charges a one-time ~0.9 s
+    # cost to the SECOND dispatch of an executable (bench_heat note);
+    # this makes chain_rate's internal warm absorb it
+    st = block(run(st, 1))
+    sec, st = chain_rate(run, st, n_short=25, n_long=525)
+    _emit(results, f"blocks_S{S}_dim0_k{steps}_{n}_iters_per_s",
+          steps / sec, "iter/s", f"{n}x{n} f32, resident blocks")
+    del st
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    z1 = np.random.default_rng(1).normal(
+        size=(n, n + 2 * K)
+    ).astype(np.float32) / 10
+    run1 = iterate_pallas_fn(mesh, "shard", K, 1e-4, axis=1, steps=steps)
+    z = jnp.asarray(z1)
+    z = block(run1(z, 1))
+    sec, z = chain_rate(run1, z, n_short=25, n_long=525)
+    _emit(results, f"dim1_single_k{steps}_{n}_iters_per_s", steps / sec,
+          "iter/s", f"{n}x{n} f32, single buffer")
+    del z
+
+
 def bench_heat(results):
     """heat2d mini-app update tiers (BASELINE heat2d row): XLA body vs the
     in-place row-streaming Pallas Laplacian, k ∈ {1, 4, 8} at 2048²."""
@@ -419,6 +466,7 @@ GROUPS = {
     "ceiling": bench_ceiling,
     "attention": bench_attention,
     "heat": bench_heat,
+    "blocks": bench_blocks,
 }
 
 
