@@ -803,3 +803,55 @@ def test_split_merge_blocks_roundtrip():
     assert all(b.shape == (18, 16) for b in st)
     np.testing.assert_array_equal(np.asarray(merge_blocks(st, 4)),
                                   np.asarray(z))
+
+
+@pytest.mark.parametrize("n_blocks", [2, 3])
+@pytest.mark.parametrize("periodic", [False, True])
+def test_iterate_blocks_sharded_matches_fused(mesh8, n_blocks, periodic):
+    """The SHARDED resident-block schedule (S resident blocks per shard on
+    an 8-device mesh, outermost ghosts over ppermute) must reproduce the
+    per-step-exchange XLA iterate on the true interior — the bench.py
+    multi-device fast-path gate (VERDICT r2 next #1)."""
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.halo import (
+        iterate_fused_fn,
+        iterate_pallas_blocks_fn,
+        merge_blocks,
+        split_blocks,
+    )
+
+    steps, outer = 2, 3
+    K = 2 * steps
+    nloc = n_blocks * 6  # interior rows per shard, divisible by S
+    other = 24
+    rng_ = np.random.default_rng(17 + n_blocks)
+    deep_blocks = [
+        rng_.normal(size=(nloc + 2 * K, other)).astype(np.float32)
+        for _ in range(8)
+    ]
+    narrow_blocks = [b[K - 2: K - 2 + nloc + 4] for b in deep_blocks]
+    z_deep = shard_1d(
+        jnp.asarray(np.concatenate(deep_blocks, axis=0)), mesh8, axis=0
+    )
+    z_narrow = shard_1d(
+        jnp.asarray(np.concatenate(narrow_blocks, axis=0)), mesh8, axis=0
+    )
+
+    fused = iterate_fused_fn(
+        mesh8, "shard", 0, 2, 2, 10.0, 1e-3, periodic=periodic
+    )
+    want = np.split(np.asarray(fused(z_narrow, steps * outer)), 8, axis=0)
+
+    run = iterate_pallas_blocks_fn(
+        n_blocks, K, 1e-2, steps=steps, interpret=True,
+        mesh=mesh8, axis_name="shard", periodic=periodic,
+    )
+    state = split_blocks(z_deep, n_blocks, K, mesh=mesh8)
+    state = run(state, outer)
+    got = np.split(
+        np.asarray(merge_blocks(state, K, mesh=mesh8)), 8, axis=0
+    )
+    for a, b in zip(want, got):
+        np.testing.assert_allclose(
+            a[2:2 + nloc], b[K:K + nloc], atol=1e-5
+        )

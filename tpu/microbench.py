@@ -407,7 +407,20 @@ def bench_blocks(results):
           steps / sec, "iter/s", f"{n}x{n} f32, resident blocks")
     del st
 
+    # round-3 sharded generalization on a world-1 mesh — the code path a
+    # multi-chip bench run enters (shard_map-wrapped state tuple); the
+    # same-window A/B vs the plain schedule above prices the wrapper
     mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    runs = iterate_pallas_blocks_fn(
+        S, K, 1e-4, steps=steps, mesh=mesh, axis_name="shard"
+    )
+    sts = split_blocks(jnp.asarray(zf), S, K, mesh=mesh)
+    sts = block(runs(sts, 1))
+    sec, sts = chain_rate(runs, sts, n_short=25, n_long=525)
+    _emit(results, f"blocks_S{S}_sharded_w1_k{steps}_{n}_iters_per_s",
+          steps / sec, "iter/s",
+          f"{n}x{n} f32, sharded resident blocks, world=1 mesh")
+    del sts
     z1 = np.random.default_rng(1).normal(
         size=(n, n + 2 * K)
     ).astype(np.float32) / 10

@@ -63,6 +63,28 @@ class Staging(enum.Enum):
             ) from None
 
 
+def _ring_rotate(lo_edge, hi_edge, cur_lo, cur_hi, *, axis_name: str,
+                 periodic: bool):
+    """Rotate packed interior edges one step around the mesh-axis ring:
+    hi edges travel right (my lo ghost receives the left neighbor's hi
+    edge), lo edges travel left. Non-periodic edge ranks get their
+    CURRENT physical ghosts (``cur_lo``/``cur_hi``) back, since the
+    partial permutation leaves non-receivers with zeros. The subtle ring
+    logic (partial permutation pairs, edge-rank masking) exists ONCE,
+    shared by ``_receive_neighbors`` and the resident-block schedule."""
+    n = lax.axis_size(axis_name)
+    pairs = n if periodic else n - 1
+    fwd = [(i, (i + 1) % n) for i in range(pairs)]
+    bwd = [((i + 1) % n, i) for i in range(pairs)]
+    from_left = lax.ppermute(hi_edge, axis_name, fwd)
+    from_right = lax.ppermute(lo_edge, axis_name, bwd)
+    if not periodic:
+        idx = lax.axis_index(axis_name)
+        from_left = jnp.where(idx == 0, cur_lo, from_left)
+        from_right = jnp.where(idx == n - 1, cur_hi, from_right)
+    return from_left, from_right
+
+
 def _receive_neighbors(
     z,
     *,
@@ -73,14 +95,12 @@ def _receive_neighbors(
     staged: bool = False,
 ):
     """Ring-receive half of the halo exchange: pack interior edges, rotate
-    them ±1, and return ``(from_left, from_right)`` — what belongs in this
-    shard's ghost bands. Non-periodic edge ranks get their CURRENT
-    (physical) ghosts back. Returns ``(None, None)`` on a 1-shard
-    non-periodic ring, where nothing moves. Shared by ``exchange_shard``
-    and ``iterate_overlap_fn`` so the subtle ring logic (partial
-    permutation pairs, edge-rank masking) exists once."""
+    them ±1 (:func:`_ring_rotate`), and return ``(from_left, from_right)``
+    — what belongs in this shard's ghost bands. Non-periodic edge ranks
+    get their CURRENT (physical) ghosts back. Returns ``(None, None)`` on
+    a 1-shard non-periodic ring, where nothing moves. Shared by
+    ``exchange_shard`` and ``iterate_overlap_fn``."""
     n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
     lo_edge, hi_edge = pack_edges(z, axis=axis, n_bnd=n_bnd)
     if staged:
         # materialize contiguous staging buffers (≅ sbuf_l/sbuf_r device
@@ -93,23 +113,14 @@ def _receive_neighbors(
             return hi_edge, lo_edge
         return None, None
 
-    fwd = [(i, (i + 1) % n) for i in range(n if periodic else n - 1)]
-    bwd = [((i + 1) % n, i) for i in range(n if periodic else n - 1)]
-    # hi edges travel right: my lo ghost receives left neighbor's hi edge
-    from_left = lax.ppermute(hi_edge, axis_name, fwd)
-    # lo edges travel left: my hi ghost receives right neighbor's lo edge
-    from_right = lax.ppermute(lo_edge, axis_name, bwd)
-
-    if not periodic:
-        # edge ranks keep their analytic physical ghosts
-        # (non-receivers get zeros from ppermute, so select the old values)
-        cur_lo = lax.slice_in_dim(z, 0, n_bnd, axis=axis)
-        cur_hi = lax.slice_in_dim(
-            z, z.shape[axis] - n_bnd, z.shape[axis], axis=axis
-        )
-        from_left = jnp.where(idx == 0, cur_lo, from_left)
-        from_right = jnp.where(idx == n - 1, cur_hi, from_right)
-    return from_left, from_right
+    cur_lo = lax.slice_in_dim(z, 0, n_bnd, axis=axis)
+    cur_hi = lax.slice_in_dim(
+        z, z.shape[axis] - n_bnd, z.shape[axis], axis=axis
+    )
+    return _ring_rotate(
+        lo_edge, hi_edge, cur_lo, cur_hi,
+        axis_name=axis_name, periodic=periodic,
+    )
 
 
 def exchange_shard(
@@ -548,28 +559,41 @@ def iterate_pallas_blocks_fn(
     steps: int = 1,
     tile: int = 512,
     interpret: bool | None = None,
+    mesh: Mesh | None = None,
+    axis_name: str | None = None,
+    periodic: bool = False,
 ):
-    """Single-device k-step iterate over ``n_blocks`` RESIDENT row blocks —
-    the multi-shard deep-halo schedule run entirely within one chip.
+    """k-step iterate over ``n_blocks`` RESIDENT row blocks per shard —
+    the deep-halo schedule with the fast full-height dim-0 kernel, run
+    either on one chip (``mesh=None``) or sharded over an N-device mesh
+    axis (``mesh`` given): each device holds its S resident blocks,
+    intra-shard ghost refresh is a narrow in-chip band copy, and the two
+    OUTERMOST ghost bands per shard (block 0's top, block S−1's bottom)
+    ride a ``ppermute`` ring to neighbor shards — the same per-k-group
+    exchange ``iterate_pallas_fn`` does, priced over ICI.
 
     Rationale (measured on v5e, BASELINE.md): the dim-0 (sublane-tap)
     k-step kernel runs fastest when the full ghosted block height fits
     VMEM strips, but an 8192-tall domain exceeds that height. Splitting
     the domain into S separate buffers restores the fast full-height path
-    per block with STATIC physical-boundary flags (block 0 lo / block S−1
-    hi), and the inter-block "exchange" is a narrow-band buffer update —
-    the same per-k-group ghost refresh a real S-shard mesh would do over
-    ICI, priced at intra-chip copies. S=2 measured 3021 iter/s at 8192²
-    f32 k=4 vs 2087 for the single-buffer dim-1 kernel in the same
-    contention window (1.45×); S≥4 loses to per-call launch overhead
-    (~100 µs × S per k-group).
+    per block, and the inter-block "exchange" is a narrow-band buffer
+    update. S=2 measured 3021 iter/s at 8192² f32 k=4 vs 2087 for the
+    single-buffer dim-1 kernel in the same contention window (1.45×);
+    S≥4 loses to per-call launch overhead (~100 µs × S per k-group).
+
+    Boundary flags: on a non-periodic multi-shard ring only the global
+    first/last block is physical, which depends on the traced shard index
+    — block 0 and block S−1 take the kernel's dynamic ``phys`` flags
+    (SMEM-driven masks) while interior blocks keep the static fast path;
+    world=1 (or ``mesh=None``) compiles fully static flags.
 
     Returns ``run(state, n_iter)`` where ``state`` is a tuple of
-    ``n_blocks`` arrays, each ``(H_b + 2·n_bnd, W)`` with ``n_bnd =
-    steps·radius`` deep ghosts along dim 0 (use :func:`split_blocks` /
-    :func:`merge_blocks` to convert a whole ghosted domain). Interior
-    semantics are identical to the per-step-exchange schedule (same
-    argument as ``iterate_pallas_fn(steps=k)``; gated by test)."""
+    ``n_blocks`` arrays, each ``(H_b + 2·n_bnd, W)`` per shard with
+    ``n_bnd = steps·radius`` deep ghosts along dim 0 (use
+    :func:`split_blocks` / :func:`merge_blocks`, which accept ``mesh``
+    for the sharded layout). Interior semantics are identical to the
+    per-step-exchange schedule (same argument as
+    ``iterate_pallas_fn(steps=k)``; gated by test and dryrun check)."""
     from tpu_mpi_tests.kernels.pallas_kernels import (
         stencil2d_iterate_pallas,
     )
@@ -587,60 +611,160 @@ def iterate_pallas_blocks_fn(
             f"iterate_pallas_fn for the single-buffer schedule"
         )
     S, K = n_blocks, n_bnd
+    world = 1 if mesh is None else mesh.shape[
+        axis_name or mesh.axis_names[0]
+    ]
+    if mesh is not None:
+        axis_name = axis_name or mesh.axis_names[0]
 
-    @functools.partial(jax.jit, donate_argnums=0)
-    def run(state, n_iter):
-        def body(_, st):
-            blocks = list(st)
-            hb = blocks[0].shape[0] - 2 * K
-            staged = []
-            for s in range(S):
-                b = blocks[s]
-                if s > 0:  # top ghost ← upper neighbor's last interior
-                    b = b.at[0:K].set(blocks[s - 1][hb:hb + K])
-                if s < S - 1:  # bottom ghost ← lower neighbor's first
-                    b = b.at[hb + K:hb + 2 * K].set(blocks[s + 1][K:2 * K])
-                staged.append(b)
-            return tuple(
-                stencil2d_iterate_pallas(
-                    bb, scale_eps, dim=0, steps=steps, tile=tile,
-                    interpret=interpret,
-                    phys_static=(1 if s == 0 else 0,
-                                 1 if s == S - 1 else 0),
-                )
-                for s, bb in enumerate(staged)
+    def body(_, st):
+        blocks = list(st)
+        hb = blocks[0].shape[0] - 2 * K
+        # ghost sources, all read from the PRE-update blocks so the
+        # refresh order cannot matter (≅ post-recvs-before-sends,
+        # mpi_stencil_gt.cc:96-107)
+        top_src = [None] * S
+        bot_src = [None] * S
+        for s in range(1, S):  # top ghost ← upper neighbor's last interior
+            top_src[s] = blocks[s - 1][hb:hb + K]
+        for s in range(S - 1):  # bottom ghost ← lower neighbor's first
+            bot_src[s] = blocks[s + 1][K:2 * K]
+        if world > 1:
+            # outermost bands ride the inter-shard ring: shard r's top
+            # ghost ← shard r−1's LAST interior (its block S−1), bottom
+            # ghost ← shard r+1's FIRST interior (its block 0); edge
+            # shards keep their analytic physical ghosts
+            top_src[0], bot_src[S - 1] = _ring_rotate(
+                blocks[0][K:2 * K],              # lo edge of the shard
+                blocks[S - 1][hb:hb + K],        # hi edge of the shard
+                blocks[0][0:K],                  # current physical lo ghost
+                blocks[S - 1][hb + K:hb + 2 * K],  # current physical hi
+                axis_name=axis_name, periodic=periodic,
             )
+        elif periodic:  # world=1 self-ring: wrap across the block tuple
+            top_src[0] = blocks[S - 1][hb:hb + K]
+            bot_src[S - 1] = blocks[0][K:2 * K]
 
-        return lax.fori_loop(0, n_iter[0], body, state)
+        def phys_kwargs(s):
+            if periodic:
+                return {"phys_static": (0, 0)}
+            if world == 1:
+                return {"phys_static": (1 if s == 0 else 0,
+                                        1 if s == S - 1 else 0)}
+            # multi-shard: only the global first/last block is physical —
+            # a traced-index condition, so edge blocks use dynamic flags
+            idx = lax.axis_index(axis_name)
+            zero = jnp.zeros((), jnp.int32)
+            if s == 0:
+                return {"phys": jnp.stack(
+                    [(idx == 0).astype(jnp.int32), zero])}
+            if s == S - 1:
+                return {"phys": jnp.stack(
+                    [zero, (idx == world - 1).astype(jnp.int32)])}
+            return {"phys_static": (0, 0)}
 
-    return lambda st, n: run(st, jnp.asarray([n], jnp.int32))
+        out = []
+        for s in range(S):
+            b = blocks[s]
+            if top_src[s] is not None:
+                b = b.at[0:K].set(top_src[s])
+            if bot_src[s] is not None:
+                b = b.at[hb + K:hb + 2 * K].set(bot_src[s])
+            out.append(
+                stencil2d_iterate_pallas(
+                    b, scale_eps, dim=0, steps=steps, tile=tile,
+                    interpret=interpret, **phys_kwargs(s),
+                )
+            )
+        return tuple(out)
+
+    if mesh is None:
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run(state, n_iter):
+            return lax.fori_loop(0, n_iter[0], body, state)
+
+    else:
+        spec = P(axis_name, None)
+        state_specs = tuple(spec for _ in range(S))
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def run(state, n_iter):
+            @functools.partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(state_specs, P()),
+                out_specs=state_specs,
+                check_vma=False,
+            )
+            def go(st, n):
+                return lax.fori_loop(0, n[0], body, tuple(st))
+
+            return go(state, n_iter)
+
+    return lambda st, n: run(tuple(st), jnp.asarray([n], jnp.int32))
 
 
-def split_blocks(z, n_blocks: int, n_bnd: int):
+def split_blocks(z, n_blocks: int, n_bnd: int, mesh: Mesh | None = None,
+                 axis_name: str | None = None):
     """Split a dim-0-ghosted domain ``(H + 2K, W)`` into ``n_blocks``
     resident blocks of ``(H/S + 2K, W)`` with overlapping ghost bands
-    (the inverse of :func:`merge_blocks`)."""
+    (the inverse of :func:`merge_blocks`).
+
+    With ``mesh``, ``z`` is the ghosted-GLOBAL sharded array (each shard
+    holds its ghosted block along dim 0, arrays/domain.py layout) and the
+    split happens per shard: result ``s`` is a global array whose shard-r
+    piece is shard r's s-th resident block."""
     from tpu_mpi_tests.utils import check_divisible
 
     K = n_bnd
-    H = z.shape[0] - 2 * K
-    hb = check_divisible(H, n_blocks, "split_blocks interior rows")
-    return tuple(
-        z[s * hb:s * hb + hb + 2 * K] for s in range(n_blocks)
-    )
+
+    def local_split(zl):
+        H = zl.shape[0] - 2 * K
+        hb = check_divisible(H, n_blocks, "split_blocks interior rows")
+        return tuple(
+            zl[s * hb:s * hb + hb + 2 * K] for s in range(n_blocks)
+        )
+
+    if mesh is None:
+        return local_split(z)
+    axis_name = axis_name or mesh.axis_names[0]
+    spec = P(axis_name, None)
+    return jax.jit(
+        shard_map(
+            local_split, mesh=mesh, in_specs=spec,
+            out_specs=tuple(spec for _ in range(n_blocks)),
+        )
+    )(z)
 
 
-def merge_blocks(state, n_bnd: int):
+def merge_blocks(state, n_bnd: int, mesh: Mesh | None = None,
+                 axis_name: str | None = None):
     """Reassemble :func:`split_blocks` blocks into the whole ghosted
-    domain (interiors concatenated, outermost ghost bands kept)."""
-    if len(state) == 1:
-        return state[0]
+    domain (interiors concatenated, outermost ghost bands kept).
+    With ``mesh``, inverts the sharded split (per-shard reassembly)."""
     K = n_bnd
-    hb = state[0].shape[0] - 2 * K
-    parts = [state[0][:K + hb]]
-    parts += [b[K:K + hb] for b in state[1:-1]]
-    parts.append(state[-1][K:])
-    return jnp.concatenate(parts, axis=0)
+
+    def local_merge(st):
+        if len(st) == 1:
+            return st[0]
+        hb = st[0].shape[0] - 2 * K
+        parts = [st[0][:K + hb]]
+        parts += [b[K:K + hb] for b in st[1:-1]]
+        parts.append(st[-1][K:])
+        return jnp.concatenate(parts, axis=0)
+
+    if mesh is None:
+        return local_merge(tuple(state))
+    axis_name = axis_name or mesh.axis_names[0]
+    spec = P(axis_name, None)
+    return jax.jit(
+        shard_map(
+            local_merge, mesh=mesh,
+            in_specs=(tuple(spec for _ in range(len(state))),),
+            out_specs=spec,
+        )
+    )(tuple(state))
 
 
 @functools.lru_cache(maxsize=None)
