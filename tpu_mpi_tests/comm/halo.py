@@ -265,12 +265,28 @@ def halo_exchange(
     )(zg)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("starts", "axis"), donate_argnums=0
+)
+def _apply_ghost_bands(zg, bands, starts, axis):
+    """Write host-staged ghost bands back into the device array — the
+    ONLY device writes of the host-staged path, each O(n_bnd·W)."""
+    for i, s in enumerate(starts):
+        zg = lax.dynamic_update_slice_in_dim(zg, bands[i], s, axis=axis)
+    return zg
+
+
 def _host_staged_exchange(zg, mesh, axis_name, axis, n_bnd, periodic):
-    """Edge blocks round-trip through host memory (≅ stage_host paths).
+    """Edge bands round-trip through host memory (≅ stage_host paths).
 
     Deliberately unfused and synchronous — this mode exists to measure the
     cost of losing device-direct communication, like the reference's
-    non-GPU-aware-MPI fallback.
+    non-GPU-aware-MPI fallback. Like the reference, ONLY the halo bands
+    ever touch the host (``sbuf``/``rbuf`` staging buffers,
+    ``mpi_stencil2d_gt.cc:167-174,236-249``): 2 edge slices per shard come
+    down (``device_get``), the ring swap happens on host, and 2 ghost
+    bands per shard go back up — O(n_bnd·W) host traffic per call, not
+    O(H·W).
     """
     if isinstance(zg, jax.Array) and not zg.is_fully_addressable:
         raise ValueError(
@@ -279,30 +295,36 @@ def _host_staged_exchange(zg, mesh, axis_name, axis, n_bnd, periodic):
             "on multi-host meshes"
         )
     n_shards = mesh.shape[axis_name]
-    blocks = np.split(np.asarray(zg), n_shards, axis=axis)
-    nloc = blocks[0].shape[axis]
+    from tpu_mpi_tests.utils import check_divisible
 
-    def sl(a, start, stop):
-        s = [slice(None)] * a.ndim
-        s[axis] = slice(start, stop)
-        return tuple(s)
+    ng = check_divisible(
+        zg.shape[axis], n_shards, "host-staged ghosted extent"
+    )
+    K = n_bnd
 
-    out = [b.copy() for b in blocks]
+    # pull ONLY the interior edge bands down (the send-side staging copy)
+    def edge(start):
+        return jax.device_get(
+            lax.slice_in_dim(zg, start, start + K, axis=axis)
+        )
+
+    lo_edges = [edge(r * ng + K) for r in range(n_shards)]
+    hi_edges = [edge(r * ng + ng - 2 * K) for r in range(n_shards)]
+
+    # host-side ring swap, then push ONLY the ghost bands back
+    starts, bands = [], []
     for r in range(n_shards):
-        left = (r - 1) % n_shards
-        right = (r + 1) % n_shards
-        if periodic or r > 0:
-            # lo ghost ← left neighbor's hi edge
-            out[r][sl(out[r], 0, n_bnd)] = blocks[left][
-                sl(blocks[left], nloc - 2 * n_bnd, nloc - n_bnd)
-            ]
-        if periodic or r < n_shards - 1:
-            # hi ghost ← right neighbor's lo edge
-            out[r][sl(out[r], nloc - n_bnd, nloc)] = blocks[right][
-                sl(blocks[right], n_bnd, 2 * n_bnd)
-            ]
-    result = np.concatenate(out, axis=axis)
-    return jax.device_put(result.astype(zg.dtype), zg.sharding)
+        if periodic or r > 0:  # lo ghost ← left neighbor's hi edge
+            starts.append(r * ng)
+            bands.append(hi_edges[(r - 1) % n_shards])
+        if periodic or r < n_shards - 1:  # hi ghost ← right's lo edge
+            starts.append(r * ng + ng - K)
+            bands.append(lo_edges[(r + 1) % n_shards])
+    if not starts:
+        return zg
+    return _apply_ghost_bands(
+        zg, jnp.asarray(np.stack(bands)), tuple(starts), axis
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -728,14 +750,21 @@ def split_blocks(z, n_blocks: int, n_bnd: int, mesh: Mesh | None = None,
 
     if mesh is None:
         return local_split(z)
-    axis_name = axis_name or mesh.axis_names[0]
+    return _split_blocks_fn(
+        mesh, axis_name or mesh.axis_names[0], n_blocks, n_bnd
+    )(z)
+
+
+@functools.lru_cache(maxsize=None)
+def _split_blocks_fn(mesh: Mesh, axis_name: str, n_blocks: int, n_bnd: int):
     spec = P(axis_name, None)
     return jax.jit(
         shard_map(
-            local_split, mesh=mesh, in_specs=spec,
+            lambda z: split_blocks(z, n_blocks, n_bnd),
+            mesh=mesh, in_specs=spec,
             out_specs=tuple(spec for _ in range(n_blocks)),
         )
-    )(z)
+    )
 
 
 def merge_blocks(state, n_bnd: int, mesh: Mesh | None = None,
@@ -756,15 +785,22 @@ def merge_blocks(state, n_bnd: int, mesh: Mesh | None = None,
 
     if mesh is None:
         return local_merge(tuple(state))
-    axis_name = axis_name or mesh.axis_names[0]
+    return _merge_blocks_fn(
+        mesh, axis_name or mesh.axis_names[0], len(state), n_bnd
+    )(tuple(state))
+
+
+@functools.lru_cache(maxsize=None)
+def _merge_blocks_fn(mesh: Mesh, axis_name: str, n_blocks: int, n_bnd: int):
     spec = P(axis_name, None)
     return jax.jit(
         shard_map(
-            local_merge, mesh=mesh,
-            in_specs=(tuple(spec for _ in range(len(state))),),
+            lambda st: merge_blocks(st, n_bnd),
+            mesh=mesh,
+            in_specs=(tuple(spec for _ in range(n_blocks)),),
             out_specs=spec,
         )
-    )(tuple(state))
+    )
 
 
 @functools.lru_cache(maxsize=None)
