@@ -46,6 +46,20 @@ def test_stream_scale_matches_xla():
     assert jnp.allclose(out, 1.5 * x)
     out = PK.stream_scale_pallas(0.5, x, block_rows=64)
     assert jnp.allclose(out, 0.5 * x)
+    out = PK.stream_scale_pallas(2.0, x, inplace=True)
+    assert jnp.allclose(out, 2.0 * x)
+
+
+def test_stream_sum3_matches_xla():
+    """The 4-stream ceiling probe (round-3 stream-count family) computes
+    w + x + y, aliased and not."""
+    w, x = init_xy(64 * 1024, jnp.float32)
+    y = 2.0 * x
+    for inplace in (False, True):
+        out = PK.stream_sum3_pallas(w, x, y, inplace=inplace)
+        assert jnp.allclose(out, w + x + y)
+    out = PK.stream_sum3_pallas(w, x, y, block_rows=64)
+    assert jnp.allclose(out, w + x + y)
 
 
 def test_stream_block_rows_fits_vmem():

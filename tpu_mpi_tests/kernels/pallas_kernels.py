@@ -117,16 +117,21 @@ def _scale_kernel(a_ref, x_ref, out_ref):
     out_ref[:] = a_ref[0] * x_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "interpret", "inplace")
+)
 def stream_scale_pallas(a, x, block_rows: int | None = None,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        inplace: bool = False):
     """out ← a·x: the minimal 2-pass (read + write) HBM stream.
 
     This is the ceiling probe's second point: with daxpy (3 passes) it gives
     two (bytes, seconds) samples whose linear fit separates true stream
     bandwidth from the fixed per-kernel launch overhead — the roofline model
     BASELINE.md uses (a raw small-op rate under-reports the ceiling because
-    the launch overhead is charged to too few bytes)."""
+    the launch overhead is charged to too few bytes). ``inplace`` aliases
+    the output onto ``x`` (required for chained loops — the daxpy_pallas
+    aliasing lesson)."""
     n = x.shape[0]
     if n % 128 != 0:
         raise ValueError(f"stream_scale_pallas needs n % 128 == 0, got {n}")
@@ -148,8 +153,50 @@ def stream_scale_pallas(a, x, block_rows: int | None = None,
         out_specs=pl.BlockSpec(
             (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
         ),
+        input_output_aliases=({1: 0} if inplace else {}),
         interpret=_auto_interpret(interpret),
     )(a_arr, x.reshape(rows, 128))
+    return out.reshape(n)
+
+
+def _sum3_kernel(w_ref, x_ref, y_ref, out_ref):
+    out_ref[:] = w_ref[:] + x_ref[:] + y_ref[:]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "interpret", "inplace")
+)
+def stream_sum3_pallas(w, x, y, block_rows: int | None = None,
+                       interpret: bool | None = None,
+                       inplace: bool = False):
+    """y ← w + x + y: the 4-stream (3 reads + 1 write) HBM probe.
+
+    Completes the stream-count family {2: scale, 3: daxpy, 4: this} whose
+    linear fit t(S) = overhead + S·bytes/BW separates the true per-stream
+    HBM bandwidth from fixed launch overhead — the round-3 probe for the
+    DAXPY 0.92× structural-gap question (VERDICT r2 weak #4). ``inplace``
+    aliases the output onto ``y`` (same contract and chained-loop
+    requirement as ``daxpy_pallas``; defaults off like its siblings so a
+    standalone call doesn't force a defensive copy)."""
+    n = x.shape[0]
+    if n % 128 != 0:
+        raise ValueError(f"stream_sum3_pallas needs n % 128 == 0, got {n}")
+    rows = n // 128
+    if block_rows is None:
+        block_rows = _stream_block_rows(jnp.dtype(x.dtype).itemsize, 4)
+    block_rows = min(block_rows, rows)
+    spec = pl.BlockSpec(
+        (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        _sum3_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, 128), x.dtype),
+        grid=(pl.cdiv(rows, block_rows),),
+        in_specs=[spec, spec, spec],
+        out_specs=spec,
+        input_output_aliases=({2: 0} if inplace else {}),
+        interpret=_auto_interpret(interpret),
+    )(w.reshape(rows, 128), x.reshape(rows, 128), y.reshape(rows, 128))
     return out.reshape(n)
 
 
