@@ -414,29 +414,36 @@ def bench_streams(results):
         )
         return per
 
+    # a COMMON block shape across the family: only S may vary between the
+    # fit's points, or the per-block pipeline cost (which differs with
+    # block count) leaks into the fitted slope — 2048 rows is the largest
+    # block the 4-buffer kernel fits in VMEM
+    BR = 2048
     y0 = jnp.ones((n,), jnp.float32)
     times = {}
     # S=2: y = a·y aliased (read + write)
     times[2] = chain(
-        lambda y: PK.stream_scale_pallas(1.0 + 1e-9, y, inplace=True), y0
+        lambda y: PK.stream_scale_pallas(
+            1.0 + 1e-9, y, inplace=True, block_rows=BR), y0
     )
     _emit(results, "stream2_scale_gbps", 2 * nb / times[2] / 1e9, "GB/s",
-          "chained aliased y=a*y, 2^26 f32")
+          f"chained aliased y=a*y, 2^26 f32, {BR}-row blocks")
     # S=3: y = a·x + y aliased (the daxpy under test)
     y0 = jnp.ones((n,), jnp.float32)
     times[3] = chain(
-        lambda y, xx: PK.daxpy_pallas(1.0, xx, y, inplace=True), y0, x
+        lambda y, xx: PK.daxpy_pallas(
+            1.0, xx, y, inplace=True, block_rows=BR), y0, x
     )
     _emit(results, "stream3_daxpy_gbps", 3 * nb / times[3] / 1e9, "GB/s",
-          "chained aliased y=a*x+y, 2^26 f32")
+          f"chained aliased y=a*x+y, 2^26 f32, {BR}-row blocks")
     # S=4: y = w + x + y aliased (3 reads + 1 write)
     y0 = jnp.ones((n,), jnp.float32)
     times[4] = chain(
-        lambda y, ww, xx: PK.stream_sum3_pallas(ww, xx, y, inplace=True),
-        y0, w, x,
+        lambda y, ww, xx: PK.stream_sum3_pallas(
+            ww, xx, y, inplace=True, block_rows=BR), y0, w, x,
     )
     _emit(results, "stream4_sum3_gbps", 4 * nb / times[4] / 1e9, "GB/s",
-          "chained aliased y=w+x+y, 2^26 f32")
+          f"chained aliased y=w+x+y, 2^26 f32, {BR}-row blocks")
     # least-squares fit t(S) = oh + S·nb/BW over the 3 points
     import numpy as np
 

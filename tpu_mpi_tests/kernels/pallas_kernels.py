@@ -835,9 +835,12 @@ def _row_block_edges(z, B: int, G: int, nb: int):
     (Chosen over the obvious clamped-index row gather by a same-window
     in-kernel A/B on v5e: equal for the iterate, 19% faster for heat;
     see BASELINE.md for the measurement history.) Rows that fall outside
-    ``z`` (block 0's top, the last block's bottom) carry arbitrary
-    values; every caller's influence-cone masking makes them
-    unreachable."""
+    ``z`` (block 0's top, and the last block's bottom when the blocking
+    covers all of ``z``) are ZERO-FILLED rather than left to wrap around
+    the array: every caller's influence-cone masking makes them
+    unreachable, but a masking bug then surfaces as a visible numeric
+    error instead of plausible wrapped values (round-2 advisor
+    finding)."""
     nx, ny = z.shape
     total = nb * B
     if G <= B:
@@ -859,6 +862,10 @@ def _row_block_edges(z, B: int, G: int, nb: int):
         zr = zp.reshape(nb2, B, ny)
         top = jnp.roll(zr[:, B - G:], 1, axis=0)[:nb]
         bot = jnp.roll(zr[:, :G], -1, axis=0)[:nb]
+        # poison the rolled-in out-of-range rows (see docstring)
+        top = top.at[0].set(0.0)
+        if nb == nb2:  # trimming exposed the wrapped last bottom edge
+            bot = bot.at[nb - 1].set(0.0)
         return top, bot
 
     def strided(src, width):
@@ -881,6 +888,14 @@ def _row_block_edges(z, B: int, G: int, nb: int):
         bots.append(strided(z[min(B + c0, nx):], w))
     top = tops[0] if len(tops) == 1 else jnp.concatenate(tops, axis=1)
     bot = bots[0] if len(bots) == 1 else jnp.concatenate(bots, axis=1)
+    # poison every top row whose source precedes z (top[i, j] sources row
+    # i·B − G + j, negative for any block with i·B < G — the z[:G]
+    # prepend is filler there; bots' pad already zeroes their
+    # out-of-range tail)
+    src_row = (
+        jnp.arange(nb)[:, None] * B - G + jnp.arange(G)[None, :]
+    )
+    top = jnp.where(src_row[:, :, None] >= 0, top, 0.0)
     return top, bot
 
 
