@@ -1,0 +1,77 @@
+#!/bin/bash
+# Batch sweep-matrix submission (≅ summit/job.lsf:9-16 wrapped around
+# summit/run.sh, and jlse/job.pbs:14-21): enumerate {world sizes ×
+# drivers × memory spaces × profilers}, run every cell through run.sh,
+# and finish with an avg.py summary over the collected out-*.txt — ONE
+# command reproduces the reference's whole result matrix.
+#
+# Usage: ./job.sh [-w "1 2"] [-d "mpi_daxpy_nvtx"] [-s "device managed"]
+#                 [-p "none xprof"] [-a PATTERN] [-- driver args...]
+#   -w  world sizes (space-separated). 1 runs on the active backend (one
+#       real chip, or the CPU fake-device mesh the driver args select);
+#       N>1 spawns N localhost processes with 1 fake CPU device each in a
+#       real jax.distributed world (the dev-loop stand-in for a pod —
+#       on an actual multi-host pod, run run.sh per worker instead).
+#   -d  driver modules under tpu_mpi_tests.drivers
+#   -s  memory-space twins (≅ um|noum managed/unmanaged binaries)
+#   -p  profiler modes (xprof traces land under profile/<tag>, named
+#       per rank — the %q{PMIX_RANK} analog)
+#   -a  avg.py pattern for the final summary (default: gather, the
+#       reference's avg.sh default)
+# Extra args after -- go to every driver cell verbatim.
+#
+# Output: out-<space>_<prof>_<driver>_<host>[_rN].txt per cell (rank) in
+# the CWD, then the aggregated table on stdout.
+
+set -eu
+
+worlds="1"
+drivers="mpi_daxpy_nvtx"
+spaces="device"
+profs="none"
+avg_pattern="gather"
+
+while getopts "w:d:s:p:a:h" opt; do
+  case "$opt" in
+    w) worlds=$OPTARG ;;
+    d) drivers=$OPTARG ;;
+    s) spaces=$OPTARG ;;
+    p) profs=$OPTARG ;;
+    a) avg_pattern=$OPTARG ;;
+    h)
+      grep '^#' "$0" | sed 's/^# \{0,1\}//'
+      exit 0
+      ;;
+    *) exit 1 ;;
+  esac
+done
+shift $((OPTIND - 1))
+
+tpu_dir=$(cd "$(dirname "$0")" && pwd)
+run_sh=$tpu_dir/run.sh
+. "$tpu_dir/worldlib.sh"
+
+for w in $worlds; do
+  for driver in $drivers; do
+    for space in $spaces; do
+      for prof in $profs; do
+        echo "== cell: world=${w} driver=${driver} space=${space}" \
+          "prof=${prof}" >&2
+        if [ "$w" -eq 1 ]; then
+          "$run_sh" "$space" "$prof" "$driver" "$@"
+        else
+          # run.sh names each rank's own out-<tag>.txt (world+rank in
+          # the tag), so no -o redirection here
+          if ! spawn_world "$w" "$run_sh" "$space" "$prof" "$driver" \
+            --fake-devices 1 "$@"; then
+            echo "cell failed" >&2
+            exit 1
+          fi
+        fi
+      done
+    done
+  done
+done
+
+echo "== matrix complete; aggregating (pattern=${avg_pattern}) =="
+python "$(dirname "$run_sh")/avg.py" --pattern "$avg_pattern" out-*.txt

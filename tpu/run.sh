@@ -29,7 +29,15 @@ shift 3
 
 repo_dir=$(cd "$(dirname "$0")/.." && pwd)
 out_dir=$PWD
+# per-rank tag (≅ %q{PMIX_RANK} trace naming, summit/run.sh:15-19): two
+# processes of a multi-process world on one host must not collide in
+# out-<tag>.txt or profile/<tag> — take the launcher-provided process id
+# (tpumt_run / run_local_multiproc / job.sh set JAX_PROCESS_ID; GCP TPU
+# pods set TPU_WORKER_ID)
+rank="${JAX_PROCESS_ID:-${TPU_WORKER_ID:-}}"
+world="${JAX_NUM_PROCESSES:-}"
 tag="${space}_${prof}_${driver}_$(hostname -s)"
+tag="${tag}${world:+_w${world}}${rank:+_r${rank}}"
 
 prof_args=""
 if [ "$prof" == "xprof" ]; then

@@ -21,22 +21,12 @@ driver=$2
 shift 2
 
 repo_dir=$(cd "$(dirname "$0")/.." && pwd)
-port=$((10000 + RANDOM % 20000))
-
-pids=()
-for ((i = 0; i < nprocs; i++)); do
-  JAX_COORDINATOR_ADDRESS="localhost:${port}" \
-  JAX_NUM_PROCESSES="$nprocs" \
-  JAX_PROCESS_ID="$i" \
-  PYTHONPATH="$repo_dir${PYTHONPATH:+:$PYTHONPATH}" \
-    python -m "tpu_mpi_tests.drivers.${driver}" --fake-devices 1 "$@" \
-    > "out-local-${i}.txt" 2>&1 &
-  pids+=($!)
-done
+. "$repo_dir/tpu/worldlib.sh"
 
 rc=0
-for pid in "${pids[@]}"; do
-  wait "$pid" || rc=$?
-done
+PYTHONPATH="$repo_dir${PYTHONPATH:+:$PYTHONPATH}" \
+  spawn_world -o out-local- "$nprocs" \
+  python -m "tpu_mpi_tests.drivers.${driver}" --fake-devices 1 "$@" \
+  || rc=$?
 echo "done (rc=$rc); outputs in out-local-*.txt"
 exit $rc

@@ -1,0 +1,40 @@
+# Shared localhost-world launcher (sourced by run_local_multiproc.sh and
+# job.sh): spawn N copies of a command wired into one real
+# jax.distributed world over localhost (≅ `mpirun -np N`, jlse/run.sh).
+#
+#   spawn_world [-o OUT_PREFIX] NPROCS COMMAND [ARGS...]
+#
+# Each rank gets JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+# JAX_PROCESS_ID; with -o, rank i's stdout+stderr land in
+# <OUT_PREFIX><i>.txt (parallel children interleave a shared pipe).
+# Returns the first nonzero child exit code.
+
+spawn_world() {
+  local out_prefix=""
+  if [ "${1:-}" == "-o" ]; then
+    out_prefix=$2
+    shift 2
+  fi
+  local nprocs=$1
+  shift
+  local port=$((10000 + RANDOM % 20000))
+  local pids=() rc=0 i pid
+  for ((i = 0; i < nprocs; i++)); do
+    if [ -n "$out_prefix" ]; then
+      JAX_COORDINATOR_ADDRESS="localhost:${port}" \
+      JAX_NUM_PROCESSES="$nprocs" \
+      JAX_PROCESS_ID="$i" \
+        "$@" > "${out_prefix}${i}.txt" 2>&1 &
+    else
+      JAX_COORDINATOR_ADDRESS="localhost:${port}" \
+      JAX_NUM_PROCESSES="$nprocs" \
+      JAX_PROCESS_ID="$i" \
+        "$@" &
+    fi
+    pids+=($!)
+  done
+  for pid in "${pids[@]}"; do
+    wait "$pid" || rc=$?
+  done
+  return "$rc"
+}

@@ -89,6 +89,24 @@ def place(x, space: Space | str = Space.DEVICE, sharding=None):
         # so HOST/MANAGED degrade to plain placement. Documented deviation —
         # the A/B benchmark axis collapses on this backend.
         return jax.device_put(x, sharding)
+    if (
+        jax.process_count() > 1
+        and jax.local_devices()[0].platform == "cpu"
+    ):
+        # the multi-process CPU dev loop cannot reshard memory-kind-
+        # annotated buffers across processes (XLA: "side-effect ops
+        # cannot be replicated" on the annotate_device_placement
+        # custom-call), and DEVICE is host RAM there anyway — degrade to
+        # plain placement with a one-line note so the space-axis A/B
+        # reader knows the axis collapsed (the axis is real on TPU)
+        import warnings
+
+        warnings.warn(
+            f"{space.value}-space placement degraded to plain device "
+            "placement on the multi-process CPU backend",
+            stacklevel=2,
+        )
+        return jax.device_put(x, sharding)
     if sharding is not None:
         sharding = sharding.with_memory_kind(kind)
     else:
