@@ -160,6 +160,9 @@ def test_pallas_width_limit_falls_back_to_xla(capsys):
     """Above the pallas body's VMEM width limit the driver must fall back
     to the XLA tier with a visible NOTE (and still pass the eigen gate),
     never crash or silently switch."""
+    # f64 width past the round-3 calibrated live model at the minimum
+    # 8-row block (temps are itemsize-scaled above f32): (4·8·8 +
+    # 44·16)·W > the 15 MiB budget
     rc, out = run_driver(
         capsys, "--mesh", "2,4", "--nx-local", "16", "--ny-local", "23040",
         "--n-steps", "2", "--kernel", "pallas", "--dtype", "float64",
