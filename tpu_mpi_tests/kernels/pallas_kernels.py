@@ -1458,6 +1458,24 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
     ``s``'s unconsumed data. The interpreter serializes devices, so the
     handshake (and the entry barrier) are hardware-only.
 
+    Why the handshake cannot be replaced by double-buffering ``comm_ref``
+    alone (round-2 advisor suggestion, analyzed round 3): a sender's
+    progress is gated by its LEFT neighbor (``rdma.wait`` waits on its
+    own send landing and its own recv arriving — landing, not
+    consumption), so nothing bounds how far a rank can run ahead of its
+    RIGHT neighbor's folds; with two slots, writes ``s`` and ``s+2``
+    share a slot and a 2-step skew clobbers unconsumed data the same
+    way. Safety requires receiver credits; the current scheme is exactly
+    a 1-credit flow (the first send needs none — the slot starts free;
+    each later send waits for the consumer's signal), with balanced
+    accounting (w−2 signals vs w−2 waits per rank). Double-buffering
+    WITH 2 credits would only overlap send ``s+1`` with the consumption
+    of ``s`` — a pod-scale latency optimization that cannot be validated
+    on this one-chip environment (the loopback self-ring serializes the
+    ring and cannot reproduce cross-device races), so it is deliberately
+    not taken; record a multi-chip non-loopback w≥4 run in MULTICHIP
+    evidence when pod hardware is available.
+
     ``loopback`` runs the full ``w``-step schedule with both neighbors
     mapped to this device (the self-ring validation trick): one chip then
     executes every code path — sliced dynamic DMA, remote self-DMA, the
