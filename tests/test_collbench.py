@@ -83,6 +83,26 @@ class TestAttnbench:
             assert f"ATTN {tier} L=128 d=16 float32 " in out
         assert "FAIL" not in out
 
+    def test_ring_stripe_runs_and_requires_causal(self, capsys):
+        from tpu_mpi_tests.drivers import attnbench
+
+        rc = attnbench.main([
+            "--fake-devices", "8", "--seq-len", "128", "--head-dim", "16",
+            "--tiers", "ring", "--n-iter", "20", "--causal", "--stripe",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "ATTN ring[striped] L=128 d=16 float32 " in out
+        assert "FAIL" not in out
+
+        import pytest as _pytest
+
+        with _pytest.raises(SystemExit):
+            attnbench.main([
+                "--fake-devices", "8", "--seq-len", "128", "--head-dim",
+                "16", "--tiers", "ring", "--n-iter", "20", "--stripe",
+            ])
+
     def test_unknown_tier_rejected(self, capsys):
         from tpu_mpi_tests.drivers import attnbench
 

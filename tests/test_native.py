@@ -212,3 +212,27 @@ def test_job_matrix_sweep(tmp_path):
         )
         assert m, (name, tail[1])
         assert float(m.group(1)) >= 0.0
+
+
+def test_vmemprobe_configs_build():
+    """tpu/vmemprobe.py's config table must stay buildable as the fit
+    models evolve (each entry computes a model through the real fit
+    functions; fn=None rows carry the fit's own rejection). The Mosaic
+    bisection itself needs a TPU — this gates the host-side half."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "vmemprobe", REPO / "tpu" / "vmemprobe.py"
+    )
+    vp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(vp)
+    cfgs = vp.configs()
+    assert len(cfgs) >= 10
+    names = [name for name, _, _ in cfgs]
+    assert len(set(names)) == len(names)
+    for name, fn, model in cfgs:
+        if fn is None:
+            continue  # a fit legitimately rejected this shape
+        assert isinstance(model, int) and 0 < model <= 16 * 2**20, (
+            name, model,
+        )

@@ -53,8 +53,8 @@ def run(args) -> int:
                    jsonl_path=args.jsonl)
     rep.banner(
         f"attnbench: L={args.seq_len} d={args.head_dim} tiers={args.tiers} "
-        f"dtype={args.dtype} causal={args.causal} n_iter={args.n_iter} "
-        f"world={world}"
+        f"dtype={args.dtype} causal={args.causal} stripe={args.stripe} "
+        f"n_iter={args.n_iter} world={world}"
     )
 
     L, d = args.seq_len, args.head_dim
@@ -82,13 +82,22 @@ def run(args) -> int:
             check_divisible(L, world, "sequence over mesh axis")
             shape = (L, world, d) if tier == "ulysses" else (L, d)
             q, k, v = (
-                shard_1d(jax.random.normal(kk, shape, dtype), mesh)
+                jax.random.normal(kk, shape, dtype)
                 for kk in jax.random.split(key, 3)
             )
+            if tier == "ring" and args.stripe:
+                # striped causal layout (comm.ring.to_striped): balanced
+                # ring — every rank ~half-live at every step; the chained
+                # output stays in the striped layout, position-consistent
+                # with the next query
+                from tpu_mpi_tests.comm.ring import to_striped
+
+                q, k, v = (to_striped(t, world) for t in (q, k, v))
+            q, k, v = (shard_1d(t, mesh) for t in (q, k, v))
             if tier == "ring":
                 attn = ring_attention_fn(
                     mesh, axis_name, causal=args.causal, flash=True,
-                    precision=prec,
+                    precision=prec, stripe=args.stripe,
                 )
             else:
                 attn = ulysses_attention_fn(
@@ -123,11 +132,13 @@ def run(args) -> int:
         del state
         tflops = flops / sec / 1e12
         heads = world if tier == "ulysses" else 1
+        striped = tier == "ring" and args.stripe
         rep.line(
-            f"ATTN {tier} L={L} d={d} {args.dtype} "
-            f"{tflops * heads:0.1f} TFLOP/s",
+            f"ATTN {tier}{'[striped]' if striped else ''} L={L} d={d} "
+            f"{args.dtype} {tflops * heads:0.1f} TFLOP/s",
             {"kind": "attn", "tier": tier, "L": L, "d": d,
              "dtype": args.dtype, "causal": args.causal,
+             "stripe": striped,
              "tflops": tflops * heads, "us_per_iter": sec * 1e6,
              "world": world},
         )
@@ -145,6 +156,11 @@ def main(argv=None) -> int:
                    help=f"comma list from {','.join(TIERS)}")
     p.add_argument("--causal", action="store_true")
     p.add_argument(
+        "--stripe", action="store_true",
+        help="striped causal layout for the ring tier (balanced: every "
+        "rank ~half-live per step; requires --causal)",
+    )
+    p.add_argument(
         "--fast", action="store_true",
         help="MXU-native (DEFAULT) matmul precision instead of HIGHEST "
         "(the throughput configuration BASELINE.md quotes)",
@@ -156,6 +172,9 @@ def main(argv=None) -> int:
         p.error("--seq-len must be >= 8 and --head-dim >= 1")
     if args.n_iter < 10:
         p.error("--n-iter must be >= 10")
+    if args.stripe and not args.causal:
+        p.error("--stripe requires --causal (non-causal rings are "
+                "already balanced)")
     _common.setup_platform(args)
     return _common.run_guarded(run, args)
 
