@@ -18,12 +18,18 @@ actually wait — see ``tpu_mpi_tests/instrument/timers.py``).
 
 Baseline: the reference publishes no numbers (BASELINE.md); the comparison
 point is the V100 roofline for the same loop at the SAME element width as
-the measurement — (2 reads + 1 write) × 4 B × 8192² bytes/iter over
-~810 GB/s STREAM-class HBM2 bandwidth ≈ 1006 iter/s for f32.
-``vs_baseline`` is measured iter/s over that equal-width point, so the
-ratio is a hardware/kernel comparison, not a dtype-width artifact; the
-reference's native-f64 roofline (503 iter/s) is kept as secondary context
-in BASELINE.md.
+the measurement — (2 reads + 1 write) × itemsize × 8192² bytes/iter over
+~810 GB/s STREAM-class HBM2 bandwidth ≈ 1006 iter/s for f32, 2012 for a
+16-bit element. ``vs_baseline`` is measured iter/s over that equal-width
+point, so the ratio is a hardware/kernel comparison, not a dtype-width
+artifact; the reference's native-f64 roofline (503 iter/s) is kept as
+secondary context in BASELINE.md.
+
+``TPU_MPI_BENCH_DTYPE=bfloat16`` runs the measured-best 16-bit schedule
+(dim-1 single-buffer, temporal blocking k≥2 — at 16-bit, lane packing
+favors the dim-1 kernel and every resident-block variant loses,
+BASELINE.md round-2/3 bf16 findings), against the 2012 iter/s 16-bit
+roofline. Default stays float32.
 """
 
 from __future__ import annotations
@@ -32,7 +38,7 @@ import json
 import os
 import statistics
 
-V100_F32_ITERS_PER_S = 1006.0  # 810e9 / (3 * 4 * 8192**2), equal-width
+V100_HBM_GBPS = 810.0  # STREAM-class HBM2 measured-class bandwidth
 V100_F64_ITERS_PER_S = 503.0  # 810e9 / (3 * 8 * 8192**2), reference dtype
 
 
@@ -52,6 +58,16 @@ def main() -> None:
     # official metric is the 8192 default on real hardware (the baseline
     # constant assumes it)
     n = int(os.environ.get("TPU_MPI_BENCH_N", 8192))
+    dtype_name = os.environ.get("TPU_MPI_BENCH_DTYPE", "float32")
+    if dtype_name not in ("float32", "bfloat16"):
+        raise SystemExit(
+            f"TPU_MPI_BENCH_DTYPE={dtype_name!r} unsupported "
+            "(float32 | bfloat16)"
+        )
+    import jax.numpy as jnp
+
+    dtype = np.dtype(jnp.bfloat16) if dtype_name == "bfloat16" \
+        else np.dtype(np.float32)
     # temporal blocking: k timesteps per HBM pass over deep (k·2-wide)
     # halos — interior-identical to per-step exchange (tested in
     # tests/test_pallas.py::test_iterate_multistep_*); the exchanged volume
@@ -83,7 +99,11 @@ def main() -> None:
     # iter/s against the single-buffer dim-1 kernel in the same
     # contention window (BASELINE.md). TPU_MPI_BENCH_BLOCKS=0 disables
     # (dim-1 schedule).
-    n_blocks = int(os.environ.get("TPU_MPI_BENCH_BLOCKS", 2))
+    # bf16 default: no blocks — the dim-1 single-buffer kernel is the
+    # measured-best 16-bit schedule (explicit TPU_MPI_BENCH_BLOCKS still
+    # overrides for A/B)
+    default_blocks = "0" if dtype_name == "bfloat16" else "2"
+    n_blocks = int(os.environ.get("TPU_MPI_BENCH_BLOCKS", default_blocks))
     use_blocks = (
         topo.platform == "tpu" and steps > 1
         and n_blocks >= 2 and (n // world) % n_blocks == 0
@@ -114,8 +134,8 @@ def main() -> None:
     zg = shard_blocks(
         mesh,
         d.global_ghosted_shape,
-        np.float32,
-        lambda r: d.init_shard(f, r, np.float32),
+        dtype,
+        lambda r: d.init_shard(f, r, dtype),
         axis=bench_dim,
     )
     if use_blocks:
@@ -157,16 +177,21 @@ def main() -> None:
     finite = [s for s in samples if np.isfinite(s)]
     iters_per_s = statistics.median(finite) if finite else float("nan")
 
+    # equal-width V100 roofline for the official 8192² workload: (2 reads
+    # + 1 write) × itemsize — 1006 iter/s f32, 2012 at 16-bit
+    equal_width_baseline = V100_HBM_GBPS * 1e9 / (3 * dtype.itemsize
+                                                  * 8192**2)
     print(
         json.dumps(
             {
                 "metric": "stencil2d_fullstep_8192_iters_per_s",
                 "value": round(iters_per_s, 2),
                 "unit": "iter/s",
-                "vs_baseline": round(iters_per_s / V100_F32_ITERS_PER_S, 3),
+                "vs_baseline": round(iters_per_s / equal_width_baseline, 3),
                 "vs_f64_reference_roofline": round(
                     iters_per_s / V100_F64_ITERS_PER_S, 3
                 ),
+                "dtype": dtype_name,
                 # invalid samples become JSON null, not a bare NaN token
                 # that would break strict parsers
                 "samples": [
@@ -175,8 +200,9 @@ def main() -> None:
                 # which per-iteration schedule actually ran (the blocks
                 # gate can decline a requested TPU_MPI_BENCH_BLOCKS)
                 "schedule": (
-                    f"blocks{n_blocks}_dim0_world{world}" if use_blocks
-                    else f"dim1_world{world}"
+                    f"blocks{n_blocks}_dim0_world{world}_{dtype_name}"
+                    if use_blocks
+                    else f"dim1_world{world}_{dtype_name}"
                 ),
                 "steps": steps,
             }

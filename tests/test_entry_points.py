@@ -55,8 +55,9 @@ def test_bench_prints_one_json_line_smoke():
     lines = [l for l in r.stdout.splitlines() if l.strip()]
     rec = json.loads(lines[-1])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "vs_f64_reference_roofline", "samples",
+                        "vs_f64_reference_roofline", "dtype", "samples",
                         "schedule", "steps"}
+    assert rec["dtype"] == "float32"
     assert rec["value"] > 0
     # the reported value is the median of the recorded (finite) samples;
     # both are independently rounded to 2 dp, so allow half-step slack

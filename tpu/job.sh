@@ -39,7 +39,9 @@ while getopts "w:d:s:p:a:h" opt; do
     p) profs=$OPTARG ;;
     a) avg_pattern=$OPTARG ;;
     h)
-      grep '^#' "$0" | sed 's/^# \{0,1\}//'
+      # header block only (lines 2..first blank): skips the shebang and
+      # any later in-body comments
+      sed -n '2,/^$/p' "$0" | grep '^#' | sed 's/^# \{0,1\}//'
       exit 0
       ;;
     *) exit 1 ;;

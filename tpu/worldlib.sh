@@ -18,7 +18,7 @@ spawn_world() {
   local nprocs=$1
   shift
   local port=$((10000 + RANDOM % 20000))
-  local pids=() rc=0 i pid
+  local pids=() rc=0 st i pid
   for ((i = 0; i < nprocs; i++)); do
     if [ -n "$out_prefix" ]; then
       JAX_COORDINATOR_ADDRESS="localhost:${port}" \
@@ -34,7 +34,9 @@ spawn_world() {
     pids+=($!)
   done
   for pid in "${pids[@]}"; do
-    wait "$pid" || rc=$?
+    # keep the FIRST nonzero exit code (the documented contract); without
+    # the guard a later failing child would overwrite it
+    wait "$pid" || { st=$?; [ "$rc" -ne 0 ] || rc=$st; }
   done
   return "$rc"
 }

@@ -178,12 +178,19 @@ def stream_sum3_pallas(w, x, y, block_rows: int | None = None,
     aliases the output onto ``y`` (same contract and chained-loop
     requirement as ``daxpy_pallas``; defaults off like its siblings so a
     standalone call doesn't force a defensive copy)."""
-    n = x.shape[0]
+    if not (w.shape == x.shape == y.shape and w.dtype == x.dtype == y.dtype):
+        raise ValueError(
+            "stream_sum3_pallas needs w/x/y of identical shape and dtype, "
+            f"got {w.shape}/{w.dtype}, {x.shape}/{x.dtype}, "
+            f"{y.shape}/{y.dtype}"
+        )
+    # n/dtype derived from y, the alias target when inplace=True
+    n = y.shape[0]
     if n % 128 != 0:
         raise ValueError(f"stream_sum3_pallas needs n % 128 == 0, got {n}")
     rows = n // 128
     if block_rows is None:
-        block_rows = _stream_block_rows(jnp.dtype(x.dtype).itemsize, 4)
+        block_rows = _stream_block_rows(jnp.dtype(y.dtype).itemsize, 4)
     block_rows = min(block_rows, rows)
     spec = pl.BlockSpec(
         (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
