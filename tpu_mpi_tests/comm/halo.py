@@ -223,6 +223,7 @@ def halo_exchange(
     n_bnd: int = 2,
     periodic: bool = False,
     staging: Staging | str = Staging.DIRECT,
+    interpret: bool | None = None,
 ):
     """Exchange halos of a ghosted-global sharded array (see arrays/domain.py
     for the layout: each shard holds its ghosted block along ``axis``).
@@ -230,6 +231,11 @@ def halo_exchange(
     Functional and donated: returns the array with interior ghosts filled
     from neighbors; the input buffer may be reused by XLA
     (≅ in-place ghost updates of the reference).
+
+    ``interpret`` applies to the PALLAS_RDMA tier only (bool, or a
+    ``pltpu.InterpretParams`` for the simulated multi-device interpreter —
+    the mode ``tests/test_ring_sync.py`` uses to execute the ring's
+    barrier under race detection).
     """
     staging = Staging.parse(staging)
     axis_name = axis_name or mesh.axis_names[0]
@@ -252,7 +258,7 @@ def halo_exchange(
             f"shape={tuple(zg.shape)})"
         )
         return _exchange_pallas_fn(
-            mesh, axis_name, axis, zg.ndim, n_bnd, periodic
+            mesh, axis_name, axis, zg.ndim, n_bnd, periodic, interpret
         )(zg)
     return _exchange_fn(
         mesh,

@@ -32,10 +32,30 @@ from jax.experimental.pallas import tpu as pltpu
 from tpu_mpi_tests.kernels.stencil import N_BND, STENCIL5
 
 
-def _auto_interpret(interpret: bool | None) -> bool:
+def _auto_interpret(interpret):
+    """Resolve an ``interpret`` argument: ``None`` → interpret off-TPU;
+    a bool or a :class:`pltpu.InterpretParams` passes through unchanged.
+
+    ``InterpretParams`` selects the SIMULATED MULTI-DEVICE interpreter
+    (one thread per simulated device, shared-memory semaphores, simulated
+    remote DMA, optional vector-clock race detection) — unlike the plain
+    ``True`` interpreter, which serializes devices and emulates remote
+    DMA with XLA collectives. The ring kernels keep their hardware
+    synchronization (entry barrier, receiver-backpressure handshake)
+    ENABLED under ``InterpretParams``: those lines then actually execute
+    concurrently, giving CI coverage of the sync logic itself."""
     if interpret is not None:
         return interpret
     return jax.default_backend() != "tpu"
+
+
+def _serial_interpret(interp) -> bool:
+    """True only for the plain bool interpreter (devices serialized,
+    remote signals unimplemented) — the mode in which hardware-style
+    synchronization must be compiled out. False on hardware AND under the
+    threaded :class:`pltpu.InterpretParams` simulator, where the real
+    barrier/handshake path both works and is the point."""
+    return isinstance(interp, bool) and interp
 
 
 # ---------------------------------------------------------------------------
@@ -1162,10 +1182,13 @@ def _ring_edge_kernel(cur_lo_ref, cur_hi_ref, lo_edge_ref, hi_edge_ref,
     (``mpi_stencil_gt.cc:96-107``) — hand back their physical ghosts
     untouched, so the caller writes results back unconditionally.
 
-    ``symmetric=True`` (interpret mode) sends unconditionally, wrap-around
-    included: the interpreter emulates remote DMA with XLA collectives, so a
-    conditional send is a conditional collective — a rendezvous deadlock
-    when edge ranks skip it. The wrapper restores physical ghosts after.
+    ``symmetric=True`` (bool-interpret mode only) sends unconditionally,
+    wrap-around included: that interpreter emulates remote DMA with XLA
+    collectives, so a conditional send is a conditional collective — a
+    rendezvous deadlock when edge ranks skip it. The wrapper restores
+    physical ghosts after. The threaded ``InterpretParams`` simulator has
+    real per-device sends, so it runs the hardware path (conditional
+    sends + barrier) unchanged.
     """
     del cur_lo_ref, cur_hi_ref  # alias donors; their data is already in new_*
     n_dev = jax.lax.axis_size(axis_name)
@@ -1177,9 +1200,10 @@ def _ring_edge_kernel(cur_lo_ref, cur_hi_ref, lo_edge_ref, hi_edge_ref,
     if use_barrier:
         # neighborhood barrier: both neighbors have entered this call, so
         # their output buffers are live and last call's reads are done
-        # (guide pattern; protects chained iterations). Hardware only — the
-        # interpreter serializes devices, so the hazard cannot occur there,
-        # and remote signals are unimplemented in interpret mode.
+        # (guide pattern; protects chained iterations). Compiled out only
+        # under the serializing bool interpreter (remote signals
+        # unimplemented there); the threaded InterpretParams simulator
+        # runs it for real.
         barrier = pltpu.get_barrier_semaphore()
         pltpu.semaphore_signal(barrier, inc=1, device_id=left,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
@@ -1279,6 +1303,7 @@ def ring_halo_pallas(
         )
         return out.reshape(-1)
     interp = _auto_interpret(interpret)
+    serial = _serial_interpret(interp)
     size = z.shape[axis]
     cur_lo = jax.lax.slice_in_dim(z, 0, n_bnd, axis=axis)
     cur_hi = jax.lax.slice_in_dim(z, size - n_bnd, size, axis=axis)
@@ -1292,8 +1317,8 @@ def ring_halo_pallas(
             _ring_edge_kernel,
             axis_name=axis_name,
             periodic=periodic,
-            use_barrier=not interp,
-            symmetric=interp,
+            use_barrier=not serial,
+            symmetric=serial,
         ),
         out_shape=(edge_struct, edge_struct),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 4,
@@ -1311,7 +1336,7 @@ def ring_halo_pallas(
         ),
         interpret=interp,
     )(cur_lo, cur_hi, lo_edge, hi_edge)
-    if interp and not periodic:
+    if serial and not periodic:
         # symmetric interpret mode sent the wrap-around pair too; put the
         # physical ghosts back on the ring-edge ranks
         n_dev = jax.lax.axis_size(axis_name)
@@ -1325,23 +1350,37 @@ def ring_halo_pallas(
 
 
 def _ring_allgather_kernel(x_ref, out_ref, copy_sem, send_sem, recv_sem,
-                           *, axis_name, use_barrier):
+                           *, axis_name, use_barrier, loopback_w=None):
     """Ring all-gather with explicit remote DMA (≅ a hand-written
     ``MPI_Allgather`` over the ring, the device-pointer gather of
     ``mpi_daxpy_nvtx.cc:282-291`` done as w−1 neighbor hops instead of one
     library call). Step ``s`` forwards the out-region received at step
     ``s−1`` (step 0: the own block) straight out of ``out_ref`` to the
     right neighbor's identical region — every region is written by exactly
-    ONE incoming DMA and forwarded only after our own recv wait, so there
-    is no buffer-slot reuse and hence no write-after-read hazard to
-    handshake away (the double-buffered-comm formulation needs receiver
-    backpressure this schedule makes unnecessary). Each step fully waits
-    (send read done + recv landed) before the next, so one send/recv
-    semaphore pair serves all steps."""
-    n_dev = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
-    right = jax.lax.rem(my + 1, jnp.int32(n_dev))
-    left = jax.lax.rem(my - 1 + jnp.int32(n_dev), jnp.int32(n_dev))
+    ONE incoming DMA, so there is no buffer-slot WAR hazard and no
+    backpressure handshake is needed.
+
+    Each step uses its OWN send/recv semaphore pair (``send_sem[s]`` /
+    ``recv_sem[s]`` — the DMA analog of the reference's per-direction MPI
+    tag separation, ``mpi_stencil_gt.cc:96-106``). A single counting pair
+    is NOT enough: nothing bounds how far the left neighbor runs ahead
+    (its progress is gated by ITS left, not by us), so two of its DMAs
+    can be in flight at once and an anonymous ``recv_sem`` wait could be
+    satisfied by the step-``s+1`` arrival — forwarding the step-``s``
+    region while it is still being written. This RAW forwarding hazard is
+    not an analysis artifact: the round-4 simulated multi-device
+    interpreter caught it as a real detected race in the single-pair
+    formulation (``tests/test_ring_sync.py``); per-step semaphores make
+    the step-``s`` read wait on exactly the step-``s`` write."""
+    if loopback_w is not None:
+        n_dev = loopback_w
+        my = jnp.int32(0)
+        right = left = jax.lax.axis_index(axis_name)  # myself
+    else:
+        n_dev = jax.lax.axis_size(axis_name)
+        my = jax.lax.axis_index(axis_name)
+        right = jax.lax.rem(my + 1, jnp.int32(n_dev))
+        left = jax.lax.rem(my - 1 + jnp.int32(n_dev), jnp.int32(n_dev))
     n = x_ref.shape[0]
 
     if use_barrier:
@@ -1352,11 +1391,23 @@ def _ring_allgather_kernel(x_ref, out_ref, copy_sem, send_sem, recv_sem,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(barrier, 2)
 
-    own = pltpu.make_async_copy(
-        x_ref, out_ref.at[pl.ds(my * n, n)], copy_sem
-    )
-    own.start()
-    own.wait()
+    if loopback_w is not None:
+        # seed EVERY region with the shard so the self-forwarding loop
+        # below moves defined data and the result is checkable
+        # (out == tile(x, w)); real hardware then executes every per-step
+        # semaphore index and sliced self-DMA of the w-step schedule
+        for i in range(n_dev):
+            seed = pltpu.make_async_copy(
+                x_ref, out_ref.at[pl.ds(i * n, n)], copy_sem
+            )
+            seed.start()
+            seed.wait()
+    else:
+        own = pltpu.make_async_copy(
+            x_ref, out_ref.at[pl.ds(my * n, n)], copy_sem
+        )
+        own.start()
+        own.wait()
 
     for step in range(n_dev - 1):
         # region forwarded this step: own block at step 0, then whatever
@@ -1368,8 +1419,8 @@ def _ring_allgather_kernel(x_ref, out_ref, copy_sem, send_sem, recv_sem,
         rdma = pltpu.make_async_remote_copy(
             src_ref=out_ref.at[pl.ds(src * n, n)],
             dst_ref=out_ref.at[pl.ds(src * n, n)],
-            send_sem=send_sem,
-            recv_sem=recv_sem,
+            send_sem=send_sem.at[step],
+            recv_sem=recv_sem.at[step],
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
@@ -1383,6 +1434,7 @@ def ring_allgather_pallas(
     axis_name: str,
     collective_id: int = 9,
     interpret: bool | None = None,
+    self_ring: int | None = None,
 ):
     """Per-shard ring all-gather along axis 0 with explicit inter-chip RDMA
     — the hand-tuned twin of ``lax.all_gather(tiled=True)`` for the
@@ -1397,6 +1449,12 @@ def ring_allgather_pallas(
     128-lane rows (Mosaic sliced DMA needs full lane tiles — a (n, 1) view
     compiles nowhere but interpret mode), so they need
     n ≡ 0 mod 128·sublane (1024 f32, 2048 bf16).
+
+    ``self_ring=k`` (single-device validation mode, the reduce-scatter's
+    twin): run the full ``k``-step forwarding schedule with both neighbors
+    mapped to this device, every region pre-seeded with the shard — the
+    result is ``tile(x, k)``, so one real chip Mosaic-compiles and checks
+    every per-step semaphore pair and sliced self-DMA of the ring.
     """
     sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
     if x.ndim == 1:
@@ -1412,6 +1470,7 @@ def ring_allgather_pallas(
             axis_name=axis_name,
             collective_id=collective_id,
             interpret=interpret,
+            self_ring=self_ring,
         ).reshape(-1)
     n = x.shape[0]
     if n % sublane != 0:
@@ -1421,20 +1480,31 @@ def ring_allgather_pallas(
         )
     interp = _auto_interpret(interpret)
     n_dev = jax.lax.axis_size(axis_name)
+    if self_ring is not None:
+        if n_dev != 1 or self_ring < 2:
+            raise ValueError(
+                f"self_ring={self_ring} is a single-device validation mode "
+                f"(needs axis size 1 and self_ring >= 2, got w={n_dev})"
+            )
+        n_dev = self_ring
     out_struct = jax.ShapeDtypeStruct((n_dev * n, *x.shape[1:]), x.dtype)
     return pl.pallas_call(
         functools.partial(
             _ring_allgather_kernel,
             axis_name=axis_name,
-            use_barrier=not interp,
+            use_barrier=not _serial_interpret(interp),
+            loopback_w=self_ring,
         ),
         out_shape=out_struct,
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
             pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            # per-step send/recv pairs (≅ per-step MPI tags): see the
+            # kernel docstring for the RAW forwarding hazard a single
+            # counting pair reintroduces
+            pltpu.SemaphoreType.DMA((max(1, n_dev - 1),)),
+            pltpu.SemaphoreType.DMA((max(1, n_dev - 1),)),
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id
@@ -1459,11 +1529,17 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
     directly) into the next step's send buffer — or, at the last step,
     into the owned output chunk.
 
-    All remote writes land in the single-slot ``comm_ref``; on hardware a
+    All remote writes land in the single-slot ``comm_ref``; a
     receiver-backpressure handshake (``ready_sem``, remote-signaled by the
     consumer) keeps step ``s+1``'s incoming DMA from overrunning step
-    ``s``'s unconsumed data. The interpreter serializes devices, so the
-    handshake (and the entry barrier) are hardware-only.
+    ``s``'s unconsumed data. The plain bool interpreter serializes devices
+    and cannot run it; on hardware and under the simulated multi-device
+    interpreter (``pltpu.InterpretParams``: per-device threads, simulated
+    remote DMA) the handshake and the entry barrier are enabled and
+    EXECUTED — ``tests/test_ring_sync.py`` runs them at non-loopback
+    w ∈ {4, 8} with vector-clock race detection on, including the
+    negative control (handshake disabled ⇒ the comm-slot WAW/RAW race is
+    detected; enabled ⇒ race-free and exact).
 
     Why the handshake cannot be replaced by double-buffering ``comm_ref``
     alone (round-2 advisor suggestion, analyzed round 3): a sender's
@@ -1477,11 +1553,15 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
     each later send waits for the consumer's signal), with balanced
     accounting (w−2 signals vs w−2 waits per rank). Double-buffering
     WITH 2 credits would only overlap send ``s+1`` with the consumption
-    of ``s`` — a pod-scale latency optimization that cannot be validated
-    on this one-chip environment (the loopback self-ring serializes the
-    ring and cannot reproduce cross-device races), so it is deliberately
-    not taken; record a multi-chip non-loopback w≥4 run in MULTICHIP
-    evidence when pod hardware is available.
+    of ``s`` — a pod-scale latency optimization whose WALL-CLOCK benefit
+    cannot be measured on this one-chip environment (the loopback
+    self-ring serializes the ring), so it is deliberately not taken. The
+    CORRECTNESS of the 1-credit scheme, however, is no longer
+    analysis-only: the simulated multi-device interpreter executes it
+    under real thread concurrency with race detection (round 4,
+    ``tests/test_ring_sync.py``); record a multi-chip non-loopback w≥4
+    wall-clock run in MULTICHIP evidence when pod hardware is
+    available.
 
     ``loopback`` runs the full ``w``-step schedule with both neighbors
     mapped to this device (the self-ring validation trick): one chip then
@@ -1571,6 +1651,7 @@ def ring_reduce_scatter_pallas(
     interpret: bool | None = None,
     tile_rows: int | None = None,
     self_ring: int | None = None,
+    unsafe_no_handshake: bool = False,
 ):
     """Per-shard ring reduce-scatter along axis 0 with explicit inter-chip
     RDMA; rank ``r`` returns chunk ``r`` of the elementwise sum (shape
@@ -1583,7 +1664,13 @@ def ring_reduce_scatter_pallas(
     self-ring the halo benchmarks use): run the full ``k``-step schedule
     with all neighbors mapped to this one device, returning the sum of the
     shard's own ``k`` chunks — so real hardware exercises every loop-body
-    code path without a multi-chip slice."""
+    code path without a multi-chip slice.
+
+    ``unsafe_no_handshake=True`` disables the receiver-backpressure
+    handshake. TESTING ONLY: it exists so the race-detection negative
+    control (``tests/test_ring_sync.py``) can prove the simulated
+    multi-device interpreter actually sees the comm-slot hazard the
+    handshake closes; running it on hardware would be a data race."""
     sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
     w = jax.lax.axis_size(axis_name)
     if self_ring is not None:
@@ -1654,8 +1741,10 @@ def ring_reduce_scatter_pallas(
             axis_name=axis_name,
             w=w,
             tile_rows=tile_rows,
-            use_barrier=not interp,
-            use_handshake=not interp,
+            use_barrier=not _serial_interpret(interp),
+            use_handshake=(
+                not _serial_interpret(interp) and not unsafe_no_handshake
+            ),
             loopback=self_ring is not None,
         ),
         out_shape=(chunk, chunk, chunk),
