@@ -557,9 +557,11 @@ def test_ring_reduce_scatter_self_ring():
 
 def test_ring_allgather_self_ring():
     """self_ring=k on one device: every region pre-seeded then forwarded
-    through the full k-step schedule → tile(x, k). The mode that lets one
-    real chip Mosaic-compile the per-step send/recv semaphore pairs
-    (round-4 race fix) and sliced self-DMAs."""
+    through the full k-step schedule → tile(x, k). A Mosaic
+    compile/execute smoke for the per-step send/recv semaphore pairs
+    (round-4 race fix) and sliced self-DMAs — the loopback value result
+    is identity by construction (each DMA is region → same region), so
+    data-path coverage at w>1 is test_ring_sync.py's job."""
     import functools
 
     import jax

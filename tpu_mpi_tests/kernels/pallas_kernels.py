@@ -1393,9 +1393,15 @@ def _ring_allgather_kernel(x_ref, out_ref, copy_sem, send_sem, recv_sem,
 
     if loopback_w is not None:
         # seed EVERY region with the shard so the self-forwarding loop
-        # below moves defined data and the result is checkable
-        # (out == tile(x, w)); real hardware then executes every per-step
-        # semaphore index and sliced self-DMA of the w-step schedule
+        # below moves defined data (final out == tile(x, w)); real
+        # hardware then executes every per-step semaphore index and
+        # sliced self-DMA of the w-step schedule. NOTE: because each
+        # loopback DMA is region -> same region on this device, the
+        # value result is identity BY CONSTRUCTION - the mode is a
+        # Mosaic compile/execute smoke (alignment errors, bad semaphore
+        # shapes, hangs; the class the round-2 hardware audit caught),
+        # not a data-path check. Data-path coverage at w>1 lives in the
+        # simulated multi-device tests (tests/test_ring_sync.py).
         for i in range(n_dev):
             seed = pltpu.make_async_copy(
                 x_ref, out_ref.at[pl.ds(i * n, n)], copy_sem
@@ -1452,9 +1458,14 @@ def ring_allgather_pallas(
 
     ``self_ring=k`` (single-device validation mode, the reduce-scatter's
     twin): run the full ``k``-step forwarding schedule with both neighbors
-    mapped to this device, every region pre-seeded with the shard — the
-    result is ``tile(x, k)``, so one real chip Mosaic-compiles and checks
-    every per-step semaphore pair and sliced self-DMA of the ring.
+    mapped to this device, every region pre-seeded with the shard; the
+    result is ``tile(x, k)``. Unlike the reduce-scatter's loopback (whose
+    sum is data-dependent), each self-DMA here is region → same region,
+    so the value result is identity by construction — the mode is a
+    Mosaic COMPILE/EXECUTE smoke for the per-step semaphore pairs and
+    sliced DMAs on real hardware (compile failures, alignment errors,
+    hangs), not a data-path check; that lives in
+    ``tests/test_ring_sync.py``'s simulated multi-device runs.
     """
     sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
     if x.ndim == 1:
