@@ -4,7 +4,7 @@
 Usage (from /root/repo):
     python tpu/microbench.py [daxpy] [stencil] [iterate] [splitfused]
                              [ceiling] [attention] [heat] [blocks] [causal]
-                             [streams]
+                             [streams] [vpu]
 
 Runs the selected groups (default: all) on whatever backend is active and
 prints one JSON line per measurement plus a summary table. Timing uses the
@@ -636,6 +636,124 @@ def bench_heat(results):
             del z
 
 
+def bench_vpu(results):
+    """VPU compute roofline for the k-step kernel (round 4, VERDICT r3
+    next #3). Two measurements whose ratio answers "is 2600 iter/s
+    parked or slow":
+
+    1. in-VMEM op-rate probes (``vpu_probe_pallas``): per-rep cost of a
+       pure fma mix and the EXACT step5 kernel body (both axes) on a
+       (512, 512) f32 resident block, from a 3-point linear fit over
+       per-mix reps triples (with a reported linearity check) — launch
+       overhead and the two HBM passes live in the intercept, leaving
+       the attainable VPU element rate for this op mix;
+    2. the S=2 resident-block schedule's marginal per-step cost: fit
+       t(k) = a + b·k over k ∈ {2,4,6,8} at 8192² — b is what one more
+       timestep really costs with HBM amortized.
+
+    The kernel's per-element step time (b / 8192²) over the probe's
+    per-element rep time is the fraction of the VPU ceiling the headline
+    reaches; the fma/step5 ratio separately prices the shifts + concat.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_mpi_tests.comm.halo import (
+        iterate_pallas_blocks_fn,
+        split_blocks,
+    )
+    from tpu_mpi_tests.instrument.timers import block, chain_rate
+    from tpu_mpi_tests.kernels import pallas_kernels as PK
+    from tpu_mpi_tests.kernels.stencil import N_BND
+
+    H = W = 512
+    elems = H * W
+    z0 = np.random.default_rng(0).normal(size=(H, W)).astype(np.float32)
+
+    import functools
+
+    def probe_per_call(mix, reps, iters=400):
+        @functools.partial(jax.jit, donate_argnums=0,
+                           static_argnames=("reps",))
+        def run(z, n_iter, reps):
+            def body(_, cur):
+                return PK.vpu_probe_pallas(cur, reps, mix)
+
+            return lax.fori_loop(0, jnp.asarray(n_iter, jnp.int32), body, z)
+
+        z = jnp.asarray(z0)
+        z = block(run(z, 1, reps=reps))
+        per, _ = chain_rate(
+            lambda zz, n_it: run(zz, n_it, reps=reps), z,
+            n_short=iters // 10, n_long=iters,
+        )
+        return per
+
+    # (nominal ops/elt, reps triple): rep counts sized so the per-rep
+    # cost differences are hundreds of us — far above the shared chip's
+    # contention noise (the first cut used 64/320 everywhere and the fma
+    # delta was ~10 us: it measured noise, NaN rates)
+    PROBES = {
+        "fma": (2, (512, 2048, 8192)),
+        "step5_d0": (7, (256, 1024, 4096)),
+        "step5_d1": (7, (64, 256, 1024)),
+    }
+    probe_rate = {}
+    for mix, (ops, reps3) in PROBES.items():
+        ts = np.array([probe_per_call(mix, r) for r in reps3])
+        rarr = np.array(reps3, np.float64)
+        per_rep, off = np.polyfit(rarr, ts, 1)
+        # linearity gate: the middle point must sit on the 2-point line
+        # through the ends, else the fit is contention-window garbage
+        mid_pred = ts[0] + (ts[2] - ts[0]) * (rarr[1] - rarr[0]) / (
+            rarr[2] - rarr[0]
+        )
+        lin = ts[1] / mid_pred
+        probe_rate[mix] = elems / per_rep  # element-steps / s
+        _emit(results, f"vpu_{mix}_gops", elems * ops / per_rep / 1e9,
+              "Gop/s",
+              f"{H}x{W} f32 resident; {per_rep / elems * 1e12:.2f} "
+              f"ps/elt/rep; nominal {ops} ops/elt; reps={reps3}; "
+              f"linearity {lin:.3f}")
+
+    # the real schedule's marginal per-step cost (same geometry as the
+    # headline: S=2 resident blocks, 8192^2 f32)
+    n, S = 8192, 2
+    ks = (2, 4, 6, 8)
+    t_call = {}
+    for k in ks:
+        K = N_BND * k
+        zf = np.random.default_rng(1).normal(
+            size=(n + 2 * K, n)
+        ).astype(np.float32) / 10
+        run = iterate_pallas_blocks_fn(S, K, 1e-4, steps=k)
+        st = split_blocks(jnp.asarray(zf), S, K)
+        st = block(run(st, 1))
+        sec, st = chain_rate(
+            run, st, n_short=max(5, 50 // k), n_long=max(50, 2000 // k)
+        )
+        t_call[k] = sec
+        _emit(results, f"vpu_kstep_S{S}_k{k}_iters_per_s", k / sec,
+              "iter/s", f"{n}x{n} f32 resident blocks")
+        del st
+
+    karr = np.array(ks, np.float64)
+    tarr = np.array([t_call[k] for k in ks])
+    b, a = np.polyfit(karr, tarr, 1)
+    kernel_rate = n * n / b  # element-steps / s
+    frac = kernel_rate / probe_rate["step5_d0"]
+    _emit(results, "vpu_kstep_marginal_us", b * 1e6, "us/step",
+          f"fit t(k)=a+b*k over k={ks}; a={a * 1e6:.0f} us; "
+          f"implied plateau {1.0 / b:.0f} iter/s")
+    _emit(results, "vpu_kstep_vs_probe_ceiling", frac, "ratio",
+          "kernel element rate / step5_d0 in-VMEM probe rate "
+          "(1.0 = the schedule reaches the measured VPU ceiling "
+          "for its own op mix)")
+
+
 GROUPS = {
     "daxpy": bench_daxpy,
     "stencil": bench_stencil,
@@ -647,6 +765,7 @@ GROUPS = {
     "blocks": bench_blocks,
     "causal": bench_causal,
     "streams": bench_streams,
+    "vpu": bench_vpu,
 }
 
 

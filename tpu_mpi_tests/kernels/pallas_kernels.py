@@ -227,6 +227,83 @@ def stream_sum3_pallas(w, x, y, block_rows: int | None = None,
     return out.reshape(n)
 
 
+def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
+    z = z_ref[:]
+
+    if mix == "fma":
+        # 2 nominal VPU ops/elt/rep (mul + add; one op if the hardware
+        # fuses) — the dependent chain pipelines across the block's rows,
+        # so this measures elementwise THROUGHPUT, not ALU latency
+        def body(_, z):
+            return jnp.float32(1.0000001) * z + jnp.float32(1e-12)
+    else:
+        # the EXACT k-step kernel body (_step5 + band concat) applied to
+        # the resident block: 7 nominal ops/elt/rep (2 sub + 2 mul + 1
+        # add derivative, + mul + add update) plus whatever the shifts
+        # and the concat stitching really cost — that difference vs the
+        # fma mix is the point of the probe
+        axis = 0 if mix == "step5_d0" else 1
+        N = z.shape[axis]
+        se = jnp.float32(se)
+
+        def body(_, z):
+            upd = _step5(z, N_BND, N - 2 * N_BND, axis, se)
+            return jnp.concatenate(
+                [
+                    jax.lax.slice_in_dim(z, 0, N_BND, axis=axis),
+                    upd,
+                    jax.lax.slice_in_dim(z, N - N_BND, N, axis=axis),
+                ],
+                axis=axis,
+            )
+
+    out_ref[:] = jax.lax.fori_loop(0, reps, body, z)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reps", "mix", "se", "interpret")
+)
+def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
+                     interpret: bool | None = None):
+    """In-VMEM vector-op rate probe (round 4, VERDICT r3 next #3): load
+    one block into VMEM, apply ``reps`` repetitions of an op mix with NO
+    intermediate HBM traffic, write back. Differencing two ``reps``
+    values cancels the launch overhead and the two HBM passes, leaving
+    the pure per-rep VPU cost — the compute-axis twin of the
+    stream-count family's bandwidth fit (``tpu/microbench.py streams``).
+
+    Mixes: ``fma`` (elementwise a·z + b, 2 nominal ops/elt) and
+    ``step5_d0``/``step5_d1`` (the k-step stencil kernel's actual
+    per-step body on the resident block: 7 nominal ops/elt plus
+    sublane/lane shifts and the band concat). The ratio of the step5
+    rates to the fma rate prices the shifts; the step5_d0 rate is the
+    VPU ceiling the resident-block headline schedule can approach.
+
+    ``z`` must be small enough to keep ~4 block-sized live buffers under
+    the VMEM budget ((512, 512) f32 = 1 MB blocks in practice). The
+    output aliases ``z`` so the probe chains. ``se`` is the step5 update
+    scale: the 1e-9 default keeps kilorep chains numerically inert for
+    timing; tests pass a visible value so the arithmetic is checkable
+    (at 1e-9 the update underflows f32 against O(100) fields)."""
+    total = int(np.prod(z.shape)) * jnp.dtype(z.dtype).itemsize
+    if 4 * total > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"vpu_probe_pallas: block {z.shape} needs ~4x"
+            f"{total} B live in VMEM, over the "
+            f"{_VMEM_BUDGET_BYTES // 2**20} MB budget"
+        )
+    if mix not in ("fma", "step5_d0", "step5_d1"):
+        raise ValueError(f"unknown mix {mix!r}")
+    return pl.pallas_call(
+        functools.partial(_vpu_probe_kernel, reps=reps, mix=mix, se=se),
+        out_shape=jax.ShapeDtypeStruct(z.shape, z.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        input_output_aliases={0: 0},
+        interpret=_auto_interpret(interpret),
+    )(z)
+
+
 # ---------------------------------------------------------------------------
 # 2-D array, 1-D 5-point stencil with explicit halo DMA
 # ---------------------------------------------------------------------------
