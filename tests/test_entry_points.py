@@ -47,7 +47,7 @@ def test_bench_prints_one_json_line_smoke():
             "TPU_MPI_BENCH_ITERS_LONG": "1050",
             "TPU_MPI_BENCH_FAKE_DEVICES": "4",
             # 2 samples: covers the samples-list schema + median bound at
-            # two-thirds the cost of the real-run default of 3
+            # a fraction of the real-run default of 5
             "TPU_MPI_BENCH_SAMPLES": "2",
         },
     )

@@ -6,7 +6,8 @@
 # command reproduces the reference's whole result matrix.
 #
 # Usage: ./job.sh [-w "1 2"] [-d "mpi_daxpy_nvtx"] [-s "device managed"]
-#                 [-p "none xprof"] [-a PATTERN] [-- driver args...]
+#                 [-p "none xprof"] [-a PATTERN]
+#                 [-x "driver=args ..."] [-- driver args...]
 #   -w  world sizes (space-separated). 1 runs on the active backend (one
 #       real chip, or the CPU fake-device mesh the driver args select);
 #       N>1 spawns N localhost processes with 1 fake CPU device each in a
@@ -18,7 +19,12 @@
 #       per rank — the %q{PMIX_RANK} analog)
 #   -a  avg.py pattern for the final summary (default: gather, the
 #       reference's avg.sh default)
-# Extra args after -- go to every driver cell verbatim.
+#   -x  per-driver extra args, "driver=args..." (repeatable) — the
+#       analog of job.lsf's per-binary invocation lines; e.g.
+#       -x "stencil2d=--n-iter 30" sizes one driver's cells without
+#       touching the others
+# Extra args after -- go to every driver cell verbatim (all drivers
+# must accept them).
 #
 # Output: out-<space>_<prof>_<driver>_<host>[_rN].txt per cell (rank) in
 # the CWD, then the aggregated table on stdout.
@@ -30,14 +36,23 @@ drivers="mpi_daxpy_nvtx"
 spaces="device"
 profs="none"
 avg_pattern="gather"
+declare -A driver_extra=()
 
-while getopts "w:d:s:p:a:h" opt; do
+while getopts "w:d:s:p:a:x:h" opt; do
   case "$opt" in
     w) worlds=$OPTARG ;;
     d) drivers=$OPTARG ;;
     s) spaces=$OPTARG ;;
     p) profs=$OPTARG ;;
     a) avg_pattern=$OPTARG ;;
+    x)
+      key=${OPTARG%%=*}
+      if [ "$key" == "$OPTARG" ] || [ -z "$key" ]; then
+        echo "-x needs driver=args, got: $OPTARG" >&2
+        exit 1
+      fi
+      driver_extra[$key]=${OPTARG#*=}
+      ;;
     h)
       # header block only (lines 2..first blank): skips the shebang and
       # any later in-body comments
@@ -53,19 +68,36 @@ tpu_dir=$(cd "$(dirname "$0")" && pwd)
 run_sh=$tpu_dir/run.sh
 . "$tpu_dir/worldlib.sh"
 
+# -x keys must name drivers that will actually run, or a typo silently
+# produces a default-sized sweep read as if the extras applied
+for key in "${!driver_extra[@]}"; do
+  case " $drivers " in
+    *" $key "*) ;;
+    *)
+      echo "-x driver '$key' not in -d list ($drivers)" >&2
+      exit 1
+      ;;
+  esac
+done
+
 for w in $worlds; do
   for driver in $drivers; do
     for space in $spaces; do
       for prof in $profs; do
         echo "== cell: world=${w} driver=${driver} space=${space}" \
           "prof=${prof}" >&2
+        # split the per-driver extras into words WITHOUT pathname
+        # expansion (read -ra does not glob; a bare $var would expand
+        # patterns against the out-*.txt files this very script writes)
+        read -ra cell_extra <<< "${driver_extra[$driver]:-}"
         if [ "$w" -eq 1 ]; then
-          "$run_sh" "$space" "$prof" "$driver" "$@"
+          "$run_sh" "$space" "$prof" "$driver" \
+            ${cell_extra[@]+"${cell_extra[@]}"} "$@"
         else
           # run.sh names each rank's own out-<tag>.txt (world+rank in
           # the tag), so no -o redirection here
           if ! spawn_world "$w" "$run_sh" "$space" "$prof" "$driver" \
-            --fake-devices 1 "$@"; then
+            --fake-devices 1 ${cell_extra[@]+"${cell_extra[@]}"} "$@"; then
             echo "cell failed" >&2
             exit 1
           fi
