@@ -19,10 +19,12 @@
 #       per rank — the %q{PMIX_RANK} analog)
 #   -a  avg.py pattern for the final summary (default: gather, the
 #       reference's avg.sh default)
-#   -x  per-driver extra args, "driver=args..." (repeatable) — the
-#       analog of job.lsf's per-binary invocation lines; e.g.
-#       -x "stencil2d=--n-iter 30" sizes one driver's cells without
-#       touching the others
+#   -x  per-driver extra args, "driver=args..." (repeatable; repeats
+#       for one driver append) — the analog of job.lsf's per-binary
+#       invocation lines; e.g. -x "stencil2d=--n-iter 30" sizes one
+#       driver's cells without touching the others. Args are split on
+#       whitespace with no quote parsing: values containing spaces
+#       cannot be passed through -x
 # Extra args after -- go to every driver cell verbatim (all drivers
 # must accept them).
 #
@@ -51,7 +53,9 @@ while getopts "w:d:s:p:a:x:h" opt; do
         echo "-x needs driver=args, got: $OPTARG" >&2
         exit 1
       fi
-      driver_extra[$key]=${OPTARG#*=}
+      # repeats for the same driver APPEND (the help text advertises
+      # -x as repeatable; silent overwrite would drop earlier sizing)
+      driver_extra[$key]="${driver_extra[$key]:-} ${OPTARG#*=}"
       ;;
     h)
       # header block only (lines 2..first blank): skips the shebang and

@@ -462,6 +462,7 @@ def iterate_pallas_fn(
     periodic: bool = False,
     rdma: bool = False,
     stream: bool | None = None,
+    tile: int = 64,
 ):
     """Like :func:`iterate_fused_fn` but with the hand-written in-place
     Pallas step (2 HBM passes/iter vs XLA's ~6). ``axis=1`` (default) puts
@@ -554,6 +555,7 @@ def iterate_pallas_fn(
                     interpret=interpret,
                     steps=steps,
                     stream=stream,
+                    tile=tile,
                     **phys_kw,
                 )
 

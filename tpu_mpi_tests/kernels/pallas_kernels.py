@@ -356,6 +356,38 @@ def _strip_rows_bytes(extent: int, itemsize: int) -> int:
     return extent * (4 * itemsize + max(12, 3 * itemsize))
 
 
+def _d1_strip_rows_bytes(ny: int, itemsize: int) -> int:
+    """Dim-1 k-step strip live bytes per row: bf16 has its own measured
+    coefficient (17.91 B/elt probed at strip 88 ·1.05 margin — the
+    shared `_strip_rows_bytes` bf16 value must stay ≥ the d0 kernel's
+    19.53 and left d1 at 1.11 conservative); other dtypes share the
+    common model."""
+    if itemsize == 2:
+        return int(ny * 18.8)
+    return _strip_rows_bytes(ny, itemsize)
+
+
+def _kstep_d1_strip(nx: int, ny: int, itemsize: int, tile: int) -> int:
+    """Dim-1 strip for the k-step iterate: the largest 8-multiple ≤
+    ``tile`` that fits the calibrated budget, computed DIRECTLY (the
+    halving fit could not land between power-of-2 steps; the direct
+    fit makes the cap honest. The calibrated bf16 budget admits 96-row
+    strips, but the round-4 interleaved re-sweep measured 64/88/96 FLAT
+    within contention noise (±3%, 64 marginally ahead), so the
+    production tile cap stays 64 and wider strips remain an explicit
+    ``tile=`` opt-in; f32's budget-max is 68 → 64 either way)."""
+    rows_bytes = _d1_strip_rows_bytes(ny, itemsize)
+    budget_max = (_VMEM_BUDGET_CAL // rows_bytes) // 8 * 8
+    tile = max(8, tile // 8 * 8)  # keep the documented 8-multiple contract
+    strip = min(min(tile, nx), max(8, budget_max))
+    if strip * rows_bytes > _VMEM_BUDGET_CAL:
+        raise ValueError(
+            f"stencil2d iterate dim-1: even an 8-row strip of width {ny} "
+            f"exceeds the VMEM budget; use the XLA stencil"
+        )
+    return strip
+
+
 def _fit_strip(tile: int, extent: int, rows_bytes: int, min_strip: int,
                budget: int = _VMEM_BUDGET_BYTES) -> int:
     """Largest strip ≤ tile fitting the VMEM ``budget``. ``rows_bytes``
@@ -717,28 +749,54 @@ def _iterate_stream0_kernel(z_ref, top_ref, bot_ref, scale_eps_ref, *rest,
     out_ref[:] = jax.lax.slice_in_dim(window, K, K + B, axis=0)
 
 
-def _stream_live_bytes(B: int, halo: int, width: int, itemsize: int) -> int:
+# Measured bf16 per-window-element temp coefficients (vmemprobe round-4
+# bisections + ~5% safety): the k-step iterate streamer's Mosaic temps
+# cost 17.51 B/elt at bf16, the heat Laplacian streamer's 14.57 — both
+# well under the f32-sized 22 the round-3 model charged (model/actual
+# 1.18/1.34, a third of the budget wasted exactly where window width
+# sets streaming throughput). Kernels WITHOUT a vmemprobe config keep
+# the conservative default.
+_BF16_TEMPS_DEFAULT = 22.0
+_BF16_TEMPS_ITER_STREAM = 18.4   # 17.51 measured · 1.05
+_BF16_TEMPS_HEAT = 15.3          # 14.57 measured · 1.05
+# heat's measured-best bf16 row block (interleaved A/B, 4096² k=4: 128
+# ~7% over the budget-admitted 256) — shared with tpu/vmemprobe.py so
+# the probe always validates the geometry production runs
+_BF16_HEAT_ROW_CLAMP = 128
+
+
+def _stream_live_bytes(B: int, halo: int, width: int, itemsize: int,
+                       bf16_temps: float = _BF16_TEMPS_DEFAULT) -> int:
     """The row-streaming kernels' shared VMEM live-set model, calibrated
-    against Mosaic's actual high-water marks (tpu/vmemprobe.py bisection,
-    round 3): double-buffered I/O blocks at the array dtype plus ~5.5
-    per-window-element temps that are F32-SIZED for narrow dtypes (they
-    do not shrink with the dtype — the round-2 ``8 × window × itemsize``
-    form under-counted bf16 by ~1.6×) and itemsize-scaled above f32
-    (wider dtypes are unmeasured; take the conservative max). Measured
-    model/actual: iterate-stream f32 1.05, bf16 1.18; heat f32 1.03,
-    bf16 1.34."""
-    temps = max(22, 11 * itemsize // 2)
-    return 4 * itemsize * B * width + temps * (B + 2 * halo) * width
+    against Mosaic's actual high-water marks (tpu/vmemprobe.py
+    bisection): double-buffered I/O blocks at the array dtype plus
+    per-window-element temps. Temps are F32-SIZED for narrow dtypes by
+    default (they do not shrink with the dtype — the round-2
+    ``8 × window × itemsize`` form under-counted bf16 by ~1.6×) and
+    itemsize-scaled above f32 (wider dtypes are unmeasured; take the
+    conservative max); kernels with a round-4 vmemprobe calibration pass
+    their measured bf16 coefficient via ``bf16_temps`` (f32 stays at 22
+    vs 20.2–20.8 measured — already within 5–8%). Measured model/actual
+    after calibration: iterate-stream bf16 1.05, heat bf16 1.05 (was
+    1.18/1.34)."""
+    if itemsize == 2:
+        temps = bf16_temps
+    else:
+        temps = max(22, 11 * itemsize // 2)
+    return int(4 * itemsize * B * width
+               + temps * (B + 2 * halo) * width)
 
 
-def _fit_block_rows(width: int, halo: int, itemsize: int, sub: int) -> int:
+def _fit_block_rows(width: int, halo: int, itemsize: int, sub: int,
+                    bf16_temps: float = _BF16_TEMPS_DEFAULT) -> int:
     """Largest sublane-multiple row block ≤ 256 whose live set fits VMEM
     (floor: one sublane tile). B starts at 256: the 8192² k=4 sweep
     measured 128–256-row blocks fastest (2090–2180 iter/s) and 512
     slowest — small blocks keep the pipeline deep without starving the
     VPU."""
     B = 256
-    while B > sub and _stream_live_bytes(B, halo, width, itemsize) > \
+    while B > sub and _stream_live_bytes(B, halo, width, itemsize,
+                                         bf16_temps) > \
             _VMEM_BUDGET_CAL:
         B = max(sub, (B // 2) // sub * sub)
     return B
@@ -754,15 +812,17 @@ def _validate_tile_rows(tile_rows: int, sub: int,
 
 
 def _stream_fit(z, halo: int, kernel_name: str,
-                tile_rows: "int | None") -> int:
+                tile_rows: "int | None",
+                bf16_temps: float = _BF16_TEMPS_DEFAULT) -> int:
     """Shared full-width streaming preamble: fitted row block ``B`` (with
     the VMEM-budget raise callers' fallbacks match on) and the optional
     test-hook clamp."""
     width = z.shape[1]
     itemsize = jnp.dtype(z.dtype).itemsize
     sub = max(8, 8 * 4 // itemsize)
-    B = _fit_block_rows(width, halo, itemsize, sub)
-    if _stream_live_bytes(B, halo, width, itemsize) > _VMEM_BUDGET_CAL:
+    B = _fit_block_rows(width, halo, itemsize, sub, bf16_temps)
+    if _stream_live_bytes(B, halo, width, itemsize,
+                          bf16_temps) > _VMEM_BUDGET_CAL:
         raise ValueError(
             f"{kernel_name}: width {width} exceeds the VMEM budget even "
             f"at {B}-row blocks; use the XLA tier"
@@ -774,17 +834,20 @@ def _stream_fit(z, halo: int, kernel_name: str,
 
 
 def _fit_stream0_blocks(ny: int, K: int, itemsize: int, sub: int,
-                        label: str = "stencil2d streaming dim-0"):
+                        label: str = "stencil2d streaming dim-0",
+                        bf16_temps: float = _BF16_TEMPS_DEFAULT):
     """(B, P) for the streaming stencil kernels (shared live-set model
     above; columns panel down to 128 lanes before giving up). The dim-1
     column streamer reuses the fit with the roles transposed and passes
     its own ``label`` so failures name the right decomposition."""
     P = min(-(-ny // 128) * 128, 1024)
-    B = _fit_block_rows(P, K, itemsize, sub)
-    while P > 128 and _stream_live_bytes(B, K, P, itemsize) > \
+    B = _fit_block_rows(P, K, itemsize, sub, bf16_temps)
+    while P > 128 and _stream_live_bytes(B, K, P, itemsize,
+                                         bf16_temps) > \
             _VMEM_BUDGET_CAL:
         P //= 2
-    if _stream_live_bytes(B, K, P, itemsize) > _VMEM_BUDGET_CAL:
+    if _stream_live_bytes(B, K, P, itemsize,
+                          bf16_temps) > _VMEM_BUDGET_CAL:
         raise ValueError(
             f"{label}: even a ({B}+2·{K})×{P} window "
             f"exceeds the VMEM budget"
@@ -800,7 +863,8 @@ def _iterate_stream0(z, se, steps, phys, phys_static, interpret,
     nx, ny = z.shape
     K = steps * N_BND
     sub = max(8, 8 * 4 // jnp.dtype(z.dtype).itemsize)
-    B, P = _fit_stream0_blocks(ny, K, jnp.dtype(z.dtype).itemsize, sub)
+    B, P = _fit_stream0_blocks(ny, K, jnp.dtype(z.dtype).itemsize, sub,
+                               bf16_temps=_BF16_TEMPS_ITER_STREAM)
     if tile_rows is not None:
         _validate_tile_rows(tile_rows, sub, name="stream_tile_rows")
         B = min(B, tile_rows)
@@ -919,10 +983,7 @@ def stencil2d_iterate_pallas(
     # the model's 28/20
     itemsize = z.dtype.itemsize
     if dim == 1:
-        strip = _fit_strip(
-            tile, nx, _strip_rows_bytes(ny, itemsize), min_strip=8,
-            budget=_VMEM_BUDGET_CAL,
-        )
+        strip = _kstep_d1_strip(nx, ny, itemsize, tile)
         grid = (pl.cdiv(nx, strip),)
         block = (strip, ny)
         index_map = lambda i: (i, 0)  # noqa: E731
@@ -1106,7 +1167,14 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
     G = n_bnd
     if steps > G:
         raise ValueError(f"heat2d_pallas: steps={steps} > ghost width {G}")
-    B = _stream_fit(z, G, "heat2d_pallas", tile_rows)
+    if tile_rows is None and jnp.dtype(z.dtype).itemsize == 2:
+        # the round-4 calibrated budget admits 256-row blocks at bf16,
+        # but the interleaved A/B (4096², k=4, 3 reps) measured 128-row
+        # blocks ~7% faster — deeper pipelining wins; the model governs
+        # SAFETY, this clamp records the measured speed choice
+        tile_rows = _BF16_HEAT_ROW_CLAMP
+    B = _stream_fit(z, G, "heat2d_pallas", tile_rows,
+                    bf16_temps=_BF16_TEMPS_HEAT)
     nb = pl.cdiv(nx, B)
     top, bot = _row_block_edges(z, B, G, nb)
     coef = jnp.asarray([cx, cy], z.dtype)
@@ -1163,14 +1231,25 @@ def _dual_step_kernel(z_ref, bot_ref, coef_ref, dx_ref, dy_ref, res_ref, *,
     dx_ref[:] = dx
     dy_ref[:] = dy
     valid = (jax.lax.broadcasted_iota(jnp.int32, dx.shape, 0) + i * B) < mx
-    zero = jnp.zeros((), dx.dtype)
-    r = (jnp.sum(jnp.where(valid, dx * dx, zero))
-         + jnp.sum(jnp.where(valid, dy * dy, zero)))
+    # residual accumulates in f32: Mosaic cannot legalize the bf16
+    # cross-lane reduction (round-4 vmemprobe coverage extension caught
+    # 'failed to legalize arith.addf' — this kernel had only ever been
+    # compiled at f32), and f32 accumulation of squares is the right
+    # numerics at 16-bit anyway
+    dxf = dx.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    r = (jnp.sum(jnp.where(valid, dxf * dxf, zero))
+         + jnp.sum(jnp.where(valid, dyf * dyf, zero)))
     # broadcast the partial over a full (8, 128) register tile (hardware
     # Mosaic requires output blocks to be whole sublane×lane tiles; a
     # per-block scalar store would need SMEM plumbing) — summing r/1024
     # over the 1024 tile slots reproduces r to rounding
-    res_ref[:] = jnp.full((8, 128), r / 1024.0, dx.dtype)
+    # the scalar divide stays f32 too (bf16 arith.divf does not
+    # legalize either); only the final store casts to the array dtype
+    res_ref[:] = jnp.full((8, 128), r / 1024.0, jnp.float32).astype(
+        dx.dtype
+    )
 
 
 @functools.partial(
