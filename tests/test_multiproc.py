@@ -329,3 +329,25 @@ def test_multiproc_stencil2d_rdma_tier(tpumt_run, tmp_path):
     out0 = rank_outputs(prefix, 2)[0]
     assert re.search(r"TEST dim:0, device , buf:0; [\d.]+, err=", out0)
     assert "ERR_NORM FAIL" not in out0
+
+
+def test_multiproc_stencil2d_managed_space(tpumt_run, tmp_path):
+    """2-process stencil2d with the MANAGED space twin: on the
+    multi-process CPU backend the host-memory-kind placement must
+    DEGRADE (single choke point ``spaces.host_sharding``) instead of
+    crashing — the round-4 on-chip job.sh matrix died here when the
+    driver retargeted the sharding itself and XLA refused to reshard
+    placement-annotated buffers across the multi-controller device
+    order ('Side-effect ops cannot be replicated')."""
+    prefix = tmp_path / "out-managed-"
+    r = launch(
+        tpumt_run, 2, sys.executable, "-m",
+        "tpu_mpi_tests.drivers.stencil2d",
+        "--fake-devices", "1", "--n-local", "16", "--n-other", "32",
+        "--n-iter", "3", "--managed", "--only", "0:0",
+        out_prefix=prefix,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out0 = rank_outputs(prefix, 2)[0]
+    assert re.search(r"TEST dim:0, managed, buf:0; [\d.]+, err=", out0)
+    assert "ERR_NORM FAIL" not in out0

@@ -236,3 +236,28 @@ def test_vmemprobe_configs_build():
         assert isinstance(model, int) and 0 < model <= 16 * 2**20, (
             name, model,
         )
+
+
+def test_spawn_world_returns_first_nonzero_rc(tmp_path):
+    """spawn_world's documented contract (round-3 advisor finding): the
+    FIRST nonzero child exit code wins, later failures don't overwrite
+    it, and the errexit-safe guard doesn't abort a `set -e` caller."""
+    script = tmp_path / "t.sh"
+    script.write_text(
+        "set -eu\n"
+        f". {REPO / 'tpu' / 'worldlib.sh'}\n"
+        # rank 0 fails fast with 7; rank 1 fails later with 3 — pid-order
+        # wait must return 7 (and keep waiting for rank 1)
+        "fake() {\n"
+        "  if [ \"$JAX_PROCESS_ID\" -eq 0 ]; then exit 7; fi\n"
+        "  sleep 0.3; exit 3\n"
+        "}\n"
+        "rc=0\n"
+        "spawn_world 2 fake || rc=$?\n"
+        "echo \"rc=$rc\"\n"
+    )
+    r = subprocess.run(
+        ["bash", str(script)], capture_output=True, text=True, timeout=60
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "rc=7" in r.stdout, r.stdout

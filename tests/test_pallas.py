@@ -834,6 +834,66 @@ def test_dual_dim_step_pallas_matches_xla(tile_rows):
     assert abs(float(br) - float(ar)) <= 1e-3 * max(1.0, abs(float(ar)))
 
 
+def test_dual_dim_step_pallas_bfloat16():
+    """bf16 dualdim: round-4 vmemprobe coverage found the kernel had
+    never compiled at bf16 (Mosaic cannot legalize bf16 cross-lane
+    reductions or scalar divides); the residual now accumulates in f32.
+    Value parity vs the f32 XLA tier at 16-bit tolerances."""
+    from tpu_mpi_tests.kernels.stencil import N_BND, dual_dim_step
+
+    z32 = rng(33, (48 + 2 * N_BND, 40 + 2 * N_BND))
+    z16 = z32.astype(jnp.bfloat16)
+    ax, ay, ar = dual_dim_step(z32, N_BND, 1.5, 0.75)
+    bx, by, br = PK.dual_dim_step_pallas(
+        z16, N_BND, 1.5, 0.75, interpret=True
+    )
+    assert bx.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(bx, np.float32), np.asarray(ax), atol=0.05
+    )
+    np.testing.assert_allclose(
+        np.asarray(by, np.float32), np.asarray(ay), atol=0.05
+    )
+    assert abs(float(br) - float(ar)) <= 0.02 * max(1.0, abs(float(ar)))
+
+
+def test_kstep_d1_strip_fit():
+    """The direct dim-1 strip fit: budget-max 8-multiples, tile as an
+    8-multiple cap, f32 64 at the headline width, bf16 96 budget-max
+    under the calibrated coefficient (production caps at 64 — measured
+    flat — but the fit must expose the honest max for opt-in tiles)."""
+    ny = 8192 + 16
+    f32, bf16, f16 = jnp.float32, jnp.bfloat16, jnp.float16
+    assert PK._kstep_d1_strip(8192, ny, f32, 512) == 64   # f32 budget-max
+    assert PK._kstep_d1_strip(8192, ny, bf16, 512) == 96  # bf16 budget-max
+    assert PK._kstep_d1_strip(8192, ny, bf16, 64) == 64   # production cap
+    assert PK._kstep_d1_strip(8192, ny, bf16, 90) == 88   # 8-multiple cap
+    assert PK._kstep_d1_strip(16, ny, bf16, 512) == 16    # extent-bounded
+    # float16 keeps the CONSERVATIVE shared model: the narrowed
+    # coefficients were bisected on bfloat16 kernels only
+    assert PK._d1_strip_rows_bytes(ny, f16) ==         PK._strip_rows_bytes(ny, 2)
+    assert PK._d1_strip_rows_bytes(ny, f16) >         PK._d1_strip_rows_bytes(ny, bf16)
+    with pytest.raises(ValueError, match="VMEM"):
+        PK._kstep_d1_strip(8192, 3 * 10**6, f32, 512)
+
+
+def test_stream_live_bytes_calibration():
+    """Calibrated bf16 temps stay at/above their measured floors and the
+    default stays conservative for uncalibrated kernels."""
+    assert PK._BF16_TEMPS_ITER_STREAM >= 17.51
+    assert PK._BF16_TEMPS_HEAT >= 14.57
+    assert PK._BF16_TEMPS_DEFAULT >= PK._BF16_TEMPS_ITER_STREAM
+    # f32 path unchanged by the bf16 parameter
+    assert PK._stream_live_bytes(128, 4, 2056, 4) == \
+        PK._stream_live_bytes(128, 4, 2056, 4, bf16_temps=15.3)
+    # calibrated bf16 model is smaller than the default, never tiny
+    lo = PK._stream_live_bytes(128, 4, 2056, 2,
+                               bf16_temps=PK._BF16_TEMPS_HEAT)
+    hi = PK._stream_live_bytes(128, 4, 2056, 2)
+    io = 4 * 2 * 128 * 2056
+    assert io < lo < hi
+
+
 def test_dual_dim_step_pallas_reference_shard_geometry():
     """1028-row shard (the reference's n_local+ghosts geometry): the fast
     edge path must source the last block's bottom edge from the real

@@ -239,11 +239,11 @@ def configs():
             # strip re-sweep measured 64/88/96 flat within contention
             # noise at bf16 (BASELINE.md), so production keeps 64 and the
             # probe validates what production runs
-            strip = PK._kstep_d1_strip(8192, ny, itemsize, 64)
+            strip = PK._kstep_d1_strip(8192, ny, dtype, 64)
         except ValueError as e:
             out.append((name, None, str(e)[:200]))
             continue
-        model = strip * PK._d1_strip_rows_bytes(ny, itemsize)
+        model = strip * PK._d1_strip_rows_bytes(ny, dtype)
 
         def fn(ny=ny, dtype=dtype):
             z = jax.numpy.ones((8192, ny), dtype)

@@ -73,6 +73,41 @@ def host_memory_kind() -> str | None:
     return None
 
 
+def _host_axis_degrades() -> bool:
+    """True when the HOST/MANAGED space axis collapses to plain device
+    placement: no host memory kinds on this backend, or the multi-process
+    CPU dev loop — XLA cannot move placement-annotated buffers across a
+    multi-controller device order ("Side-effect ops cannot be replicated"
+    on the annotate_device_placement custom-call; found by the round-4
+    on-chip job.sh matrix when its w=2 managed stencil2d cell died).
+    DEVICE is host RAM on CPU anyway; the axis is real on TPU."""
+    if host_memory_kind() is None:
+        return True
+    return (
+        jax.process_count() > 1
+        and jax.local_devices()[0].platform == "cpu"
+    )
+
+
+def host_sharding(sharding, context: str = "host/managed"):
+    """Retarget ``sharding`` at the host memory kind for HOST/MANAGED
+    placement, or return it UNCHANGED (with a one-line note) when the
+    space axis degrades (:func:`_host_axis_degrades`) — the single choke
+    point for the retarget, so drivers cannot bypass the multi-process
+    guard (the round-4 matrix failure did exactly that)."""
+    if _host_axis_degrades():
+        if host_memory_kind() is not None:
+            import warnings
+
+            warnings.warn(
+                f"{context}-space placement degraded to plain device "
+                "placement on the multi-process CPU backend",
+                stacklevel=2,
+            )
+        return sharding
+    return sharding.with_memory_kind(host_memory_kind())
+
+
 def place(x, space: Space | str = Space.DEVICE, sharding=None):
     """Place an array in the requested space (≅ gt::copy into a spaced tensor).
 
@@ -82,36 +117,10 @@ def place(x, space: Space | str = Space.DEVICE, sharding=None):
     space = Space.parse(space)
     if space is Space.DEVICE:
         return jax.device_put(x, sharding)
-
-    kind = host_memory_kind()
-    if kind is None:
-        # CPU backend without host memory kinds: DEVICE already is host RAM,
-        # so HOST/MANAGED degrade to plain placement. Documented deviation —
-        # the A/B benchmark axis collapses on this backend.
-        return jax.device_put(x, sharding)
-    if (
-        jax.process_count() > 1
-        and jax.local_devices()[0].platform == "cpu"
-    ):
-        # the multi-process CPU dev loop cannot reshard memory-kind-
-        # annotated buffers across processes (XLA: "side-effect ops
-        # cannot be replicated" on the annotate_device_placement
-        # custom-call), and DEVICE is host RAM there anyway — degrade to
-        # plain placement with a one-line note so the space-axis A/B
-        # reader knows the axis collapsed (the axis is real on TPU)
-        import warnings
-
-        warnings.warn(
-            f"{space.value}-space placement degraded to plain device "
-            "placement on the multi-process CPU backend",
-            stacklevel=2,
-        )
-        return jax.device_put(x, sharding)
+    if sharding is None and not _host_axis_degrades():
+        sharding = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
     if sharding is not None:
-        sharding = sharding.with_memory_kind(kind)
-    else:
-        dev = jax.local_devices()[0]
-        sharding = jax.sharding.SingleDeviceSharding(dev, memory_kind=kind)
+        sharding = host_sharding(sharding, context=space.value)
     return jax.device_put(x, sharding)
 
 

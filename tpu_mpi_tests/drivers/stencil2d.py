@@ -90,15 +90,13 @@ def _deriv_test(args, mesh, topo, rep, dim: int, space: str, buf: bool) -> int:
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from tpu_mpi_tests.arrays.spaces import host_memory_kind
+    from tpu_mpi_tests.arrays.spaces import host_sharding
 
     spec = [None, None]
     spec[dim] = axis_name
     sharding = NamedSharding(mesh, P(*spec))
     if Space.parse(space) is not Space.DEVICE:
-        kind = host_memory_kind()
-        if kind is not None:
-            sharding = sharding.with_memory_kind(kind)
+        sharding = host_sharding(sharding, context=str(space))
     if args.init == "device":
         # compute the analytic field on chip; for managed space, land it in
         # host memory afterwards (the managed twin starts host-resident)
@@ -261,11 +259,9 @@ def _sum_test(args, mesh, topo, rep, dim: int, space: str) -> int:
     fill = np.pi / world
     sharding = NamedSharding(mesh, P(*spec))
     if Space.parse(space) is not Space.DEVICE:
-        from tpu_mpi_tests.arrays.spaces import host_memory_kind
+        from tpu_mpi_tests.arrays.spaces import host_sharding
 
-        kind = host_memory_kind()
-        if kind is not None:
-            sharding = sharding.with_memory_kind(kind)
+        sharding = host_sharding(sharding, context=str(space))
     z = C.shard_blocks(
         mesh,
         d.global_interior_shape,

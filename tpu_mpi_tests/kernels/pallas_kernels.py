@@ -356,18 +356,20 @@ def _strip_rows_bytes(extent: int, itemsize: int) -> int:
     return extent * (4 * itemsize + max(12, 3 * itemsize))
 
 
-def _d1_strip_rows_bytes(ny: int, itemsize: int) -> int:
-    """Dim-1 k-step strip live bytes per row: bf16 has its own measured
-    coefficient (17.91 B/elt probed at strip 88 ·1.05 margin — the
-    shared `_strip_rows_bytes` bf16 value must stay ≥ the d0 kernel's
-    19.53 and left d1 at 1.11 conservative); other dtypes share the
-    common model."""
-    if itemsize == 2:
+def _d1_strip_rows_bytes(ny: int, dtype) -> int:
+    """Dim-1 k-step strip live bytes per row: BFLOAT16 (specifically —
+    the coefficient was bisected on bf16 kernels; float16 may legalize
+    via f32 widening and keeps the conservative shared model) has its
+    own measured coefficient (17.91 B/elt probed at strip 88 ·1.05
+    margin — the shared `_strip_rows_bytes` bf16 value must stay ≥ the
+    d0 kernel's 19.53 and left d1 at 1.11 conservative); other dtypes
+    share the common model."""
+    if jnp.dtype(dtype) == jnp.bfloat16:
         return int(ny * 18.8)
-    return _strip_rows_bytes(ny, itemsize)
+    return _strip_rows_bytes(ny, jnp.dtype(dtype).itemsize)
 
 
-def _kstep_d1_strip(nx: int, ny: int, itemsize: int, tile: int) -> int:
+def _kstep_d1_strip(nx: int, ny: int, dtype, tile: int) -> int:
     """Dim-1 strip for the k-step iterate: the largest 8-multiple ≤
     ``tile`` that fits the calibrated budget, computed DIRECTLY (the
     halving fit could not land between power-of-2 steps; the direct
@@ -376,7 +378,7 @@ def _kstep_d1_strip(nx: int, ny: int, itemsize: int, tile: int) -> int:
     within contention noise (±3%, 64 marginally ahead), so the
     production tile cap stays 64 and wider strips remain an explicit
     ``tile=`` opt-in; f32's budget-max is 68 → 64 either way)."""
-    rows_bytes = _d1_strip_rows_bytes(ny, itemsize)
+    rows_bytes = _d1_strip_rows_bytes(ny, dtype)
     budget_max = (_VMEM_BUDGET_CAL // rows_bytes) // 8 * 8
     tile = max(8, tile // 8 * 8)  # keep the documented 8-multiple contract
     strip = min(min(tile, nx), max(8, budget_max))
@@ -863,8 +865,12 @@ def _iterate_stream0(z, se, steps, phys, phys_static, interpret,
     nx, ny = z.shape
     K = steps * N_BND
     sub = max(8, 8 * 4 // jnp.dtype(z.dtype).itemsize)
-    B, P = _fit_stream0_blocks(ny, K, jnp.dtype(z.dtype).itemsize, sub,
-                               bf16_temps=_BF16_TEMPS_ITER_STREAM)
+    B, P = _fit_stream0_blocks(
+        ny, K, jnp.dtype(z.dtype).itemsize, sub,
+        bf16_temps=(_BF16_TEMPS_ITER_STREAM
+                    if jnp.dtype(z.dtype) == jnp.bfloat16
+                    else _BF16_TEMPS_DEFAULT),
+    )
     if tile_rows is not None:
         _validate_tile_rows(tile_rows, sub, name="stream_tile_rows")
         B = min(B, tile_rows)
@@ -983,7 +989,7 @@ def stencil2d_iterate_pallas(
     # the model's 28/20
     itemsize = z.dtype.itemsize
     if dim == 1:
-        strip = _kstep_d1_strip(nx, ny, itemsize, tile)
+        strip = _kstep_d1_strip(nx, ny, z.dtype, tile)
         grid = (pl.cdiv(nx, strip),)
         block = (strip, ny)
         index_map = lambda i: (i, 0)  # noqa: E731
@@ -1167,14 +1173,18 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
     G = n_bnd
     if steps > G:
         raise ValueError(f"heat2d_pallas: steps={steps} > ghost width {G}")
-    if tile_rows is None and jnp.dtype(z.dtype).itemsize == 2:
+    if tile_rows is None and jnp.dtype(z.dtype) == jnp.bfloat16:
         # the round-4 calibrated budget admits 256-row blocks at bf16,
         # but the interleaved A/B (4096², k=4, 3 reps) measured 128-row
         # blocks ~7% faster — deeper pipelining wins; the model governs
         # SAFETY, this clamp records the measured speed choice
         tile_rows = _BF16_HEAT_ROW_CLAMP
-    B = _stream_fit(z, G, "heat2d_pallas", tile_rows,
-                    bf16_temps=_BF16_TEMPS_HEAT)
+    B = _stream_fit(
+        z, G, "heat2d_pallas", tile_rows,
+        bf16_temps=(_BF16_TEMPS_HEAT
+                    if jnp.dtype(z.dtype) == jnp.bfloat16
+                    else _BF16_TEMPS_DEFAULT),
+    )
     nb = pl.cdiv(nx, B)
     top, bot = _row_block_edges(z, B, G, nb)
     coef = jnp.asarray([cx, cy], z.dtype)
