@@ -232,7 +232,6 @@ def configs():
         (8192 + 2 * K, jnp.float32),
         (8192 + 2 * K, jnp.bfloat16),
     ):
-        itemsize = jnp.dtype(dtype).itemsize
         name = f"fullwidth_d1_k{steps}_8192x{ny}_{jnp.dtype(dtype).name}"
         try:
             # tile=64 mirrors the production bench/halo path: the round-4

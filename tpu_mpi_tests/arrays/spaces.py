@@ -117,11 +117,11 @@ def place(x, space: Space | str = Space.DEVICE, sharding=None):
     space = Space.parse(space)
     if space is Space.DEVICE:
         return jax.device_put(x, sharding)
-    if sharding is None and not _host_axis_degrades():
+    if sharding is None:
         sharding = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
-    if sharding is not None:
-        sharding = host_sharding(sharding, context=space.value)
-    return jax.device_put(x, sharding)
+    # single choke point for the retarget AND the degrade note — every
+    # HOST/MANAGED placement passes through host_sharding
+    return jax.device_put(x, host_sharding(sharding, context=space.value))
 
 
 def ensure_device(x):

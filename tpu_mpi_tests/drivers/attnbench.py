@@ -54,6 +54,7 @@ def run(args) -> int:
     rep.banner(
         f"attnbench: L={args.seq_len} d={args.head_dim} tiers={args.tiers} "
         f"dtype={args.dtype} causal={args.causal} stripe={args.stripe} "
+        f"k_tile={args.k_tile} "
         f"n_iter={args.n_iter} world={world}"
     )
 
@@ -98,11 +99,12 @@ def run(args) -> int:
                 attn = ring_attention_fn(
                     mesh, axis_name, causal=args.causal, flash=True,
                     precision=prec, stripe=args.stripe,
+                    k_tile=args.k_tile,
                 )
             else:
                 attn = ulysses_attention_fn(
                     mesh, axis_name, causal=args.causal, flash=True,
-                    precision=prec,
+                    precision=prec, k_tile=args.k_tile,
                 )
         else:
             q, k, v = (
@@ -112,7 +114,7 @@ def run(args) -> int:
             if tier == "flash":
                 attn = functools.partial(
                     flash_attention_pallas, causal=args.causal,
-                    precision=prec,
+                    precision=prec, k_tile=args.k_tile,
                 )
             else:
                 attn = xla_attn
@@ -161,6 +163,15 @@ def main(argv=None) -> int:
         "rank ~half-live per step; requires --causal)",
     )
     p.add_argument(
+        "--k-tile", type=int, default=2048,
+        help="flash kernel key-tile ceiling (auto-shrinks to fit). The "
+        "round-4 balance measurement: the striped causal ring realizes "
+        "more of its ~2x balance at finer tiles (paced-proxy speedup "
+        "1.25x at 2048 vs 1.53x at 512, BASELINE.md) - the skip "
+        "granularity vs per-tile carry-rescale trade-off is workload-"
+        "dependent, so it is a knob, not a constant",
+    )
+    p.add_argument(
         "--fast", action="store_true",
         help="MXU-native (DEFAULT) matmul precision instead of HIGHEST "
         "(the throughput configuration BASELINE.md quotes)",
@@ -172,6 +183,8 @@ def main(argv=None) -> int:
         p.error("--seq-len must be >= 8 and --head-dim >= 1")
     if args.n_iter < 10:
         p.error("--n-iter must be >= 10")
+    if args.k_tile < 8:
+        p.error("--k-tile must be >= 8")
     if args.stripe and not args.causal:
         p.error("--stripe requires --causal (non-causal rings are "
                 "already balanced)")
