@@ -527,11 +527,15 @@ def test_ring_allreduce_single_device():
     assert np.array_equal(np.asarray(ar(jnp.asarray(x))), x)
 
 
-def test_ring_reduce_scatter_self_ring():
+@pytest.mark.parametrize("credits", [1, 2])
+def test_ring_reduce_scatter_self_ring(credits):
     """self_ring=k on one device must return the sum of the shard's own k
     chunks — the schedule's result when every virtual rank holds the same
     data (this is the mode that lets ONE real chip execute the full loop
-    body: sliced DMA, self-RDMA, VMEM accumulate, handshake)."""
+    body: sliced DMA, self-RDMA, VMEM accumulate, handshake). Both
+    credit levels: the loopback+credits interplay (self-targeted parity
+    recv sems, self-signaled credit schedule) is the path the on-chip
+    BASELINE claim rests on, so CI pins it."""
     import functools
 
     import jax
@@ -547,7 +551,8 @@ def test_ring_reduce_scatter_self_ring():
     )
     def rs(x):
         return PK.ring_reduce_scatter_pallas(
-            x, axis_name="shard", interpret=True, self_ring=4
+            x, axis_name="shard", interpret=True, self_ring=4,
+            credits=credits,
         )
 
     got = np.asarray(rs(jnp.asarray(x)))

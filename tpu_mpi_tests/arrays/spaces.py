@@ -89,6 +89,19 @@ def _host_axis_degrades() -> bool:
     )
 
 
+def _warn_degraded(context: str) -> None:
+    """One-line degrade note (only when the backend HAS host kinds — on
+    plain CPU the axis never existed and a warning would be noise)."""
+    if host_memory_kind() is not None:
+        import warnings
+
+        warnings.warn(
+            f"{context}-space placement degraded to plain device "
+            "placement on the multi-process CPU backend",
+            stacklevel=3,
+        )
+
+
 def host_sharding(sharding, context: str = "host/managed"):
     """Retarget ``sharding`` at the host memory kind for HOST/MANAGED
     placement, or return it UNCHANGED (with a one-line note) when the
@@ -96,14 +109,7 @@ def host_sharding(sharding, context: str = "host/managed"):
     point for the retarget, so drivers cannot bypass the multi-process
     guard (the round-4 matrix failure did exactly that)."""
     if _host_axis_degrades():
-        if host_memory_kind() is not None:
-            import warnings
-
-            warnings.warn(
-                f"{context}-space placement degraded to plain device "
-                "placement on the multi-process CPU backend",
-                stacklevel=2,
-            )
+        _warn_degraded(context)
         return sharding
     return sharding.with_memory_kind(host_memory_kind())
 
@@ -118,6 +124,12 @@ def place(x, space: Space | str = Space.DEVICE, sharding=None):
     if space is Space.DEVICE:
         return jax.device_put(x, sharding)
     if sharding is None:
+        if _host_axis_degrades():
+            # keep the array's placement untouched (committing it to
+            # local device 0 would break already-sharded inputs in a
+            # multi-process world), but still emit the degrade note
+            _warn_degraded(space.value)
+            return jax.device_put(x, None)
         sharding = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
     # single choke point for the retarget AND the degrade note — every
     # HOST/MANAGED placement passes through host_sharding

@@ -1694,7 +1694,7 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
                                 acc_a, acc_b, copy_sem, copy_sem2,
                                 send_sem, recv_sem, ready_sem,
                                 *, axis_name, w, tile_rows, use_barrier,
-                                use_handshake, loopback):
+                                use_handshake, loopback, credits):
     """Ring reduce-scatter with explicit remote DMA: w−1 hops, each
     forwarding a running partial sum one chunk to the right; rank ``r``
     ends owning chunk ``r`` fully reduced (``lax.psum_scatter`` ordering,
@@ -1706,10 +1706,11 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
     directly) into the next step's send buffer — or, at the last step,
     into the owned output chunk.
 
-    All remote writes land in the single-slot ``comm_ref``; a
-    receiver-backpressure handshake (``ready_sem``, remote-signaled by the
-    consumer) keeps step ``s+1``'s incoming DMA from overrunning step
-    ``s``'s unconsumed data. The plain bool interpreter serializes devices
+    All remote writes land in ``comm_ref``, which holds ``credits``
+    slots (1 = the single-slot default, 2 = the double-buffered
+    pod-latency variant); a receiver-backpressure handshake
+    (``ready_sem``, remote-signaled by the consumer after a slot is
+    folded) keeps an incoming DMA from overrunning unconsumed data. The plain bool interpreter serializes devices
     and cannot run it; on hardware and under the simulated multi-device
     interpreter (``pltpu.InterpretParams``: per-device threads, simulated
     remote DMA) the handshake and the entry barrier are enabled and
@@ -1718,26 +1719,26 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
     negative control (handshake disabled ⇒ the comm-slot WAW/RAW race is
     detected; enabled ⇒ race-free and exact).
 
-    Why the handshake cannot be replaced by double-buffering ``comm_ref``
-    alone (round-2 advisor suggestion, analyzed round 3): a sender's
-    progress is gated by its LEFT neighbor (``rdma.wait`` waits on its
-    own send landing and its own recv arriving — landing, not
-    consumption), so nothing bounds how far a rank can run ahead of its
-    RIGHT neighbor's folds; with two slots, writes ``s`` and ``s+2``
-    share a slot and a 2-step skew clobbers unconsumed data the same
-    way. Safety requires receiver credits; the current scheme is exactly
-    a 1-credit flow (the first send needs none — the slot starts free;
-    each later send waits for the consumer's signal), with balanced
-    accounting (w−2 signals vs w−2 waits per rank). Double-buffering
-    WITH 2 credits would only overlap send ``s+1`` with the consumption
-    of ``s`` — a pod-scale latency optimization whose WALL-CLOCK benefit
-    cannot be measured on this one-chip environment (the loopback
-    self-ring serializes the ring), so it is deliberately not taken. The
-    CORRECTNESS of the 1-credit scheme, however, is no longer
-    analysis-only: the simulated multi-device interpreter executes it
-    under real thread concurrency with race detection (round 4,
-    ``tests/test_ring_sync.py``); record a multi-chip non-loopback w≥4
-    wall-clock run in MULTICHIP evidence when pod hardware is
+    Why double-buffering ``comm_ref`` ALONE (no credits — the round-2
+    advisor suggestion) is unsafe: a sender's progress is gated by its
+    LEFT neighbor (``rdma.wait`` waits on its own send landing and its
+    own recv arriving — landing, not consumption), so nothing bounds how
+    far a rank can run ahead of its RIGHT neighbor's folds; with two
+    slots, writes ``s`` and ``s+2`` share a slot and a 2-step skew
+    clobbers unconsumed data the same way (the credits=2 negative
+    control in ``tests/test_ring_sync.py`` executes exactly this race).
+    Safety requires receiver credits: sends ``s ≥ credits`` wait one
+    credit, consumers signal after folding slot ``s ≤ w−2−credits`` —
+    balanced accounting, ``w−1−credits`` signals vs waits per rank.
+    ``credits=2`` additionally needs PER-PARITY recv semaphores
+    (``recv_sem[s % 2]``): with two arrivals in flight an anonymous
+    counting wait could be satisfied by the ``s+1`` arrival while slot
+    ``s % 2`` is still being written — the all-gather's round-4 RAW
+    hazard class. Both credit levels run race-free and exact under the
+    simulated multi-device interpreter at non-loopback w ∈ {4, 8}; the
+    2-credit variant's wall-clock BENEFIT (overlapping send ``s+1``
+    with the right neighbor's fold of ``s``) needs real multi-chip skew
+    — record a pod run in MULTICHIP evidence when hardware is
     available.
 
     ``loopback`` runs the full ``w``-step schedule with both neighbors
@@ -1751,7 +1752,7 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
     else:
         right = jax.lax.rem(my + 1, jnp.int32(w))
         left = jax.lax.rem(my - 1 + jnp.int32(w), jnp.int32(w))
-    cn = comm_ref.shape[0]
+    cn = comm_ref.shape[0] // credits  # comm_ref holds `credits` slots
 
     if use_barrier:
         barrier = pltpu.get_barrier_semaphore()
@@ -1778,26 +1779,40 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
     seed.wait()
 
     for s in range(w - 1):
-        if use_handshake and s > 0:
-            # right consumed my previous payload; its comm slot is free
+        sl = s % credits  # comm slot (and recv-semaphore parity)
+        if use_handshake and s >= credits:
+            # right consumed my payload s - credits; a comm slot is free.
+            # credits=1: wait before every send after the first (the slot
+            # starts free); credits=2: the first TWO sends are free, so
+            # send s+1 overlaps right's consumption of s — the pod-scale
+            # latency optimization, slot-safe because writes s and s+2
+            # (same slot) are still separated by a consume
             pltpu.semaphore_wait(ready_sem, 1)
         rdma = pltpu.make_async_remote_copy(
             src_ref=send_ref,
-            dst_ref=comm_ref,
+            dst_ref=comm_ref.at[pl.ds(sl * cn, cn)],
             send_sem=send_sem,
-            recv_sem=recv_sem,
+            # per-parity recv semaphores: with 2 credits the left
+            # neighbor may have arrivals s and s+1 in flight at once,
+            # and an ANONYMOUS counting wait could be satisfied by the
+            # s+1 arrival while slot s%2 is still being written — the
+            # same hazard class the round-4 race detector caught in the
+            # all-gather. Parity sems cannot alias: left's s+2 (same
+            # parity) needs my consume-credit for s first.
+            recv_sem=recv_sem.at[sl],
             device_id=right,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
         rdma.wait()
-        # comm_ref holds the (s+1)-rank partial of chunk (my − s − 2);
-        # fold in my contribution
+        # comm slot sl holds the (s+1)-rank partial of chunk
+        # (my − s − 2); fold in my contribution
         c = jax.lax.rem(my - jnp.int32(s) - 2 + wrap, jnp.int32(w))
         dst = out_ref if s == w - 2 else send_ref
         for j in range(cn // tile_rows):
             ca = pltpu.make_async_copy(
-                comm_ref.at[pl.ds(j * tile_rows, tile_rows)], acc_a, copy_sem
+                comm_ref.at[pl.ds(sl * cn + j * tile_rows, tile_rows)],
+                acc_a, copy_sem,
             )
             cb = pltpu.make_async_copy(
                 x_ref.at[pl.ds(c * cn + j * tile_rows, tile_rows)],
@@ -1813,9 +1828,11 @@ def _ring_reduce_scatter_kernel(x_ref, out_ref, comm_ref, send_ref,
             )
             cw.start()
             cw.wait()
-        if use_handshake and s < w - 2:
-            # tell left its next write into my comm slot may proceed (the
-            # last step signals nothing: nobody sends again)
+        if use_handshake and s <= w - 2 - credits:
+            # tell left a slot freed, releasing its send s + credits (the
+            # last `credits` consumes release nothing: nobody sends
+            # again). Accounting balances: w − 1 − credits signals vs
+            # w − 1 − credits waits per rank.
             pltpu.semaphore_signal(ready_sem, inc=1, device_id=left,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
 
@@ -1829,6 +1846,7 @@ def ring_reduce_scatter_pallas(
     tile_rows: int | None = None,
     self_ring: int | None = None,
     unsafe_no_handshake: bool = False,
+    credits: int = 1,
 ):
     """Per-shard ring reduce-scatter along axis 0 with explicit inter-chip
     RDMA; rank ``r`` returns chunk ``r`` of the elementwise sum (shape
@@ -1847,7 +1865,17 @@ def ring_reduce_scatter_pallas(
     handshake. TESTING ONLY: it exists so the race-detection negative
     control (``tests/test_ring_sync.py``) can prove the simulated
     multi-device interpreter actually sees the comm-slot hazard the
-    handshake closes; running it on hardware would be a data race."""
+    handshake closes; running it on hardware would be a data race.
+
+    ``credits=2`` selects the double-buffered comm variant (two comm
+    slots, per-parity recv semaphores, 2-credit receiver backpressure):
+    send ``s+1`` overlaps the right neighbor's consumption of ``s`` — a
+    pod-scale latency optimization whose wall-clock benefit needs real
+    multi-chip skew to show, but whose CORRECTNESS is executed in CI
+    under the simulated multi-device interpreter with race detection
+    (round 4; the round-3 analysis that a naive double-buffer WITHOUT
+    credits would be unsafe still holds — the negative control
+    demonstrates the hazard class)."""
     sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
     w = jax.lax.axis_size(axis_name)
     if self_ring is not None:
@@ -1872,6 +1900,8 @@ def ring_reduce_scatter_pallas(
             interpret=interpret,
             tile_rows=tile_rows,
             self_ring=self_ring,
+            unsafe_no_handshake=unsafe_no_handshake,
+            credits=credits,
         ).reshape(-1)
     n = x.shape[0]
     if n % (w * sublane) != 0:
@@ -1880,6 +1910,8 @@ def ring_reduce_scatter_pallas(
             f"== 0 for {jnp.dtype(x.dtype).name} on a {w}-ring "
             f"(w × sublane tile), got {n}"
         )
+    if credits not in (1, 2):
+        raise ValueError(f"credits={credits} must be 1 or 2")
     interp = _auto_interpret(interpret)
     cn = n // w
     itemsize = jnp.dtype(x.dtype).itemsize
@@ -1912,6 +1944,7 @@ def ring_reduce_scatter_pallas(
             f"{_VMEM_BUDGET_BYTES // (2 * sublane * itemsize)} elements)"
         )
     chunk = jax.ShapeDtypeStruct((cn, *x.shape[1:]), x.dtype)
+    comm = jax.ShapeDtypeStruct((credits * cn, *x.shape[1:]), x.dtype)
     out, _, _ = pl.pallas_call(
         functools.partial(
             _ring_reduce_scatter_kernel,
@@ -1923,8 +1956,9 @@ def ring_reduce_scatter_pallas(
                 not _serial_interpret(interp) and not unsafe_no_handshake
             ),
             loopback=self_ring is not None,
+            credits=credits,
         ),
-        out_shape=(chunk, chunk, chunk),
+        out_shape=(chunk, comm, chunk),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 3,
         scratch_shapes=[
@@ -1933,7 +1967,7 @@ def ring_reduce_scatter_pallas(
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((credits,)),
             pltpu.SemaphoreType.REGULAR,
         ],
         compiler_params=pltpu.CompilerParams(
