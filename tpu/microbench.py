@@ -600,7 +600,14 @@ def bench_blocks(results):
 
 def bench_heat(results):
     """heat2d mini-app update tiers (BASELINE heat2d row): XLA body vs the
-    in-place row-streaming Pallas Laplacian, k ∈ {1, 4, 8} at 2048²."""
+    in-place row-streaming Pallas Laplacian, k ∈ {1, 4, 8} at 2048²,
+    f32 and (round 4, under the calibrated VMEM fit + measured-best
+    B=128 clamp) bf16. CAVEAT for the bf16 rows at this size: one
+    k-group's device work (~24 µs at k=4) sits BELOW the ~100 µs
+    per-call launch overhead, so single runs swing ~3× with the shared
+    chip's contention (21k–61k steps/s observed at k=4) — treat them as
+    floor-bound; the robust bf16 heat evidence is the 4096² interleaved
+    A/B (BASELINE round-4 strip re-sweep)."""
     import numpy as np
 
     import jax
@@ -612,11 +619,13 @@ def bench_heat(results):
 
     mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("x", "y"))
     n = 2048
-    for kernel in ("xla", "pallas"):
+    for kernel, dtype in (("xla", np.float32), ("pallas", np.float32),
+                          ("pallas", jnp.bfloat16)):
+        dname = jnp.dtype(dtype).name
         for k in (1, 4, 8):
             z0 = np.random.default_rng(0).normal(
                 size=(n + 2 * k, n + 2 * k)
-            ).astype(np.float32) / 10
+            ).astype(dtype) / np.asarray(10, dtype)
             run = heat_step2d_fn(
                 mesh, "x", "y", k, 0.05, 0.05, steps=k, kernel=kernel
             )
@@ -631,7 +640,7 @@ def bench_heat(results):
             sec, z = chain_rate(
                 run, z, n_short=max(1, 40 // k), n_long=max(2, 2000 // k)
             )
-            _emit(results, f"heat2d_{kernel}_k{k}_2048_steps_per_s",
+            _emit(results, f"heat2d_{kernel}_{dname}_k{k}_2048_steps_per_s",
                   k / sec, "steps/s")
             del z
 
