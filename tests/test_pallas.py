@@ -566,14 +566,19 @@ def test_vpu_probe_mixes():
     update — on a unit ramp the 5-point first derivative is exactly 1, so
     each rep adds se to the interior span."""
     reps = 3
-    # fma on ones: closed form a^r + b·(a^(r-1)+...+1)
-    z = jnp.ones((16, 128), jnp.float32)
-    out = PK.vpu_probe_pallas(z, reps, "fma", interpret=True)
-    a, b = 1.0000001, 1e-12
-    want = 1.0
-    for _ in range(reps):
-        want = a * want + b
-    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+    # fma on ones: closed form via the recurrence (a = 1 − 2⁻⁷ exact in
+    # both probe dtypes, so the reference needs no rounding model)
+    a, b = 0.9921875, 1e-10
+    for dt in (jnp.float32, jnp.bfloat16):
+        z = jnp.ones((16, 128), dt)
+        out = PK.vpu_probe_pallas(z, reps, "fma", interpret=True)
+        want = 1.0
+        for _ in range(reps):
+            want = a * want + b
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), want,
+            rtol=1e-6 if dt == jnp.float32 else 1e-2,
+        )
 
     # step5: se visible (0.01 — the 1e-9 timing default underflows f32
     # against the ramp and would make this check vacuous), expected via

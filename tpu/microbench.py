@@ -684,7 +684,7 @@ def bench_vpu(results):
 
     import functools
 
-    def probe_per_call(mix, reps, iters=400):
+    def probe_per_call(mix, reps, dname, iters=400):
         @functools.partial(jax.jit, donate_argnums=0,
                            static_argnames=("reps",))
         def run(z, n_iter, reps):
@@ -693,7 +693,7 @@ def bench_vpu(results):
 
             return lax.fori_loop(0, jnp.asarray(n_iter, jnp.int32), body, z)
 
-        z = jnp.asarray(z0)
+        z = jnp.asarray(z0, dtype=dname)
         z = block(run(z, 1, reps=reps))
         per, _ = chain_rate(
             lambda zz, n_it: run(zz, n_it, reps=reps), z,
@@ -704,15 +704,19 @@ def bench_vpu(results):
     # (nominal ops/elt, reps triple): rep counts sized so the per-rep
     # cost differences are hundreds of us — far above the shared chip's
     # contention noise (the first cut used 64/320 everywhere and the fma
-    # delta was ~10 us: it measured noise, NaN rates)
+    # delta was ~10 us: it measured noise, NaN rates). bf16 probes
+    # (round 4) put a measured ceiling under the OFFICIAL bf16 headline's
+    # claimed VPU plateau; its schedule is dim-1, so step5_d1 is the mix
     PROBES = {
-        "fma": (2, (512, 2048, 8192)),
-        "step5_d0": (7, (256, 1024, 4096)),
-        "step5_d1": (7, (64, 256, 1024)),
+        ("fma", "float32"): (2, (512, 2048, 8192)),
+        ("step5_d0", "float32"): (7, (256, 1024, 4096)),
+        ("step5_d1", "float32"): (7, (64, 256, 1024)),
+        ("fma", "bfloat16"): (2, (512, 2048, 8192)),
+        ("step5_d1", "bfloat16"): (7, (64, 256, 1024)),
     }
     probe_rate = {}
-    for mix, (ops, reps3) in PROBES.items():
-        ts = np.array([probe_per_call(mix, r) for r in reps3])
+    for (mix, dname), (ops, reps3) in PROBES.items():
+        ts = np.array([probe_per_call(mix, r, dname) for r in reps3])
         rarr = np.array(reps3, np.float64)
         per_rep, off = np.polyfit(rarr, ts, 1)
         # linearity gate: the middle point must sit on the 2-point line
@@ -727,10 +731,10 @@ def bench_vpu(results):
             # NaN convention), not ship a confident headline with the
             # anomaly buried in the detail string
             per_rep = float("nan")
-        probe_rate[mix] = elems / per_rep  # element-steps / s
-        _emit(results, f"vpu_{mix}_gops", elems * ops / per_rep / 1e9,
-              "Gop/s",
-              f"{H}x{W} f32 resident; {per_rep / elems * 1e12:.2f} "
+        probe_rate[(mix, dname)] = elems / per_rep  # element-steps / s
+        _emit(results, f"vpu_{mix}_{dname}_gops",
+              elems * ops / per_rep / 1e9, "Gop/s",
+              f"{H}x{W} {dname} resident; {per_rep / elems * 1e12:.2f} "
               f"ps/elt/rep; nominal {ops} ops/elt; reps={reps3}; "
               f"linearity {lin:.3f}")
 
@@ -759,7 +763,7 @@ def bench_vpu(results):
     tarr = np.array([t_call[k] for k in ks])
     b, a = np.polyfit(karr, tarr, 1)
     kernel_rate = n * n / b  # element-steps / s
-    frac = kernel_rate / probe_rate["step5_d0"]
+    frac = kernel_rate / probe_rate[("step5_d0", "float32")]
     _emit(results, "vpu_kstep_marginal_us", b * 1e6, "us/step",
           f"fit t(k)=a+b*k over k={ks}; a={a * 1e6:.0f} us; "
           f"implied plateau {1.0 / b:.0f} iter/s")
@@ -767,6 +771,47 @@ def bench_vpu(results):
           "kernel element rate / step5_d0 in-VMEM probe rate "
           "(1.0 = the schedule reaches the measured VPU ceiling "
           "for its own op mix)")
+
+    # the OFFICIAL bf16 headline schedule's marginal per-step cost:
+    # dim-1 single buffer at 8192² bf16 (no mesh — the kernel alone),
+    # against the bf16 step5_d1 probe ceiling
+    t16 = {}
+    for k in ks:
+        K16 = N_BND * k
+        z16 = np.random.default_rng(2).normal(
+            size=(n, n + 2 * K16)
+        ).astype(jnp.bfloat16) / np.asarray(10, jnp.bfloat16)
+
+        @functools.partial(jax.jit, donate_argnums=0,
+                           static_argnames=("k",))
+        def run16(z, n_iter, k):
+            def body(_, cur):
+                return PK.stencil2d_iterate_pallas(
+                    cur, 1e-4, dim=1, steps=k, phys_static=(1, 1)
+                )
+
+            return lax.fori_loop(0, jnp.asarray(n_iter, jnp.int32),
+                                 body, z)
+
+        z = jnp.asarray(z16)
+        z = block(run16(z, 1, k=k))
+        sec, z = chain_rate(
+            lambda zz, n_it, k=k: run16(zz, n_it, k=k), z,
+            n_short=max(5, 50 // k), n_long=max(50, 2000 // k),
+        )
+        t16[k] = sec
+        _emit(results, f"vpu_kstep_bf16_d1_k{k}_iters_per_s", k / sec,
+              "iter/s", f"{n}x{n} bf16 dim-1 single buffer")
+        del z
+    t16arr = np.array([t16[k] for k in ks])
+    b16, a16 = np.polyfit(karr, t16arr, 1)
+    frac16 = (n * n / b16) / probe_rate[("step5_d1", "bfloat16")]
+    _emit(results, "vpu_kstep_bf16_marginal_us", b16 * 1e6, "us/step",
+          f"fit over k={ks}; a={a16 * 1e6:.0f} us; implied plateau "
+          f"{1.0 / b16:.0f} iter/s")
+    _emit(results, "vpu_kstep_bf16_vs_probe_ceiling", frac16, "ratio",
+          "bf16 dim-1 kernel element rate / bf16 step5_d1 in-VMEM "
+          "probe rate")
 
 
 def bench_stripebalance(results):

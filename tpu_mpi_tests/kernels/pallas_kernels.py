@@ -233,9 +233,19 @@ def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
     if mix == "fma":
         # 2 nominal VPU ops/elt/rep (mul + add; one op if the hardware
         # fuses) — the dependent chain pipelines across the block's rows,
-        # so this measures elementwise THROUGHPUT, not ALU latency
+        # so this measures elementwise THROUGHPUT, not ALU latency.
+        # Constants take the BLOCK dtype (f32 literals would promote a
+        # bf16 block to f32 compute and silently measure the wrong mix)
+        # and must be FOLD-PROOF in every dtype: 1.0000001 rounds to
+        # exactly 1.0 in bf16 and the multiply could be simplified away.
+        # a = 1 − 2⁻⁷ is exact in bf16 and f32, and with a < 1 the
+        # recurrence converges to the b/(1−a) ≈ 1.3e-8 fixed point —
+        # kilorep chains neither overflow nor decay to a foldable zero
+        a = jnp.asarray(0.9921875, z.dtype)
+        b = jnp.asarray(1e-10, z.dtype)
+
         def body(_, z):
-            return jnp.float32(1.0000001) * z + jnp.float32(1e-12)
+            return a * z + b
     else:
         # the EXACT k-step kernel body (_step5 + band concat) applied to
         # the resident block: 7 nominal ops/elt/rep (2 sub + 2 mul + 1
@@ -244,7 +254,7 @@ def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
         # fma mix is the point of the probe
         axis = 0 if mix == "step5_d0" else 1
         N = z.shape[axis]
-        se = jnp.float32(se)
+        se = jnp.asarray(se, z.dtype)
 
         def body(_, z):
             upd = _step5(z, N_BND, N - 2 * N_BND, axis, se)
