@@ -31,6 +31,20 @@ def test_sweep_all_collectives(capsys, tmp_path):
     assert all(r["world"] == 8 for r in coll)
 
 
+def test_rdma_credits_2_sweep(capsys):
+    """--rdma-credits 2 runs the double-buffered reduce-scatter variant
+    through the driver (the one-command pod experiment) and reports a
+    structurally valid row."""
+    rc = collbench.main([
+        "--collectives", "allreduce_rdma", "--sizes-kib", "64",
+        "--n-iter", "20", "--rdma-credits", "2",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "rdma_credits=2" in out
+    assert re.search(r"COLL allreduce_rdma bytes=65536 .* credits=2", out)
+
+
 def test_busbw_accounting():
     # nccl-tests conventions at w=8, 1 MiB shards
     b = 1 << 20

@@ -323,7 +323,7 @@ def reduce_scatter_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
 
 @functools.lru_cache(maxsize=None)
 def _allreduce_rdma_fn(mesh: Mesh, axis_name: str,
-                       interpret: bool | None):
+                       interpret: bool | None, credits: int = 1):
     from tpu_mpi_tests.kernels.pallas_kernels import ring_allreduce_pallas
 
     @jax.jit
@@ -334,21 +334,24 @@ def _allreduce_rdma_fn(mesh: Mesh, axis_name: str,
     def reduce(x):
         # shard is this logical rank's (1, L) row; the ring runs on the row
         return ring_allreduce_pallas(
-            x[0], axis_name=axis_name, interpret=interpret
+            x[0], axis_name=axis_name, interpret=interpret,
+            credits=credits,
         )[None]
 
     return reduce
 
 
 def allreduce_rdma(per_rank, mesh: Mesh, axis_name: str | None = None,
-                   interpret: bool | None = None):
+                   interpret: bool | None = None, credits: int = 1):
     """Hand-tier :func:`allreduce_sum`: explicit-RDMA ring reduce-scatter +
     all-gather instead of ``lax.psum`` (≅ hand-writing the in-place device
     ``MPI_Allreduce(MPI_SUM)`` of ``mpi_stencil2d_gt.cc:615-625`` as
     2(w−1) ring hops; SURVEY §5.8). Same contract as :func:`allreduce_sum`
     (``(n_ranks, L)`` sharded on axis 0 → every row the elementwise sum);
     ``L`` must satisfy the ring kernels' lane alignment
-    (``L % (w·128·sublane) == 0``)."""
+    (``L % (w·128·sublane) == 0``). ``credits=2`` selects the
+    double-buffered reduce-scatter (the pod-latency variant — see
+    ``ring_reduce_scatter_pallas``)."""
     axis_name = axis_name or mesh.axis_names[0]
     n = mesh.shape[axis_name]
     if per_rank.ndim != 2 or per_rank.shape[0] != n:
@@ -356,7 +359,9 @@ def allreduce_rdma(per_rank, mesh: Mesh, axis_name: str | None = None,
             f"allreduce_rdma: need shape (n_ranks={n}, L), got "
             f"{per_rank.shape}"
         )
-    return _allreduce_rdma_fn(mesh, axis_name, interpret)(per_rank)
+    return _allreduce_rdma_fn(
+        mesh, axis_name, interpret, credits
+    )(per_rank)
 
 
 def host_value(x) -> np.ndarray:

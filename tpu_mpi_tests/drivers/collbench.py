@@ -52,7 +52,8 @@ COLL_LINE_RE = (
 )
 
 
-def _loop_fn(mesh, axis_name: str, name: str, world: int):
+def _loop_fn(mesh, axis_name: str, name: str, world: int,
+             rdma_credits: int = 1):
     import jax
     import jax.numpy as jnp
     from jax import lax, shard_map
@@ -105,7 +106,7 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int):
 
             def body(_, x):
                 return ring_allreduce_pallas(
-                    x, axis_name=axis_name
+                    x, axis_name=axis_name, credits=rdma_credits
                 ) * (1.0 / world)
         else:  # alltoall
             def body(_, x):
@@ -163,7 +164,8 @@ def run(args) -> int:
     rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
     rep.banner(
         f"collbench: world={world} sizes_kib={args.sizes_kib} "
-        f"collectives={args.collectives} n_iter={args.n_iter}"
+        f"collectives={args.collectives} n_iter={args.n_iter} "
+        f"rdma_credits={args.rdma_credits}"
     )
 
     names = _common.parse_choice_list(
@@ -182,7 +184,8 @@ def run(args) -> int:
                 # the alltoall reshape and the psum_scatter chunking both
                 # split the shard w ways
                 check_divisible(n, world, f"{name} elements per shard")
-            run_fn = _loop_fn(mesh, axis_name, name, world)
+            run_fn = _loop_fn(mesh, axis_name, name, world,
+                              rdma_credits=args.rdma_credits)
             if name in COLLECTIVES_RDMA:
                 # ring kernels have lane-alignment floors (e.g. w·128·
                 # sublane elements for the 1-D allreduce); probe at trace
@@ -217,15 +220,23 @@ def run(args) -> int:
             )
             moved = _busbw_bytes(name, shard_bytes, world)
             busbw = moved / sec / 1e9
+            # rdma rows record their credit depth, or the pod A/B the
+            # --rdma-credits flag exists for cannot be reconstructed
+            # from merged jsonl results
+            cred_txt = (f" credits={args.rdma_credits}"
+                        if name == "allreduce_rdma" else "")
+            cred_rec = ({"rdma_credits": args.rdma_credits}
+                        if name == "allreduce_rdma" else {})
             rep.line(
                 # %.4g, not %.2f: a loaded host can push busbw below
                 # 0.005 GB/s, which fixed-point floors to a misleading
                 # "0.00" (a positive measurement must print positive)
                 f"COLL {name} bytes={shard_bytes} {sec * 1e6:0.2f} us/iter"
-                f"  busbw={busbw:0.4g} GB/s  n={n_eff}",
+                f"  busbw={busbw:0.4g} GB/s  n={n_eff}{cred_txt}",
                 {"kind": "coll", "collective": name, "dtype": args.dtype,
                  "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
-                 "busbw_gbps": busbw, "world": world, "n_iter": n_eff},
+                 "busbw_gbps": busbw, "world": world, "n_iter": n_eff,
+                 **cred_rec},
             )
             del x
     return 0
@@ -240,6 +251,14 @@ def main(argv=None) -> int:
         f"tier, {'/'.join(COLLECTIVES_RDMA)} select the hand-written "
         "RDMA ring twins (sizes below their lane-alignment floor are "
         "reported as COLL-SKIP)",
+    )
+    p.add_argument(
+        "--rdma-credits", type=int, default=1, choices=(1, 2),
+        help="receiver-credit depth for the allreduce_rdma ring's "
+        "reduce-scatter phase: 2 = the double-buffered pod-latency "
+        "variant (overlaps send s+1 with the right neighbor's fold of "
+        "s; simulated-race-free, wall-clock benefit needs multi-chip "
+        "skew — this flag is the one-command pod experiment)",
     )
     p.add_argument(
         "--sizes-kib",

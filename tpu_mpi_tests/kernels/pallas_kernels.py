@@ -1994,6 +1994,7 @@ def ring_allreduce_pallas(
     axis_name: str,
     collective_id: int = 10,
     interpret: bool | None = None,
+    credits: int = 1,
 ):
     """Hand-tier ring allreduce: reduce-scatter (w−1 hops) + ring
     all-gather (w−1 hops) — the bandwidth-optimal 2(w−1)/w·n schedule and
@@ -2011,6 +2012,7 @@ def ring_allreduce_pallas(
         axis_name=axis_name,
         collective_id=collective_id,
         interpret=interpret,
+        credits=credits,
     )
     if jax.lax.axis_size(axis_name) == 1:
         return rs
