@@ -42,7 +42,11 @@ def test_rdma_credits_2_sweep(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "rdma_credits=2" in out
-    assert re.search(r"COLL allreduce_rdma bytes=65536 .* credits=2", out)
+    rows = re.findall(collbench.COLL_LINE_RE, out)
+    assert rows and rows[0][0] == "allreduce_rdma"
+    # the SHARED parse pattern recovers the credit depth (last group) —
+    # format and regex live next to each other in collbench by contract
+    assert rows[0][5] == "2"
 
 
 def test_busbw_accounting():

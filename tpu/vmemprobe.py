@@ -176,9 +176,6 @@ def configs():
         name = f"heat_k{steps}_{nx}x{ny}_{jnp.dtype(dtype).name}"
         B = PK._fit_block_rows(ny, steps, itemsize, sub,
                                bf16_temps=PK._BF16_TEMPS_HEAT)
-        if itemsize == 2:
-            # mirror the kernel's measured-best bf16 row-block clamp
-            B = min(B, PK._BF16_HEAT_ROW_CLAMP)
         if PK._stream_live_bytes(B, steps, ny, itemsize,
                                  bf16_temps=PK._BF16_TEMPS_HEAT) > \
                 PK._VMEM_BUDGET_CAL:

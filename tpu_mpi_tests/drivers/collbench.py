@@ -48,7 +48,7 @@ COLLECTIVES_RDMA = ("allgather_rdma", "allreduce_rdma")
 # a format change is a one-site edit; both test files import this
 COLL_LINE_RE = (
     r"COLL (\w+) bytes=(\d+) ([\d.e+-]+|nan) us/iter  "
-    r"busbw=([\d.e+-]+|nan) GB/s  n=(\d+)"
+    r"busbw=([\d.e+-]+|nan) GB/s  n=(\d+)(?: credits=(\d+))?"
 )
 
 

@@ -771,10 +771,6 @@ def _iterate_stream0_kernel(z_ref, top_ref, bot_ref, scale_eps_ref, *rest,
 _BF16_TEMPS_DEFAULT = 22.0
 _BF16_TEMPS_ITER_STREAM = 18.4   # 17.51 measured · 1.05
 _BF16_TEMPS_HEAT = 15.3          # 14.57 measured · 1.05
-# heat's measured-best bf16 row block (interleaved A/B, 4096² k=4: 128
-# ~7% over the budget-admitted 256) — shared with tpu/vmemprobe.py so
-# the probe always validates the geometry production runs
-_BF16_HEAT_ROW_CLAMP = 128
 
 
 def _stream_live_bytes(B: int, halo: int, width: int, itemsize: int,
@@ -1183,12 +1179,13 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
     G = n_bnd
     if steps > G:
         raise ValueError(f"heat2d_pallas: steps={steps} > ghost width {G}")
-    if tile_rows is None and jnp.dtype(z.dtype) == jnp.bfloat16:
-        # the round-4 calibrated budget admits 256-row blocks at bf16,
-        # but the interleaved A/B (4096², k=4, 3 reps) measured 128-row
-        # blocks ~7% faster — deeper pipelining wins; the model governs
-        # SAFETY, this clamp records the measured speed choice
-        tile_rows = _BF16_HEAT_ROW_CLAMP
+    # NOTE a round-4 attempt to clamp bf16 blocks at 128 on A/B evidence
+    # was REVERTED: at widths where B=256 genuinely fits (≤~2.5k bf16)
+    # the 2048² workload sits under the ~100 µs per-call overhead floor
+    # and the measured "difference" was noise, while at 4096² the
+    # calibrated fit caps B at 128 anyway — both A/B arms had silently
+    # run the same kernel. The fitted B stands; tile_rows remains the
+    # explicit override.
     B = _stream_fit(
         z, G, "heat2d_pallas", tile_rows,
         bf16_temps=(_BF16_TEMPS_HEAT
