@@ -668,6 +668,41 @@ def test_ring_allgather_self_ring_rejects_multi_device(mesh8):
         ag(jnp.ones((64, 8), jnp.float32))
 
 
+def test_ring_reduce_scatter_rejects_bad_credits(mesh8):
+    import functools
+
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def rs(x):
+        return PK.ring_reduce_scatter_pallas(
+            x, axis_name="shard", interpret=True, credits=3
+        )
+
+    with pytest.raises(ValueError, match="credits=3"):
+        rs(jnp.ones((8 * 64, 8), jnp.float32))
+
+
+def test_allreduce_rdma_credits_2_matches_psum(mesh8):
+    """The comm-layer credits passthrough: the 2-credit hand allreduce
+    equals lax.psum (integer-valued so summation order cannot differ)."""
+    from tpu_mpi_tests.comm import collectives as C
+
+    L = 8 * 1024
+    per_rank = (np.arange(8 * L, dtype=np.float32).reshape(8, L) % 17) - 8.0
+    got = np.asarray(C.allreduce_rdma(
+        C.shard_1d(jnp.asarray(per_rank), mesh8), mesh8, interpret=True,
+        credits=2,
+    ))
+    assert np.array_equal(got, np.broadcast_to(per_rank.sum(0), got.shape))
+
+
 def test_ring_reduce_scatter_self_ring_rejects_multi_device(mesh8):
     import functools
 

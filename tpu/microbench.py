@@ -601,13 +601,14 @@ def bench_blocks(results):
 def bench_heat(results):
     """heat2d mini-app update tiers (BASELINE heat2d row): XLA body vs the
     in-place row-streaming Pallas Laplacian, k ∈ {1, 4, 8} at 2048²,
-    f32 and (round 4, under the calibrated VMEM fit + measured-best
-    B=128 clamp) bf16. CAVEAT for the bf16 rows at this size: one
+    f32 and (round 4, under the calibrated VMEM fit) bf16. CAVEAT for
+    the bf16 rows at this size: one
     k-group's device work (~24 µs at k=4) sits BELOW the ~100 µs
     per-call launch overhead, so single runs swing ~3× with the shared
     chip's contention (21k–61k steps/s observed at k=4) — treat them as
-    floor-bound; the robust bf16 heat evidence is the 4096² interleaved
-    A/B (BASELINE round-4 strip re-sweep)."""
+    floor-bound; bf16 heat at 4096² (5.6–6.8k steps/s) is the robust
+    size (BASELINE round-4 strip re-sweep, incl. the reverted
+    noise-based block-clamp note)."""
     import numpy as np
 
     import jax
