@@ -713,6 +713,7 @@ def bench_vpu(results):
         ("step5_d0", "float32"): (7, (256, 1024, 4096)),
         ("step5_d1", "float32"): (7, (64, 256, 1024)),
         ("fma", "bfloat16"): (2, (512, 2048, 8192)),
+        ("step5_d0", "bfloat16"): (7, (256, 1024, 4096)),
         ("step5_d1", "bfloat16"): (7, (64, 256, 1024)),
     }
     probe_rate = {}
