@@ -2,7 +2,7 @@
 
 Runs the flagship per-iteration pipeline — halo exchange + 5-point stencil
 derivative + in-place interior update, the ``mpi_stencil2d_gt.cc:511-535``
-hot loop — on an 8192×8192 float32 domain and prints ONE JSON line.
+hot loop — on an 8192×8192 domain and prints ONE JSON line.
 
 Fast path (TPU, one device, temporal blocking on): the resident-block
 schedule (``halo.iterate_pallas_blocks_fn``) — the domain lives as S=2
@@ -25,11 +25,17 @@ point, so the ratio is a hardware/kernel comparison, not a dtype-width
 artifact; the reference's native-f64 roofline (503 iter/s) is kept as
 secondary context in BASELINE.md.
 
-``TPU_MPI_BENCH_DTYPE=bfloat16`` runs the measured-best 16-bit schedule
-(dim-1 single-buffer, temporal blocking k≥2 — at 16-bit, lane packing
-favors the dim-1 kernel and every resident-block variant loses,
-BASELINE.md round-2/3 bf16 findings), against the 2012 iter/s 16-bit
-roofline. Default stays float32.
+Round 5 (VERDICT r4 #3): ONE invocation measures BOTH official dtypes.
+The primary dtype (``TPU_MPI_BENCH_DTYPE``, default float32) keeps the
+top-level headline fields for cross-round comparability; the other dtype
+runs its own measured-best schedule in the same process/window and lands
+as a same-shaped sub-object under its dtype name — so the driver-captured
+``BENCH_r{N}.json`` carries the repo's fastest official number (bf16
+dim-1, k≥2 temporal blocking — BASELINE.md round-2/3 bf16 findings)
+without env vars. ``TPU_MPI_BENCH_SECOND_DTYPE=none`` disables the
+second measurement; an explicit ``TPU_MPI_BENCH_BLOCKS`` override applies
+to the PRIMARY dtype only (the secondary always runs its default
+schedule, keeping the sub-object's meaning fixed).
 """
 
 from __future__ import annotations
@@ -42,74 +48,41 @@ V100_HBM_GBPS = 810.0  # STREAM-class HBM2 measured-class bandwidth
 V100_F64_ITERS_PER_S = 503.0  # 810e9 / (3 * 8 * 8192**2), reference dtype
 
 
-def main() -> None:
-    import jax
+def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
+             blocks_env: str | None):
+    """One dtype's full measurement: build the schedule, chain-time it,
+    median-of-samples. Returns the JSON-ready dict (top-level field shapes;
+    the caller nests the secondary dtype's copy)."""
+    import jax.numpy as jnp
     import numpy as np
 
     from tpu_mpi_tests.arrays.domain import Domain2D
     from tpu_mpi_tests.comm.collectives import shard_blocks
     from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
-    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
     from tpu_mpi_tests.instrument.timers import chain_rate
-    from tpu_mpi_tests.kernels.stencil import analytic_pairs
-    from tpu_mpi_tests.utils import check_divisible
-
-    # TPU_MPI_BENCH_N / _FAKE_DEVICES shrink the run for CI smoke; the
-    # official metric is the 8192 default on real hardware (the baseline
-    # constant assumes it)
-    n = int(os.environ.get("TPU_MPI_BENCH_N", 8192))
-    dtype_name = os.environ.get("TPU_MPI_BENCH_DTYPE", "float32")
-    if dtype_name not in ("float32", "bfloat16"):
-        raise SystemExit(
-            f"TPU_MPI_BENCH_DTYPE={dtype_name!r} unsupported "
-            "(float32 | bfloat16)"
-        )
-    import jax.numpy as jnp
+    from tpu_mpi_tests.kernels.stencil import N_BND, analytic_pairs
 
     dtype = np.dtype(jnp.bfloat16) if dtype_name == "bfloat16" \
         else np.dtype(np.float32)
-    # temporal blocking: k timesteps per HBM pass over deep (k·2-wide)
-    # halos — interior-identical to per-step exchange (tested in
-    # tests/test_pallas.py::test_iterate_multistep_*); the exchanged volume
-    # per timestep is unchanged, messages drop k-fold
-    steps = int(os.environ.get("TPU_MPI_BENCH_STEPS", 4))
-    n_fake = int(os.environ.get("TPU_MPI_BENCH_FAKE_DEVICES", "0"))
-    if n_fake > 0:  # 0 = off, matching the drivers' --fake-devices default
-        from tpu_mpi_tests.drivers._common import force_cpu_devices
-
-        force_cpu_devices(n_fake)
     eps = 1e-6
-    bootstrap()
-    topo = topology()
-    world = topo.global_device_count
-    mesh = make_mesh()
-    axis_name = mesh.axis_names[0]
-
-    check_divisible(n, world, "bench domain over devices")
     if topo.platform != "tpu":
         steps = 1  # CPU smoke path uses the XLA iterate (shallow halos)
-    from tpu_mpi_tests.kernels.stencil import N_BND
 
     # resident-block schedule (TPU, k>1): S separate buffers per shard
     # run the fast full-height dim-0 (sublane-tap) kernel; the
     # inter-block ghost refresh is a narrow in-chip band copy and, on a
     # multi-device mesh, the outermost ghost bands ride a ppermute ring
-    # over ICI (round-3 generalization — the schedule now runs on real
-    # multi-chip meshes, VERDICT r2 next #1). Measured 3021 vs 2087
-    # iter/s against the single-buffer dim-1 kernel in the same
-    # contention window (BASELINE.md). TPU_MPI_BENCH_BLOCKS=0 disables
-    # (dim-1 schedule).
-    # bf16 default: no blocks — the dim-1 single-buffer kernel is the
-    # measured-best 16-bit schedule (explicit TPU_MPI_BENCH_BLOCKS still
-    # overrides for A/B)
+    # over ICI (round-3 generalization). Measured 3021 vs 2087 iter/s
+    # against the single-buffer dim-1 kernel in the same contention
+    # window (BASELINE.md). bf16 default: no blocks — the dim-1
+    # single-buffer kernel is the measured-best 16-bit schedule.
     default_blocks = "0" if dtype_name == "bfloat16" else "2"
-    n_blocks = int(os.environ.get("TPU_MPI_BENCH_BLOCKS", default_blocks))
+    n_blocks = int(blocks_env if blocks_env is not None else default_blocks)
     use_blocks = (
         topo.platform == "tpu" and steps > 1
         and n_blocks >= 2 and (n // world) % n_blocks == 0
     )
-    if "TPU_MPI_BENCH_BLOCKS" in os.environ and n_blocks >= 2 \
-            and not use_blocks:
+    if blocks_env is not None and n_blocks >= 2 and not use_blocks:
         # never silently mis-attribute a schedule: a requested block count
         # that fails the gate is reported (stderr — stdout stays the one
         # JSON line) and the JSON records the schedule that actually ran
@@ -181,33 +154,102 @@ def main() -> None:
     # + 1 write) × itemsize — 1006 iter/s f32, 2012 at 16-bit
     equal_width_baseline = V100_HBM_GBPS * 1e9 / (3 * dtype.itemsize
                                                   * 8192**2)
-    print(
-        json.dumps(
-            {
-                "metric": "stencil2d_fullstep_8192_iters_per_s",
-                "value": round(iters_per_s, 2),
-                "unit": "iter/s",
-                "vs_baseline": round(iters_per_s / equal_width_baseline, 3),
-                "vs_f64_reference_roofline": round(
-                    iters_per_s / V100_F64_ITERS_PER_S, 3
-                ),
-                "dtype": dtype_name,
-                # invalid samples become JSON null, not a bare NaN token
-                # that would break strict parsers
-                "samples": [
-                    round(s, 2) if np.isfinite(s) else None for s in samples
-                ],
-                # which per-iteration schedule actually ran (the blocks
-                # gate can decline a requested TPU_MPI_BENCH_BLOCKS)
-                "schedule": (
-                    f"blocks{n_blocks}_dim0_world{world}_{dtype_name}"
-                    if use_blocks
-                    else f"dim1_world{world}_{dtype_name}"
-                ),
-                "steps": steps,
-            }
+    return {
+        "value": round(iters_per_s, 2),
+        "unit": "iter/s",
+        "vs_baseline": round(iters_per_s / equal_width_baseline, 3),
+        "vs_f64_reference_roofline": round(
+            iters_per_s / V100_F64_ITERS_PER_S, 3
+        ),
+        "dtype": dtype_name,
+        # invalid samples become JSON null, not a bare NaN token
+        # that would break strict parsers
+        "samples": [
+            round(s, 2) if np.isfinite(s) else None for s in samples
+        ],
+        # which per-iteration schedule actually ran (the blocks
+        # gate can decline a requested TPU_MPI_BENCH_BLOCKS)
+        "schedule": (
+            f"blocks{n_blocks}_dim0_world{world}_{dtype_name}"
+            if use_blocks
+            else f"dim1_world{world}_{dtype_name}"
+        ),
+        "steps": steps,
+    }
+
+
+def main() -> None:
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.utils import check_divisible
+
+    # TPU_MPI_BENCH_N / _FAKE_DEVICES shrink the run for CI smoke; the
+    # official metric is the 8192 default on real hardware (the baseline
+    # constant assumes it)
+    n = int(os.environ.get("TPU_MPI_BENCH_N", 8192))
+    dtype_name = os.environ.get("TPU_MPI_BENCH_DTYPE", "float32")
+    if dtype_name not in ("float32", "bfloat16"):
+        raise SystemExit(
+            f"TPU_MPI_BENCH_DTYPE={dtype_name!r} unsupported "
+            "(float32 | bfloat16)"
         )
-    )
+    # temporal blocking: k timesteps per HBM pass over deep (k·2-wide)
+    # halos — interior-identical to per-step exchange (tested in
+    # tests/test_pallas.py::test_iterate_multistep_*); the exchanged volume
+    # per timestep is unchanged, messages drop k-fold
+    steps = int(os.environ.get("TPU_MPI_BENCH_STEPS", 4))
+    n_fake = int(os.environ.get("TPU_MPI_BENCH_FAKE_DEVICES", "0"))
+    if n_fake > 0:  # 0 = off, matching the drivers' --fake-devices default
+        from tpu_mpi_tests.drivers._common import force_cpu_devices
+
+        force_cpu_devices(n_fake)
+    bootstrap()
+    topo = topology()
+    world = topo.global_device_count
+    mesh = make_mesh()
+    axis_name = mesh.axis_names[0]
+    check_divisible(n, world, "bench domain over devices")
+
+    rec = {"metric": "stencil2d_fullstep_8192_iters_per_s"}
+    rec.update(_measure(
+        dtype_name, n=n, steps=steps, world=world, mesh=mesh,
+        axis_name=axis_name, topo=topo,
+        blocks_env=os.environ.get("TPU_MPI_BENCH_BLOCKS"),
+    ))
+
+    second = os.environ.get("TPU_MPI_BENCH_SECOND_DTYPE", "")
+    if second in ("none", "0"):
+        second_dtype = None
+    elif second:
+        if second not in ("float32", "bfloat16"):
+            # same contract as the primary knob: a typo must fail, not
+            # record a mislabeled float32 run into the round artifact
+            raise SystemExit(
+                f"TPU_MPI_BENCH_SECOND_DTYPE={second!r} unsupported "
+                "(float32 | bfloat16 | none | 0)"
+            )
+        second_dtype = second
+    else:
+        second_dtype = "bfloat16" if dtype_name == "float32" else "float32"
+    if second_dtype == dtype_name:
+        # explicit-but-redundant request: say so rather than silently
+        # dropping the sub-object (stdout stays the one JSON line)
+        import sys
+
+        print(
+            f"NOTE TPU_MPI_BENCH_SECOND_DTYPE={second!r} equals the "
+            "primary dtype; no second measurement",
+            file=sys.stderr,
+            flush=True,
+        )
+    elif second_dtype:
+        # same process, back-to-back → same contention window as the
+        # primary to first order; the sub-object mirrors the top-level
+        # field shapes so both headlines parse identically
+        rec[second_dtype] = _measure(
+            second_dtype, n=n, steps=steps, world=world, mesh=mesh,
+            axis_name=axis_name, topo=topo, blocks_env=None,
+        )
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -611,6 +611,63 @@ def test_vpu_probe_mixes():
         assert np.abs(want2 - ramp).max() > 1e-3
 
 
+def test_vpu_probe_heat5_mix():
+    """Round-5 probe mix (VERDICT r4 #6): heat5 applies the heat
+    streamer's exact per-step body — replicate it in numpy (clamped-edge
+    shifts, two-axis Euler, interior-only mask) and compare."""
+    reps = 3
+    cx = cy = 0.0078125
+    rng = np.random.default_rng(9)
+    z0 = rng.normal(size=(16, 128)).astype(np.float32)
+    got = np.asarray(PK.vpu_probe_pallas(
+        jnp.asarray(z0), reps, "heat5", interpret=True
+    ))
+    w = z0.astype(np.float64)
+    for _ in range(reps):
+        up = np.concatenate([w[1:], w[-1:]], axis=0)
+        down = np.concatenate([w[:1], w[:-1]], axis=0)
+        right = np.concatenate([w[:, 1:], w[:, -1:]], axis=1)
+        left = np.concatenate([w[:, :1], w[:, :-1]], axis=1)
+        new = (w + cx * (up + down - 2.0 * w)
+               + cy * (left + right - 2.0 * w))
+        keep = np.zeros_like(w, bool)
+        keep[1:-1, 1:-1] = True
+        w = np.where(keep, new, w)
+    np.testing.assert_allclose(got, w, rtol=0, atol=1e-5)
+    assert np.abs(w - z0).max() > 1e-3  # the update is visible
+
+
+def test_vpu_probe_dualdim_mix():
+    """Round-5 probe mix: dualdim applies 4-tap derivatives on both axes,
+    folds them into the interior at ``se`` scale, and adds the f32
+    squared-residual scalar — the exact recurrence replicated in numpy."""
+    from tpu_mpi_tests.kernels.stencil import N_BND, STENCIL5
+
+    reps = 2
+    se = 0.05  # visible against the 2⁻⁷ derivative scale
+    sx = sy = 0.0078125
+    rng = np.random.default_rng(10)
+    z0 = rng.normal(size=(16, 128)).astype(np.float32)
+    got = np.asarray(PK.vpu_probe_pallas(
+        jnp.asarray(z0), reps, "dualdim", se=se, interpret=True
+    ))
+    taps = [(k, float(c)) for k, c in enumerate(STENCIL5) if c != 0.0]
+    z = z0.astype(np.float64)
+    H, W = z.shape
+    for _ in range(reps):
+        dx = sum(c * z[k:k + H - 2 * N_BND, :] for k, c in taps) * sx
+        dy = sum(c * z[:, k:k + W - 2 * N_BND] for k, c in taps) * sy
+        r = ((dx.astype(np.float32) ** 2).sum(dtype=np.float64)
+             + (dy.astype(np.float32) ** 2).sum(dtype=np.float64)) / 1024.0
+        zx = z.copy()
+        zx[N_BND:H - N_BND, :] += se * dx
+        zy = zx.copy()
+        zy[:, N_BND:W - N_BND] += se * dy
+        z = zy + se * r
+    np.testing.assert_allclose(got, z, rtol=0, atol=1e-3)
+    assert np.abs(z - z0).max() > 1e-3
+
+
 def test_vpu_probe_rejects_vmem_blowout():
     with pytest.raises(ValueError, match="VMEM"):
         PK.vpu_probe_pallas(

@@ -1,0 +1,57 @@
+"""CI gate for the pod-day protocol (tpu/pod.sh, round 5 — VERDICT r4
+#7). Lives OUTSIDE test_multiproc.py on purpose: that module skips
+wholesale without a C++ toolchain (tpumt_run), but pod.sh needs only
+bash + python — the gate must not rot on toolchain-less machines."""
+
+import json
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+def test_pod_protocol_dryrun(tmp_path):
+    """The pod-day protocol (tpu/pod.sh, round 5 — VERDICT r4 #7) must
+    stay runnable: a 2-process localhost CPU world at CI shapes executes
+    every cell (dual-dtype bench, XLA + RDMA collective sweeps at both
+    credit depths, contiguous + striped causal ring attention, the
+    stencil2d halo driver, the in-place RDMA gather) and writes a
+    MULTICHIP-shaped PODRUN.json with all cells rc=0 — so real pod
+    access converts to BASELINE rows with zero new engineering on the
+    day."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + (
+        ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    proc = subprocess.Popen(
+        ["bash", str(REPO / "tpu" / "pod.sh"), "-w", "2", "-c",
+         "-o", str(tmp_path)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=560)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, 9)
+        stdout, stderr = proc.communicate()
+        pytest.fail(f"pod.sh dry-run timed out; partial:\n{stdout}\n{stderr}")
+    assert proc.returncode == 0, stdout + stderr
+
+    rec = json.loads((tmp_path / "PODRUN.json").read_text())
+    assert rec["ok"] is True
+    assert rec["world"] == 2
+    expected = {"bench", "coll-xla", "coll-rdma-c1", "coll-rdma-c2",
+                "attn-contig", "attn-striped", "stencil2d", "gather-rdma"}
+    assert set(rec["cells"]) == expected, rec
+    assert all(rc == 0 for rc in rec["cells"].values()), rec
+    # the bench cell's rank-0 output must carry the dual-dtype JSON line
+    bench_out = (tmp_path / "out-pod-bench-r0.txt").read_text()
+    line = [l for l in bench_out.splitlines() if l.startswith("{")][-1]
+    brec = json.loads(line)
+    assert brec["dtype"] == "float32" and "bfloat16" in brec

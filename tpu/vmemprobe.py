@@ -192,9 +192,9 @@ def configs():
         out.append((name, fn, model))
 
     # one-step derivative row-streamer (stencil2d_pallas stream path) and
-    # the dual-dim step kernel at bf16: UNCALIBRATED consumers of the
-    # shared model (conservative default temps) — their ratios are
-    # recorded so future slack is visible, not assumed
+    # the dual-dim step kernel at bf16: round-5 CALIBRATED consumers
+    # (VERDICT r4 #4) — the probe validates the per-kernel coefficients
+    # the fits now run with
     for dtype in (jnp.bfloat16,):
         itemsize = jnp.dtype(dtype).itemsize
         sub = max(8, 8 * 4 // itemsize)
@@ -202,11 +202,17 @@ def configs():
         from tpu_mpi_tests.kernels.stencil import N_BND as NB
 
         try:
-            B, P = PK._fit_stream0_blocks(512, NB, itemsize, sub)
+            B, P = PK._fit_stream0_blocks(
+                512, NB, itemsize, sub,
+                bf16_temps=PK._BF16_TEMPS_DERIV_STREAM,
+            )
         except ValueError as e:
             out.append((name, None, str(e)[:200]))
         else:
-            model = PK._stream_live_bytes(B, NB, P, itemsize)
+            model = PK._stream_live_bytes(
+                B, NB, P, itemsize,
+                bf16_temps=PK._BF16_TEMPS_DERIV_STREAM,
+            )
 
             def fn(dtype=dtype):
                 z = jax.numpy.ones((16388, 512), dtype)
@@ -215,8 +221,10 @@ def configs():
             out.append((name, fn, model))
 
         name = f"dualdim_2056x2056_{jnp.dtype(dtype).name}"
-        Bd = PK._fit_block_rows(2056, NB, itemsize, sub)
-        model = PK._stream_live_bytes(Bd, NB, 2056, itemsize)
+        Bd = PK._fit_block_rows(2056, NB, itemsize, sub,
+                                bf16_temps=PK._BF16_TEMPS_DUAL_DIM)
+        model = PK._stream_live_bytes(Bd, NB, 2056, itemsize,
+                                      bf16_temps=PK._BF16_TEMPS_DUAL_DIM)
 
         def fn2(dtype=dtype):
             z = jax.numpy.ones((2056, 2056), dtype)

@@ -98,7 +98,7 @@ def _local_attention(q, k, v, causal: bool, precision,
 
 
 def _local_attention_flash(q, k, v, causal, interpret, precision,
-                           q_tile, k_tile):
+                           q_tile, k_tile, skip_tile=None):
     """Per-head Pallas flash local attention over (L, H_local, Dh):
     the single-head kernel vmapped over the head axis (pallas_call carries
     a batching rule, so the grid gains a head dimension)."""
@@ -107,6 +107,7 @@ def _local_attention_flash(q, k, v, causal, interpret, precision,
     f = functools.partial(
         flash_attention_pallas, causal=causal, interpret=interpret,
         precision=precision, q_tile=q_tile, k_tile=k_tile,
+        skip_tile=skip_tile,
     )
     return jax.vmap(f, in_axes=1, out_axes=1)(q, k, v)
 
@@ -121,7 +122,8 @@ def ulysses_attention(
     block_keys: int = 512,
     flash: bool = False,
     interpret: bool | None = None,
-    k_tile: int = 2048,
+    k_tile: int | None = None,
+    skip_tile: int | None = None,
 ):
     """Per-shard Ulysses attention (call inside ``shard_map``): inputs
     (L_local, H, Dh) sequence-sharded; H must divide the mesh axis size.
@@ -129,8 +131,10 @@ def ulysses_attention(
     sequence length is bounded by activations, not an L² score matrix.
     ``flash=True`` swaps in the Pallas flash kernel per head (same carry
     as the ring flavor's hand tier) at the kernel's tuned key-tile width
-    (``k_tile``, default 2048 — the per-k-tile carry rescale makes narrow
-    tiles ~2× slower, BASELINE.md); pass ``k_tile`` to override.
+    (``k_tile=None`` resolves to the measured-best width,
+    ``comm.ring.MEASURED_BEST_K_TILE`` — the per-k-tile carry rescale makes
+    narrow tiles ~2× slower, BASELINE.md); pass ``k_tile`` to override;
+    ``skip_tile`` sets the causal sub-span skip granularity (round 5).
     ``block_keys`` governs only the non-flash blockwise path, whose
     narrower default bounds its O(L·block·H) score memory."""
     n = lax.axis_size(axis_name)
@@ -138,7 +142,8 @@ def ulysses_attention(
     qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
     if flash:
         out = _local_attention_flash(qh, kh, vh, causal, interpret,
-                                     precision, q_tile=256, k_tile=k_tile)
+                                     precision, q_tile=256, k_tile=k_tile,
+                                     skip_tile=skip_tile)
     else:
         out = _local_attention(qh, kh, vh, causal, precision,
                                block_keys=block_keys)
@@ -149,7 +154,8 @@ def ulysses_attention(
 def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
                          block_keys: int = 512, flash: bool = False,
                          interpret: bool | None = None,
-                         k_tile: int = 2048,
+                         k_tile: int | None = None,
+                         skip_tile: int | None = None,
                          precision=lax.Precision.HIGHEST):
     """Jitted Ulysses attention over (L_global, H, Dh) arrays sharded along
     the sequence (axis 0). ``flash=True`` uses the Pallas flash kernel for
@@ -171,6 +177,7 @@ def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
         return ulysses_attention(q, k, v, axis_name, causal=causal,
                                  block_keys=block_keys, flash=flash,
                                  interpret=interpret, k_tile=k_tile,
+                                 skip_tile=skip_tile,
                                  precision=precision)
 
     return attn

@@ -49,12 +49,25 @@ def run(args) -> int:
     mesh = make_mesh()
     axis_name = mesh.axis_names[0]
 
+    from tpu_mpi_tests.comm.ring import _resolve_k_tile
+
+    # banner records the OPERATIVE tile widths (k_tile=None resolves
+    # through the measured-best table; both still auto-shrink to divisors
+    # of the block lengths at trace time - the 'ceil' semantics)
+    from tpu_mpi_tests.comm.ring import _resolve_skip_tile
+
+    # stripe only affects the RING tier's layout; flash/ulysses always
+    # run the contig defaults — the banner shows the REQUEST (None =
+    # measured-best table) and each flash-kernel tier's JSONL row
+    # carries its resolved tile CEILINGS (they still auto-shrink to
+    # divisors at trace time; the xla tier records neither — never
+    # mis-attribute a schedule)
     rep = Reporter(rank=topo.process_index, size=world,
                    jsonl_path=args.jsonl)
     rep.banner(
         f"attnbench: L={args.seq_len} d={args.head_dim} tiers={args.tiers} "
         f"dtype={args.dtype} causal={args.causal} stripe={args.stripe} "
-        f"k_tile={args.k_tile} "
+        f"k_tile={args.k_tile} skip_tile={args.skip_tile} "
         f"n_iter={args.n_iter} world={world}"
     )
 
@@ -99,12 +112,13 @@ def run(args) -> int:
                 attn = ring_attention_fn(
                     mesh, axis_name, causal=args.causal, flash=True,
                     precision=prec, stripe=args.stripe,
-                    k_tile=args.k_tile,
+                    k_tile=args.k_tile, skip_tile=args.skip_tile,
                 )
             else:
                 attn = ulysses_attention_fn(
                     mesh, axis_name, causal=args.causal, flash=True,
                     precision=prec, k_tile=args.k_tile,
+                    skip_tile=args.skip_tile,
                 )
         else:
             q, k, v = (
@@ -115,6 +129,7 @@ def run(args) -> int:
                 attn = functools.partial(
                     flash_attention_pallas, causal=args.causal,
                     precision=prec, k_tile=args.k_tile,
+                    skip_tile=args.skip_tile,
                 )
             else:
                 attn = xla_attn
@@ -135,14 +150,20 @@ def run(args) -> int:
         tflops = flops / sec / 1e12
         heads = world if tier == "ulysses" else 1
         striped = tier == "ring" and args.stripe
+        row = {"kind": "attn", "tier": tier, "L": L, "d": d,
+               "dtype": args.dtype, "causal": args.causal,
+               "stripe": striped,
+               "tflops": tflops * heads, "us_per_iter": sec * 1e6,
+               "world": world}
+        if tier != "xla":  # flash-kernel tiers only: resolved ceilings
+            row["k_tile_ceiling"] = _resolve_k_tile(args.k_tile, striped)
+            row["skip_tile_ceiling"] = _resolve_skip_tile(
+                args.skip_tile, striped
+            )
         rep.line(
             f"ATTN {tier}{'[striped]' if striped else ''} L={L} d={d} "
             f"{args.dtype} {tflops * heads:0.1f} TFLOP/s",
-            {"kind": "attn", "tier": tier, "L": L, "d": d,
-             "dtype": args.dtype, "causal": args.causal,
-             "stripe": striped,
-             "tflops": tflops * heads, "us_per_iter": sec * 1e6,
-             "world": world},
+            row,
         )
         if not (tflops > 0):
             rep.line(f"ATTN FAIL {tier}: non-positive rate {tflops}")
@@ -163,13 +184,20 @@ def main(argv=None) -> int:
         "rank ~half-live per step; requires --causal)",
     )
     p.add_argument(
-        "--k-tile", type=int, default=2048,
-        help="flash kernel key-tile ceiling (auto-shrinks to fit). The "
-        "round-4 balance measurement: the striped causal ring realizes "
-        "more of its ~2x balance at finer tiles (paced-proxy speedup "
-        "1.25x at 2048 vs 1.53x at 512, BASELINE.md) - the skip "
-        "granularity vs per-tile carry-rescale trade-off is workload-"
-        "dependent, so it is a knob, not a constant",
+        "--k-tile", type=int, default=None,
+        help="flash kernel key-tile ceiling (auto-shrinks to fit). "
+        "Default: the measured-best width for the layout "
+        "(comm.ring.MEASURED_BEST_K_TILE, pinned to BASELINE.md by "
+        "tests/test_ring.py) - since round 5's skip/rescale decoupling "
+        "the causal skip granularity is the separate --skip-tile knob",
+    )
+    p.add_argument(
+        "--skip-tile", type=int, default=None,
+        help="causal sub-span skip granularity for the diagonal band "
+        "(round 5, VERDICT r4 #1); 0 = coupled path (full-width "
+        "masking). Default: the measured-best per layout "
+        "(comm.ring.MEASURED_BEST_SKIP_TILE - striped wants 256-wide "
+        "sub-span skipping, contiguous/self-causal runs best coupled)",
     )
     p.add_argument(
         "--fast", action="store_true",
@@ -183,8 +211,13 @@ def main(argv=None) -> int:
         p.error("--seq-len must be >= 8 and --head-dim >= 1")
     if args.n_iter < 10:
         p.error("--n-iter must be >= 10")
-    if args.k_tile < 8:
+    if args.k_tile is not None and args.k_tile < 8:
         p.error("--k-tile must be >= 8")
+    if args.skip_tile is not None and args.skip_tile != 0 \
+            and args.skip_tile < 8:
+        p.error("--skip-tile must be 0 (legacy coupled path) or >= 8; "
+                "the kernel snaps it down to a divisor of the fitted "
+                "k_tile at trace time")
     if args.stripe and not args.causal:
         p.error("--stripe requires --causal (non-causal rings are "
                 "already balanced)")

@@ -246,6 +246,86 @@ def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
 
         def body(_, z):
             return a * z + b
+    elif mix == "heat5":
+        # the EXACT heat Laplacian step body (_heat_stream0_kernel's
+        # per-step update: 4 full-extent concat shifts, the two-axis
+        # explicit-Euler expression, the border where-mask) applied to
+        # the resident block — ~11 nominal ops/elt/rep plus the shifts.
+        # cx = cy = 2⁻⁷: exact in bf16 and f32 (fold-proof, round-4 fma
+        # lesson), and a CONTRACTIVE diffusion step — rep chains decay
+        # toward the block mean, never overflow
+        cx = jnp.asarray(0.0078125, z.dtype)
+        cy = jnp.asarray(0.0078125, z.dtype)
+        H, W = z.shape
+        wi = jax.lax.broadcasted_iota(jnp.int32, z.shape, 0)
+        ci = jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
+        ok = (wi >= 1) & (wi < H - 1) & (ci >= 1) & (ci < W - 1)
+
+        def body(_, w):
+            up = jnp.concatenate([w[1:H], w[H - 1:H]], axis=0)
+            down = jnp.concatenate([w[0:1], w[0:H - 1]], axis=0)
+            right = jnp.concatenate([w[:, 1:W], w[:, W - 1:W]], axis=1)
+            left = jnp.concatenate([w[:, 0:1], w[:, 0:W - 1]], axis=1)
+            new = (w + cx * (up + down - 2.0 * w)
+                   + cy * (left + right - 2.0 * w))
+            return jnp.where(ok, new, w)
+    elif mix == "dualdim":
+        # the EXACT dual-dim step body (_dual_step_kernel: 4-tap
+        # derivative accumulations on BOTH axes from one window read,
+        # per-axis scale, f32 squared-residual reduction) — ~20 nominal
+        # ops/elt/rep. The derivatives fold back into the interior and
+        # the residual scalar folds in ``se``-scaled so every output
+        # element depends on the whole reduction (nothing dead-codes);
+        # tests replicate this recurrence in numpy
+        se_c = jnp.asarray(se, z.dtype)
+        sx = jnp.asarray(0.0078125, z.dtype)
+        sy = jnp.asarray(0.0078125, z.dtype)
+        H, W = z.shape
+        taps = [(k, c) for k, c in enumerate(STENCIL5.tolist())
+                if c != 0.0]
+
+        def body(_, zz):
+            accx = None
+            for k, c in taps:
+                t = c * jax.lax.slice_in_dim(zz, k, k + H - 2 * N_BND,
+                                             axis=0)
+                accx = t if accx is None else accx + t
+            dx = accx * sx                      # (H-2G, W)
+            accy = None
+            for k, c in taps:
+                t = c * jax.lax.slice_in_dim(zz, k, k + W - 2 * N_BND,
+                                             axis=1)
+                accy = t if accy is None else accy + t
+            dy = accy * sy                      # (H, W-2G)
+            dxf = dx.astype(jnp.float32)
+            dyf = dy.astype(jnp.float32)
+            # scalar chain stays f32 end-to-end: bf16 scalar arith.mulf /
+            # addf / divf do not legalize on the TPU scalar unit (the
+            # round-4 dual-dim kernel finding, re-confirmed here) — the
+            # scalar broadcasts to an f32 vector and casts at the fold
+            r = (jnp.sum(dxf * dxf) + jnp.sum(dyf * dyf)) / 1024.0
+            shift = jnp.asarray(se, jnp.float32) * r
+            zx = jnp.concatenate(
+                [
+                    jax.lax.slice_in_dim(zz, 0, N_BND, axis=0),
+                    jax.lax.slice_in_dim(zz, N_BND, H - N_BND, axis=0)
+                    + se_c * dx,
+                    jax.lax.slice_in_dim(zz, H - N_BND, H, axis=0),
+                ],
+                axis=0,
+            )
+            zy = jnp.concatenate(
+                [
+                    jax.lax.slice_in_dim(zx, 0, N_BND, axis=1),
+                    jax.lax.slice_in_dim(zx, N_BND, W - N_BND, axis=1)
+                    + se_c * dy,
+                    jax.lax.slice_in_dim(zx, W - N_BND, W, axis=1),
+                ],
+                axis=1,
+            )
+            return zy + jnp.full(
+                zy.shape, shift, jnp.float32
+            ).astype(zz.dtype)
     else:
         # the EXACT k-step kernel body (_step5 + band concat) applied to
         # the resident block: 7 nominal ops/elt/rep (2 sub + 2 mul + 1
@@ -282,12 +362,18 @@ def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
     the pure per-rep VPU cost — the compute-axis twin of the
     stream-count family's bandwidth fit (``tpu/microbench.py streams``).
 
-    Mixes: ``fma`` (elementwise a·z + b, 2 nominal ops/elt) and
+    Mixes: ``fma`` (elementwise a·z + b, 2 nominal ops/elt),
     ``step5_d0``/``step5_d1`` (the k-step stencil kernel's actual
     per-step body on the resident block: 7 nominal ops/elt plus
-    sublane/lane shifts and the band concat). The ratio of the step5
-    rates to the fma rate prices the shifts; the step5_d0 rate is the
-    VPU ceiling the resident-block headline schedule can approach.
+    sublane/lane shifts and the band concat), and — round 5, VERDICT r4
+    #6 — ``heat5`` (the heat Laplacian streamer's exact per-step body:
+    4 concat shifts + two-axis Euler update + border mask, ~11 nominal
+    ops/elt) and ``dualdim`` (the dual-dim step kernel's body: 4-tap
+    derivatives on both axes + f32 squared-residual reduction, ~20
+    nominal ops/elt). The ratio of a kernel mix's rate to the fma rate
+    prices its shifts/reductions; each hand kernel's marginal element
+    rate over its own mix's probe rate is the fraction of the VPU
+    ceiling it reaches (``tpu/microbench.py vpu``/``roofline2``).
 
     ``z`` must be small enough to keep ~4 block-sized live buffers under
     the VMEM budget ((512, 512) f32 = 1 MB blocks in practice). The
@@ -302,7 +388,7 @@ def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
             f"{total} B live in VMEM, over the "
             f"{_VMEM_BUDGET_BYTES // 2**20} MB budget"
         )
-    if mix not in ("fma", "step5_d0", "step5_d1"):
+    if mix not in ("fma", "step5_d0", "step5_d1", "heat5", "dualdim"):
         raise ValueError(f"unknown mix {mix!r}")
     return pl.pallas_call(
         functools.partial(_vpu_probe_kernel, reps=reps, mix=mix, se=se),
@@ -525,7 +611,10 @@ def _stencil_stream0(z, scale_arr, interpret):
     itemsize = jnp.dtype(z.dtype).itemsize
     sub = max(8, 8 * 4 // itemsize)
     # window rows = B + E = B + 2·K at K=N_BND — the iterate fit applies
-    B, P = _fit_stream0_blocks(ny, N_BND, itemsize, sub)
+    B, P = _fit_stream0_blocks(
+        ny, N_BND, itemsize, sub,
+        bf16_temps=_BF16_TEMPS_DERIV_STREAM,
+    )
     nb = pl.cdiv(mx, B)
     _, bot = _row_block_edges(z, B, E, nb)
     return pl.pallas_call(
@@ -581,6 +670,7 @@ def _stencil_stream1(z, scale_arr, interpret):
     P, B = _fit_stream0_blocks(
         ny, N_BND, itemsize, sub,
         label="stencil2d streaming dim-1 (transposed window: rows×cols)",
+        bf16_temps=_BF16_TEMPS_DERIV_STREAM,
     )
     nb = pl.cdiv(mn, B)
     # right edge of out-column block j = input columns [jB+B, jB+B+E);
@@ -771,6 +861,16 @@ def _iterate_stream0_kernel(z_ref, top_ref, bot_ref, scale_eps_ref, *rest,
 _BF16_TEMPS_DEFAULT = 22.0
 _BF16_TEMPS_ITER_STREAM = 18.4   # 17.51 measured · 1.05
 _BF16_TEMPS_HEAT = 15.3          # 14.57 measured · 1.05
+# round-5 calibrations (VERDICT r4 #4 — the last two consumers of the
+# shared model, previously budgeting blind at the conservative default):
+# solved from the round-5 vmemprobe bisected actuals via the shared
+# live-set form (temps = (actual − 4·itemsize·B·W) / (window·W)). The
+# one-step derivative streamer's temps are far below every k-step
+# kernel's — one output, no multi-step window carry — and the dual-dim
+# coefficient admits 256-row blocks at ≤~2.8k widths (re-swept, see
+# BASELINE round-5 calibration note)
+_BF16_TEMPS_DERIV_STREAM = 5.7    # 5.36 measured · 1.05
+_BF16_TEMPS_DUAL_DIM = 10.4      # 9.88 measured · 1.05
 
 
 def _stream_live_bytes(B: int, halo: int, width: int, itemsize: int,
@@ -1296,7 +1396,12 @@ def dual_dim_step_pallas(z, n_bnd: int, scale_x: float, scale_y: float,
             f"(2·n_bnd ghosts + interior), got {z.shape}"
         )
     mx, my = nx - 2 * G, ny - 2 * G
-    B = _stream_fit(z, G, "dual_dim_step_pallas", tile_rows)
+    B = _stream_fit(
+        z, G, "dual_dim_step_pallas", tile_rows,
+        bf16_temps=(_BF16_TEMPS_DUAL_DIM
+                    if jnp.dtype(z.dtype) == jnp.bfloat16
+                    else _BF16_TEMPS_DEFAULT),
+    )
     nb = pl.cdiv(mx, B)
     _, bot = _row_block_edges(z, B, 2 * G, nb)
     coef = jnp.asarray([scale_x, scale_y], z.dtype)
@@ -2120,8 +2225,13 @@ def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile):
     """Tile fit for the resident-K/V flash kernel. Live model (matches the
     Mosaic stack-OOM sizes observed on v5e): the full K/V blocks
     (2·Lk·d·itemsize) + the scores tile in f32 and its dtype-cast copy
-    (q_tile·k_tile·(4+itemsize)) + q/acc/m/l tiles. Returns None when K/V
-    residency alone exceeds VMEM — the caller takes the streaming kernel."""
+    (q_tile·k_tile·(4+itemsize)) + q/acc/m/l tiles. The round-5 causal
+    sub-span path allocates NO extra state (its band sub-spans are
+    narrower than the dense scores tile), so causal and non-causal fits
+    admit identical tiles — a scratch-based design that diverged the two
+    fits was reverted for exactly that reason. Returns None when K/V
+    residency alone exceeds VMEM — the caller takes the streaming
+    kernel."""
 
     def live(qt, kt):
         return (
@@ -2159,8 +2269,8 @@ def _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile):
 
 
 def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
-                        m_out, l_out, acc_out, *, scale, causal, k_tile,
-                        precision):
+                        m_out, l_out, acc_out, *, scale, causal,
+                        k_tile, skip_tile, precision):
     """One q tile against a full K/V block with the online-softmax carry.
 
     The scores tile (q_tile × k_tile) lives only in VMEM/registers — the
@@ -2172,40 +2282,59 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
 
     Causal masking works in GLOBAL positions ``pos = off + stride·idx``
     (``off_ref = [q_off, k_off, stride]``): contiguous layouts pass
-    stride 1; the striped ring layout passes stride = world. Fully-masked
-    k tiles are SKIPPED, not computed-then-masked: the inner loop stops
-    at the last tile whose first key position can be ≤ this q tile's last
-    query position (exact under monotone positions) — causal
-    self-attention does ~half the matmul work of the dense loop.
+    stride 1; the striped ring layout passes stride = world.
+
+    Round 5 (VERDICT r4 next #1) decouples the SKIP granularity from the
+    RESCALE granularity. The causal loop is split in three regimes:
+
+    * columns fully live for EVERY row of this q tile (below the FIRST
+      row's horizon) run mask-free single-pass dense bodies — full
+      ``k_tile``-wide tiles, then ``chunk_cols``-wide spans inside the
+      partial tile — one carry rescale each, no ``where``, wide MXU
+      matmuls;
+    * the narrow band crossing the diagonal (< chunk_cols + q_tile
+      columns) runs masked ``skip_tile``-wide sub-spans, each with its
+      own carry update — per-update cost is confined to the band, so a
+      ~half-live striped block costs ~its live matmul FLOPs while the
+      bulk keeps wide-tile rescale economics (the round-2 finding that
+      narrow tiles everywhere are ~2× slower, BASELINE.md tile-tuning
+      row);
+    * fully-dead columns beyond the LAST row's horizon are never touched
+      (round 3).
     """
     from tpu_mpi_tests.comm.ring import online_softmax_update
 
     q = q_ref[:]                                        # (qt, d)
     m, l, acc = m_ref[:], l_ref[:], acc_ref[:]          # (qt,1)(qt,1)(qt,d)
-    qt = q.shape[0]
+    qt, d = q.shape
     n_kt = k_ref.shape[0] // k_tile
     stride = off_ref[2]
+    # program_id only at kernel top level: the interpret-mode lowering
+    # substitutes it in the outer jaxpr, not inside fori_loop bodies
+    i_q = pl.program_id(0)
     q_pos = (
         off_ref[0] + stride * (
-            pl.program_id(0) * qt
-            + jax.lax.broadcasted_iota(jnp.int32, (qt, 1), 0)
+            i_q * qt + jax.lax.broadcasted_iota(jnp.int32, (qt, 1), 0)
         )
     )
 
-    def body(i, carry):
+    def dense_span(carry, start, width, masked):
+        """One single-pass carry update over columns [start, start+width)
+        (``width`` static): the full-width body shared by the k_tile tile
+        loop and the mask-free chunk loop."""
         m, l, acc = carry
-        kb = k_ref[pl.ds(i * k_tile, k_tile), :]        # (kt, d)
-        vb = v_ref[pl.ds(i * k_tile, k_tile), :]
+        kb = k_ref[pl.ds(start, width), :]              # (width, d)
+        vb = v_ref[pl.ds(start, width), :]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
-        ) * scale                                       # (qt, kt)
-        if causal:
+        ) * scale                                       # (qt, width)
+        if masked:
             k_pos = (
                 off_ref[1] + stride * (
-                    i * k_tile
-                    + jax.lax.broadcasted_iota(jnp.int32, (1, k_tile), 1)
+                    start
+                    + jax.lax.broadcasted_iota(jnp.int32, (1, width), 1)
                 )
             )
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
@@ -2217,19 +2346,81 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
         )
         return m_new, l_new, acc_new
 
-    if causal:
-        # skip fully-masked k tiles: tile i is live iff its first key
-        # position k_off + stride·i·kt ≤ this q tile's LAST query position
-        q_max = off_ref[0] + stride * ((pl.program_id(0) + 1) * qt - 1)
+    def dense_body(i, carry, masked):
+        return dense_span(carry, i * k_tile, k_tile, masked)
+
+    if not causal:
+        m, l, acc = jax.lax.fori_loop(
+            0, n_kt, functools.partial(dense_body, masked=False), (m, l, acc)
+        )
+        m_out[:], l_out[:], acc_out[:] = m, l, acc
+        return
+
+    if skip_tile == 0:
+        # legacy coupled mode (round 3/4 behavior): full-width mask over
+        # every live tile — kept as the interleaved same-window A/B
+        # partner for the decoupled path (microbench ``causal`` group)
+        q_max = off_ref[0] + stride * ((i_q + 1) * qt - 1)
         lim = q_max - off_ref[1]
         n_live = jnp.where(
-            lim < 0,
-            0,
-            jnp.minimum(lim // stride // k_tile + 1, n_kt),
+            lim < 0, 0, jnp.minimum(lim // stride // k_tile + 1, n_kt)
         )
-    else:
-        n_live = n_kt
-    m, l, acc = jax.lax.fori_loop(0, n_live, body, (m, l, acc))
+        m, l, acc = jax.lax.fori_loop(
+            0, n_live, functools.partial(dense_body, masked=True),
+            (m, l, acc),
+        )
+        m_out[:], l_out[:], acc_out[:] = m, l, acc
+        return
+
+    cap = n_kt * k_tile
+    # live-column horizons: C_min from the FIRST query row (columns below
+    # it are live for every row → mask-free), C_max from the LAST (columns
+    # beyond it are dead for every row → skipped). Positions are monotone
+    # in the row index (stride ≥ 1), so both horizons are exact.
+    q_min = off_ref[0] + stride * (i_q * qt)
+    q_max = off_ref[0] + stride * ((i_q + 1) * qt - 1)
+    c_min = jnp.clip((q_min - off_ref[1]) // stride + 1, 0, cap)
+    c_max = jnp.clip((q_max - off_ref[1]) // stride + 1, 0, cap)
+    n_full = c_min // k_tile
+
+    m, l, acc = jax.lax.fori_loop(
+        0, n_full, functools.partial(dense_body, masked=False), (m, l, acc)
+    )
+
+    # BOUNDARY REGION: columns [n_full·k_tile, c_max) — the partial-tile
+    # remainder plus the diagonal band, width < k_tile + qt. The mask-free
+    # prefix fully live for every row (end ≤ C_min — up to a whole tile on
+    # the contiguous diagonal) runs chunk_cols-wide dense bodies; the
+    # remaining ≤ (chunk_cols + qt)/skip_tile sub-spans to C_max run the
+    # dense body at skip_tile width WITH the mask. Each sub-span pays its
+    # own carry rescale, but only the narrow band does — the round-2
+    # "narrow tiles are 2× slower" cost came from rescaling EVERY tile of
+    # the block at fine granularity. (Design history: a scores-scratch
+    # two-pass variant with ONE rescale per boundary chunk measured
+    # SLOWER than even the coupled path on the self-causal diagonal —
+    # the scratch round-trip + separate exp pass cost more than the
+    # rescales it saved — and its full-k_tile scratch silently halved
+    # the f32 L=8192 fit in the decoupled arm only. Sub-span alignment:
+    # skip | chunk | k_tile | Lk, so no sub-span crosses the K block and
+    # no dynamic-slice clamp can shift data against the mask positions.)
+    base = n_full * k_tile
+    chunk_cols = skip_tile * max(1, 1024 // skip_tile)
+    chunk_cols = skip_tile * _fit_divisor(
+        k_tile // skip_tile, chunk_cols // skip_tile
+    )
+    n_fc = jnp.maximum(0, (c_min - base) // chunk_cols)  # fully-live chunks
+
+    def dense_chunk_body(c, carry):
+        return dense_span(carry, base + c * chunk_cols, chunk_cols, False)
+
+    m, l, acc = jax.lax.fori_loop(0, n_fc, dense_chunk_body, (m, l, acc))
+
+    def band_body(s, carry):
+        return dense_span(carry, s * skip_tile, skip_tile, True)
+
+    s0 = (base + n_fc * chunk_cols) // skip_tile
+    s1 = (c_max + skip_tile - 1) // skip_tile
+    m, l, acc = jax.lax.fori_loop(s0, s1, band_body, (m, l, acc))
     m_out[:], l_out[:], acc_out[:] = m, l, acc
 
 
@@ -2328,15 +2519,16 @@ def flash_attention_block_pallas(q, k, v, m, l, acc, q_off, k_off, *,
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "scale", "causal", "q_tile", "k_tile", "interpret", "precision",
-        "self_causal",
+        "scale", "causal", "q_tile", "k_tile", "skip_tile", "interpret",
+        "precision", "self_causal",
     ),
     donate_argnums=(3, 4, 5),
 )
 def _flash_attention_block_jit(
     q, k, v, m, l, acc, q_off, k_off, *,
     scale: float, causal: bool = False,
-    q_tile: int = 256, k_tile: int = 2048,
+    q_tile: int = 256, k_tile: int | None = None,
+    skip_tile: int | None = None,
     interpret: bool | None = None,
     precision=jax.lax.Precision.HIGHEST,
     pos_stride=1,
@@ -2356,11 +2548,35 @@ def _flash_attention_block_jit(
     Causal masking runs in global positions ``off + pos_stride·idx``
     (``pos_stride`` is a traced scalar): the striped ring layout passes
     stride = world so each rank's rows interleave globally. Fully-masked
-    k tiles are skipped, not masked (round-3; VERDICT r2 weak #1).
+    k tiles are skipped, not masked (round-3; VERDICT r2 weak #1). Round 5
+    (VERDICT r4 #1): fully-live columns run mask-free wide dense bodies,
+    and only the narrow diagonal band runs masked ``skip_tile``-wide
+    sub-spans — each band sub-span pays its OWN carry update, so smaller
+    ``skip_tile`` trades finer masking against more rescales within the
+    band (the measured break-even is layout-dependent:
+    ``comm.ring.MEASURED_BEST_SKIP_TILE``). ``skip_tile=0`` is the
+    coupled path (full-width masking over every live tile).
     ``self_causal=True`` (static) requires literal ``q_off == k_off``
     (enforced by the :func:`flash_attention_block_pallas` wrapper) —
     single-block causal self-attention — letting the streaming path also
     elide dead tiles' K/V DMAs via index remapping."""
+    if k_tile is None or skip_tile is None:
+        # measured-best defaults (VERDICT r4 #2); the layout-aware table
+        # lives with the ring layouts, imported lazily like
+        # online_softmax_update (no import cycle). The kernel has no
+        # layout notion (pos_stride is traced), so these fallbacks are
+        # the CONTIG entries — coupled full-width masking, the measured
+        # best for the narrow contiguous/self-causal band;
+        # ring_attention resolves stripe-aware BEFORE calling here
+        from tpu_mpi_tests.comm.ring import (
+            _resolve_k_tile,
+            _resolve_skip_tile,
+        )
+
+        if k_tile is None:
+            k_tile = _resolve_k_tile(None, False)
+        if skip_tile is None:
+            skip_tile = _resolve_skip_tile(None, False)
     L, d = q.shape
     Lk = k.shape[0]
     # shrink requested tiles to (a) the VMEM live-set budget and (b) the
@@ -2389,6 +2605,12 @@ def _flash_attention_block_jit(
 
     if fit is not None:
         q_tile, k_tile = fit
+        # skip granularity: largest divisor of k_tile ≤ the requested
+        # sub-span width (decoupled from the bulk dense-tile width =
+        # k_tile); skip_tile=0 selects the legacy coupled path
+        # (full-width masking over every live tile)
+        if skip_tile:
+            skip_tile = _fit_divisor(k_tile, min(skip_tile, k_tile))
         qspec = pl.BlockSpec((q_tile, d), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
         kvspec = pl.BlockSpec((Lk, d), lambda i: (0, 0),
@@ -2398,7 +2620,7 @@ def _flash_attention_block_jit(
         return pl.pallas_call(
             functools.partial(
                 _flash_block_kernel, scale=scale, causal=causal,
-                k_tile=k_tile, precision=precision,
+                k_tile=k_tile, skip_tile=skip_tile, precision=precision,
             ),
             out_shape=out_shape,
             grid=(L // q_tile,),
@@ -2448,12 +2670,15 @@ def _flash_attention_block_jit(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "scale", "causal", "q_tile", "k_tile", "interpret", "precision"
+        "scale", "causal", "q_tile", "k_tile", "skip_tile", "interpret",
+        "precision",
     ),
 )
 def flash_attention_pallas(
     q, k, v, *, scale: float | None = None, causal: bool = False,
-    q_tile: int = 256, k_tile: int = 2048, interpret: bool | None = None,
+    q_tile: int = 256, k_tile: int | None = None,
+    skip_tile: int | None = None,
+    interpret: bool | None = None,
     precision=jax.lax.Precision.HIGHEST,
 ):
     """Single-device flash attention: softmax(q·kᵀ·scale)·v without ever
@@ -2469,7 +2694,7 @@ def flash_attention_pallas(
     acc = jnp.zeros((L, d), jnp.float32)
     m, l, acc = flash_attention_block_pallas(
         q, k, v, m, l, acc, 0, 0, scale=float(scale), causal=causal,
-        q_tile=q_tile, k_tile=k_tile, interpret=interpret,
-        precision=precision, self_causal=causal,
+        q_tile=q_tile, k_tile=k_tile, skip_tile=skip_tile,
+        interpret=interpret, precision=precision, self_causal=causal,
     )
     return (acc / l).astype(q.dtype)
