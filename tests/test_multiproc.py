@@ -351,4 +351,3 @@ def test_multiproc_stencil2d_managed_space(tpumt_run, tmp_path):
     out0 = rank_outputs(prefix, 2)[0]
     assert re.search(r"TEST dim:0, managed, buf:0; [\d.]+, err=", out0)
     assert "ERR_NORM FAIL" not in out0
-

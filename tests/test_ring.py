@@ -344,6 +344,45 @@ def test_flash_skip_rescale_decoupling(skip_tile):
     np.testing.assert_allclose(got, ref, atol=5e-5)
 
 
+def test_flash_bf16_highest_precision_upcast():
+    """bf16 inputs at precision=HIGHEST (the documented default) must
+    work AND deliver better-than-bf16 arithmetic: Mosaic rejects bf16
+    operands with fp32 contract precision ("Bad lhs type",
+    hardware-discovered round 5), so the kernels upcast sub-f32 matmul
+    operands to f32 in VMEM (`_qk_operands`/`_pv_operands`). Gate: the
+    HIGHEST result from bf16 inputs tracks the f64 reference of the
+    bf16-ROUNDED inputs distinctly tighter than storage rounding alone
+    would require — proof the dots really ran wider than bf16."""
+    from tpu_mpi_tests.kernels.pallas_kernels import flash_attention_pallas
+
+    rng = np.random.default_rng(21)
+    L, d = 256, 64
+    qb, kb, vb = (
+        jnp.asarray(rng.normal(size=(L, d)), jnp.bfloat16) for _ in range(3)
+    )
+    got_hi = np.asarray(flash_attention_pallas(
+        qb, kb, vb, causal=True, q_tile=64, k_tile=128, interpret=True,
+    ).astype(jnp.float32))
+    from jax import lax
+
+    got_lo = np.asarray(flash_attention_pallas(
+        qb, kb, vb, causal=True, q_tile=64, k_tile=128, interpret=True,
+        precision=lax.Precision.DEFAULT,
+    ).astype(jnp.float32))
+    ref = reference_attention(
+        np.asarray(qb, np.float64), np.asarray(kb, np.float64),
+        np.asarray(vb, np.float64), causal=True,
+    )
+    assert np.isfinite(got_hi).all()
+    err_hi = np.abs(got_hi - ref).max()
+    err_lo = np.abs(got_lo - ref).max()
+    # the bf16 OUTPUT cast floors both at ~4e-3; HIGHEST's advantage is
+    # keeping the probabilities f32 into the PV matmul (DEFAULT downcasts
+    # p to bf16), so it must track the reference at least as tightly
+    assert err_hi <= 8e-3, err_hi
+    assert err_hi <= err_lo + 1e-6, (err_hi, err_lo)
+
+
 def test_flash_skip_tile_striped_stride(mesh8):
     """The sub-span skip path under the STRIPED layout's stride=world
     positions (the configuration the decoupling was built for): striped
@@ -445,15 +484,20 @@ def test_flash_tile_skip_at_default_geometry(monkeypatch):
     np.testing.assert_allclose(got_s, ref, atol=5e-5)
 
 
-@pytest.mark.parametrize("causal", [False, True])
-def test_flash_streaming_kv_path(causal, monkeypatch):
+@pytest.mark.parametrize("causal,skip_tile", [
+    (False, None), (True, None), (True, 64), (True, 16),
+])
+def test_flash_streaming_kv_path(causal, skip_tile, monkeypatch):
     """When full K/V residency exceeds the VMEM budget the kernel falls
     back to streaming K/V tiles over a 2-D grid (accumulators resident
     across the inner dimension) — unbounded sequence length on one chip
     (verified at L=32768 d=128 on real hardware, BASELINE.md). Forced
     here by shrinking the budget so small shapes take the streaming path;
     L=1024 with the 256-key tile floor gives 4 inner grid steps, so the
-    j>0 carry fold (the kernel's novel logic) actually executes."""
+    j>0 carry fold (the kernel's novel logic) actually executes.
+    skip_tile ∈ {64, 16} (round 5) exercises the streaming three-regime
+    split: mask-free fully-live cells + the boundary cell's masked
+    sub-span loop (4 and 16 sub-spans per 256-wide tile)."""
     from tpu_mpi_tests.kernels import pallas_kernels as PK
 
     # the budget is read at TRACE time: clear the jit caches so earlier
@@ -468,7 +512,7 @@ def test_flash_streaming_kv_path(causal, monkeypatch):
     try:
         got = np.asarray(PK.flash_attention_pallas(
             jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal,
-            interpret=True,
+            skip_tile=skip_tile, interpret=True,
         ))
     finally:
         PK.flash_attention_pallas.clear_cache()

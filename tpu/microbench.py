@@ -512,18 +512,17 @@ def bench_causal(results):
         )
         iters = max(100, 800 * 8192 // L)
         # (causal?, skip_tile, tag): skip_tile=None resolves to the
-        # measured-best default (0/coupled for this self-causal contig
-        # geometry); the decoupled 256 variant is its same-window A/B
-        # partner — the causal pair ALTERNATES twice back-to-back and
-        # the min is reported (contention only inflates; round-4
-        # separate-pass lesson). This A/B is what MEASURED the
-        # contig-coupled default. The stream path ignores skip_tile
-        # (grid-cell skip) — only resident gets both.
-        variants = [(False, None, "full"), (True, None, "causal")]
-        if path == "resident":
-            variants += [(True, 256, "causal_decoupled"),
-                         (True, None, "causal"),
-                         (True, 256, "causal_decoupled")]
+        # measured-best default (0/coupled for self-causal geometry on
+        # BOTH kernel paths); the decoupled 256 variant is its
+        # same-window A/B partner — the causal pair ALTERNATES twice
+        # back-to-back and the min is reported (contention only
+        # inflates; round-4 separate-pass lesson). These A/Bs are what
+        # MEASURED the coupled defaults (resident contig AND
+        # _STREAM_SKIP_TILE_DEFAULT).
+        variants = [(False, None, "full"), (True, None, "causal"),
+                    (True, 256, "causal_decoupled"),
+                    (True, None, "causal"),
+                    (True, 256, "causal_decoupled")]
         # ONE jitted fn per unique config: redefining inside the
         # alternation loop would make the repeated arms recompile the
         # same program (jax.jit caches per wrapped-function object)
@@ -1016,6 +1015,10 @@ def bench_roofline2(results):
         # tiles (negligible) + the chain feedback's read+write of z
         ops_time = 1.0 / probe_rate[("dualdim", dname)]
         bytes_time = 5 * itemsize / (STREAM_GBPS * 1e9)
+        # a NaN probe rate (linearity-gated) must invalidate the derived
+        # ceiling rows too — NaN comparisons are silently False and
+        # would mislabel the bytes number as an ops-ceiling fraction
+        suspect = suspect or not np.isfinite(ops_time)
         binding = "bytes" if bytes_time > ops_time else "ops"
         model = max(bytes_time, ops_time)
         _emit(results, f"roofline_dualdim_{dname}_marginal_ps",

@@ -51,11 +51,6 @@ def run(args) -> int:
 
     from tpu_mpi_tests.comm.ring import _resolve_k_tile
 
-    # banner records the OPERATIVE tile widths (k_tile=None resolves
-    # through the measured-best table; both still auto-shrink to divisors
-    # of the block lengths at trace time - the 'ceil' semantics)
-    from tpu_mpi_tests.comm.ring import _resolve_skip_tile
-
     # stripe only affects the RING tier's layout; flash/ulysses always
     # run the contig defaults — the banner shows the REQUEST (None =
     # measured-best table) and each flash-kernel tier's JSONL row
@@ -155,11 +150,18 @@ def run(args) -> int:
                "stripe": striped,
                "tflops": tflops * heads, "us_per_iter": sec * 1e6,
                "world": world}
-        if tier != "xla":  # flash-kernel tiers only: resolved ceilings
+        if tier != "xla":  # flash-kernel tiers only
             row["k_tile_ceiling"] = _resolve_k_tile(args.k_tile, striped)
-            row["skip_tile_ceiling"] = _resolve_skip_tile(
-                args.skip_tile, striped
-            )
+            if args.skip_tile is not None:
+                # explicit request: operative on both kernel paths
+                # (modulo the divisor snap)
+                row["skip_tile_ceiling"] = args.skip_tile
+            else:
+                # None resolves PER PATH inside the kernel (layout table
+                # for resident, _STREAM_SKIP_TILE_DEFAULT for streaming)
+                # and the driver cannot know which path the fit takes —
+                # record the request, never a possibly-wrong constant
+                row["skip_tile_req"] = None
         rep.line(
             f"ATTN {tier}{'[striped]' if striped else ''} L={L} d={d} "
             f"{args.dtype} {tflops * heads:0.1f} TFLOP/s",

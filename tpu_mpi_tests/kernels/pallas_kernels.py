@@ -2221,7 +2221,8 @@ def _shrink_tiles_to_budget(live, L, Lk, q_tile, k_tile):
     return _fit_divisor(L, q_tile), _fit_divisor(Lk, k_tile)
 
 
-def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile):
+def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile,
+                     f32_upcast=False):
     """Tile fit for the resident-K/V flash kernel. Live model (matches the
     Mosaic stack-OOM sizes observed on v5e): the full K/V blocks
     (2·Lk·d·itemsize) + the scores tile in f32 and its dtype-cast copy
@@ -2229,32 +2230,49 @@ def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile):
     sub-span path allocates NO extra state (its band sub-spans are
     narrower than the dense scores tile), so causal and non-causal fits
     admit identical tiles — a scratch-based design that diverged the two
-    fits was reverted for exactly that reason. Returns None when K/V
-    residency alone exceeds VMEM — the caller takes the streaming
-    kernel."""
+    fits was reverted for exactly that reason. ``f32_upcast`` (sub-f32
+    inputs at precision=HIGHEST) charges the in-kernel f32 operand
+    copies the upcast helpers materialize (q + per-tile K and V slices).
+    Returns None when K/V residency alone exceeds VMEM — the caller
+    takes the streaming kernel."""
 
     def live(qt, kt):
         return (
             2 * Lk * d * itemsize
             + qt * kt * (4 + itemsize)
             + qt * (d * (itemsize + 4) + 8)
+            + ((qt + 2 * kt) * d * 4 if f32_upcast else 0)
         )
 
     return _shrink_tiles_to_budget(live, L, Lk, q_tile, k_tile)
 
 
-def _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile):
+# Streaming-path skip_tile default, MEASURED on chip (BASELINE round-5
+# streaming-decoupling note): the self-causal stream A/B reads coupled
+# 2.424/2.459 ms vs decoupled 2.637/2.663 at L=32K bf16 (alternated
+# min-of-2) — the boundary cell is 1 of ~8 live cells per q tile and
+# the sub-span machinery costs more than the ~half-cell waste it saves,
+# the same verdict as the resident contiguous diagonal. 0 = coupled
+# full-width masking; the striped ring never reaches this path at
+# production sizes (its blocks stay VMEM-resident), so no striped entry.
+_STREAM_SKIP_TILE_DEFAULT = 0
+
+
+def _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile,
+                      f32_upcast=False):
     """Tile fit for the streaming-K/V kernel: K/V tiles are grid-blocked
     (double-buffered by the pipeline), so only tiles — never full blocks —
-    are resident and any Lk fits. Unsatisfiable only for huge d, which no
-    tiling can fix — raise the constraint instead of the opaque Mosaic
-    scoped-vmem OOM."""
+    are resident and any Lk fits. ``f32_upcast`` charges the
+    HIGHEST-precision sub-f32 operand copies like the resident fit.
+    Unsatisfiable only for huge d, which no tiling can fix — raise the
+    constraint instead of the opaque Mosaic scoped-vmem OOM."""
 
     def live(qt, kt):
         return (
             4 * kt * d * itemsize           # k+v tiles, double-buffered
             + qt * kt * (4 + itemsize)      # scores f32 + dtype-cast copy
             + qt * (d * (itemsize + 4) + 8)
+            + ((qt + 2 * kt) * d * 4 if f32_upcast else 0)
         )
 
     fit = _shrink_tiles_to_budget(live, L, Lk, q_tile, k_tile)
@@ -2266,6 +2284,36 @@ def _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile):
             f"dimension"
         )
     return fit
+
+
+def _wants_true_f32(precision) -> bool:
+    hp = jax.lax.Precision.HIGHEST
+    return precision == hp or precision == (hp, hp)
+
+
+def _qk_operands(q, kb, precision):
+    """HIGHEST-precision matmuls on sub-f32 operands upcast to f32 INSIDE
+    the kernel: Mosaic's ``tpu.matmul`` rejects bf16 operands with fp32
+    contract precision ("Bad lhs type", hardware-discovered round 5), and
+    HIGHEST semantically requests full-f32 arithmetic anyway. f32 inputs
+    (and any non-HIGHEST precision) pass through untouched."""
+    if _wants_true_f32(precision):
+        # each operand independently (callers may pre-hoist the
+        # loop-invariant q upcast; a mixed f32×bf16 dot is not legal)
+        if q.dtype != jnp.float32:
+            q = q.astype(jnp.float32)
+        if kb.dtype != jnp.float32:
+            kb = kb.astype(jnp.float32)
+    return q, kb
+
+
+def _pv_operands(p, vb, precision):
+    """PV-matmul twin of :func:`_qk_operands`: ``p`` is already f32, so
+    under HIGHEST+sub-f32 only ``vb`` upcasts (avoiding the lossy
+    f32→bf16→f32 round trip a generic helper would take)."""
+    if _wants_true_f32(precision) and vb.dtype != jnp.float32:
+        return p, vb.astype(jnp.float32)
+    return p.astype(vb.dtype), vb
 
 
 def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
@@ -2305,6 +2353,11 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
     from tpu_mpi_tests.comm.ring import online_softmax_update
 
     q = q_ref[:]                                        # (qt, d)
+    if _wants_true_f32(precision) and q.dtype != jnp.float32:
+        # hoist the loop-invariant operand upcast: _qk_operands then
+        # sees an f32 q and only casts the per-tile K slice (Mosaic does
+        # not guarantee loop-invariant code motion out of fori bodies)
+        q = q.astype(jnp.float32)
     m, l, acc = m_ref[:], l_ref[:], acc_ref[:]          # (qt,1)(qt,1)(qt,d)
     qt, d = q.shape
     n_kt = k_ref.shape[0] // k_tile
@@ -2326,7 +2379,7 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
         kb = k_ref[pl.ds(start, width), :]              # (width, d)
         vb = v_ref[pl.ds(start, width), :]
         s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
+            *_qk_operands(q, kb, precision), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         ) * scale                                       # (qt, width)
@@ -2340,7 +2393,7 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
         m_new, l_new, p, corr = online_softmax_update(m, l, s, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            *_pv_operands(p, vb, precision), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         )
@@ -2426,7 +2479,7 @@ def _flash_block_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
 
 def _flash_stream_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
                          m_out, l_out, acc_out, *, scale, causal,
-                         k_tile, precision):
+                         k_tile, skip_tile, precision):
     """Streaming-K/V flash step: 2-D grid (q tiles × k tiles), K/V tiles
     DMA'd per inner step instead of resident — unbounded sequence length on
     one chip, at the cost of re-streaming K/V once per q tile. The
@@ -2439,7 +2492,15 @@ def _flash_stream_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
     ``off + stride·idx`` like the resident-K/V kernel. The self-causal
     caller additionally remaps dead cells' K/V index_map onto the last
     live tile so Mosaic elides their DMAs too (same-index revisits are
-    not refetched)."""
+    not refetched).
+
+    Round 5 (``skip_tile`` > 0): the resident kernel's three-regime split
+    applied per CELL — cells fully live for EVERY q row run the mask-free
+    full-width body, and the ≤1 boundary cell crossing the diagonal runs
+    masked ``skip_tile``-wide sub-spans bounded to the live prefix (each
+    with its own carry fold, the band form the resident kernel measured
+    best). ``skip_tile=0`` keeps the coupled full-width-mask body for
+    every live cell."""
     from tpu_mpi_tests.comm.ring import online_softmax_update
 
     i, j = pl.program_id(0), pl.program_id(1)
@@ -2453,23 +2514,40 @@ def _flash_stream_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
     qt = q_ref.shape[0]
     stride = off_ref[2]
     if causal:
+        q_min = off_ref[0] + stride * (i * qt)
         q_max = off_ref[0] + stride * ((i + 1) * qt - 1)
         k_min = off_ref[1] + stride * (j * k_tile)
+        k_max = off_ref[1] + stride * ((j + 1) * k_tile - 1)
         live = k_min <= q_max
+        full = k_max <= q_min if skip_tile else live
     else:
         live = True
+        full = True
 
-    @pl.when(live)
+    def fold_span(s, vb):
+        """One carry fold of scores ``s`` against value rows ``vb`` into
+        the VMEM-resident output accumulators."""
+        m_new, l_new, p, corr = online_softmax_update(
+            m_out[:], l_out[:], s, keepdims=True
+        )
+        acc_out[:] = acc_out[:] * corr + jax.lax.dot_general(
+            *_pv_operands(p, vb, precision), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+        m_out[:] = m_new
+        l_out[:] = l_new
+
+    @pl.when(full)
     def _():
         q = q_ref[:]                                    # (qt, d)
         kb = k_ref[:]                                   # (kt, d)
-        vb = v_ref[:]
         s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
+            *_qk_operands(q, kb, precision), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=precision,
         ) * scale
-        if causal:
+        if causal and not skip_tile:
             q_pos = (
                 off_ref[0] + stride * (
                     i * qt
@@ -2483,16 +2561,47 @@ def _flash_stream_kernel(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, off_ref,
                 )
             )
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m_new, l_new, p, corr = online_softmax_update(
-            m_out[:], l_out[:], s, keepdims=True
-        )
-        acc_out[:] = acc_out[:] * corr + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=precision,
-        )
-        m_out[:] = m_new
-        l_out[:] = l_new
+        fold_span(s, v_ref[:])
+
+    if causal and skip_tile:
+        # boundary cell: masked sub-spans over the live prefix only
+        @pl.when(live & jnp.logical_not(full))
+        def _():
+            q = q_ref[:]
+            if _wants_true_f32(precision) and q.dtype != jnp.float32:
+                q = q.astype(jnp.float32)  # hoisted out of the sub loop
+            q_pos = (
+                off_ref[0] + stride * (
+                    i * qt
+                    + jax.lax.broadcasted_iota(jnp.int32, (qt, 1), 0)
+                )
+            )
+            live_cols = jnp.clip(
+                (q_max - k_min) // stride + 1, 0, k_tile
+            )
+            n_sub = (live_cols + skip_tile - 1) // skip_tile
+
+            def sub(js, _):
+                kb = k_ref[pl.ds(js * skip_tile, skip_tile), :]
+                s = jax.lax.dot_general(
+                    *_qk_operands(q, kb, precision),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                    precision=precision,
+                ) * scale
+                k_pos = (
+                    off_ref[1] + stride * (
+                        j * k_tile + js * skip_tile
+                        + jax.lax.broadcasted_iota(
+                            jnp.int32, (1, skip_tile), 1
+                        )
+                    )
+                )
+                s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+                fold_span(s, v_ref[pl.ds(js * skip_tile, skip_tile), :])
+                return 0
+
+            jax.lax.fori_loop(0, n_sub, sub, 0)
 
 
 def flash_attention_block_pallas(q, k, v, m, l, acc, q_off, k_off, *,
@@ -2560,23 +2669,17 @@ def _flash_attention_block_jit(
     (enforced by the :func:`flash_attention_block_pallas` wrapper) —
     single-block causal self-attention — letting the streaming path also
     elide dead tiles' K/V DMAs via index remapping."""
-    if k_tile is None or skip_tile is None:
+    if k_tile is None:
         # measured-best defaults (VERDICT r4 #2); the layout-aware table
         # lives with the ring layouts, imported lazily like
         # online_softmax_update (no import cycle). The kernel has no
-        # layout notion (pos_stride is traced), so these fallbacks are
-        # the CONTIG entries — coupled full-width masking, the measured
-        # best for the narrow contiguous/self-causal band;
-        # ring_attention resolves stripe-aware BEFORE calling here
-        from tpu_mpi_tests.comm.ring import (
-            _resolve_k_tile,
-            _resolve_skip_tile,
-        )
+        # layout notion (pos_stride is traced), so this fallback is the
+        # CONTIG entry; ring_attention resolves stripe-aware BEFORE
+        # calling here. skip_tile=None resolves PER PATH below — the
+        # resident and streaming kernels measured different optima.
+        from tpu_mpi_tests.comm.ring import _resolve_k_tile
 
-        if k_tile is None:
-            k_tile = _resolve_k_tile(None, False)
-        if skip_tile is None:
-            skip_tile = _resolve_skip_tile(None, False)
+        k_tile = _resolve_k_tile(None, False)
     L, d = q.shape
     Lk = k.shape[0]
     # shrink requested tiles to (a) the VMEM live-set budget and (b) the
@@ -2588,7 +2691,8 @@ def _flash_attention_block_jit(
     # tiles grid-blocked per inner step): slower per call (~re-streams K/V
     # once per q tile) but unbounded in Lk.
     itemsize = jnp.dtype(q.dtype).itemsize
-    fit = _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile)
+    upcast = _wants_true_f32(precision) and itemsize < 4
+    fit = _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile, upcast)
     off = jnp.stack(
         [
             jnp.asarray(q_off, jnp.int32),
@@ -2605,6 +2709,10 @@ def _flash_attention_block_jit(
 
     if fit is not None:
         q_tile, k_tile = fit
+        if skip_tile is None:
+            from tpu_mpi_tests.comm.ring import _resolve_skip_tile
+
+            skip_tile = _resolve_skip_tile(None, False)
         # skip granularity: largest divisor of k_tile ≤ the requested
         # sub-span width (decoupled from the bulk dense-tile width =
         # k_tile); skip_tile=0 selects the legacy coupled path
@@ -2631,7 +2739,15 @@ def _flash_attention_block_jit(
             interpret=_auto_interpret(interpret),
         )(*operands)
 
-    q_tile, k_tile = _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile)
+    q_tile, k_tile = _fit_stream_tiles(
+        L, Lk, d, itemsize, q_tile, k_tile, upcast
+    )
+    if skip_tile is None:
+        skip_tile = _STREAM_SKIP_TILE_DEFAULT
+    # same snap policy as the resident path: band sub-spans must tile the
+    # stream k tile exactly (skip | k_tile keeps every slice in-bounds)
+    if skip_tile:
+        skip_tile = _fit_divisor(k_tile, min(skip_tile, k_tile))
     qspec = pl.BlockSpec((q_tile, d), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM)
     if causal and self_causal:
@@ -2655,7 +2771,7 @@ def _flash_attention_block_jit(
     return pl.pallas_call(
         functools.partial(
             _flash_stream_kernel, scale=scale, causal=causal,
-            k_tile=k_tile, precision=precision,
+            k_tile=k_tile, skip_tile=skip_tile, precision=precision,
         ),
         out_shape=out_shape,
         grid=(L // q_tile, Lk // k_tile),
