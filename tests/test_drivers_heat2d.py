@@ -142,6 +142,30 @@ def test_pallas_tier_matches_xla_tier_bitwise():
     ))
     np.testing.assert_array_equal(multi, single)
 
+    # round-5 border-coefficient variant (zeroed coefficient arrays
+    # replace the per-step select): w + 0·δ²x + 0·δ²y == w exactly for
+    # finite fields, so the variant must be BIT-identical to the
+    # where-masked path, ragged multi-block included
+    for tr in (None, 16):
+        coeff = np.asarray(heat2d_pallas(
+            jnp.asarray(z0), 0.1, 0.2, steps=2, n_bnd=nb, interpret=True,
+            tile_rows=tr, border_coeff=True,
+        ))
+        np.testing.assert_array_equal(coeff, single)
+
+    # f64: the coefficient select must run NATIVELY in the array dtype
+    # (a review-caught first cut routed every dtype through an f32
+    # select, silently rounding f64 coefficients)
+    z64 = z0.astype(np.float64)
+    a64 = np.asarray(heat2d_pallas(
+        jnp.asarray(z64), 0.1, 0.2, steps=2, n_bnd=nb, interpret=True,
+    ))
+    c64 = np.asarray(heat2d_pallas(
+        jnp.asarray(z64), 0.1, 0.2, steps=2, n_bnd=nb, interpret=True,
+        border_coeff=True,
+    ))
+    np.testing.assert_array_equal(c64, a64)
+
 
 def test_heat_step2d_rejects_unknown_kernel():
     import jax

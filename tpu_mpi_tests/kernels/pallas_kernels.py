@@ -874,7 +874,8 @@ _BF16_TEMPS_DUAL_DIM = 10.4      # 9.88 measured · 1.05
 
 
 def _stream_live_bytes(B: int, halo: int, width: int, itemsize: int,
-                       bf16_temps: float = _BF16_TEMPS_DEFAULT) -> int:
+                       bf16_temps: float = _BF16_TEMPS_DEFAULT,
+                       extra_temps: float = 0.0) -> int:
     """The row-streaming kernels' shared VMEM live-set model, calibrated
     against Mosaic's actual high-water marks (tpu/vmemprobe.py
     bisection): double-buffered I/O blocks at the array dtype plus
@@ -891,12 +892,16 @@ def _stream_live_bytes(B: int, halo: int, width: int, itemsize: int,
         temps = bf16_temps
     else:
         temps = max(22, 11 * itemsize // 2)
+    # extra_temps: additional per-window-element live bytes a kernel
+    # VARIANT keeps beyond the calibrated mix (heat border_coeff's two
+    # coefficient arrays = 2·itemsize)
     return int(4 * itemsize * B * width
-               + temps * (B + 2 * halo) * width)
+               + (temps + extra_temps) * (B + 2 * halo) * width)
 
 
 def _fit_block_rows(width: int, halo: int, itemsize: int, sub: int,
-                    bf16_temps: float = _BF16_TEMPS_DEFAULT) -> int:
+                    bf16_temps: float = _BF16_TEMPS_DEFAULT,
+                    extra_temps: float = 0.0) -> int:
     """Largest sublane-multiple row block ≤ 256 whose live set fits VMEM
     (floor: one sublane tile). B starts at 256: the 8192² k=4 sweep
     measured 128–256-row blocks fastest (2090–2180 iter/s) and 512
@@ -904,7 +909,7 @@ def _fit_block_rows(width: int, halo: int, itemsize: int, sub: int,
     VPU."""
     B = 256
     while B > sub and _stream_live_bytes(B, halo, width, itemsize,
-                                         bf16_temps) > \
+                                         bf16_temps, extra_temps) > \
             _VMEM_BUDGET_CAL:
         B = max(sub, (B // 2) // sub * sub)
     return B
@@ -921,16 +926,18 @@ def _validate_tile_rows(tile_rows: int, sub: int,
 
 def _stream_fit(z, halo: int, kernel_name: str,
                 tile_rows: "int | None",
-                bf16_temps: float = _BF16_TEMPS_DEFAULT) -> int:
+                bf16_temps: float = _BF16_TEMPS_DEFAULT,
+                extra_temps: float = 0.0) -> int:
     """Shared full-width streaming preamble: fitted row block ``B`` (with
     the VMEM-budget raise callers' fallbacks match on) and the optional
     test-hook clamp."""
     width = z.shape[1]
     itemsize = jnp.dtype(z.dtype).itemsize
     sub = max(8, 8 * 4 // itemsize)
-    B = _fit_block_rows(width, halo, itemsize, sub, bf16_temps)
+    B = _fit_block_rows(width, halo, itemsize, sub, bf16_temps,
+                        extra_temps)
     if _stream_live_bytes(B, halo, width, itemsize,
-                          bf16_temps) > _VMEM_BUDGET_CAL:
+                          bf16_temps, extra_temps) > _VMEM_BUDGET_CAL:
         raise ValueError(
             f"{kernel_name}: width {width} exceeds the VMEM budget even "
             f"at {B}-row blocks; use the XLA tier"
@@ -1214,7 +1221,7 @@ def _row_block_edges(z, B: int, G: int, nb: int):
 
 
 def _heat_stream0_kernel(z_ref, top_ref, bot_ref, coef_ref, out_ref, *,
-                         steps, B, G, R):
+                         steps, B, G, R, border_coeff=False):
     """Row-streaming 2-D heat (5-point Laplacian) k-step block: per step,
     ``interior += cx·δ²x + cy·δ²y`` over the maximal span — the exact
     recurrence of ``heat_step2d_fn``'s XLA body (stale creep within the
@@ -1246,6 +1253,36 @@ def _heat_stream0_kernel(z_ref, top_ref, bot_ref, coef_ref, out_ref, *,
     hi_r = jnp.minimum(W - 1, R - 1 - abs0)  # the absolute clip folded in
     ok = ((w_iota >= lo_r) & (w_iota < hi_r)
           & (c_iota >= 1) & (c_iota < ny - 1))
+    if border_coeff:
+        # border handling via once-precomputed ZEROED coefficient arrays
+        # instead of a per-step select: w + 0·δ²x + 0·δ²y == w exactly
+        # (finite fields), so border/ghost positions keep their value
+        # bit-identically while each step drops the where — ~1 of the
+        # body's ~11 VPU ops (round-5 A/B). The finiteness premise needs
+        # one sanitization: a ragged last block's z-rows beyond the array
+        # (abs row ≥ R) are pallas pad junk — NaN-poisoned in interpret
+        # mode, arbitrary bits on hardware — which the where-path never
+        # lets into arithmetic but 0·junk would (0·NaN = NaN). Zero them
+        # once per call; their outputs are discarded out-of-bounds
+        # writes, so the zeroing is unobservable.
+        zero = jnp.zeros((), window.dtype)
+        window = jnp.where(w_iota + abs0 < R, window, zero)
+        if jnp.dtype(window.dtype).itemsize < 4:
+            # sub-f32 only: an i1 mask against bf16 scalar broadcasts
+            # trips a Mosaic relayout ("Non-singleton logical dimension
+            # is replicated ... (8,128) -> (16,128)"); f32 select +
+            # downcast lowers cleanly and the bf16(f32(cx)) round trip
+            # is exact. f32/f64 select natively — routing them through
+            # f32 would silently round f64 coefficients.
+            cxa = jnp.where(
+                ok, jnp.float32(cx), jnp.float32(0.0)
+            ).astype(window.dtype)
+            cya = jnp.where(
+                ok, jnp.float32(cy), jnp.float32(0.0)
+            ).astype(window.dtype)
+        else:
+            cxa = jnp.where(ok, cx, zero)
+            cya = jnp.where(ok, cy, zero)
     for _ in range(steps):
         up = jnp.concatenate([window[1:W], window[W - 1:W]], axis=0)
         down = jnp.concatenate([window[0:1], window[0:W - 1]], axis=0)
@@ -1255,26 +1292,45 @@ def _heat_stream0_kernel(z_ref, top_ref, bot_ref, coef_ref, out_ref, *,
         left = jnp.concatenate(
             [window[:, 0:1], window[:, 0:ny - 1]], axis=1
         )
-        new = (window + cx * (up + down - 2.0 * window)
-               + cy * (left + right - 2.0 * window))
-        window = jnp.where(ok, new, window)
+        if border_coeff:
+            window = (window + cxa * (up + down - 2.0 * window)
+                      + cya * (left + right - 2.0 * window))
+        else:
+            new = (window + cx * (up + down - 2.0 * window)
+                   + cy * (left + right - 2.0 * window))
+            window = jnp.where(ok, new, window)
     out_ref[:] = jax.lax.slice_in_dim(window, G, G + B, axis=0)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("steps", "n_bnd", "interpret", "tile_rows"),
+    jax.jit, static_argnames=("steps", "n_bnd", "interpret", "tile_rows",
+                              "border_coeff"),
     donate_argnums=0,
 )
 def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
                   interpret: bool | None = None,
-                  tile_rows: int | None = None):
+                  tile_rows: int | None = None,
+                  border_coeff: bool = False):
     """Hand tier of the heat mini-app's update (``heat_step2d_fn``):
     ``steps`` explicit-Euler 5-point-Laplacian steps on a both-dims-ghosted
     shard, in place (aliased), 2 HBM passes per call vs the XLA body's ~6
     per step. Full shard width rides in each block (column ghosts are
     in-window); rows stream with gathered G-row edges, so height is
     unbounded. Raises when the width alone exceeds the VMEM budget (the
-    XLA body is the fallback there)."""
+    XLA body is the fallback there).
+
+    ``border_coeff=True`` (round-5 opt-in): replaces the per-step border
+    ``where`` with once-precomputed zeroed coefficient arrays —
+    bit-identical to the default path for FINITE fields without signed
+    zeros at preserved positions (``w + 0·δ`` keeps ``w`` exactly;
+    a −0.0 border cell can flip to +0.0, and an inf/NaN border cell
+    becomes NaN — the where path preserves both bit-exactly). Measured
+    flat-to-marginally-faster by min-estimator (0.875–0.984 across
+    tall-domain A/B rounds) but within the contention band by median, so
+    the default stays the where path; the fit charges the variant's two
+    extra window-sized arrays (``extra_temps``), shrinking B instead of
+    risking a scoped-vmem OOM at budget-edge widths. BASELINE round-5
+    heat note."""
     nx, ny = z.shape
     G = n_bnd
     if steps > G:
@@ -1286,11 +1342,16 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
     # calibrated fit caps B at 128 anyway — both A/B arms had silently
     # run the same kernel. The fitted B stands; tile_rows remains the
     # explicit override.
+    itemsize_z = jnp.dtype(z.dtype).itemsize
     B = _stream_fit(
         z, G, "heat2d_pallas", tile_rows,
         bf16_temps=(_BF16_TEMPS_HEAT
                     if jnp.dtype(z.dtype) == jnp.bfloat16
                     else _BF16_TEMPS_DEFAULT),
+        # the border_coeff variant keeps 2 window-sized coefficient
+        # arrays live beyond the calibrated mix — charge them so the
+        # fit shrinks B instead of scoped-OOMing at budget-edge widths
+        extra_temps=(2.0 * itemsize_z if border_coeff else 0.0),
     )
     nb = pl.cdiv(nx, B)
     top, bot = _row_block_edges(z, B, G, nb)
@@ -1298,6 +1359,7 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
     return pl.pallas_call(
         functools.partial(
             _heat_stream0_kernel, steps=steps, B=B, G=G, R=nx,
+            border_coeff=border_coeff,
         ),
         out_shape=jax.ShapeDtypeStruct((nx, ny), z.dtype),
         grid=(nb,),
