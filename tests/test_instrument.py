@@ -131,3 +131,39 @@ def test_daxpy_driver_catches_compensating_error(capsys, monkeypatch):
     assert rc == 1
     assert "ELEMENT FAIL" in out
     assert "CHECKSUM FAIL" not in out
+
+
+def test_chain_rate_repeats_survives_invalid_first_reading(monkeypatch):
+    """Round-5 ``repeats``: the finite-MIN over repeated short/long pairs —
+    a contention-spiked (non-positive-delta → invalid) first repeat must
+    not poison a clean second one, and the min must be symmetric in the
+    repeat order. Clock scripted via perf_counter so the semantics are
+    deterministic (no sleep flakiness)."""
+    from tpu_mpi_tests.instrument import timers as T
+
+    # perf_counter readings consumed in order: each repeat takes 4
+    # (t0/short, t0/long). Repeat 1: short=5s, long=1s -> delta<0 ->
+    # invalid. Repeat 2: short=1s, long=3s -> delta=2s over (200-100)
+    # iters = 0.02 s/iter.
+    ticks = iter([
+        0.0, 5.0,      # repeat 1 short
+        5.0, 6.0,      # repeat 1 long (delta = 1 - 5 < 0 -> invalid)
+        6.0, 7.0,      # repeat 2 short
+        7.0, 10.0,     # repeat 2 long (delta = 3 - 1 = 2)
+    ])
+    monkeypatch.setattr(T.time, "perf_counter", lambda: next(ticks))
+    monkeypatch.setattr(T, "block", lambda x: x)
+
+    per, state = T.chain_rate(
+        lambda st, n: st, "state", n_short=100, n_long=200, repeats=2
+    )
+    assert per == 2.0 / 100
+    assert state == "state"
+
+    # all repeats invalid -> NaN (the invalid-looks-invalid convention)
+    ticks = iter([0.0, 5.0, 5.0, 6.0, 6.0, 11.0, 11.0, 12.0])
+    monkeypatch.setattr(T.time, "perf_counter", lambda: next(ticks))
+    per, _ = T.chain_rate(
+        lambda st, n: st, "state", n_short=100, n_long=200, repeats=2
+    )
+    assert per != per

@@ -1000,7 +1000,12 @@ def bench_roofline2(results):
             z = block(run(z, 1))
             z = block(run(z, 1))
             iters = max(40, 400 * 2056 ** 2 // nn ** 2)
-            sec, z = chain_rate(run, z, n_short=iters // 10, n_long=iters)
+            # min-of-2 chained readings per size (chain_rate repeats):
+            # contention only INFLATES, and a single inflated point is
+            # exactly what NaN'd this fit's linearity gate in 2 of 3
+            # round-5 windows
+            sec, z = chain_rate(run, z, n_short=iters // 10, n_long=iters,
+                                repeats=2)
             t_call[nn] = sec
             del z
         earr = np.array([nn * nn for nn in sizes], np.float64)

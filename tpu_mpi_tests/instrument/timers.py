@@ -118,7 +118,8 @@ def dispatch_rate(f, *args, n_iter: int = 2000, n_base: int = 200) -> float:
     return max(t_full - t_base, 1e-12) / n_iter
 
 
-def chain_rate(run, state, n_short: int = 100, n_long: int = 2100):
+def chain_rate(run, state, n_short: int = 100, n_long: int = 2100,
+               repeats: int = 1):
     """Seconds per iteration of a device-side chained loop.
 
     ``run(state, n)`` must execute ``n`` data-dependent iterations on device
@@ -131,20 +132,31 @@ def chain_rate(run, state, n_short: int = 100, n_long: int = 2100):
 
     Returns ``(seconds_per_iter, final_state)``. A non-positive delta
     (possible on a heavily contended host where timer noise exceeds the
-    device work) returns NaN rather than a sign-masked absurd rate — an
+    device work) yields NaN rather than a sign-masked absurd rate — an
     invalid measurement must look invalid downstream.
+
+    ``repeats`` > 1 (round 5) measures the pair that many times and
+    returns the FINITE minimum — contention only inflates, so the min is
+    the robust estimator (the standing BASELINE argument), and a single
+    spiked or invalid repeat cannot poison the reading (NaN only when
+    EVERY repeat is invalid). Use for fit sweeps whose derived gates
+    (linearity checks) a single inflated point would trip.
     """
     state = block(run(state, 3))  # compile + warm
-    t0 = time.perf_counter()
-    state = block(run(state, n_short))
-    t_short = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    state = block(run(state, n_long))
-    t_long = time.perf_counter() - t0
-    delta = t_long - t_short
-    if delta <= 0:
-        return float("nan"), state
-    return delta / (n_long - n_short), state
+    best = float("nan")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        state = block(run(state, n_short))
+        t_short = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        state = block(run(state, n_long))
+        t_long = time.perf_counter() - t0
+        delta = t_long - t_short
+        if delta > 0:
+            per = delta / (n_long - n_short)
+            if not (best == best) or per < best:  # best is NaN or worse
+                best = per
+    return best, state
 
 
 class PhaseTimer:
