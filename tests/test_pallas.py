@@ -657,8 +657,14 @@ def test_vpu_probe_dualdim_mix():
     for _ in range(reps):
         dx = sum(c * z[k:k + H - 2 * N_BND, :] for k, c in taps) * sx
         dy = sum(c * z[:, k:k + W - 2 * N_BND] for k, c in taps) * sy
-        r = ((dx.astype(np.float32) ** 2).sum(dtype=np.float64)
-             + (dy.astype(np.float32) ** 2).sum(dtype=np.float64)) / 1024.0
+        # the probe mirrors the kernel's two row-masked reductions
+        # (each excludes its last row — mixed-mask, fold-proof)
+        sqx = dx.astype(np.float32) ** 2
+        sqx[H - 2 * N_BND - 1:, :] = 0.0
+        sqy = dy.astype(np.float32) ** 2
+        sqy[H - 1:, :] = 0.0
+        r = (sqx.sum(dtype=np.float64)
+             + sqy.sum(dtype=np.float64)) / 1024.0
         zx = z.copy()
         zx[N_BND:H - N_BND, :] += se * dx
         zy = zx.copy()
@@ -666,6 +672,57 @@ def test_vpu_probe_dualdim_mix():
         z = zy + se * r
     np.testing.assert_allclose(got, z, rtol=0, atol=1e-3)
     assert np.abs(z - z0).max() > 1e-3
+
+
+def test_vpu_probe_dualdim_lean_mix():
+    """Round-5 op-diet probe mix: difference-form folded-coefficient
+    taps on the both-dims interior + ONE masked fused residual
+    reduction (mask excludes the last derivative row — mixed
+    true/false so nothing constant-folds) — the exact recurrence
+    replicated in numpy."""
+    from tpu_mpi_tests.kernels.stencil import N_BND, STENCIL5
+
+    reps = 2
+    se = 0.05
+    s = 0.0078125
+    c1, c2 = float(STENCIL5[3]), float(STENCIL5[4])
+    fc1 = np.float32(np.float32(s) * c1)
+    fc2 = np.float32(np.float32(s) * c2)
+    rng_ = np.random.default_rng(11)
+    z0 = rng_.normal(size=(16, 128)).astype(np.float32)
+    got = np.asarray(PK.vpu_probe_pallas(
+        jnp.asarray(z0), reps, "dualdim_lean", se=se, interpret=True
+    ))
+    z = z0.astype(np.float64)
+    H, W = z.shape
+    G = N_BND
+    for _ in range(reps):
+        core = z[:, G:W - G]
+        mid = z[G:H - G, :]
+        dx = (fc1 * (core[G + 1:H - G + 1] - core[G - 1:H - G - 1])
+              + fc2 * (core[G + 2:H - G + 2] - core[G - 2:H - G - 2]))
+        dy = (fc1 * (mid[:, G + 1:W - G + 1] - mid[:, G - 1:W - G - 1])
+              + fc2 * (mid[:, G + 2:W - G + 2] - mid[:, G - 2:W - G - 2]))
+        sq = (dx.astype(np.float32) ** 2
+              + dy.astype(np.float32) ** 2).astype(np.float64)
+        sq[H - 2 * G - 1:, :] = 0.0  # last derivative row masked out
+        r = sq.sum() / 1024.0
+        zn = z.copy()
+        zn[G:H - G, G:W - G] += se * dx + se * dy
+        z = zn + se * r
+    np.testing.assert_allclose(got, z, rtol=0, atol=1e-3)
+    assert np.abs(z - z0).max() > 1e-3
+
+
+def test_dual_dim_lean_default_pinned():
+    """The lean-body default records the on-chip interleaved A/B verdict
+    (BASELINE round-5 dual-dim op-diet note: raw/lean marginal 0.75x
+    f32 / 0.915x bf16 — the raw 4-tap body is measured-best at BOTH
+    dtypes because its const-mul+add pairs execute as FMAs). A change
+    here must come with a new measurement."""
+    assert PK._DUAL_DIM_LEAN_DEFAULT == {
+        "float32": False, "bfloat16": False,
+    }
 
 
 def test_vpu_probe_rejects_vmem_blowout():
@@ -919,35 +976,42 @@ def test_daxpy_inplace_alias_matches():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("lean", [False, True])
 @pytest.mark.parametrize("tile_rows", [None, 16])
-def test_dual_dim_step_pallas_matches_xla(tile_rows):
+def test_dual_dim_step_pallas_matches_xla(tile_rows, lean):
     """The streamed dual-derivative kernel must match dual_dim_step on
     both derivatives and (to summation rounding) the residual; tile_rows
-    forces multi-block streaming with a ragged last block."""
+    forces multi-block streaming with a ragged last block. Both kernel
+    bodies (raw 4-tap accumulation and the round-5 lean difference form,
+    which differs only by FP association) meet the same gates."""
     from tpu_mpi_tests.kernels.stencil import N_BND, dual_dim_step
 
     z = rng(31, (4 + 2 * N_BND + 66, 52 + 2 * N_BND))
     ax, ay, ar = dual_dim_step(z, N_BND, 1.5, 0.75)
     bx, by, br = PK.dual_dim_step_pallas(
-        z, N_BND, 1.5, 0.75, interpret=True, tile_rows=tile_rows
+        z, N_BND, 1.5, 0.75, interpret=True, tile_rows=tile_rows,
+        lean=lean
     )
     np.testing.assert_allclose(np.asarray(bx), np.asarray(ax), atol=1e-5)
     np.testing.assert_allclose(np.asarray(by), np.asarray(ay), atol=1e-5)
     assert abs(float(br) - float(ar)) <= 1e-3 * max(1.0, abs(float(ar)))
 
 
-def test_dual_dim_step_pallas_bfloat16():
+@pytest.mark.parametrize("lean", [False, True])
+def test_dual_dim_step_pallas_bfloat16(lean):
     """bf16 dualdim: round-4 vmemprobe coverage found the kernel had
     never compiled at bf16 (Mosaic cannot legalize bf16 cross-lane
     reductions or scalar divides); the residual now accumulates in f32.
-    Value parity vs the f32 XLA tier at 16-bit tolerances."""
+    Value parity vs the f32 XLA tier at 16-bit tolerances. The lean
+    body's coefficient fold runs on the f32 scalar unit (converts
+    legalize; bf16 scalar arith does not) and is covered here at bf16."""
     from tpu_mpi_tests.kernels.stencil import N_BND, dual_dim_step
 
     z32 = rng(33, (48 + 2 * N_BND, 40 + 2 * N_BND))
     z16 = z32.astype(jnp.bfloat16)
     ax, ay, ar = dual_dim_step(z32, N_BND, 1.5, 0.75)
     bx, by, br = PK.dual_dim_step_pallas(
-        z16, N_BND, 1.5, 0.75, interpret=True
+        z16, N_BND, 1.5, 0.75, interpret=True, lean=lean
     )
     assert bx.dtype == jnp.bfloat16
     np.testing.assert_allclose(

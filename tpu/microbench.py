@@ -908,6 +908,8 @@ def bench_roofline2(results):
         ("heat5", "bfloat16"): (11, (64, 256, 1024)),
         ("dualdim", "float32"): (20, (32, 128, 512)),
         ("dualdim", "bfloat16"): (20, (32, 128, 512)),
+        ("dualdim_lean", "float32"): (14, (32, 128, 512)),
+        ("dualdim_lean", "bfloat16"): (14, (32, 128, 512)),
     }
     probe_rate = {}
     for (mix, dname), (ops, reps3) in PROBES.items():
@@ -969,73 +971,98 @@ def bench_roofline2(results):
               "— HBM lives in the intercept)")
 
     # dual-dim one-shot kernel: t(elems) = a + c*elems over 3 sizes,
-    # chained via z + eps*residual (the +2 HBM passes are charged below)
+    # chained via z + eps*residual (the +2 HBM passes are charged below).
+    # Round-5 op diet: BOTH kernel bodies (raw 4-tap vs lean
+    # difference-form, `lean=`) measured INTERLEAVED per size — the bf16
+    # tier reads issue-bound (ops axis ~= bytes axis with imperfect
+    # overlap), so saved vector ops should convert to wall-clock; the
+    # A/B records whether they do.
     for dtype in (np.float32, jnp.bfloat16):
         dname = jnp.dtype(dtype).name
         itemsize = jnp.dtype(dtype).itemsize
         sizes = (2056, 2904, 4104)
-        t_call = {}
+        t_call: dict = {False: {}, True: {}}
         for nn in sizes:
             z0d = np.random.default_rng(2).normal(
                 size=(nn, nn)
             ).astype(dtype) / np.asarray(10, dtype)
             eps = jnp.asarray(1e-6, dtype)
 
-            @functools.partial(jax.jit, donate_argnums=0)
-            def run(z, n_iter, eps=eps):
+            @functools.partial(jax.jit, donate_argnums=0,
+                               static_argnames=("lean",))
+            def run(z, n_iter, lean, eps=eps):
                 def body(_, zz):
                     # tile_rows pinned: the calibrated bf16 fit admits
                     # B=256 at the two smaller widths but caps 128 at
                     # 4104 — an unpinned sweep would blend two block
                     # schedules into one marginal fit
                     _, _, r = PK.dual_dim_step_pallas(zz, N_BND, 1.0, 1.0,
-                                                      tile_rows=128)
+                                                      tile_rows=128,
+                                                      lean=lean)
                     return zz + eps * r.astype(zz.dtype)
 
                 return lax.fori_loop(
                     0, jnp.asarray(n_iter, jnp.int32), body, z
                 )
 
-            z = jnp.asarray(z0d)
-            z = block(run(z, 1))
-            z = block(run(z, 1))
             iters = max(40, 400 * 2056 ** 2 // nn ** 2)
-            # min-of-2 chained readings per size (chain_rate repeats):
-            # contention only INFLATES, and a single inflated point is
-            # exactly what NaN'd this fit's linearity gate in 2 of 3
-            # round-5 windows
-            sec, z = chain_rate(run, z, n_short=iters // 10, n_long=iters,
-                                repeats=2)
-            t_call[nn] = sec
-            del z
+            for lean in (False, True):
+                z = jnp.asarray(z0d)
+                z = block(run(z, 1, lean=lean))
+                z = block(run(z, 1, lean=lean))
+                # min-of-2 chained readings per size (chain_rate
+                # repeats): contention only INFLATES, and a single
+                # inflated point is exactly what NaN'd this fit's
+                # linearity gate in 2 of 3 round-5 windows
+                sec, z = chain_rate(
+                    lambda zz, n_it, lean=lean: run(zz, n_it, lean=lean),
+                    z, n_short=iters // 10, n_long=iters, repeats=2,
+                )
+                t_call[lean][nn] = sec
+                del z
         earr = np.array([nn * nn for nn in sizes], np.float64)
-        tarr = np.array([t_call[nn] for nn in sizes])
-        c, a = np.polyfit(earr, tarr, 1)
-        mid_pred = tarr[0] + (tarr[2] - tarr[0]) * (earr[1] - earr[0]) / (
-            earr[2] - earr[0]
-        )
-        lin = tarr[1] / mid_pred
-        suspect = not (0.85 <= lin <= 1.15)
-        # bytes per element: read z + write dx + dy (~3 arrays) + res
-        # tiles (negligible) + the chain feedback's read+write of z
-        ops_time = 1.0 / probe_rate[("dualdim", dname)]
         bytes_time = 5 * itemsize / (STREAM_GBPS * 1e9)
-        # a NaN probe rate (linearity-gated) must invalidate the derived
-        # ceiling rows too — NaN comparisons are silently False and
-        # would mislabel the bytes number as an ops-ceiling fraction
-        suspect = suspect or not np.isfinite(ops_time)
-        binding = "bytes" if bytes_time > ops_time else "ops"
-        model = max(bytes_time, ops_time)
-        _emit(results, f"roofline_dualdim_{dname}_marginal_ps",
-              float("nan") if suspect else c * 1e12, "ps/elt",
-              f"fit t=a+c*elems over {sizes}; a={a * 1e6:.0f} us; "
-              f"linearity {lin:.3f}; ops axis {ops_time * 1e12:.2f} "
-              f"ps/elt, bytes axis (5 passes incl. chain feedback) "
-              f"{bytes_time * 1e12:.2f} ps/elt -> {binding}-bound")
-        _emit(results, f"roofline_dualdim_{dname}_vs_ceiling",
-              float("nan") if suspect else model / c, "ratio",
-              f"binding-axis model time / measured marginal (1.0 = at "
-              f"the {binding} ceiling)")
+        cs = {}
+        for lean in (False, True):
+            tarr = np.array([t_call[lean][nn] for nn in sizes])
+            c, a = np.polyfit(earr, tarr, 1)
+            mid_pred = tarr[0] + (tarr[2] - tarr[0]) * (
+                earr[1] - earr[0]
+            ) / (earr[2] - earr[0])
+            lin = tarr[1] / mid_pred
+            fit_suspect = not (0.85 <= lin <= 1.15)
+            # bytes per element: read z + write dx + dy (~3 arrays) +
+            # res tiles (negligible) + the chain feedback's read+write
+            mix = "dualdim_lean" if lean else "dualdim"
+            ops_time = 1.0 / probe_rate[(mix, dname)]
+            # a NaN probe rate (linearity-gated) must invalidate the
+            # derived ceiling rows too — NaN comparisons are silently
+            # False and would mislabel the bytes number as an
+            # ops-ceiling fraction. It does NOT invalidate the raw/lean
+            # gain row below: that ratio compares the two wall-clock
+            # fits only, so it is gated on fit_suspect alone.
+            suspect = fit_suspect or not np.isfinite(ops_time)
+            binding = "bytes" if bytes_time > ops_time else "ops"
+            model = max(bytes_time, ops_time)
+            cs[lean] = float("nan") if fit_suspect else c
+            _emit(results, f"roofline_{mix}_{dname}_marginal_ps",
+                  float("nan") if suspect else c * 1e12, "ps/elt",
+                  f"fit t=a+c*elems over {sizes}; a={a * 1e6:.0f} us; "
+                  f"linearity {lin:.3f}; ops axis {ops_time * 1e12:.2f} "
+                  f"ps/elt, bytes axis (5 passes incl. chain feedback) "
+                  f"{bytes_time * 1e12:.2f} ps/elt -> {binding}-bound")
+            _emit(results, f"roofline_{mix}_{dname}_vs_ceiling",
+                  float("nan") if suspect else model / c, "ratio",
+                  f"binding-axis model time / measured marginal (1.0 = "
+                  f"at the {binding} ceiling)")
+        reads = " ".join(
+            f"{nn}:[raw {t_call[False][nn] * 1e3:.2f}, lean "
+            f"{t_call[True][nn] * 1e3:.2f}]ms" for nn in sizes
+        )
+        _emit(results, f"dualdim_lean_gain_{dname}",
+              cs[False] / cs[True], "x",
+              f"raw marginal / lean marginal, interleaved per size "
+              f"(>1 = lean faster); per-size calls {reads}")
 
     # VERDICT r4 #5: heat bf16 block-size A/B above the launch floor —
     # tall 2048-wide domain, B=128 vs 256, interleaved twice, min per arm

@@ -302,8 +302,17 @@ def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
             # scalar chain stays f32 end-to-end: bf16 scalar arith.mulf /
             # addf / divf do not legalize on the TPU scalar unit (the
             # round-4 dual-dim kernel finding, re-confirmed here) — the
-            # scalar broadcasts to an f32 vector and casts at the fold
-            r = (jnp.sum(dxf * dxf) + jnp.sum(dyf * dyf)) / 1024.0
+            # scalar broadcasts to an f32 vector and casts at the fold.
+            # The two row-masked reductions mirror the kernel's ragged
+            # last-block `where(valid, ...)` pair (review fix: the mix
+            # originally omitted them, underpricing the raw kernel's op
+            # mix vs the lean variant's single masked reduction); the
+            # mask excludes the last row — mixed true/false, fold-proof
+            rx = jax.lax.broadcasted_iota(jnp.int32, dxf.shape, 0)
+            ry = jax.lax.broadcasted_iota(jnp.int32, dyf.shape, 0)
+            zf = jnp.zeros((), jnp.float32)
+            r = (jnp.sum(jnp.where(rx < H - 2 * N_BND - 1, dxf * dxf, zf))
+                 + jnp.sum(jnp.where(ry < H - 1, dyf * dyf, zf))) / 1024.0
             shift = jnp.asarray(se, jnp.float32) * r
             zx = jnp.concatenate(
                 [
@@ -325,6 +334,79 @@ def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
             )
             return zy + jnp.full(
                 zy.shape, shift, jnp.float32
+            ).astype(zz.dtype)
+    elif mix == "dualdim_lean":
+        # the EXACT op-diet dual-dim body (_dual_step_kernel lean=True):
+        # difference-form taps with the per-axis scale FOLDED into the
+        # two coefficients (5 vector ops/axis vs the 4-tap form's 8) and
+        # ONE masked fused residual reduction (1 where + 1 sum vs
+        # 2 where-free sums) — ~14 nominal ops/elt/rep. Same fold-back
+        # recurrence as the dualdim mix; the residual mask excludes the
+        # last derivative row (mixed true/false — fold-proof, the
+        # round-4 constant-fold lesson) and numpy replicates it
+        se_c = jnp.asarray(se, z.dtype)
+        H, W = z.shape
+        fc1 = float(np.float32(np.float32(0.0078125) * np.float32(_C1)))
+        fc2 = float(np.float32(np.float32(0.0078125) * np.float32(_C2)))
+        c1x = jnp.asarray(fc1, z.dtype)
+        c2x = jnp.asarray(fc2, z.dtype)
+        c1y, c2y = c1x, c2x  # probe uses sx == sy
+
+        def body(_, zz):
+            # both derivatives on the both-dims interior, exactly like
+            # the kernel block (core = column-interior for dx, mid =
+            # row-interior for dy; both (H-2G, W-2G))
+            core = jax.lax.slice_in_dim(zz, N_BND, W - N_BND, axis=1)
+            mid = jax.lax.slice_in_dim(zz, N_BND, H - N_BND, axis=0)
+
+            def rs(off):
+                return jax.lax.slice_in_dim(
+                    core, N_BND + off, N_BND + off + H - 2 * N_BND,
+                    axis=0,
+                )
+
+            def cs(off):
+                return jax.lax.slice_in_dim(
+                    mid, N_BND + off, N_BND + off + W - 2 * N_BND,
+                    axis=1,
+                )
+
+            dx = c1x * (rs(1) - rs(-1)) + c2x * (rs(2) - rs(-2))
+            dy = c1y * (cs(1) - cs(-1)) + c2y * (cs(2) - cs(-2))
+            dxf = dx.astype(jnp.float32)
+            dyf = dy.astype(jnp.float32)
+            # one fused masked reduction; mask depends on the row iota
+            # so nothing constant-folds, mirroring the kernel's ragged
+            # last-block row mask (scalar chain stays f32 — bf16 scalar
+            # arith does not legalize)
+            rows = jax.lax.broadcasted_iota(jnp.int32, dxf.shape, 0)
+            r = jnp.sum(jnp.where(
+                rows < H - 2 * N_BND - 1, dxf * dxf + dyf * dyf,
+                jnp.zeros((), jnp.float32),
+            )) / 1024.0
+            shift = jnp.asarray(se, jnp.float32) * r
+            interior = (
+                jax.lax.slice_in_dim(mid, N_BND, W - N_BND, axis=1)
+                + se_c * dx + se_c * dy
+            )
+            stitched_mid = jnp.concatenate(
+                [
+                    jax.lax.slice_in_dim(mid, 0, N_BND, axis=1),
+                    interior,
+                    jax.lax.slice_in_dim(mid, W - N_BND, W, axis=1),
+                ],
+                axis=1,
+            )
+            zx = jnp.concatenate(
+                [
+                    jax.lax.slice_in_dim(zz, 0, N_BND, axis=0),
+                    stitched_mid,
+                    jax.lax.slice_in_dim(zz, H - N_BND, H, axis=0),
+                ],
+                axis=0,
+            )
+            return zx + jnp.full(
+                zx.shape, shift, jnp.float32
             ).astype(zz.dtype)
     else:
         # the EXACT k-step kernel body (_step5 + band concat) applied to
@@ -370,7 +452,10 @@ def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
     4 concat shifts + two-axis Euler update + border mask, ~11 nominal
     ops/elt) and ``dualdim`` (the dual-dim step kernel's body: 4-tap
     derivatives on both axes + f32 squared-residual reduction, ~20
-    nominal ops/elt). The ratio of a kernel mix's rate to the fma rate
+    nominal ops/elt; ``dualdim_lean`` is the op-diet body —
+    difference-form taps with the scale folded into the coefficients
+    plus ONE fused masked residual reduction, ~14 nominal ops/elt).
+    The ratio of a kernel mix's rate to the fma rate
     prices its shifts/reductions; each hand kernel's marginal element
     rate over its own mix's probe rate is the fraction of the VPU
     ceiling it reaches (``tpu/microbench.py vpu``/``roofline2``).
@@ -388,7 +473,8 @@ def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
             f"{total} B live in VMEM, over the "
             f"{_VMEM_BUDGET_BYTES // 2**20} MB budget"
         )
-    if mix not in ("fma", "step5_d0", "step5_d1", "heat5", "dualdim"):
+    if mix not in ("fma", "step5_d0", "step5_d1", "heat5", "dualdim",
+                   "dualdim_lean"):
         raise ValueError(f"unknown mix {mix!r}")
     return pl.pallas_call(
         functools.partial(_vpu_probe_kernel, reps=reps, mix=mix, se=se),
@@ -1380,33 +1466,64 @@ def heat2d_pallas(z, cx, cy, steps: int = 1, n_bnd: int = 1,
 
 
 def _dual_step_kernel(z_ref, bot_ref, coef_ref, dx_ref, dy_ref, res_ref, *,
-                      B, G, mx):
+                      B, G, mx, lean):
     """One streamed (B, ny) block of the flagship dual-dim pipeline
     (``dual_dim_step``): dz/dx (row taps on the col interior), dz/dy
     (lane taps on the row interior), and this block's residual partial —
     three outputs from ONE read of the window, vs the XLA tier's
     per-tap re-reads. Ragged last-block rows are excluded from the
     residual by an absolute-row mask (their derivative rows are dropped
-    by the pipeline's ragged store masking)."""
+    by the pipeline's ragged store masking).
+
+    ``lean`` (round-5 op diet, measured on chip — BASELINE round-5
+    dual-dim note): difference-form taps (STENCIL5 is antisymmetric,
+    asserted at module load) with the per-axis scale folded into the two
+    coefficients — 5 vector ops/axis vs the raw accumulation's 8 — and
+    ONE fused masked residual reduction (1 where + 1 sum vs 2 + 2). The
+    fold happens on the f32 SCALAR unit (bf16 scalar arith does not
+    legalize; the converts do), so only the final coefficient cast pays
+    16-bit rounding. Values differ from the raw form only by FP
+    association; the drivers' analytic gates cover both."""
     sx = coef_ref[0]
     sy = coef_ref[1]
     i = pl.program_id(0)
     window = jnp.concatenate([z_ref[:], bot_ref[0]], axis=0)  # (B+2G, ny)
     ny = window.shape[1]
     my = ny - 2 * G
-    taps = [(k, c) for k, c in enumerate(STENCIL5.tolist()) if c != 0.0]
     core = window[:, G:ny - G]
-    accx = None
-    for k, c in taps:
-        t = c * jax.lax.slice_in_dim(core, k, k + B, axis=0)
-        accx = t if accx is None else accx + t
-    dx = accx * sx
     mid = jax.lax.slice_in_dim(window, G, G + B, axis=0)
-    accy = None
-    for k, c in taps:
-        t = c * jax.lax.slice_in_dim(mid, k, k + my, axis=1)
-        accy = t if accy is None else accy + t
-    dy = accy * sy
+    if lean:
+        dt = window.dtype
+        sxf = sx.astype(jnp.float32)
+        syf = sy.astype(jnp.float32)
+        c1x = (sxf * _C1).astype(dt)
+        c2x = (sxf * _C2).astype(dt)
+        c1y = (syf * _C1).astype(dt)
+        c2y = (syf * _C2).astype(dt)
+
+        def rs(off):
+            return jax.lax.slice_in_dim(core, G + off, G + off + B,
+                                        axis=0)
+
+        def cs(off):
+            return jax.lax.slice_in_dim(mid, G + off, G + off + my,
+                                        axis=1)
+
+        dx = c1x * (rs(1) - rs(-1)) + c2x * (rs(2) - rs(-2))
+        dy = c1y * (cs(1) - cs(-1)) + c2y * (cs(2) - cs(-2))
+    else:
+        taps = [(k, c) for k, c in enumerate(STENCIL5.tolist())
+                if c != 0.0]
+        accx = None
+        for k, c in taps:
+            t = c * jax.lax.slice_in_dim(core, k, k + B, axis=0)
+            accx = t if accx is None else accx + t
+        dx = accx * sx
+        accy = None
+        for k, c in taps:
+            t = c * jax.lax.slice_in_dim(mid, k, k + my, axis=1)
+            accy = t if accy is None else accy + t
+        dy = accy * sy
     dx_ref[:] = dx
     dy_ref[:] = dy
     valid = (jax.lax.broadcasted_iota(jnp.int32, dx.shape, 0) + i * B) < mx
@@ -1418,8 +1535,11 @@ def _dual_step_kernel(z_ref, bot_ref, coef_ref, dx_ref, dy_ref, res_ref, *,
     dxf = dx.astype(jnp.float32)
     dyf = dy.astype(jnp.float32)
     zero = jnp.zeros((), jnp.float32)
-    r = (jnp.sum(jnp.where(valid, dxf * dxf, zero))
-         + jnp.sum(jnp.where(valid, dyf * dyf, zero)))
+    if lean:
+        r = jnp.sum(jnp.where(valid, dxf * dxf + dyf * dyf, zero))
+    else:
+        r = (jnp.sum(jnp.where(valid, dxf * dxf, zero))
+             + jnp.sum(jnp.where(valid, dyf * dyf, zero)))
     # broadcast the partial over a full (8, 128) register tile (hardware
     # Mosaic requires output blocks to be whole sublane×lane tiles; a
     # per-block scalar store would need SMEM plumbing) — summing r/1024
@@ -1431,18 +1551,38 @@ def _dual_step_kernel(z_ref, bot_ref, coef_ref, dx_ref, dy_ref, res_ref, *,
     )
 
 
+# Lean (op-diet) body default per dtype, measured on chip (BASELINE
+# round-5 dual-dim op-diet note): the lean body was built because the
+# bf16 tier measured ISSUE-bound (0.585-0.606x its bytes ceiling with
+# ops axis ~= bytes axis), so fewer nominal vector ops should have
+# converted to wall-clock. The interleaved per-size A/B REFUTED it:
+# raw/lean marginal = 0.75x f32, 0.915x bf16 (lean slower at both
+# dtypes), and the in-VMEM probes explain why — the raw 4-tap body's
+# const-mul+add pairs execute as FMAs (f32 95 vs lean 69 G elem/s
+# resident), so the difference-form sub/mul/add chain is MORE real VPU
+# work despite fewer nominal ops. The raw body is measured-best; lean
+# stays an exactness-gated opt-in (`lean=True`) and
+# tests/test_pallas.py pins this table to the measured verdict.
+_DUAL_DIM_LEAN_DEFAULT = {"float32": False, "bfloat16": False}
+
+
 @functools.partial(
-    jax.jit, static_argnames=("n_bnd", "interpret", "tile_rows"),
+    jax.jit, static_argnames=("n_bnd", "interpret", "tile_rows", "lean"),
 )
 def dual_dim_step_pallas(z, n_bnd: int, scale_x: float, scale_y: float,
                          interpret: bool | None = None,
-                         tile_rows: int | None = None):
+                         tile_rows: int | None = None,
+                         lean: bool | None = None):
     """Hand tier of :func:`~tpu_mpi_tests.kernels.stencil.dual_dim_step`
     (the 2-D process-grid step's per-shard pipeline): row-streamed blocks
     produce both derivatives and the residual from one window read.
     Same contract: ``(dz_dx, dz_dy, residual)`` with the ghost frame
     stripped. Raises the shared "VMEM budget" error when the width alone
-    cannot fit (callers fall back to the XLA tier)."""
+    cannot fit (callers fall back to the XLA tier).
+
+    ``lean`` selects the op-diet kernel body (see ``_dual_step_kernel``);
+    ``None`` resolves through the measured-best per-dtype table
+    ``_DUAL_DIM_LEAN_DEFAULT``."""
     from tpu_mpi_tests.kernels.stencil import N_BND as RADIUS_BND
 
     if n_bnd != RADIUS_BND:
@@ -1458,6 +1598,8 @@ def dual_dim_step_pallas(z, n_bnd: int, scale_x: float, scale_y: float,
             f"(2·n_bnd ghosts + interior), got {z.shape}"
         )
     mx, my = nx - 2 * G, ny - 2 * G
+    if lean is None:
+        lean = _DUAL_DIM_LEAN_DEFAULT.get(jnp.dtype(z.dtype).name, False)
     B = _stream_fit(
         z, G, "dual_dim_step_pallas", tile_rows,
         bf16_temps=(_BF16_TEMPS_DUAL_DIM
@@ -1468,7 +1610,7 @@ def dual_dim_step_pallas(z, n_bnd: int, scale_x: float, scale_y: float,
     _, bot = _row_block_edges(z, B, 2 * G, nb)
     coef = jnp.asarray([scale_x, scale_y], z.dtype)
     dx, dy, res = pl.pallas_call(
-        functools.partial(_dual_step_kernel, B=B, G=G, mx=mx),
+        functools.partial(_dual_step_kernel, B=B, G=G, mx=mx, lean=lean),
         out_shape=(
             jax.ShapeDtypeStruct((mx, my), z.dtype),
             jax.ShapeDtypeStruct((mx, my), z.dtype),
