@@ -4,7 +4,8 @@
 Usage (from /root/repo):
     python tpu/microbench.py [daxpy] [stencil] [iterate] [splitfused]
                              [ceiling] [attention] [heat] [blocks] [causal]
-                             [streams] [vpu] [stripebalance] [roofline2]
+                             [streams] [vpu] [stripebalance] [stripeskip]
+                             [roofline2]
 
 Runs the selected groups (default: all) on whatever backend is active and
 prints one JSON line per measurement plus a summary table. Timing uses the
@@ -1147,22 +1148,12 @@ def bench_roofline2(results):
           "blocks faster")
 
 
-def bench_stripebalance(results):
-    """Striped causal ring balance, measured on ONE chip (round 4,
-    VERDICT r3 next #4). The ring's wall-clock is paced per step by its
-    slowest rank, so the single-chip proxy is: time the per-step flash
-    kernel at EVERY (rank, step) cell of a w=8 ring — contiguous vs
-    striped layout — and compare Σ_s max_r t(r,s) (the paced proxy) and
-    Σ_{r,s} t(r,s) (total work). One compiled executable serves all
-    cells (offsets/stride are traced SMEM scalars driving the causal
-    tile-skip), so cells differ only by the masking geometry. Also
-    measures the to_striped/from_striped conversion cost at the same
-    (L, d).
-
-    Expected shape of the result: contiguous keeps SOME rank full-live
-    at every step (rank w−1 is live at all of them), so Σ_s max_r ≈
-    w × full-block cost; striped makes every cell ~half-live, so the
-    paced proxy halves while total work stays ~equal."""
+def _make_stripe_cell_measurer(w, lq, d):
+    """Shared (rank, step)-cell timing machinery for the stripe groups:
+    one compiled per-step flash executable per (k_tile, skip_tile) —
+    offsets/stride are traced SMEM scalars, so every ring cell of a
+    layout reuses it — timed with 3300-call chains and one contention
+    retry. Returns ``measured(qo, ko, st, kt, skt) -> sec``."""
     import functools
 
     import numpy as np
@@ -1171,11 +1162,9 @@ def bench_stripebalance(results):
     import jax.numpy as jnp
     from jax import lax
 
-    from tpu_mpi_tests.comm.ring import from_striped, to_striped
     from tpu_mpi_tests.instrument.timers import block, chain_rate
     from tpu_mpi_tests.kernels import pallas_kernels as PK
 
-    w, lq, d = 8, 4096, 128
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.normal(size=(lq, d)).astype(np.float32))
     kb = jnp.asarray(rng.normal(size=(lq, d)).astype(np.float32))
@@ -1216,6 +1205,122 @@ def bench_stripebalance(results):
         # a NaN on a live cell stays NaN: it poisons the sums so an
         # invalid grid cannot masquerade as a measured speedup
         return sec
+
+    return measured
+
+
+def _paced_with_suspect(t):
+    """Shared grid-validity companion to the stripe cell measurer:
+    paced proxy Σ_s max_r plus the checks both stripe groups need — a
+    non-finite cell (double chain failure; NaN poisons the sums by
+    design) or a lone live cell >5× the grid median (contention spike
+    that the NaN retry cannot see) marks the grid suspect, with a
+    human-readable note. Returns ``(paced_sec, note, suspect)``."""
+    import numpy as np
+
+    note = ""
+    suspect = False
+    if not np.all(np.isfinite(t)):
+        suspect = True
+        note = "; NaN cell(s) after retry: grid invalid"
+    else:
+        live = t[t > 0]
+        med = np.median(live) if live.size else 0.0
+        if live.size and live.max() > 5 * med:
+            suspect = True
+            note = (f"; OUTLIER-SUSPECT: max cell "
+                    f"{live.max() * 1e3:.2f} ms vs median "
+                    f"{med * 1e3:.3f}")
+    return t.max(axis=0).sum(), note, suspect
+
+
+def bench_stripeskip(results):
+    """Round-5 follow-up sweep: the striped ring's ``skip_tile`` (the
+    masked band sub-span width) was SET to 256 when the skip/rescale
+    decoupling shipped — 256 ≈ the band width per 4096-row block at
+    w=8 — but never swept. Narrower spans waste less band-edge rounding
+    (≤ skip_tile/2 columns) at more per-span carry updates; wider the
+    reverse. Sweep ``TPU_MPI_STRIPE_SKIPS`` (default 128,256,512) at
+    the production k_tile on the striped grid only (contig's measured
+    default is the coupled loop), every skip's cell measured
+    INTERLEAVED per (rank, step) so all arms share contention windows;
+    paced proxy Σ_s max_r compared across skips. A winner that
+    separates from the ±3-5%% band in REPLICATED windows justifies
+    changing ``MEASURED_BEST_SKIP_TILE['striped']``; otherwise 256
+    stands confirmed."""
+    import numpy as np
+
+    w, lq, d = 8, 4096, 128
+    measured = _make_stripe_cell_measurer(w, lq, d)
+    kt = int(os.environ.get("TPU_MPI_STRIPE_SKIP_KT", "2048"))
+    # dedup (order-preserving): a duplicated value in the env list would
+    # silently re-measure 64 cells per duplicate and emit its row twice
+    skips = tuple(dict.fromkeys(
+        int(x) for x in os.environ.get(
+            "TPU_MPI_STRIPE_SKIPS", "128,256,512"
+        ).split(",")
+    ))
+    grids = {skt: np.zeros((w, w)) for skt in skips}
+    for r in range(w):
+        for s in range(w):
+            src = (r - s) % w
+            for skt in skips:
+                grids[skt][r, s] = measured(r, src, w, kt, skt)
+    suspect = False
+    paced = {}
+    for skt, t in grids.items():
+        paced[skt], note, gsusp = _paced_with_suspect(t)
+        suspect = suspect or gsusp
+        _emit(results, f"stripeskip_skip{skt}_kt{kt}_paced_ms",
+              paced[skt] * 1e3, "ms",
+              f"striped decoupled paced proxy, w={w} lq={lq} d={d}; "
+              f"total work {t.sum() * 1e3:.2f} ms{note}")
+    # the pick must be NaN-safe even beyond the suspect gate: min()
+    # over a dict with a NaN value can return the NaN arm (NaN
+    # comparisons are always False), reporting an unmeasured grid as
+    # the winner
+    finite = {s: p for s, p in paced.items() if np.isfinite(p)}
+    best = min(finite, key=finite.get) if finite else None
+    _emit(results, f"stripeskip_best_kt{kt}",
+          float("nan") if (suspect or best is None) else float(best),
+          "skip_tile",
+          (f"fastest paced arm of {skips}; margins vs best: "
+           + " ".join(f"{s}:{paced[s] / paced[best]:.3f}x"
+                      for s in skips) if best is not None
+           else f"no finite arm of {skips}")
+          + ("; NaN: a suspect grid invalidates the pick"
+             if suspect else ""))
+
+
+def bench_stripebalance(results):
+    """Striped causal ring balance, measured on ONE chip (round 4,
+    VERDICT r3 next #4). The ring's wall-clock is paced per step by its
+    slowest rank, so the single-chip proxy is: time the per-step flash
+    kernel at EVERY (rank, step) cell of a w=8 ring — contiguous vs
+    striped layout — and compare Σ_s max_r t(r,s) (the paced proxy) and
+    Σ_{r,s} t(r,s) (total work). One compiled executable serves all
+    cells (offsets/stride are traced SMEM scalars driving the causal
+    tile-skip), so cells differ only by the masking geometry. Also
+    measures the to_striped/from_striped conversion cost at the same
+    (L, d).
+
+    Expected shape of the result: contiguous keeps SOME rank full-live
+    at every step (rank w−1 is live at all of them), so Σ_s max_r ≈
+    w × full-block cost; striped makes every cell ~half-live, so the
+    paced proxy halves while total work stays ~equal."""
+    import functools
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_mpi_tests.comm.ring import from_striped, to_striped
+    from tpu_mpi_tests.instrument.timers import block, chain_rate
+
+    w, lq, d = 8, 4096, 128
+    measured = _make_stripe_cell_measurer(w, lq, d)
 
     # k_tile axis: the striped layout's ~2x balance is realized only at
     # fine skip granularity — at k_tile=2048 a 4096-row block has 2 k
@@ -1262,18 +1367,14 @@ def bench_stripebalance(results):
             note = (f"; {skipped} geometrically-dead cells set to 0 "
                     f"unmeasured" if name == "contig" else "")
             # a contention spike can inflate one cell 10-30x without
-            # tripping the NaN retry; make such grids self-identifying
-            # (a 9.4 ms striped paced reading in one replicate traced
-            # to exactly this)
-            live = t[t > 0]
-            med = np.median(live) if live.size else 0.0
-            if live.size and live.max() > 5 * med:
-                suspect = True
-                note += (f"; OUTLIER-SUSPECT: max cell "
-                         f"{live.max() * 1e3:.2f} ms vs median "
-                         f"{med * 1e3:.3f}")
+            # tripping the NaN retry; _paced_with_suspect makes such
+            # grids self-identifying (a 9.4 ms striped paced reading in
+            # one replicate traced to exactly this)
+            paced_sec, gnote, gsusp = _paced_with_suspect(t)
+            suspect = suspect or gsusp
+            note += gnote
             _emit(results, f"stripe_{name}_kt{kt}_paced_ms",
-                  t.max(axis=0).sum() * 1e3, "ms",
+                  paced_sec * 1e3, "ms",
                   f"sum over steps of max-rank per-step flash time, "
                   f"w={w} lq={lq} d={d}; total work "
                   f"{t.sum() * 1e3:.2f} ms; last-rank sum "
@@ -1286,8 +1387,9 @@ def bench_stripebalance(results):
               f"contig/striped paced proxy, cells interleaved "
               f"same-window; total-work ratio {work_ratio:.3f} "
               f"(~1 = balance moved work, not added it)"
-              + ("; NaN: an OUTLIER-SUSPECT grid invalidates the "
-                 "derived speedup" if suspect else ""))
+              + ("; NaN: a suspect grid (outlier or NaN cell — see "
+                 "grid rows) invalidates the derived speedup"
+                 if suspect else ""))
         skip_gain = (grids["striped_coupled"].max(axis=0).sum()
                      / grids["striped"].max(axis=0).sum())
         _emit(results, f"stripe_skip_decouple_gain_kt{kt}",
@@ -1299,6 +1401,7 @@ def bench_stripebalance(results):
     # layout conversion cost at the same global (L, d) — what a caller
     # pays once before/after the whole ring pass, not per step
     L = w * lq
+    rng = np.random.default_rng(0)
     xg = jnp.asarray(rng.normal(size=(L, d)).astype(np.float32))
     for nm, fn in (("to_striped", to_striped), ("from_striped",
                                                from_striped)):
@@ -1335,6 +1438,7 @@ GROUPS = {
     "streams": bench_streams,
     "vpu": bench_vpu,
     "stripebalance": bench_stripebalance,
+    "stripeskip": bench_stripeskip,
     "roofline2": bench_roofline2,
 }
 
