@@ -1,0 +1,84 @@
+"""Pure-numpy semantics tests for tpu/microbench.py's shared helpers.
+
+The measurement groups themselves are hardware-only (chained device
+loops), but the grid-validity logic both stripe groups share is pure
+numpy and its contract is load-bearing: a suspect grid must invalidate
+derived rows (BASELINE's OUTLIER-SUSPECT / NaN-cell discipline), and
+the stripeskip best-arm pick must never report an unmeasured grid as
+the winner.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_microbench():
+    spec = importlib.util.spec_from_file_location(
+        "tpumt_microbench", os.path.join(_REPO, "tpu", "microbench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+MB = _load_microbench()
+
+
+def test_paced_with_suspect_clean_grid():
+    t = np.full((4, 4), 1e-3)
+    paced, note, suspect = MB._paced_with_suspect(t)
+    assert not suspect
+    assert note == ""
+    assert abs(paced - 4e-3) < 1e-12  # sum over steps of max over ranks
+
+
+def test_paced_with_suspect_nan_cell():
+    """A double-failed cell (NaN after the retry) must both poison the
+    paced sum AND flag the grid — silently dropping it from the stats
+    (NaN > 0 is False) was the reviewed-out failure mode."""
+    t = np.full((4, 4), 1e-3)
+    t[1, 2] = np.nan
+    paced, note, suspect = MB._paced_with_suspect(t)
+    assert suspect
+    assert "NaN" in note
+    assert np.isnan(paced)
+
+
+def test_paced_with_suspect_outlier_cell():
+    """A lone live cell >5x the grid median marks the grid
+    OUTLIER-SUSPECT (the contention-spike self-identification that
+    invalidated a round-4 stripebalance replicate grid)."""
+    t = np.full((4, 4), 1e-3)
+    t[2, 3] = 10e-3
+    paced, note, suspect = MB._paced_with_suspect(t)
+    assert suspect
+    assert "OUTLIER-SUSPECT" in note
+    # the paced proxy itself is still finite — only derived
+    # cross-grid rows are invalidated by the flag
+    assert np.isfinite(paced)
+
+
+def test_paced_with_suspect_zero_cells_ignored():
+    """Geometrically-dead cells are stored as exact 0 and excluded from
+    the outlier statistics (the contig grid's dead-future cells)."""
+    t = np.full((4, 4), 1e-3)
+    t[0, 1:] = 0.0  # dead cells
+    paced, note, suspect = MB._paced_with_suspect(t)
+    assert not suspect
+    assert np.isfinite(paced)
+
+
+def test_best_finite_arm_skips_nan():
+    """The stripeskip best-arm pick must never report a NaN
+    (unmeasured) arm as the winner — plain min() over a dict with a NaN
+    value can, because NaN comparisons are always False."""
+    assert MB._best_finite_arm({128: np.nan, 256: 2e-3, 512: 3e-3}) == 256
+    # NaN first in iteration order is the case plain min() gets wrong
+    assert MB._best_finite_arm({128: np.nan, 256: np.nan, 512: 1.0}) == 512
+    assert MB._best_finite_arm({128: np.nan}) is None
+    assert MB._best_finite_arm({}) is None
+    assert MB._best_finite_arm({128: 3e-3, 256: 1e-3}) == 256

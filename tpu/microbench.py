@@ -1234,6 +1234,18 @@ def _paced_with_suspect(t):
     return t.max(axis=0).sum(), note, suspect
 
 
+def _best_finite_arm(paced):
+    """NaN-safe best-arm pick for a {arm: seconds} dict: min over
+    finite values only — a plain ``min(paced, key=paced.get)`` can
+    return a NaN arm (NaN comparisons are always False), reporting an
+    unmeasured grid as the sweep winner. Returns None when no arm is
+    finite."""
+    import numpy as np
+
+    finite = {s: p for s, p in paced.items() if np.isfinite(p)}
+    return min(finite, key=finite.get) if finite else None
+
+
 def bench_stripeskip(results):
     """Round-5 follow-up sweep: the striped ring's ``skip_tile`` (the
     masked band sub-span width) was SET to 256 when the skip/rescale
@@ -1275,12 +1287,7 @@ def bench_stripeskip(results):
               paced[skt] * 1e3, "ms",
               f"striped decoupled paced proxy, w={w} lq={lq} d={d}; "
               f"total work {t.sum() * 1e3:.2f} ms{note}")
-    # the pick must be NaN-safe even beyond the suspect gate: min()
-    # over a dict with a NaN value can return the NaN arm (NaN
-    # comparisons are always False), reporting an unmeasured grid as
-    # the winner
-    finite = {s: p for s, p in paced.items() if np.isfinite(p)}
-    best = min(finite, key=finite.get) if finite else None
+    best = _best_finite_arm(paced)
     _emit(results, f"stripeskip_best_kt{kt}",
           float("nan") if (suspect or best is None) else float(best),
           "skip_tile",
