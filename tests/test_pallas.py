@@ -610,6 +610,33 @@ def test_vpu_probe_mixes():
         # proves nothing
         assert np.abs(want2 - ramp).max() > 1e-3
 
+    # step5fma (the raw 4-tap se-folded form, probed to test whether
+    # the dual-dim FMA lesson transfers to the headline body — it does
+    # NOT, BASELINE round-5 VPU note): same update up to FP
+    # association, tap constants folded with se at trace time
+    for mix, axis in (("step5fma_d0", 0), ("step5fma_d1", 1)):
+        shape = [8, 128]
+        ramp = np.broadcast_to(
+            np.arange(shape[axis], dtype=np.float32).reshape(
+                [-1, 1] if axis == 0 else [1, -1]
+            ),
+            shape,
+        ).copy()
+        got = np.asarray(PK.vpu_probe_pallas(
+            jnp.asarray(ramp), reps, mix, se=se, interpret=True
+        ))
+        N = shape[axis]
+        t1, t2 = np.float32(se * c1), np.float32(se * c2)
+        z = np.moveaxis(ramp.astype(np.float64), axis, 0)
+        for _ in range(reps):
+            z[2:N - 2] = (z[2:N - 2] + t1 * z[3:N - 1]
+                          + np.float32(-se * c1) * z[1:N - 3]
+                          + t2 * z[4:N]
+                          + np.float32(-se * c2) * z[:N - 4])
+        want3 = np.moveaxis(z, 0, axis)
+        np.testing.assert_allclose(got, want3, rtol=0, atol=1e-4)
+        assert np.abs(want3 - ramp).max() > 1e-3
+
 
 def test_vpu_probe_heat5_mix():
     """Round-5 probe mix (VERDICT r4 #6): heat5 applies the heat

@@ -746,6 +746,16 @@ def bench_vpu(results):
         ("step5_d0", "bfloat16"): (7, (256, 1024, 4096)),
         ("step5_d1", "bfloat16"): (7, (64, 256, 1024)),
     }
+    if os.environ.get("TPU_MPI_VPU_STEP5FMA", "") not in ("", "0"):
+        # opt-in reproduction of the round-5 diff-vs-fma form A/B
+        # (BASELINE VPU note: the raw 4-tap se-folded form measured
+        # SLOWER on every axis/dtype; to interleave the forms
+        # per-reps-point as the recorded A/B did, run this twice and
+        # pair same-window readings — a single pass still reproduces
+        # the form ratio to the window band)
+        for dname in ("float32", "bfloat16"):
+            PROBES[("step5fma_d0", dname)] = (7, (256, 1024, 4096))
+            PROBES[("step5fma_d1", dname)] = (7, (64, 256, 1024))
     probe_rate = {}
     for (mix, dname), (ops, reps3) in PROBES.items():
         ts = np.array([probe_per_call(mix, r, dname) for r in reps3])

@@ -409,21 +409,48 @@ def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
                 zx.shape, shift, jnp.float32
             ).astype(zz.dtype)
     else:
-        # the EXACT k-step kernel body (_step5 + band concat) applied to
-        # the resident block: 7 nominal ops/elt/rep (2 sub + 2 mul + 1
-        # add derivative, + mul + add update) plus whatever the shifts
-        # and the concat stitching really cost — that difference vs the
-        # fma mix is the point of the probe
-        axis = 0 if mix == "step5_d0" else 1
+        # step5_*: the EXACT k-step kernel body (_step5 + band concat)
+        # applied to the resident block — 7 nominal ops/elt/rep (2 sub
+        # + 2 mul + 1 add derivative, + mul + add update) plus whatever
+        # the shifts and the concat stitching really cost; that
+        # difference vs the fma mix is the point of the probe.
+        # step5fma_*: the same update in raw 4-tap se-folded form —
+        # old + Σ tᵢ·z₊ᵢ with tᵢ = se·STENCIL5ᵢ folded at trace time
+        # (se is static here), 4 independent mul+add pairs with no
+        # serial sub dependency. Built to test whether the dual-dim
+        # op-diet lesson (raw 4-tap accumulation beat the difference
+        # form ~1.4x in-VMEM via FMA fusion) transfers to the headline
+        # body — it does NOT (BASELINE round-5 VPU note: diff/fma
+        # 0.80-0.98x, difference form faster everywhere). Same real
+        # arithmetic, different FP association; both variants share the
+        # stitching below so the A/B stays like-for-like.
+        axis = 0 if mix.endswith("_d0") else 1
         N = z.shape[axis]
-        se = jnp.asarray(se, z.dtype)
+        if mix.startswith("step5fma"):
+            t1 = jnp.asarray(float(se) * _C1, z.dtype)
+            tm1 = jnp.asarray(-float(se) * _C1, z.dtype)
+            t2 = jnp.asarray(float(se) * _C2, z.dtype)
+            tm2 = jnp.asarray(-float(se) * _C2, z.dtype)
+
+            def upd_fn(zz):
+                def zs(off):
+                    return jax.lax.slice_in_dim(
+                        zz, N_BND + off, N - N_BND + off, axis=axis
+                    )
+
+                return (zs(0) + t1 * zs(1) + tm1 * zs(-1)
+                        + t2 * zs(2) + tm2 * zs(-2))
+        else:
+            se_t = jnp.asarray(se, z.dtype)
+
+            def upd_fn(zz):
+                return _step5(zz, N_BND, N - 2 * N_BND, axis, se_t)
 
         def body(_, z):
-            upd = _step5(z, N_BND, N - 2 * N_BND, axis, se)
             return jnp.concatenate(
                 [
                     jax.lax.slice_in_dim(z, 0, N_BND, axis=axis),
-                    upd,
+                    upd_fn(z),
                     jax.lax.slice_in_dim(z, N - N_BND, N, axis=axis),
                 ],
                 axis=axis,
@@ -447,7 +474,11 @@ def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
     Mixes: ``fma`` (elementwise a·z + b, 2 nominal ops/elt),
     ``step5_d0``/``step5_d1`` (the k-step stencil kernel's actual
     per-step body on the resident block: 7 nominal ops/elt plus
-    sublane/lane shifts and the band concat), and — round 5, VERDICT r4
+    sublane/lane shifts and the band concat; ``step5fma_d0``/``_d1``
+    are the same update in raw 4-tap se-folded form — the refuted
+    round-5 alternative, kept so the diff-vs-fma A/B stays
+    reproducible via ``tpu/microbench.py vpu`` with
+    ``TPU_MPI_VPU_STEP5FMA=1``), and — round 5, VERDICT r4
     #6 — ``heat5`` (the heat Laplacian streamer's exact per-step body:
     4 concat shifts + two-axis Euler update + border mask, ~11 nominal
     ops/elt) and ``dualdim`` (the dual-dim step kernel's body: 4-tap
@@ -473,8 +504,8 @@ def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
             f"{total} B live in VMEM, over the "
             f"{_VMEM_BUDGET_BYTES // 2**20} MB budget"
         )
-    if mix not in ("fma", "step5_d0", "step5_d1", "heat5", "dualdim",
-                   "dualdim_lean"):
+    if mix not in ("fma", "step5_d0", "step5_d1", "step5fma_d0",
+                   "step5fma_d1", "heat5", "dualdim", "dualdim_lean"):
         raise ValueError(f"unknown mix {mix!r}")
     return pl.pallas_call(
         functools.partial(_vpu_probe_kernel, reps=reps, mix=mix, se=se),
