@@ -20,7 +20,8 @@ def test_pod_protocol_dryrun(tmp_path):
     stencil2d halo driver, the in-place RDMA gather) and writes a
     MULTICHIP-shaped PODRUN.json with all cells rc=0 — so real pod
     access converts to BASELINE rows with zero new engineering on the
-    day."""
+    day. The attention pairs run at BOTH dtypes (round-5 dtype note:
+    the striped layout's verdict inverts between f32 and bf16)."""
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + (
         ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
@@ -36,7 +37,7 @@ def test_pod_protocol_dryrun(tmp_path):
         start_new_session=True,
     )
     try:
-        stdout, stderr = proc.communicate(timeout=560)
+        stdout, stderr = proc.communicate(timeout=840)
     except subprocess.TimeoutExpired:
         os.killpg(proc.pid, 9)
         stdout, stderr = proc.communicate()
@@ -47,7 +48,9 @@ def test_pod_protocol_dryrun(tmp_path):
     assert rec["ok"] is True
     assert rec["world"] == 2
     expected = {"bench", "coll-xla", "coll-rdma-c1", "coll-rdma-c2",
-                "attn-contig", "attn-striped", "stencil2d", "gather-rdma"}
+                "attn-contig-f32", "attn-striped-f32",
+                "attn-contig-bf16", "attn-striped-bf16",
+                "stencil2d", "gather-rdma"}
     assert set(rec["cells"]) == expected, rec
     assert all(rc == 0 for rc in rec["cells"].values()), rec
     # the bench cell's rank-0 output must carry the dual-dtype JSON line

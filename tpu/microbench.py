@@ -1055,13 +1055,27 @@ def bench_roofline2(results):
             suspect = fit_suspect or not np.isfinite(ops_time)
             binding = "bytes" if bytes_time > ops_time else "ops"
             model = max(bytes_time, ops_time)
+            # physical-bound gate: a measured marginal BELOW the bytes
+            # model is impossible regardless of which axis binds (5 HBM
+            # passes cannot beat the marginal stream rate) — an
+            # inflated small-size point flattens the slope without
+            # tripping the linearity gate (one round-5 window read
+            # 18.1 ps/elt = "1.49x the ceiling" with linearity 0.883).
+            # 1.1 allows fit noise.
+            impossible = (np.isfinite(c) and c > 0
+                          and bytes_time / c > 1.1)
+            fit_suspect = fit_suspect or impossible
+            suspect = suspect or impossible
             cs[lean] = float("nan") if fit_suspect else c
             _emit(results, f"roofline_{mix}_{dname}_marginal_ps",
                   float("nan") if suspect else c * 1e12, "ps/elt",
                   f"fit t=a+c*elems over {sizes}; a={a * 1e6:.0f} us; "
                   f"linearity {lin:.3f}; ops axis {ops_time * 1e12:.2f} "
                   f"ps/elt, bytes axis (5 passes incl. chain feedback) "
-                  f"{bytes_time * 1e12:.2f} ps/elt -> {binding}-bound")
+                  f"{bytes_time * 1e12:.2f} ps/elt -> {binding}-bound"
+                  + ("; SUB-PHYSICAL slope (below the bytes model): "
+                     "inflated small-size point, fit invalid"
+                     if impossible else ""))
             _emit(results, f"roofline_{mix}_{dname}_vs_ceiling",
                   float("nan") if suspect else model / c, "ratio",
                   f"binding-axis model time / measured marginal (1.0 = "

@@ -12,7 +12,11 @@
 #      double-buffered pod-latency experiment), ppermute = the halo
 #      pattern's wire rate;
 #   3. striped-vs-contiguous causal ring attention wall-clock at the
-#      measured-best per-layout defaults (attnbench ring tier);
+#      measured-best per-layout defaults (attnbench ring tier) — at
+#      BOTH dtypes: the single-chip proxy says stripe pays at f32
+#      (1.42-1.51x) and loses at bf16 (0.79-0.83x, BASELINE round-5
+#      dtype note); the pod wall-clock with real ppermute overlap is
+#      the open question for each;
 #   4. the stencil2d halo-exchange driver at reference scale (the
 #      job.sh matrix's communication-bound cell, exact-parity gated);
 #   5. gather_inplace over the RDMA all-gather (donated-buffer parity).
@@ -72,12 +76,15 @@ if [ "$ci" -eq 1 ]; then
 else
   sizes_kib="4,64,1024,16384"
   coll_iter=500
-  attn_args=(--seq-len 32768 --head-dim 128 --dtype bfloat16 --fast
-             --n-iter 200)
+  attn_args=(--seq-len 32768 --head-dim 128 --n-iter 200)
   sten_args=(--n-local 2048 --n-other 4096 --n-iter 30)
   gather_args=(--n-per-rank 1048576)
   bench_env=()
 fi
+# dtype pairs for the attention cells (cell 3): bf16 runs the
+# benchmarked 16-bit config (DEFAULT precision via --fast)
+attn_f32=(--dtype float32)
+attn_bf16=(--dtype bfloat16 --fast)
 
 declare -A cell_rc=()
 run_cell() {
@@ -125,15 +132,21 @@ run_cell coll-rdma-c2 -- python -m tpu_mpi_tests.drivers.collbench \
   --collectives allreduce_rdma --rdma-credits 2 \
   --jsonl out-pod-coll-rdma-c2.jsonl
 
-# 3. causal ring attention: contiguous vs striped, per-layout
-#    measured-best defaults (BASELINE stripebalance's multi-chip unknown
-#    is exactly this wall-clock overlap with ppermute transfer)
-run_cell attn-contig -- python -m tpu_mpi_tests.drivers.attnbench \
-  "${fake[@]+"${fake[@]}"}" --tiers ring --causal \
-  "${attn_args[@]}" --jsonl out-pod-attn-contig.jsonl
-run_cell attn-striped -- python -m tpu_mpi_tests.drivers.attnbench \
-  "${fake[@]+"${fake[@]}"}" --tiers ring --causal --stripe \
-  "${attn_args[@]}" --jsonl out-pod-attn-striped.jsonl
+# 3. causal ring attention: contiguous vs striped at BOTH dtypes,
+#    per-layout measured-best defaults (BASELINE stripebalance's
+#    multi-chip unknown is exactly this wall-clock overlap with
+#    ppermute transfer; the layout verdict is dtype-dependent on the
+#    single-chip proxy, so pod day measures each dtype's pair)
+for dt in f32 bf16; do
+  if [ "$dt" = f32 ]; then dt_args=("${attn_f32[@]}")
+  else dt_args=("${attn_bf16[@]}"); fi
+  run_cell "attn-contig-$dt" -- python -m tpu_mpi_tests.drivers.attnbench \
+    "${fake[@]+"${fake[@]}"}" --tiers ring --causal \
+    "${attn_args[@]}" "${dt_args[@]}" --jsonl "out-pod-attn-contig-$dt.jsonl"
+  run_cell "attn-striped-$dt" -- python -m tpu_mpi_tests.drivers.attnbench \
+    "${fake[@]+"${fake[@]}"}" --tiers ring --causal --stripe \
+    "${attn_args[@]}" "${dt_args[@]}" --jsonl "out-pod-attn-striped-$dt.jsonl"
+done
 
 # 4. halo exchange at reference scale (exact-parity gated inside)
 run_cell stencil2d -- python -m tpu_mpi_tests.drivers.stencil2d \

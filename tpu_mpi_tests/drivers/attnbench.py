@@ -70,8 +70,9 @@ def run(args) -> int:
         # dtype-dependent (BASELINE round-5 stripebalance dtype note —
         # 1.42-1.51x at f32, 0.79-0.83x at bf16 where per-cell fixed
         # cost dominates the halved matmul work). Benchmarking the
-        # combination is the point of this driver, so note, don't block.
-        rep.line(
+        # combination is the point of this driver, so note, don't
+        # block; banner = rank-0 only, like the config line above
+        rep.banner(
             "NOTE --stripe at bfloat16: the striped layout measured "
             "SLOWER than contiguous at 16-bit (0.79-0.83x paced, "
             "BASELINE round-5) — it pays at float32 only"
