@@ -1,8 +1,14 @@
 # Dev workflow (≅ the reference's root Makefile role).
-.PHONY: test native bench smoke clean
+SHELL := /bin/bash
+.PHONY: test verify native bench smoke clean
 
 test:
 	python -m pytest tests/ -q
+
+# the blessed tier-1 gate, verbatim from ROADMAP.md — builders and CI
+# invoke this one entry point instead of hand-copying the command
+verify:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 native:
 	$(MAKE) -C native

@@ -114,7 +114,7 @@ class TestReduceScatter:
         import functools
 
         import jax
-        from jax import shard_map
+        from tpu_mpi_tests.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from tpu_mpi_tests.kernels import pallas_kernels as PK

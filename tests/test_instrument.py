@@ -2,6 +2,7 @@ import io
 import json
 
 import jax.numpy as jnp
+import pytest
 
 from tpu_mpi_tests.instrument import PhaseTimer, Reporter
 from tpu_mpi_tests.instrument.timers import block
@@ -78,6 +79,51 @@ class TestReporter:
         recs = [json.loads(ln) for ln in p.read_text().splitlines()]
         assert recs[0]["kind"] == "sum" and recs[0]["value"] == 1.0
         assert recs[1]["kind"] == "time" and recs[1]["phase"] == "kernel"
+
+    def test_context_manager_closes_jsonl(self, tmp_path):
+        p = tmp_path / "out.jsonl"
+        with Reporter(stream=io.StringIO(), jsonl_path=str(p)) as r:
+            r.sum_line(1.0)
+            assert r._jsonl_file is not None
+        assert r._jsonl_file is None
+        assert json.loads(p.read_text())["kind"] == "sum"
+
+    def test_multiprocess_jsonl_path_suffixed_per_rank(self, tmp_path):
+        """Two processes appending to one path corrupt it; proc_count > 1
+        auto-suffixes (out.jsonl -> out.p<i>.jsonl) so each rank owns its
+        file and tpumt-report merges the set."""
+        base = tmp_path / "out.jsonl"
+        buf = io.StringIO()
+        for i in range(2):
+            with Reporter(rank=i, size=2, stream=buf, jsonl_path=str(base),
+                          proc_index=i, proc_count=2) as r:
+                r.sum_line(float(i))
+        assert not base.exists()
+        for i in range(2):
+            rec = json.loads((tmp_path / f"out.p{i}.jsonl").read_text())
+            assert rec["value"] == float(i)
+        # single process keeps the exact path
+        with Reporter(stream=buf, jsonl_path=str(base)) as r:
+            r.sum_line(5.0)
+        assert base.exists()
+
+    def test_time_lines_stats(self, tmp_path):
+        p = tmp_path / "out.jsonl"
+        buf = io.StringIO()
+        t = PhaseTimer()
+        for _ in range(3):
+            with t.phase("k"):
+                pass
+        with Reporter(stream=buf, jsonl_path=str(p)) as r:
+            r.time_lines(t, stats=True)
+        (line,) = buf.getvalue().splitlines()
+        assert line.startswith("TIME k : ")
+        assert "count=3" in line and "mean=" in line
+        assert "min=" in line and "max=" in line
+        (rec,) = [json.loads(ln) for ln in p.read_text().splitlines()]
+        assert rec["count"] == 3
+        assert rec["min_s"] <= rec["mean_s"] <= rec["max_s"]
+        assert rec["seconds"] == pytest.approx(3 * rec["mean_s"])
 
 
 def test_trace_range_and_gate_smoke(tmp_path):

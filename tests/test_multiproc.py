@@ -142,7 +142,9 @@ def test_multiproc_2level_mesh_collectives(tpumt_run, tmp_path):
         import functools
         import jax
         import numpy as np
-        from jax import lax, shard_map
+        from jax import lax
+
+        from tpu_mpi_tests.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh_2level, topology
@@ -212,7 +214,9 @@ def test_multiproc_4proc_stencil1d_and_ring(tpumt_run, tmp_path):
         import functools
         import jax
         import numpy as np
-        from jax import lax, shard_map
+        from jax import lax
+
+        from tpu_mpi_tests.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from tpu_mpi_tests.comm.mesh import (

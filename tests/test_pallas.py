@@ -474,7 +474,7 @@ def test_ring_reduce_scatter_2d(mesh8):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tpu_mpi_tests.comm import collectives as C
@@ -508,7 +508,7 @@ def test_ring_allreduce_single_device():
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
@@ -539,7 +539,7 @@ def test_ring_reduce_scatter_self_ring(credits):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
@@ -769,7 +769,7 @@ def test_ring_allgather_self_ring():
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("shard",))
@@ -792,7 +792,7 @@ def test_ring_allgather_self_ring_rejects_multi_device(mesh8):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @jax.jit
@@ -813,7 +813,7 @@ def test_ring_reduce_scatter_rejects_bad_credits(mesh8):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @jax.jit
@@ -848,7 +848,7 @@ def test_ring_reduce_scatter_self_ring_rejects_multi_device(mesh8):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @jax.jit
@@ -883,7 +883,7 @@ def test_ring_reduce_scatter_rejects_vmem_blowout(mesh8):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     @jax.jit

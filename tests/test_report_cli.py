@@ -1,0 +1,145 @@
+"""tpumt-report (instrument/aggregate.py): cross-rank JSONL merging,
+straggler detection, and the rank-file suffix conventions."""
+
+import json
+
+import pytest
+
+from tpu_mpi_tests.instrument import aggregate
+
+
+def _write_jsonl(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+@pytest.fixture()
+def two_rank_run(tmp_path):
+    """A deterministic two-rank run: rank 1 is a 2x straggler on the
+    exchange phase; spans carry bandwidth."""
+    manifest = {
+        "kind": "manifest", "platform": "cpu", "global_device_count": 8,
+        "device_kinds": ["cpu"], "process_count": 2, "jax": "0.0-test",
+        "git_sha": "abc123", "argv": ["stencil2d", "--telemetry"],
+    }
+    _write_jsonl(tmp_path / "run.p0.jsonl", [
+        dict(manifest, process_index=0),
+        {"kind": "time", "phase": "exchange", "seconds": 1.0, "rank": 0},
+        {"kind": "time", "phase": "kernel", "seconds": 0.5, "rank": 0},
+        {"kind": "span", "op": "all_gather", "nbytes": 1 << 30,
+         "seconds": 1.0, "gbps": 1.0, "world": 8, "rank": 0},
+        {"kind": "span", "op": "all_gather", "nbytes": 1 << 30,
+         "seconds": 0.5, "gbps": 2.0, "world": 8, "rank": 0},
+    ])
+    _write_jsonl(tmp_path / "run.p1.jsonl", [
+        dict(manifest, process_index=1),
+        {"kind": "time", "phase": "exchange", "seconds": 2.0, "rank": 1},
+        {"kind": "time", "phase": "kernel", "seconds": 0.5, "rank": 1},
+        {"kind": "span", "op": "all_gather", "nbytes": 1 << 30,
+         "seconds": 0.25, "gbps": 4.0, "world": 8, "rank": 1},
+    ])
+    return tmp_path
+
+
+def test_expand_rank_files_finds_suffixed_set(two_rank_run):
+    base = str(two_rank_run / "run.jsonl")
+    files = aggregate.expand_rank_files([base])
+    assert [f.rsplit("/", 1)[-1] for f in files] == [
+        "run.p0.jsonl", "run.p1.jsonl"
+    ]
+
+
+def test_summary_merges_ranks_and_finds_straggler(two_rank_run):
+    files = aggregate.expand_rank_files([str(two_rank_run / "run.jsonl")])
+    s = aggregate.summarize(files)
+    assert s["manifest"]["process_index"] == 0
+    assert s["manifest_count"] == 2
+
+    ph = s["phases"]["exchange"]
+    assert ph["ranks"] == 2 and ph["count"] == 2
+    assert ph["mean_s"] == 1.5 and ph["min_s"] == 1.0 and ph["max_s"] == 2.0
+    assert ph["skew"] == 2.0 and ph["straggler_rank"] == 1
+    assert s["phases"]["kernel"]["skew"] == 1.0
+
+    op = s["ops"]["all_gather"]
+    assert op["ops"] == 3 and op["bytes"] == 3 * (1 << 30)
+    assert op["ranks"] == 2
+    # per-rank totals: rank0 = 1.5s, rank1 = 0.25s -> rank0 straggles
+    assert op["skew"] == 6.0 and op["straggler_rank"] == 0
+    assert op["gbps_p50"] == 2.0
+    assert op["gbps_p10"] == 1.0 and op["gbps_p90"] == 4.0
+
+
+def test_cli_text_output_golden(two_rank_run, capsys):
+    """Golden-file shape of the text report on the two-rank fixture."""
+    rc = aggregate.main([str(two_rank_run / "run.jsonl")])
+    out = capsys.readouterr().out.splitlines()
+    assert rc == 0
+    assert out[0] == (
+        "RUN cpux8 (cpu) procs=2 jax=0.0-test git=abc123"
+    )
+    assert out[1] == "ARGV stencil2d --telemetry"
+    assert out[2].startswith("FILES 2: ")
+    assert (
+        "PHASE exchange: ranks=2 n=2 mean=1.5 min=1 max=2 skew=2" in out
+    )
+    assert (
+        "PHASE kernel: ranks=2 n=2 mean=0.5 min=0.5 max=0.5 skew=1" in out
+    )
+    assert any(
+        line.startswith("OP all_gather: ranks=2 ops=3 bytes=3221225472")
+        and "gbps p10/p50/p90=1/2/4" in line
+        for line in out
+    )
+    assert "STRAGGLER PHASE exchange: rank 1 is 2x the fastest rank" in "\n".join(out)
+    assert "STRAGGLER OP all_gather: rank 0 is 6x the fastest rank" in "\n".join(out)
+
+
+def test_cli_json_output(two_rank_run, capsys):
+    rc = aggregate.main(["--json", str(two_rank_run / "run.jsonl")])
+    assert rc == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s["phases"]["exchange"]["skew"] == 2.0
+
+
+def test_cli_skew_threshold_silences_stragglers(two_rank_run, capsys):
+    rc = aggregate.main(
+        ["--skew-threshold", "10", str(two_rank_run / "run.jsonl")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "STRAGGLER" not in out
+    assert "OK no stragglers above 10x" in out
+
+
+def test_cli_missing_files(tmp_path, capsys):
+    rc = aggregate.main([str(tmp_path / "nope.jsonl")])
+    assert rc == 1
+
+
+def test_corrupt_lines_skipped(tmp_path):
+    p = tmp_path / "r.jsonl"
+    p.write_text('{"kind": "time", "phase": "a", "seconds": 1.0}\n'
+                 "not json at all\n"
+                 '{"kind": "time", "phase": "a", "seconds": 3.0}\n')
+    s = aggregate.summarize([str(p)])
+    # both valid records land on the same (file-index) rank
+    assert s["phases"]["a"]["per_rank_s"] == {"0": 4.0}
+
+
+def test_avg_py_expands_rank_suffixed_jsonl(two_rank_run, capsys):
+    """tpu/avg.py --key globs the per-rank set from the base path."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_avg", Path(__file__).resolve().parent.parent / "tpu" / "avg.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main(
+        ["--no-native", "--pattern", "time", "--key", "seconds",
+         str(two_rank_run / "run.jsonl")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "run.p0.jsonl" in out and "run.p1.jsonl" in out

@@ -42,7 +42,7 @@ def test_ring_pass_rotates(mesh8):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
 
     x = shard_1d(jnp.arange(8, dtype=jnp.float32).reshape(8, 1), mesh8)
 
@@ -62,7 +62,7 @@ def test_ring_scan_sums_all_blocks(mesh8):
     import functools
 
     import jax
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
 
     x = shard_1d(
         jnp.arange(16, dtype=jnp.float32).reshape(16, 1), mesh8

@@ -46,7 +46,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpu_mpi_tests.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.pallas import tpu as pltpu
 

@@ -1,0 +1,262 @@
+"""Comm-layer telemetry tests: span accounting, flight recorder, manifest,
+driver wiring (--telemetry), and the tpumt-report cross-rank aggregator."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_mpi_tests.instrument import telemetry as T
+
+
+@pytest.fixture()
+def fresh(monkeypatch):
+    """A fresh registry so cross-test state cannot satisfy assertions;
+    the module-level functions read ``_TELEMETRY`` at call time."""
+    reg = T.Telemetry()
+    monkeypatch.setattr(T, "_TELEMETRY", reg)
+    return reg
+
+
+class TestCommSpan:
+    def test_counters_and_sink_records(self, fresh):
+        records = []
+        fresh.enable(sink=records.append)
+        with T.comm_span("all_gather", nbytes=1024, axis_name="shard",
+                         world=8) as span:
+            span.result = jnp.ones(4)
+        with T.comm_span("all_gather", nbytes=1024, axis_name="shard",
+                         world=8):
+            pass
+        c = T.counters()
+        assert c["all_gather"]["ops"] == 2
+        assert c["all_gather"]["bytes"] == 2048
+        assert c["all_gather"]["seconds"] > 0
+        (r1, r2) = records
+        assert r1["kind"] == "span" and r1["op"] == "all_gather"
+        assert r1["nbytes"] == 1024 and r1["axis"] == "shard"
+        assert r1["world"] == 8 and r1["seconds"] > 0
+        assert r1["gbps"] == pytest.approx(
+            1024 / r1["seconds"] / 1e9
+        )
+
+    def test_nesting_records_each_level(self, fresh):
+        fresh.enable()
+        with T.comm_span("outer", nbytes=100):
+            with T.comm_span("inner", nbytes=10):
+                pass
+        c = T.counters()
+        assert c["outer"]["ops"] == 1 and c["inner"]["ops"] == 1
+        assert c["outer"]["bytes"] == 100 and c["inner"]["bytes"] == 10
+        # the outer span's wall time includes the inner's
+        assert c["outer"]["seconds"] >= c["inner"]["seconds"]
+
+    def test_span_call_disabled_is_passthrough(self, fresh):
+        assert not fresh.enabled
+        out = T.span_call("op", lambda a, b: a + b, 1, 2, nbytes=5)
+        assert out == 3
+        assert T.counters() == {}
+        assert T.flight_events() == []
+
+    def test_span_under_jit_trace_is_not_recorded(self, fresh):
+        """A wrapper invoked inside a jitted loop body executes ONCE at
+        trace time; recording there would fabricate telemetry (ops=1,
+        trace-duration seconds, garbage GB/s) for an n-iteration loop —
+        spans must pass through unrecorded under a trace."""
+        from jax import lax
+
+        fresh.enable()
+
+        @jax.jit
+        def loop(x):
+            def body(_, xx):
+                return T.span_call("traced_op", lambda a: a + 1, xx,
+                                   nbytes=1024)
+            return lax.fori_loop(0, 1000, body, x)
+
+        out = loop(jnp.zeros(4))
+        assert float(out[0]) == 1000.0
+        assert "traced_op" not in T.counters()
+
+    def test_span_call_enabled_blocks_and_records(self, fresh):
+        fresh.enable()
+        out = T.span_call(
+            "k", lambda: jnp.arange(8.0) * 2, nbytes=64, axis_name="x",
+            world=4,
+        )
+        assert float(out.sum()) == 56.0
+        assert T.counters()["k"] == {
+            "ops": 1,
+            "bytes": 64,
+            "seconds": pytest.approx(T.counters()["k"]["seconds"]),
+        }
+
+
+class TestFlightRecorder:
+    def test_dispatch_notes_recorded_even_when_disabled(self, fresh):
+        assert not fresh.enabled
+        T.note_dispatch("ring_halo_pallas(world=8)")
+        (e,) = T.flight_events()
+        assert e.note == "ring_halo_pallas(world=8)"
+        assert "dispatched" in e.describe()
+
+    def test_capacity_bounds_buffer(self, monkeypatch):
+        reg = T.Telemetry(flight_capacity=4)
+        monkeypatch.setattr(T, "_TELEMETRY", reg)
+        for i in range(10):
+            T.note_dispatch(f"op{i}")
+        notes = [e.note for e in T.flight_events()]
+        assert notes == ["op6", "op7", "op8", "op9"]
+
+    def test_flight_lines_order_and_ages(self, fresh):
+        for i in range(5):
+            T.note_dispatch(f"op{i}")
+        lines = T.flight_lines(3)
+        assert len(lines) == 3
+        assert lines[0].startswith("op2") and lines[2].startswith("op4")
+        assert all("s ago" in line for line in lines)
+
+
+class TestWrapperSpans:
+    """Every public collective/halo wrapper records a span when enabled."""
+
+    def test_collectives_and_halo_record(self, fresh, mesh8):
+        from tpu_mpi_tests.comm import collectives as C
+        from tpu_mpi_tests.comm.halo import Staging, halo_exchange
+
+        fresh.enable()
+        x = C.shard_1d(jnp.arange(64, dtype=jnp.float32), mesh8)
+        C.all_gather(x, mesh8)
+        pr = C.shard_1d(jnp.ones((8, 16), jnp.float32), mesh8)
+        C.allreduce_sum(pr, mesh8)
+        pr2 = C.shard_1d(jnp.ones((8, 16), jnp.float32), mesh8)
+        C.reduce_scatter_sum(pr2, mesh8)
+        C.barrier(mesh8)
+        z = np.arange(8 * 12 * 8, dtype=np.float32).reshape(96, 8)
+        zs = jax.device_put(z, NamedSharding(mesh8, P("shard", None)))
+        halo_exchange(zs, mesh8, axis=0, staging=Staging.DIRECT)
+
+        c = T.counters()
+        for op in ("all_gather", "allreduce", "reduce_scatter", "barrier",
+                   "halo_exchange"):
+            assert c[op]["ops"] >= 1, f"missing span for {op}"
+        # payload conventions: gather moves (w-1)*global bytes
+        assert c["all_gather"]["bytes"] == 7 * 64 * 4
+        # halo: 2 directions x (w-1) pairs x n_bnd*W*itemsize bands
+        assert c["halo_exchange"]["bytes"] == 2 * 7 * 2 * 8 * 4
+        # bandwidth derivable for every byte-carrying op
+        assert all(
+            v["seconds"] > 0 for v in c.values()
+        )
+
+    def test_ring_attention_records(self, fresh, mesh8):
+        from tpu_mpi_tests.comm.ring import ring_attention_fn
+
+        fresh.enable()
+        attn = ring_attention_fn(mesh8, "shard")
+        q = jax.device_put(
+            jnp.ones((16, 4), jnp.float32),
+            NamedSharding(mesh8, P("shard", None)),
+        )
+        attn(q, q, q)
+        c = T.counters()
+        assert c["ring_attention"]["ops"] == 1
+        assert c["ring_attention"]["bytes"] == 7 * 2 * 16 * 4 * 4
+
+    def test_ulysses_attention_records(self, fresh, mesh8):
+        from tpu_mpi_tests.comm.alltoall import ulysses_attention_fn
+
+        fresh.enable()
+        attn = ulysses_attention_fn(mesh8, "shard")
+        q = jax.device_put(
+            jnp.ones((16, 8, 4), jnp.float32),
+            NamedSharding(mesh8, P("shard", None, None)),
+        )
+        attn(q, q, q)
+        assert T.counters()["ulysses_attention"]["ops"] == 1
+
+
+def test_watchdog_flight_dump_meets_floor(fresh):
+    """Acceptance: a watchdog fire includes the last >= 8 comm ops."""
+    from tpu_mpi_tests.instrument.watchdog import DUMP_EVENTS, Watchdog
+
+    assert DUMP_EVENTS >= 8
+    assert T.FLIGHT_CAPACITY >= DUMP_EVENTS
+    for i in range(DUMP_EVENTS + 4):
+        T.note_dispatch(f"collective_{i}")
+    msgs = []
+    wd = Watchdog(0.01, "p", _on_timeout=msgs.append)
+    wd._fire()
+    for i in range(4, DUMP_EVENTS + 4):
+        assert f"collective_{i}" in msgs[0]
+
+
+class TestManifest:
+    def test_schema_and_serializable(self):
+        from tpu_mpi_tests.instrument.manifest import (
+            manifest_banner,
+            run_manifest,
+        )
+
+        m = run_manifest(argv=["prog", "--flag"], extra_key=7)
+        for key in ("kind", "time_unix", "time_iso", "argv", "hostname",
+                    "python", "jax", "process_index", "process_count",
+                    "local_device_count", "global_device_count", "platform",
+                    "device_kinds", "env", "git_sha"):
+            assert key in m, key
+        assert m["kind"] == "manifest"
+        assert m["argv"] == ["prog", "--flag"]
+        assert m["extra_key"] == 7
+        assert m["platform"] == "cpu" and m["global_device_count"] == 8
+        # env capture includes the framework/JAX knobs the conftest sets
+        assert "XLA_FLAGS" in m["env"]
+        json.dumps(m)  # JSONL-safe
+        banner = manifest_banner(m)
+        assert banner.startswith("MANIFEST cpu")
+        assert "jax=" in banner and "git=" in banner
+
+
+def test_driver_telemetry_end_to_end(tmp_path, capsys, fresh):
+    """--telemetry --jsonl: manifest first, span records per comm op,
+    TELEMETRY counter lines + summary records on close (acceptance)."""
+    from tpu_mpi_tests.drivers import stencil2d
+
+    jl = tmp_path / "run.jsonl"
+    rc = stencil2d.main(
+        ["--n-local", "32", "--n-other", "64", "--n-iter", "2",
+         "--n-warmup", "1", "--dtype", "float32", "--only", "1:0",
+         "--telemetry", "--jsonl", str(jl)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    recs = [json.loads(line) for line in jl.read_text().splitlines()]
+    assert recs[0]["kind"] == "manifest"
+    spans = [r for r in recs if r.get("kind") == "span"]
+    assert spans, "no span records emitted"
+    halo = [r for r in spans if r["op"] == "halo_exchange"]
+    assert halo and all(r["nbytes"] > 0 and r["seconds"] > 0 for r in halo)
+    assert all("rank" in r for r in spans)
+    summaries = [r for r in recs if r.get("kind") == "telemetry_summary"]
+    assert any(s["op"] == "halo_exchange" for s in summaries)
+    assert "MANIFEST cpu" in out
+    assert "TELEMETRY halo_exchange :" in out
+    # the registry was disabled when the reporter closed
+    assert not T.registry().enabled
+
+
+def test_driver_without_telemetry_emits_no_spans(tmp_path, capsys, fresh):
+    from tpu_mpi_tests.drivers import gather_inplace
+
+    jl = tmp_path / "run.jsonl"
+    rc = gather_inplace.main(
+        ["--n-per-rank", "64", "--jsonl", str(jl)]
+    )
+    capsys.readouterr()
+    assert rc == 0
+    recs = [json.loads(line) for line in jl.read_text().splitlines()]
+    # manifest still present (self-describing results), but no spans
+    assert recs[0]["kind"] == "manifest"
+    assert not [r for r in recs if r.get("kind") == "span"]

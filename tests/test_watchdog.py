@@ -38,16 +38,12 @@ def test_watchdog_fires_on_timeout():
 def test_watchdog_attributes_last_comm_op(monkeypatch):
     """A wedged RDMA semaphore hangs silently; the watchdog names the last
     dispatched comm op so the hang is attributable (VERDICT r1 missing #4)."""
-    import time as _time
-
+    from tpu_mpi_tests.instrument import telemetry as T
     from tpu_mpi_tests.instrument import watchdog as W
 
-    # set via monkeypatch so teardown restores prior state (note_comm_op's
-    # global is sticky by design)
-    monkeypatch.setattr(
-        W, "_last_comm_op", ("ring_halo_pallas(axis=0, world=8)",
-                             _time.time())
-    )
+    # fresh registry so state from other tests cannot satisfy the asserts
+    monkeypatch.setattr(T, "_TELEMETRY", T.Telemetry())
+    W.note_comm_op("ring_halo_pallas(axis=0, world=8)")
     fired = threading.Event()
     msgs = []
 
@@ -62,6 +58,34 @@ def test_watchdog_attributes_last_comm_op(monkeypatch):
     assert "dispatched" in msgs[0]
 
 
+def test_watchdog_dumps_flight_recorder_history(monkeypatch):
+    """A watchdog fire dumps the recent comm-op HISTORY (≥8 events with
+    ages), not just the single most recent op — 'wedged on the first
+    collective' and 'ran 10k exchanges then stalled' must look different."""
+    from tpu_mpi_tests.instrument import telemetry as T
+    from tpu_mpi_tests.instrument import watchdog as W
+
+    monkeypatch.setattr(T, "_TELEMETRY", T.Telemetry())
+    for i in range(12):
+        W.note_comm_op(f"op_number_{i}(world=8)")
+
+    fired = threading.Event()
+    msgs = []
+
+    def on_timeout(msg):
+        msgs.append(msg)
+        fired.set()
+
+    wd = Watchdog(0.05, "hung-ring", _on_timeout=on_timeout).start()
+    assert fired.wait(timeout=5.0)
+    wd.cancel()
+    # the last >= 8 recorded ops appear, newest last, each with an age
+    for i in range(4, 12):
+        assert f"op_number_{i}(world=8)" in msgs[0]
+    assert msgs[0].index("op_number_4") < msgs[0].index("op_number_11")
+    assert "s ago" in msgs[0]
+
+
 def test_rdma_exchange_records_comm_op(mesh8, monkeypatch):
     """The PALLAS_RDMA halo path registers itself with the watchdog."""
     import jax
@@ -69,15 +93,22 @@ def test_rdma_exchange_records_comm_op(mesh8, monkeypatch):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpu_mpi_tests.comm.halo import Staging, halo_exchange
+    from tpu_mpi_tests.instrument import telemetry as T
     from tpu_mpi_tests.instrument import watchdog as W
 
-    # clear state other tests may have left so the assertions below can
-    # only be satisfied by the halo_exchange call itself
-    monkeypatch.setattr(W, "_last_comm_op", None)
+    # fresh registry so the assertions below can only be satisfied by the
+    # halo_exchange call itself
+    monkeypatch.setattr(T, "_TELEMETRY", T.Telemetry())
     assert W.last_comm_op() is None
     z = np.arange(8 * 12 * 8, dtype=np.float32).reshape(8 * 12, 8)
     zs = jax.device_put(z, NamedSharding(mesh8, P("shard", None)))
-    halo_exchange(zs, mesh8, axis=0, staging=Staging.PALLAS_RDMA)
+    try:
+        halo_exchange(zs, mesh8, axis=0, staging=Staging.PALLAS_RDMA)
+    except Exception:
+        # the dispatch note must precede kernel build/launch — that is the
+        # attribution contract (a wedged kernel can never report itself),
+        # so it must be recorded even where this jax cannot run the kernel
+        pass
     op = W.last_comm_op()
     assert op is not None and "ring_halo_pallas(axis=0" in op
     assert "world=8" in op

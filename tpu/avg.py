@@ -21,7 +21,15 @@ import subprocess
 import sys
 from pathlib import Path
 
-NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+REPO = Path(__file__).resolve().parent.parent
+NATIVE_DIR = REPO / "native"
+
+# standalone script: make the package importable when run from anywhere
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+from tpu_mpi_tests.instrument.aggregate import (  # noqa: E402
+    expand_rank_files,
+)
 
 
 def native_binary() -> Path | None:
@@ -93,6 +101,12 @@ def main(argv=None) -> int:
     p.add_argument("files", nargs="*", default=None)
     args = p.parse_args(argv)
     files = args.files or sorted(glob.glob("out-*.txt"))
+    # multi-process runs write per-rank JSONL as base.p<i>.jsonl (see
+    # instrument/report.rank_suffixed_path); expand a base path to its set
+    # so `avg.py --key seconds out.jsonl` aggregates every rank's file —
+    # the SAME expansion tpumt-report uses, so the two tools cannot
+    # diverge on which files an argument names
+    files = expand_rank_files(files)
     if not files:
         print("avg.py: no input files", file=sys.stderr)
         return 1

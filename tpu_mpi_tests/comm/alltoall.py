@@ -19,10 +19,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_mpi_tests.compat import axis_size, shard_map
 from tpu_mpi_tests.comm.ring import online_softmax_update
+from tpu_mpi_tests.instrument.telemetry import span_call
 from tpu_mpi_tests.utils import check_divisible
 
 
@@ -137,7 +139,7 @@ def ulysses_attention(
     ``skip_tile`` sets the causal sub-span skip granularity (round 5).
     ``block_keys`` governs only the non-flash blockwise path, whose
     narrower default bounds its O(L·block·H) score memory."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     check_divisible(q.shape[1], n, "ulysses heads over mesh axis")
     qh, kh, vh = (seq_to_heads(t, axis_name) for t in (q, k, v))
     if flash:
@@ -180,4 +182,21 @@ def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
                                  skip_tile=skip_tile,
                                  precision=precision)
 
-    return attn
+    world = mesh.shape[axis_name]
+
+    def attn_recorded(q, k, v):
+        # telemetry payload: two all-to-alls — q/k/v seq→head, then the
+        # output (q-shaped) head→seq; each moves (w−1)/w of its operand
+        moved = (
+            2 * int(getattr(q, "nbytes", 0))
+            + int(getattr(k, "nbytes", 0))
+            + int(getattr(v, "nbytes", 0))
+        )
+        return span_call(
+            "ulysses_attention", attn, q, k, v,
+            nbytes=(world - 1) * moved // world,
+            axis_name=axis_name, world=world,
+            flash=flash, causal=causal,
+        )
+
+    return attn_recorded

@@ -30,8 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from tpu_mpi_tests.compat import shard_map
+from tpu_mpi_tests.instrument.telemetry import comm_span, span_call
 from tpu_mpi_tests.utils import TpuMtError, check_divisible
 
 
@@ -182,12 +183,27 @@ def _all_gather_fn(mesh: Mesh, axis_name: str, axis: int, ndim: int):
     return gather
 
 
+def _gather_payload_bytes(x, world: int) -> int:
+    """Telemetry payload convention for gather-like collectives: total
+    bytes received across ranks (each rank receives all w−1 foreign
+    shards) — the aggregate the run summary turns into GB/s."""
+    return (world - 1) * int(getattr(x, "nbytes", 0))
+
+
 def all_gather(x_sharded, mesh: Mesh, axis_name: str | None = None,
                axis: int = 0):
     """Replicate a sharded array on every device (≅ ``MPI_Allgather`` of
     each rank's shard into a full copy per rank)."""
     axis_name = axis_name or mesh.axis_names[0]
-    return _all_gather_fn(mesh, axis_name, axis, x_sharded.ndim)(x_sharded)
+    world = mesh.shape[axis_name]
+    return span_call(
+        "all_gather",
+        _all_gather_fn(mesh, axis_name, axis, x_sharded.ndim),
+        x_sharded,
+        nbytes=_gather_payload_bytes(x_sharded, world),
+        axis_name=axis_name,
+        world=world,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -234,9 +250,21 @@ def all_gather_rdma(x_sharded, mesh: Mesh, axis_name: str | None = None,
     collective pillar (≅ hand-writing the ``MPI_Allgather`` of
     ``mpi_daxpy_nvtx.cc:285-288`` as w−1 ring hops; SURVEY §5.8)."""
     axis_name = axis_name or mesh.axis_names[0]
-    return _all_gather_rdma_fn(
-        mesh, axis_name, x_sharded.ndim, interpret
-    )(x_sharded)
+    world = mesh.shape[axis_name]
+    from tpu_mpi_tests.instrument.watchdog import note_comm_op
+
+    note_comm_op(
+        f"ring_allgather_pallas(world={world}, "
+        f"shape={tuple(x_sharded.shape)})"
+    )
+    return span_call(
+        "all_gather_rdma",
+        _all_gather_rdma_fn(mesh, axis_name, x_sharded.ndim, interpret),
+        x_sharded,
+        nbytes=_gather_payload_bytes(x_sharded, world),
+        axis_name=axis_name,
+        world=world,
+    )
 
 
 def all_gather_inplace(allx_sharded, mesh: Mesh, axis_name: str | None = None,
@@ -247,8 +275,15 @@ def all_gather_inplace(allx_sharded, mesh: Mesh, axis_name: str | None = None,
     reuse its memory — the closest functional analog of in-place semantics
     with immutable arrays."""
     axis_name = axis_name or mesh.axis_names[0]
-    return _all_gather_inplace_fn(mesh, axis_name, axis, allx_sharded.ndim)(
-        allx_sharded
+    world = mesh.shape[axis_name]
+    nbytes = _gather_payload_bytes(allx_sharded, world)
+    return span_call(
+        "all_gather_inplace",
+        _all_gather_inplace_fn(mesh, axis_name, axis, allx_sharded.ndim),
+        allx_sharded,
+        nbytes=nbytes,
+        axis_name=axis_name,
+        world=world,
     )
 
 
@@ -280,7 +315,17 @@ def allreduce_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
             f"allreduce_sum: leading axis {per_rank.shape[0]} must equal "
             f"mesh axis {axis_name}={n} (one row per rank)"
         )
-    return _allreduce_fn(mesh, axis_name, per_rank.ndim)(per_rank)
+    # ring-allreduce payload: each rank moves 2(w−1)/w of its row,
+    # aggregated over ranks = 2(w−1)·row bytes
+    row_bytes = int(getattr(per_rank, "nbytes", 0)) // n
+    return span_call(
+        "allreduce",
+        _allreduce_fn(mesh, axis_name, per_rank.ndim),
+        per_rank,
+        nbytes=2 * (n - 1) * row_bytes,
+        axis_name=axis_name,
+        world=n,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -318,7 +363,15 @@ def reduce_scatter_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
             f"{per_rank.shape}"
         )
     check_divisible(per_rank.shape[1], n, "reduce_scatter_sum chunking")
-    return _reduce_scatter_fn(mesh, axis_name, per_rank.ndim)(per_rank)
+    row_bytes = int(getattr(per_rank, "nbytes", 0)) // n
+    return span_call(
+        "reduce_scatter",
+        _reduce_scatter_fn(mesh, axis_name, per_rank.ndim),
+        per_rank,
+        nbytes=(n - 1) * row_bytes,
+        axis_name=axis_name,
+        world=n,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -359,9 +412,21 @@ def allreduce_rdma(per_rank, mesh: Mesh, axis_name: str | None = None,
             f"allreduce_rdma: need shape (n_ranks={n}, L), got "
             f"{per_rank.shape}"
         )
-    return _allreduce_rdma_fn(
-        mesh, axis_name, interpret, credits
-    )(per_rank)
+    from tpu_mpi_tests.instrument.watchdog import note_comm_op
+
+    note_comm_op(
+        f"ring_allreduce_pallas(world={n}, shape={tuple(per_rank.shape)}, "
+        f"credits={credits})"
+    )
+    row_bytes = int(getattr(per_rank, "nbytes", 0)) // n
+    return span_call(
+        "allreduce_rdma",
+        _allreduce_rdma_fn(mesh, axis_name, interpret, credits),
+        per_rank,
+        nbytes=2 * (n - 1) * row_bytes,
+        axis_name=axis_name,
+        world=n,
+    )
 
 
 def host_value(x) -> np.ndarray:
@@ -439,8 +504,13 @@ def reduce_sum(values) -> float:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        bits = np.frombuffer(np.float64(total).tobytes(), np.uint32)
-        gathered = multihost_utils.process_allgather(jnp.asarray(bits))
+        with comm_span(
+            "reduce_sum", nbytes=8 * jax.process_count(),
+            world=jax.process_count(),
+        ) as span:
+            bits = np.frombuffer(np.float64(total).tobytes(), np.uint32)
+            gathered = multihost_utils.process_allgather(jnp.asarray(bits))
+            span.result = gathered
         vals = np.ascontiguousarray(
             np.asarray(gathered, np.uint32).reshape(-1, 2)
         ).view(np.float64)
@@ -450,5 +520,11 @@ def reduce_sum(values) -> float:
 
 def barrier(mesh: Mesh):
     """≅ ``MPI_Barrier``: a completed collective across the mesh."""
-    x = shard_1d(jnp.ones((len(mesh.devices.flat),), jnp.int32), mesh)
-    _allreduce_fn(mesh, mesh.axis_names[0], 1)(x).block_until_ready()
+    axis_name = mesh.axis_names[0]
+    with comm_span(
+        "barrier", axis_name=axis_name, world=mesh.shape[axis_name]
+    ) as span:
+        x = shard_1d(jnp.ones((len(mesh.devices.flat),), jnp.int32), mesh)
+        out = _allreduce_fn(mesh, axis_name, 1)(x)
+        out.block_until_ready()
+        span.result = out

@@ -36,9 +36,11 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_mpi_tests.compat import axis_size, shard_map
+from tpu_mpi_tests.instrument.telemetry import span_call
 from tpu_mpi_tests.kernels.pack import pack_edges, unpack_ghosts
 
 
@@ -72,7 +74,7 @@ def _ring_rotate(lo_edge, hi_edge, cur_lo, cur_hi, *, axis_name: str,
     partial permutation leaves non-receivers with zeros. The subtle ring
     logic (partial permutation pairs, edge-rank masking) exists ONCE,
     shared by ``_receive_neighbors`` and the resident-block schedule."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     pairs = n if periodic else n - 1
     fwd = [(i, (i + 1) % n) for i in range(pairs)]
     bwd = [((i + 1) % n, i) for i in range(pairs)]
@@ -100,7 +102,7 @@ def _receive_neighbors(
     get their CURRENT (physical) ghosts back. Returns ``(None, None)`` on
     a 1-shard non-periodic ring, where nothing moves. Shared by
     ``exchange_shard`` and ``iterate_overlap_fn``."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     lo_edge, hi_edge = pack_edges(z, axis=axis, n_bnd=n_bnd)
     if staged:
         # materialize contiguous staging buffers (≅ sbuf_l/sbuf_r device
@@ -242,9 +244,20 @@ def halo_exchange(
     from tpu_mpi_tests.arrays.spaces import ensure_device
 
     zg = ensure_device(zg)
+    world = mesh.shape[axis_name]
+    # telemetry payload: 2 directions × one ghost band per neighbor pair
+    # (world pairs on a periodic ring, world−1 otherwise); band = n_bnd
+    # slabs of the non-decomposed extent. Computed before the call — the
+    # input is donated and its metadata may be gone afterwards.
+    pairs = world if periodic else world - 1
+    band_bytes = n_bnd * (zg.size // zg.shape[axis]) * zg.dtype.itemsize
+    nbytes = 2 * pairs * band_bytes
     if staging is Staging.HOST_STAGED:
-        return _host_staged_exchange(
-            zg, mesh, axis_name, axis, n_bnd, periodic
+        return span_call(
+            "halo_exchange_host",
+            _host_staged_exchange,
+            zg, mesh, axis_name, axis, n_bnd, periodic,
+            nbytes=nbytes, axis_name=axis_name, world=world,
         )
     if staging is Staging.PALLAS_RDMA:
         # a wedged DMA semaphore / neighborhood barrier in the hand-written
@@ -254,21 +267,32 @@ def halo_exchange(
 
         note_comm_op(
             f"ring_halo_pallas(axis={axis}, n_bnd={n_bnd}, "
-            f"periodic={periodic}, world={mesh.shape[axis_name]}, "
+            f"periodic={periodic}, world={world}, "
             f"shape={tuple(zg.shape)})"
         )
-        return _exchange_pallas_fn(
-            mesh, axis_name, axis, zg.ndim, n_bnd, periodic, interpret
-        )(zg)
-    return _exchange_fn(
-        mesh,
-        axis_name,
-        axis,
-        zg.ndim,
-        n_bnd,
-        periodic,
-        staging is Staging.DEVICE_STAGED,
-    )(zg)
+        return span_call(
+            "halo_exchange_rdma",
+            _exchange_pallas_fn(
+                mesh, axis_name, axis, zg.ndim, n_bnd, periodic, interpret
+            ),
+            zg,
+            nbytes=nbytes, axis_name=axis_name, world=world,
+        )
+    return span_call(
+        "halo_exchange",
+        _exchange_fn(
+            mesh,
+            axis_name,
+            axis,
+            zg.ndim,
+            n_bnd,
+            periodic,
+            staging is Staging.DEVICE_STAGED,
+        ),
+        zg,
+        nbytes=nbytes, axis_name=axis_name, world=world,
+        staging=staging.value,
+    )
 
 
 @functools.partial(
@@ -276,9 +300,15 @@ def halo_exchange(
 )
 def _apply_ghost_bands(zg, bands, starts, axis):
     """Write host-staged ghost bands back into the device array — the
-    ONLY device writes of the host-staged path, each O(n_bnd·W)."""
+    ONLY device writes of the host-staged path, each O(n_bnd·W).
+
+    Starts are pinned to int32: under x64 a Python-int start lowers to an
+    s64 constant that older XLA's update-slice clamp compares against an
+    s32 bound (hlo verifier rejection)."""
     for i, s in enumerate(starts):
-        zg = lax.dynamic_update_slice_in_dim(zg, bands[i], s, axis=axis)
+        zg = lax.dynamic_update_slice_in_dim(
+            zg, bands[i], np.int32(s), axis=axis
+        )
     return zg
 
 

@@ -29,8 +29,15 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_mpi_tests.compat import (
+    axis_size,
+    pcast_varying,
+    shard_map,
+)
+from tpu_mpi_tests.instrument.telemetry import span_call
 
 
 def online_softmax_update(m, l, s, keepdims: bool = False):
@@ -96,7 +103,7 @@ def from_striped(x, world: int):
 def ring_pass(x, axis_name: str, shift: int = 1):
     """Rotate ``x`` ``shift`` steps around the mesh-axis ring (periodic):
     each rank receives the block of ``rank - shift``."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -108,12 +115,12 @@ def ring_scan(f, init, block, axis_name: str):
     ``f`` must keep carry shapes static. Step ``s`` on rank ``r`` sees the
     block originally owned by rank ``(r - s) % n``.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     r = lax.axis_index(axis_name)
     # the folded carry becomes device-varying (it mixes in this rank's
     # blocks); mark the init accordingly or vma inference rejects the loop
     init = jax.tree.map(
-        lambda x: lax.pcast(jnp.asarray(x), (axis_name,), to="varying"), init
+        lambda x: pcast_varying(jnp.asarray(x), axis_name), init
     )
 
     def body(s, state):
@@ -214,7 +221,7 @@ def ring_attention(
     skip_tile = _resolve_skip_tile(skip_tile, stripe)
 
     lq = q.shape[0]
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     r = lax.axis_index(axis_name)
 
     if flash:
@@ -320,4 +327,19 @@ def ring_attention_fn(
             skip_tile=skip_tile, precision=precision, stripe=stripe,
         )
 
-    return attn
+    world = mesh.shape[axis_name]
+
+    def attn_recorded(q, k, v):
+        # telemetry payload: every rank eventually receives all w−1
+        # foreign K/V blocks as they rotate the ring
+        kv_bytes = int(getattr(k, "nbytes", 0)) + int(
+            getattr(v, "nbytes", 0)
+        )
+        return span_call(
+            "ring_attention", attn, q, k, v,
+            nbytes=(world - 1) * kv_bytes,
+            axis_name=axis_name, world=world,
+            flash=flash, causal=causal, stripe=stripe,
+        )
+
+    return attn_recorded

@@ -1,0 +1,71 @@
+"""JAX version compatibility shims.
+
+The framework targets the current ``jax.shard_map`` API (top-level export,
+``check_vma`` flag, ``lax.pcast``); older jaxlibs in the field (0.4.x) ship
+the same machinery as ``jax.experimental.shard_map`` with the flag named
+``check_rep`` and no ``pcast``. Every internal module imports from here so
+the suite runs unmodified on both — the comm layer is the system under
+test and must not be un-importable on a merely-older runtime.
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+try:  # current API: top-level export, check_vma
+    from jax import shard_map as _shard_map
+
+    _VMA_FLAG = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _VMA_FLAG = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+    """``jax.shard_map`` with the ``check_vma`` spelling on every version."""
+    kwargs[_VMA_FLAG] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def axis_size(axis_name: str) -> int:
+    """Static mesh-axis size inside a ``shard_map`` body on every version
+    (``lax.axis_size`` is a recent addition; older jax exposes the bound
+    frame size through ``jax.core.axis_frame``)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    from jax import core
+
+    return core.axis_frame(axis_name)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across renames: older jax calls it
+    ``TPUCompilerParams`` and lacks some fields (e.g. ``has_side_effects``)
+    — unknown fields are dropped there, which is safe for this repo's
+    kernels: their outputs are always consumed through
+    ``input_output_aliases``, so DCE cannot drop the calls the flag was
+    protecting."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        import dataclasses
+
+        cls = pltpu.TPUCompilerParams
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in kwargs.items() if k in known}
+    return cls(**kwargs)
+
+
+def pcast_varying(x, axis_name: str):
+    """``lax.pcast(x, (axis_name,), to="varying")`` where it exists.
+
+    Older jax has no varying-manual-axes tracking (the ``check_rep``
+    machinery never needs the cast), so the identity is the correct
+    fallback there."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return x

@@ -28,7 +28,21 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         help="element type; reference is float64 (MPI_DOUBLE) — TPU default "
         "is float32, float64 enables the x64 software path",
     )
-    p.add_argument("--jsonl", default=None, help="append JSONL records here")
+    p.add_argument(
+        "--jsonl",
+        default=None,
+        help="append JSONL records here (multi-process runs auto-suffix "
+        "the path per process: out.jsonl -> out.p<i>.jsonl; merge with "
+        "tpumt-report)",
+    )
+    p.add_argument(
+        "--telemetry",
+        action="store_true",
+        help="record a span (op/bytes/axis/seconds/GB/s) for every comm "
+        "wrapper call into the JSONL sink plus cumulative per-op counters "
+        "(instrument/telemetry.py); spans sync-honestly block on their op, "
+        "so leave this off for pure-throughput timing runs",
+    )
     p.add_argument(
         "--profile-dir",
         default=None,
@@ -61,6 +75,50 @@ def run_guarded(run, args) -> int:
 
     with deadline(args.deadline, "driver"):
         return run(args)
+
+
+def make_reporter(args, rank: int = 0, size: int = 1):
+    """Build the driver's Reporter with the full observability wiring —
+    one call so every driver gets it without per-driver plumbing:
+
+    * per-process JSONL suffixing (multi-process runs never append to one
+      shared file — ``tpumt-report`` merges the suffixed set);
+    * a run-manifest record (``kind: "manifest"``) as the first JSONL
+      line whenever a sink is configured, so every result file is
+      self-describing;
+    * with ``--telemetry``: the telemetry registry is enabled with the
+      reporter's JSONL as its span sink, a rank-0 manifest banner is
+      printed, and closing the reporter (drivers hold it in a ``with``
+      block) flushes per-op counter lines and disables the registry.
+    """
+    import jax
+
+    from tpu_mpi_tests.instrument.report import Reporter
+
+    rep = Reporter(
+        rank=rank,
+        size=size,
+        jsonl_path=args.jsonl,
+        proc_index=jax.process_index(),
+        proc_count=jax.process_count(),
+    )
+    telemetry_on = getattr(args, "telemetry", False)
+    if rep.jsonl_path or telemetry_on:
+        from tpu_mpi_tests.instrument.manifest import (
+            manifest_banner,
+            run_manifest,
+        )
+
+        m = run_manifest()
+        rep.jsonl(m)
+        if telemetry_on:
+            rep.banner(manifest_banner(m))
+    if telemetry_on:
+        from tpu_mpi_tests.instrument import telemetry as T
+
+        T.enable(sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}))
+        rep.attach_telemetry()
+    return rep
 
 
 def force_cpu_devices(n: int) -> None:

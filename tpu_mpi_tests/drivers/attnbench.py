@@ -37,7 +37,6 @@ def run(args) -> int:
     from tpu_mpi_tests.comm.collectives import shard_1d
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
     from tpu_mpi_tests.comm.ring import ring_attention_fn
-    from tpu_mpi_tests.instrument import Reporter
     from tpu_mpi_tests.instrument.timers import chain_rate
     from tpu_mpi_tests.kernels.pallas_kernels import flash_attention_pallas
     from tpu_mpi_tests.utils import check_divisible
@@ -57,132 +56,132 @@ def run(args) -> int:
     # carries its resolved tile CEILINGS (they still auto-shrink to
     # divisors at trace time; the xla tier records neither — never
     # mis-attribute a schedule)
-    rep = Reporter(rank=topo.process_index, size=world,
-                   jsonl_path=args.jsonl)
-    rep.banner(
-        f"attnbench: L={args.seq_len} d={args.head_dim} tiers={args.tiers} "
-        f"dtype={args.dtype} causal={args.causal} stripe={args.stripe} "
-        f"k_tile={args.k_tile} skip_tile={args.skip_tile} "
-        f"n_iter={args.n_iter} world={world}"
-    )
-    if args.stripe and args.dtype == "bfloat16":
-        # measured regression, not an error: the striped balance win is
-        # dtype-dependent (BASELINE round-5 stripebalance dtype note —
-        # 1.42-1.51x at f32, 0.79-0.83x at bf16 where per-cell fixed
-        # cost dominates the halved matmul work). Benchmarking the
-        # combination is the point of this driver, so note, don't
-        # block; banner = rank-0 only, like the config line above
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
         rep.banner(
-            "NOTE --stripe at bfloat16: the striped layout measured "
-            "SLOWER than contiguous at 16-bit (0.79-0.83x paced, "
-            "BASELINE round-5) — it pays at float32 only"
+            f"attnbench: L={args.seq_len} d={args.head_dim} tiers={args.tiers} "
+            f"dtype={args.dtype} causal={args.causal} stripe={args.stripe} "
+            f"k_tile={args.k_tile} skip_tile={args.skip_tile} "
+            f"n_iter={args.n_iter} world={world}"
         )
-
-    L, d = args.seq_len, args.head_dim
-    # causal computes only the lower triangle — half the matmul work
-    # (flash-attn benchmark convention)
-    flops = (2.0 if args.causal else 4.0) * L * L * d
-    tiers = _common.parse_choice_list(args.tiers, TIERS, "tier")
-    if tiers is None:
-        return 2
-
-    prec = lax.Precision.DEFAULT if args.fast else lax.Precision.HIGHEST
-
-    def xla_attn(q, k, v):
-        s = jnp.matmul(q, k.T, precision=prec) / (d**0.5)
-        if args.causal:
-            s = jnp.where(
-                jnp.tril(jnp.ones((L, L), bool)), s, -jnp.inf
+        if args.stripe and args.dtype == "bfloat16":
+            # measured regression, not an error: the striped balance win is
+            # dtype-dependent (BASELINE round-5 stripebalance dtype note —
+            # 1.42-1.51x at f32, 0.79-0.83x at bf16 where per-cell fixed
+            # cost dominates the halved matmul work). Benchmarking the
+            # combination is the point of this driver, so note, don't
+            # block; banner = rank-0 only, like the config line above
+            rep.banner(
+                "NOTE --stripe at bfloat16: the striped layout measured "
+                "SLOWER than contiguous at 16-bit (0.79-0.83x paced, "
+                "BASELINE round-5) — it pays at float32 only"
             )
-        return jnp.matmul(jax.nn.softmax(s, axis=-1), v, precision=prec)
 
-    rc = 0
-    for tier in tiers:
-        key = jax.random.PRNGKey(0)
-        if tier in ("ring", "ulysses"):
-            check_divisible(L, world, "sequence over mesh axis")
-            shape = (L, world, d) if tier == "ulysses" else (L, d)
-            q, k, v = (
-                jax.random.normal(kk, shape, dtype)
-                for kk in jax.random.split(key, 3)
+        L, d = args.seq_len, args.head_dim
+        # causal computes only the lower triangle — half the matmul work
+        # (flash-attn benchmark convention)
+        flops = (2.0 if args.causal else 4.0) * L * L * d
+        tiers = _common.parse_choice_list(args.tiers, TIERS, "tier")
+        if tiers is None:
+            return 2
+
+        prec = lax.Precision.DEFAULT if args.fast else lax.Precision.HIGHEST
+
+        def xla_attn(q, k, v):
+            s = jnp.matmul(q, k.T, precision=prec) / (d**0.5)
+            if args.causal:
+                s = jnp.where(
+                    jnp.tril(jnp.ones((L, L), bool)), s, -jnp.inf
+                )
+            return jnp.matmul(jax.nn.softmax(s, axis=-1), v, precision=prec)
+
+        rc = 0
+        for tier in tiers:
+            key = jax.random.PRNGKey(0)
+            if tier in ("ring", "ulysses"):
+                check_divisible(L, world, "sequence over mesh axis")
+                shape = (L, world, d) if tier == "ulysses" else (L, d)
+                q, k, v = (
+                    jax.random.normal(kk, shape, dtype)
+                    for kk in jax.random.split(key, 3)
+                )
+                if tier == "ring" and args.stripe:
+                    # striped causal layout (comm.ring.to_striped): balanced
+                    # ring — every rank ~half-live at every step; the chained
+                    # output stays in the striped layout, position-consistent
+                    # with the next query
+                    from tpu_mpi_tests.comm.ring import to_striped
+
+                    q, k, v = (to_striped(t, world) for t in (q, k, v))
+                q, k, v = (shard_1d(t, mesh) for t in (q, k, v))
+                if tier == "ring":
+                    attn = ring_attention_fn(
+                        mesh, axis_name, causal=args.causal, flash=True,
+                        precision=prec, stripe=args.stripe,
+                        k_tile=args.k_tile, skip_tile=args.skip_tile,
+                    )
+                else:
+                    attn = ulysses_attention_fn(
+                        mesh, axis_name, causal=args.causal, flash=True,
+                        precision=prec, k_tile=args.k_tile,
+                        skip_tile=args.skip_tile,
+                    )
+            else:
+                q, k, v = (
+                    jax.random.normal(kk, (L, d), dtype)
+                    for kk in jax.random.split(key, 3)
+                )
+                if tier == "flash":
+                    attn = functools.partial(
+                        flash_attention_pallas, causal=args.causal,
+                        precision=prec, k_tile=args.k_tile,
+                        skip_tile=args.skip_tile,
+                    )
+                else:
+                    attn = xla_attn
+
+            @functools.partial(jax.jit, donate_argnums=0)
+            def loop(state, n, attn=attn):
+                def body(_, st):
+                    qq, kk, vv = st
+                    return attn(qq, kk, vv), kk, vv
+
+                return lax.fori_loop(0, jnp.asarray(n, jnp.int32), body, state)
+
+            sec, state = chain_rate(
+                loop, (q, k, v), n_short=args.n_iter // 10 or 1,
+                n_long=args.n_iter,
             )
-            if tier == "ring" and args.stripe:
-                # striped causal layout (comm.ring.to_striped): balanced
-                # ring — every rank ~half-live at every step; the chained
-                # output stays in the striped layout, position-consistent
-                # with the next query
-                from tpu_mpi_tests.comm.ring import to_striped
-
-                q, k, v = (to_striped(t, world) for t in (q, k, v))
-            q, k, v = (shard_1d(t, mesh) for t in (q, k, v))
-            if tier == "ring":
-                attn = ring_attention_fn(
-                    mesh, axis_name, causal=args.causal, flash=True,
-                    precision=prec, stripe=args.stripe,
-                    k_tile=args.k_tile, skip_tile=args.skip_tile,
-                )
-            else:
-                attn = ulysses_attention_fn(
-                    mesh, axis_name, causal=args.causal, flash=True,
-                    precision=prec, k_tile=args.k_tile,
-                    skip_tile=args.skip_tile,
-                )
-        else:
-            q, k, v = (
-                jax.random.normal(kk, (L, d), dtype)
-                for kk in jax.random.split(key, 3)
+            del state
+            tflops = flops / sec / 1e12
+            heads = world if tier == "ulysses" else 1
+            striped = tier == "ring" and args.stripe
+            row = {"kind": "attn", "tier": tier, "L": L, "d": d,
+                   "dtype": args.dtype, "causal": args.causal,
+                   "stripe": striped,
+                   "tflops": tflops * heads, "us_per_iter": sec * 1e6,
+                   "world": world}
+            if tier != "xla":  # flash-kernel tiers only
+                row["k_tile_ceiling"] = _resolve_k_tile(args.k_tile, striped)
+                if args.skip_tile is not None:
+                    # explicit request: operative on both kernel paths
+                    # (modulo the divisor snap)
+                    row["skip_tile_ceiling"] = args.skip_tile
+                else:
+                    # None resolves PER PATH inside the kernel (layout table
+                    # for resident, _STREAM_SKIP_TILE_DEFAULT for streaming)
+                    # and the driver cannot know which path the fit takes —
+                    # record the request, never a possibly-wrong constant
+                    row["skip_tile_req"] = None
+            rep.line(
+                f"ATTN {tier}{'[striped]' if striped else ''} L={L} d={d} "
+                f"{args.dtype} {tflops * heads:0.1f} TFLOP/s",
+                row,
             )
-            if tier == "flash":
-                attn = functools.partial(
-                    flash_attention_pallas, causal=args.causal,
-                    precision=prec, k_tile=args.k_tile,
-                    skip_tile=args.skip_tile,
-                )
-            else:
-                attn = xla_attn
-
-        @functools.partial(jax.jit, donate_argnums=0)
-        def loop(state, n, attn=attn):
-            def body(_, st):
-                qq, kk, vv = st
-                return attn(qq, kk, vv), kk, vv
-
-            return lax.fori_loop(0, jnp.asarray(n, jnp.int32), body, state)
-
-        sec, state = chain_rate(
-            loop, (q, k, v), n_short=args.n_iter // 10 or 1,
-            n_long=args.n_iter,
-        )
-        del state
-        tflops = flops / sec / 1e12
-        heads = world if tier == "ulysses" else 1
-        striped = tier == "ring" and args.stripe
-        row = {"kind": "attn", "tier": tier, "L": L, "d": d,
-               "dtype": args.dtype, "causal": args.causal,
-               "stripe": striped,
-               "tflops": tflops * heads, "us_per_iter": sec * 1e6,
-               "world": world}
-        if tier != "xla":  # flash-kernel tiers only
-            row["k_tile_ceiling"] = _resolve_k_tile(args.k_tile, striped)
-            if args.skip_tile is not None:
-                # explicit request: operative on both kernel paths
-                # (modulo the divisor snap)
-                row["skip_tile_ceiling"] = args.skip_tile
-            else:
-                # None resolves PER PATH inside the kernel (layout table
-                # for resident, _STREAM_SKIP_TILE_DEFAULT for streaming)
-                # and the driver cannot know which path the fit takes —
-                # record the request, never a possibly-wrong constant
-                row["skip_tile_req"] = None
-        rep.line(
-            f"ATTN {tier}{'[striped]' if striped else ''} L={L} d={d} "
-            f"{args.dtype} {tflops * heads:0.1f} TFLOP/s",
-            row,
-        )
-        if not (tflops > 0):
-            rep.line(f"ATTN FAIL {tier}: non-positive rate {tflops}")
-            rc = 1
-    return rc
+            if not (tflops > 0):
+                rep.line(f"ATTN FAIL {tier}: non-positive rate {tflops}")
+                rc = 1
+        return rc
 
 
 def main(argv=None) -> int:

@@ -56,7 +56,9 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int,
              rdma_credits: int = 1):
     import jax
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def consume_neighbor(gathered, x):
@@ -151,7 +153,6 @@ def run(args) -> int:
 
     from tpu_mpi_tests.comm.collectives import shard_1d
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument import Reporter
     from tpu_mpi_tests.instrument.timers import chain_rate
     from tpu_mpi_tests.utils import check_divisible
 
@@ -161,85 +162,86 @@ def run(args) -> int:
     world = topo.global_device_count
     axis_name = mesh.axis_names[0]
 
-    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
-    rep.banner(
-        f"collbench: world={world} sizes_kib={args.sizes_kib} "
-        f"collectives={args.collectives} n_iter={args.n_iter} "
-        f"rdma_credits={args.rdma_credits}"
-    )
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
+        rep.banner(
+            f"collbench: world={world} sizes_kib={args.sizes_kib} "
+            f"collectives={args.collectives} n_iter={args.n_iter} "
+            f"rdma_credits={args.rdma_credits}"
+        )
 
-    names = _common.parse_choice_list(
-        args.collectives, COLLECTIVES + COLLECTIVES_RDMA, "collective"
-    )
-    if names is None:
-        return 2
+        names = _common.parse_choice_list(
+            args.collectives, COLLECTIVES + COLLECTIVES_RDMA, "collective"
+        )
+        if names is None:
+            return 2
 
-    dtype = _common.jnp_dtype(args)
-    itemsize = jnp.dtype(dtype).itemsize
-    for name in names:
-        for kib in (int(s) for s in args.sizes_kib.split(",")):
-            shard_bytes = kib * 1024
-            n = shard_bytes // itemsize
-            if name in ("alltoall", "reducescatter"):
-                # the alltoall reshape and the psum_scatter chunking both
-                # split the shard w ways
-                check_divisible(n, world, f"{name} elements per shard")
-            run_fn = _loop_fn(mesh, axis_name, name, world,
-                              rdma_credits=args.rdma_credits)
-            if name in COLLECTIVES_RDMA:
-                # ring kernels have lane-alignment floors (e.g. w·128·
-                # sublane elements for the 1-D allreduce); probe at trace
-                # time (no execution, no donation) and report the skip
-                # instead of failing the sweep or hiding the row
-                import jax
+        dtype = _common.jnp_dtype(args)
+        itemsize = jnp.dtype(dtype).itemsize
+        for name in names:
+            for kib in (int(s) for s in args.sizes_kib.split(",")):
+                shard_bytes = kib * 1024
+                n = shard_bytes // itemsize
+                if name in ("alltoall", "reducescatter"):
+                    # the alltoall reshape and the psum_scatter chunking both
+                    # split the shard w ways
+                    check_divisible(n, world, f"{name} elements per shard")
+                run_fn = _loop_fn(mesh, axis_name, name, world,
+                                  rdma_credits=args.rdma_credits)
+                if name in COLLECTIVES_RDMA:
+                    # ring kernels have lane-alignment floors (e.g. w·128·
+                    # sublane elements for the 1-D allreduce); probe at trace
+                    # time (no execution, no donation) and report the skip
+                    # instead of failing the sweep or hiding the row
+                    import jax
 
-                try:
-                    jax.eval_shape(
-                        run_fn,
-                        jax.ShapeDtypeStruct((n * world,), dtype),
-                        1,
-                    )
-                except ValueError as e:
-                    rep.line(
-                        f"COLL-SKIP {name} bytes={shard_bytes} ({e})"
-                    )
-                    continue
-            x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
-            # scale the chain length inversely with payload so small
-            # messages accumulate enough device time to clear host-timer
-            # noise (a fixed count yields NaN/garbage under ~ms jitter:
-            # 500 x 15 us is invisible next to a 100 ms tunnel round-trip);
-            # the actual count is reported per row (no silent config drift)
-            n_eff = min(
-                max(args.n_iter, 100_000),
-                max(args.n_iter, args.n_iter * (1 << 20)
-                    // max(shard_bytes, 1)),
-            )
-            sec, x = chain_rate(
-                run_fn, x, n_short=n_eff // 10 or 1, n_long=n_eff
-            )
-            moved = _busbw_bytes(name, shard_bytes, world)
-            busbw = moved / sec / 1e9
-            # rdma rows record their credit depth, or the pod A/B the
-            # --rdma-credits flag exists for cannot be reconstructed
-            # from merged jsonl results
-            cred_txt = (f" credits={args.rdma_credits}"
-                        if name == "allreduce_rdma" else "")
-            cred_rec = ({"rdma_credits": args.rdma_credits}
-                        if name == "allreduce_rdma" else {})
-            rep.line(
-                # %.4g, not %.2f: a loaded host can push busbw below
-                # 0.005 GB/s, which fixed-point floors to a misleading
-                # "0.00" (a positive measurement must print positive)
-                f"COLL {name} bytes={shard_bytes} {sec * 1e6:0.2f} us/iter"
-                f"  busbw={busbw:0.4g} GB/s  n={n_eff}{cred_txt}",
-                {"kind": "coll", "collective": name, "dtype": args.dtype,
-                 "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
-                 "busbw_gbps": busbw, "world": world, "n_iter": n_eff,
-                 **cred_rec},
-            )
-            del x
-    return 0
+                    try:
+                        jax.eval_shape(
+                            run_fn,
+                            jax.ShapeDtypeStruct((n * world,), dtype),
+                            1,
+                        )
+                    except ValueError as e:
+                        rep.line(
+                            f"COLL-SKIP {name} bytes={shard_bytes} ({e})"
+                        )
+                        continue
+                x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
+                # scale the chain length inversely with payload so small
+                # messages accumulate enough device time to clear host-timer
+                # noise (a fixed count yields NaN/garbage under ~ms jitter:
+                # 500 x 15 us is invisible next to a 100 ms tunnel round-trip);
+                # the actual count is reported per row (no silent config drift)
+                n_eff = min(
+                    max(args.n_iter, 100_000),
+                    max(args.n_iter, args.n_iter * (1 << 20)
+                        // max(shard_bytes, 1)),
+                )
+                sec, x = chain_rate(
+                    run_fn, x, n_short=n_eff // 10 or 1, n_long=n_eff
+                )
+                moved = _busbw_bytes(name, shard_bytes, world)
+                busbw = moved / sec / 1e9
+                # rdma rows record their credit depth, or the pod A/B the
+                # --rdma-credits flag exists for cannot be reconstructed
+                # from merged jsonl results
+                cred_txt = (f" credits={args.rdma_credits}"
+                            if name == "allreduce_rdma" else "")
+                cred_rec = ({"rdma_credits": args.rdma_credits}
+                            if name == "allreduce_rdma" else {})
+                rep.line(
+                    # %.4g, not %.2f: a loaded host can push busbw below
+                    # 0.005 GB/s, which fixed-point floors to a misleading
+                    # "0.00" (a positive measurement must print positive)
+                    f"COLL {name} bytes={shard_bytes} {sec * 1e6:0.2f} us/iter"
+                    f"  busbw={busbw:0.4g} GB/s  n={n_eff}{cred_txt}",
+                    {"kind": "coll", "collective": name, "dtype": args.dtype,
+                     "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
+                     "busbw_gbps": busbw, "world": world, "n_iter": n_eff,
+                     **cred_rec},
+                )
+                del x
+        return 0
 
 
 def main(argv=None) -> int:

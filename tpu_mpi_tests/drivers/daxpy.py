@@ -22,62 +22,64 @@ def run(args) -> int:
 
     import tpu_mpi_tests.kernels.daxpy as kd
     from tpu_mpi_tests.arrays.spaces import Space, place, to_device
-    from tpu_mpi_tests.instrument import PhaseTimer, ProfilerGate, Reporter
+    from tpu_mpi_tests.instrument import PhaseTimer, ProfilerGate
     from tpu_mpi_tests.instrument.timers import block
     from tpu_mpi_tests.instrument.trace import trace_range
 
     dtype = _common.jnp_dtype(args)
-    rep = Reporter(jsonl_path=args.jsonl)
-    timer = PhaseTimer()
-    n, a = args.n, args.a
+    rep = _common.make_reporter(args)
+    with rep:
+        timer = PhaseTimer()
+        n, a = args.n, args.a
 
-    with ProfilerGate(args.profile_dir):
-        # initializeArrays on host, then copyInput H2D (daxpy_nvtx.cu:72-79)
-        h_x, h_y = kd.init_xy_np(n, dtype)
-        with trace_range("copyInput"), timer.phase("copyInput"):
-            d_x = block(to_device(place(h_x, Space.HOST)))
-            d_y = block(to_device(place(h_y, Space.HOST)))
+        with ProfilerGate(args.profile_dir):
+            # initializeArrays on host, then copyInput H2D (daxpy_nvtx.cu:72-79)
+            h_x, h_y = kd.init_xy_np(n, dtype)
+            with trace_range("copyInput"), timer.phase("copyInput"):
+                d_x = block(to_device(place(h_x, Space.HOST)))
+                d_y = block(to_device(place(h_y, Space.HOST)))
 
-        with trace_range("daxpy"), timer.phase("kernel"):
-            d_y = block(kd.daxpy(jnp.asarray(a, dtype), d_x, d_y))
+            with trace_range("daxpy"), timer.phase("kernel"):
+                d_y = block(kd.daxpy(jnp.asarray(a, dtype), d_x, d_y))
 
-        with trace_range("copyOutput"), timer.phase("copyOutput"):
-            y = np.asarray(d_y)
+            with trace_range("copyOutput"), timer.phase("copyOutput"):
+                y = np.asarray(d_y)
 
-    if args.print_elements:
-        for v in y:
-            rep.line(f"{v:f}")
-    total = float(y.sum(dtype=np.float64))
-    rep.sum_line(total)
-    for phase, secs in timer.as_dict().items():
-        rep.time_line(phase, secs)
+        if args.print_elements:
+            for v in y:
+                rep.line(f"{v:f}")
+        total = float(y.sum(dtype=np.float64))
+        rep.sum_line(total)
+        # --verbose appends count/mean/min/max per phase on the TIME lines;
+        # the JSONL time records always carry the distribution
+        rep.time_lines(timer, stats=args.verbose)
 
-    # per-element verification (≅ the reference's per-element loop,
-    # daxpy.cu:82-87): a compensating-error bug passes a checksum, so with
-    # the reference's a=2 every element is asserted exactly. This holds for
-    # ANY n and dtype: x is stored as x̂ = dtype(i+1), the multiply by 2 is
-    # exact (power of two), and 2x̂ − x̂ = x̂ exactly (Sterbenz lemma), so
-    # the device result must bit-equal dtype(i+1) even where i+1 itself
-    # rounds. Other a values fall back to the checksum alone — matching the
-    # reference, whose check is hardwired to its init (daxpy.cu:85).
-    if a == 2.0:
-        h_want = np.arange(1, n + 1, dtype=np.float64).astype(dtype)
-        bad = np.flatnonzero(y != np.asarray(h_want))
-        if bad.size:
-            i = int(bad[0])
-            rep.line(
-                f"ELEMENT FAIL: {bad.size}/{n} mismatches, first at "
-                f"[{i}]: got {y[i]}, expected {np.asarray(h_want)[i]}"
-            )
+        # per-element verification (≅ the reference's per-element loop,
+        # daxpy.cu:82-87): a compensating-error bug passes a checksum, so with
+        # the reference's a=2 every element is asserted exactly. This holds for
+        # ANY n and dtype: x is stored as x̂ = dtype(i+1), the multiply by 2 is
+        # exact (power of two), and 2x̂ − x̂ = x̂ exactly (Sterbenz lemma), so
+        # the device result must bit-equal dtype(i+1) even where i+1 itself
+        # rounds. Other a values fall back to the checksum alone — matching the
+        # reference, whose check is hardwired to its init (daxpy.cu:85).
+        if a == 2.0:
+            h_want = np.arange(1, n + 1, dtype=np.float64).astype(dtype)
+            bad = np.flatnonzero(y != np.asarray(h_want))
+            if bad.size:
+                i = int(bad[0])
+                rep.line(
+                    f"ELEMENT FAIL: {bad.size}/{n} mismatches, first at "
+                    f"[{i}]: got {y[i]}, expected {np.asarray(h_want)[i]}"
+                )
+                return 1
+
+        expected = kd.expected_checksum(n)
+        # float32 accumulates rounding over large n; scale tolerance with n
+        tol = 0 if args.dtype == "float64" else max(1e-6 * expected, 1.0)
+        if abs(total - expected) > tol:
+            rep.line(f"CHECKSUM FAIL: got {total}, expected {expected}")
             return 1
-
-    expected = kd.expected_checksum(n)
-    # float32 accumulates rounding over large n; scale tolerance with n
-    tol = 0 if args.dtype == "float64" else max(1e-6 * expected, 1.0)
-    if abs(total - expected) > tol:
-        rep.line(f"CHECKSUM FAIL: got {total}, expected {expected}")
-        return 1
-    return 0
+        return 0
 
 
 def main(argv=None) -> int:

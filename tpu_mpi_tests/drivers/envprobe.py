@@ -19,29 +19,27 @@ def run(args) -> int:
     import jax
 
     from tpu_mpi_tests.comm.mesh import bootstrap, topology
-    from tpu_mpi_tests.instrument import Reporter
 
     bootstrap()
     topo = topology()
-    rep = Reporter(
-        rank=topo.process_index,
-        size=topo.process_count,
-        jsonl_path=args.jsonl,
+    rep = _common.make_reporter(
+        args, rank=topo.process_index, size=topo.process_count
     )
-    val = os.environ.get(args.var)
-    shown = val if val is not None else "<not set>"
-    rep.line(
-        f"{topo.process_index}/{topo.process_count} {args.var}={shown}",
-        {"kind": "envprobe", "var": args.var, "value": val,
-         "rank": topo.process_index},
-    )
-    if args.verbose:
-        for d in jax.local_devices():
-            rep.line(
-                f"{topo.process_index}/{topo.process_count} "
-                f"device {d.id} ({d.device_kind}) sees {args.var}={shown}"
-            )
-    return 0
+    with rep:
+        val = os.environ.get(args.var)
+        shown = val if val is not None else "<not set>"
+        rep.line(
+            f"{topo.process_index}/{topo.process_count} {args.var}={shown}",
+            {"kind": "envprobe", "var": args.var, "value": val,
+             "rank": topo.process_index},
+        )
+        if args.verbose:
+            for d in jax.local_devices():
+                rep.line(
+                    f"{topo.process_index}/{topo.process_count} "
+                    f"device {d.id} ({d.device_kind}) sees {args.var}={shown}"
+                )
+        return 0
 
 
 def main(argv=None) -> int:

@@ -25,7 +25,6 @@ def run(args) -> int:
 
     from tpu_mpi_tests.comm import collectives as C
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument import Reporter
     from tpu_mpi_tests.instrument.timers import block
 
     dtype = _common.jnp_dtype(args)
@@ -35,33 +34,34 @@ def run(args) -> int:
     world = topo.global_device_count
     n = args.n_per_rank
 
-    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
 
-    # fill own slice: global buffer whose shard r holds (r+1)
-    fill = np.repeat(np.arange(1, world + 1, dtype=np.float64), n)
-    allx = C.shard_1d(jnp.asarray(fill.astype(dtype)), mesh)
-    local_sums = [(r + 1) * n for r in range(world)]
+        # fill own slice: global buffer whose shard r holds (r+1)
+        fill = np.repeat(np.arange(1, world + 1, dtype=np.float64), n)
+        allx = C.shard_1d(jnp.asarray(fill.astype(dtype)), mesh)
+        local_sums = [(r + 1) * n for r in range(world)]
 
-    if args.rdma:
-        # hand-written RDMA ring tier (≅ hand-coding the MPI_Allgather);
-        # shard rows must meet the sublane-tile alignment
-        g = block(C.all_gather_rdma(allx, mesh))
-    else:
-        g = block(C.all_gather_inplace(allx, mesh))
-    asum = float(np.asarray(g, dtype=np.float64).sum())
+        if args.rdma:
+            # hand-written RDMA ring tier (≅ hand-coding the MPI_Allgather);
+            # shard rows must meet the sublane-tile alignment
+            g = block(C.all_gather_rdma(allx, mesh))
+        else:
+            g = block(C.all_gather_inplace(allx, mesh))
+        asum = float(np.asarray(g, dtype=np.float64).sum())
 
-    for r in range(world):
-        rep.line(
-            f"{r}/{world} lsum={local_sums[r]:.1f} asum={asum:.1f}",
-            {"kind": "gather_inplace", "rank": r, "lsum": local_sums[r],
-             "asum": asum},
-        )
+        for r in range(world):
+            rep.line(
+                f"{r}/{world} lsum={local_sums[r]:.1f} asum={asum:.1f}",
+                {"kind": "gather_inplace", "rank": r, "lsum": local_sums[r],
+                 "asum": asum},
+            )
 
-    expected = float(sum(local_sums))
-    if asum != expected:
-        rep.line(f"PARITY FAIL: asum {asum} != sum of lsums {expected}")
-        return 1
-    return 0
+        expected = float(sum(local_sums))
+        if asum != expected:
+            rep.line(f"PARITY FAIL: asum {asum} != sum of lsums {expected}")
+            return 1
+        return 0
 
 
 def main(argv=None) -> int:

@@ -40,7 +40,6 @@ def run(args) -> int:
 
     from tpu_mpi_tests.comm.halo import heat_step2d_fn
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument import Reporter
     from tpu_mpi_tests.instrument.timers import block
 
     dtype = _common.jnp_dtype(args)
@@ -62,105 +61,106 @@ def run(args) -> int:
     )
     cx, cy = args.nu * dt / dx**2, args.nu * dt / dy**2
 
-    rep = Reporter(rank=topo.process_index, size=n_dev, jsonl_path=args.jsonl)
-    rep.banner(
-        f"heat2d: mesh={px}x{py} n={nx}x{ny} nu={args.nu} dt={dt:.3e} "
-        f"steps={args.n_steps} dtype={args.dtype}"
-    )
-
-    # ghosted-per-shard layout, interior = sin(kx x)·sin(ky y), ghosts zero
-    # (the first exchange fills them — periodic, so no physical bands).
-    # Ghost width = halo_steps × the 5-point Laplacian's radius (1): the
-    # exchange moves exactly the bytes the fused timesteps read; at the
-    # default halo_steps=1 that is the minimal per-step exchange, and
-    # --halo-steps k trades k-deep ghosts for 1/k the exchanges (temporal
-    # blocking, interior-identical — the eigen gate proves it at k>1)
-    nb = args.halo_steps
-    gxs, gys = args.nx_local + 2 * nb, args.ny_local + 2 * nb
-    zg_host = np.zeros((px * gxs, py * gys), dtype=dtype)
-    xs = np.arange(nx, dtype=np.float64) * dx
-    ys = np.arange(ny, dtype=np.float64) * dy
-    z0 = np.sin(args.kx * xs)[:, None] * np.sin(args.ky * ys)[None, :]
-    for rx in range(px):
-        for ry in range(py):
-            blk = z0[
-                rx * args.nx_local:(rx + 1) * args.nx_local,
-                ry * args.ny_local:(ry + 1) * args.ny_local,
-            ]
-            zg_host[
-                rx * gxs + nb:rx * gxs + nb + args.nx_local,
-                ry * gys + nb:ry * gys + nb + args.ny_local,
-            ] = blk.astype(dtype)
-    zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
-
-    step, kernel = _common.pick_kernel_tier(
-        lambda k: heat_step2d_fn(
-            mesh, "x", "y", nb, float(cx), float(cy),
-            steps=args.halo_steps, kernel=k,
-        ),
-        (jax.ShapeDtypeStruct(zs.shape, zs.dtype), 1),
-        args.kernel,
-        rep,
-    )
-    outer_total = args.n_steps // args.halo_steps
-    # compile + warm: 1 outer body = halo_steps real timesteps, counted
-    zs = block(step(zs, 1))
-
-    t0 = time.perf_counter()
-    zs = block(step(zs, outer_total - 1))
-    seconds = time.perf_counter() - t0
-    timed_steps = (outer_total - 1) * args.halo_steps
-    steps_per_s = timed_steps / seconds if seconds > 0 else float("inf")
-    rep.line(
-        f"HEAT mesh:{px}x{py} n:{nx}x{ny}; steps={args.n_steps} "
-        f"{steps_per_s:0.1f} steps/s",
-        {"kind": "heat", "px": px, "py": py, "nx": nx, "ny": ny,
-         "steps": args.n_steps, "steps_per_s": steps_per_s,
-         "nu": args.nu, "dt": dt, "kernel": kernel},
-    )
-
-    rc = 0
-    if zs.is_fully_addressable:
-        # eigenvalue gate: field == g^T · z0 to roundoff
-        g = (
-            1.0
-            - cx * (2.0 - 2.0 * math.cos(args.kx * dx))
-            - cy * (2.0 - 2.0 * math.cos(args.ky * dy))
+    rep = _common.make_reporter(args, rank=topo.process_index, size=n_dev)
+    with rep:
+        rep.banner(
+            f"heat2d: mesh={px}x{py} n={nx}x{ny} nu={args.nu} dt={dt:.3e} "
+            f"steps={args.n_steps} dtype={args.dtype}"
         )
-        want = (g**args.n_steps) * z0
-        got = np.zeros((nx, ny), dtype=np.float64)
-        zg_out = np.asarray(jax.device_get(zs), np.float64)
+
+        # ghosted-per-shard layout, interior = sin(kx x)·sin(ky y), ghosts zero
+        # (the first exchange fills them — periodic, so no physical bands).
+        # Ghost width = halo_steps × the 5-point Laplacian's radius (1): the
+        # exchange moves exactly the bytes the fused timesteps read; at the
+        # default halo_steps=1 that is the minimal per-step exchange, and
+        # --halo-steps k trades k-deep ghosts for 1/k the exchanges (temporal
+        # blocking, interior-identical — the eigen gate proves it at k>1)
+        nb = args.halo_steps
+        gxs, gys = args.nx_local + 2 * nb, args.ny_local + 2 * nb
+        zg_host = np.zeros((px * gxs, py * gys), dtype=dtype)
+        xs = np.arange(nx, dtype=np.float64) * dx
+        ys = np.arange(ny, dtype=np.float64) * dy
+        z0 = np.sin(args.kx * xs)[:, None] * np.sin(args.ky * ys)[None, :]
         for rx in range(px):
             for ry in range(py):
-                got[
+                blk = z0[
                     rx * args.nx_local:(rx + 1) * args.nx_local,
                     ry * args.ny_local:(ry + 1) * args.ny_local,
-                ] = zg_out[
+                ]
+                zg_host[
                     rx * gxs + nb:rx * gxs + nb + args.nx_local,
                     ry * gys + nb:ry * gys + nb + args.ny_local,
-                ]
-        denom = float(np.sqrt(np.mean(want**2)))
-        with np.errstate(over="ignore"):  # unstable dt overflows by design;
-            # the gate reports it as inf > tol, not as a warning
-            rel = (
-                float(np.sqrt(np.mean((got - want) ** 2)))
-                / max(denom, 1e-300)
-            )
-        tol = args.tol if args.tol is not None else _default_tol(args)
-        rep.line(
-            f"HEAT ERR rel={rel:e} (gate {tol:e})",
-            {"kind": "heat_err", "rel": rel, "tol": tol, "g": g},
+                ] = blk.astype(dtype)
+        zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
+
+        step, kernel = _common.pick_kernel_tier(
+            lambda k: heat_step2d_fn(
+                mesh, "x", "y", nb, float(cx), float(cy),
+                steps=args.halo_steps, kernel=k,
+            ),
+            (jax.ShapeDtypeStruct(zs.shape, zs.dtype), 1),
+            args.kernel,
+            rep,
         )
-        if not np.isfinite(rel) or rel > tol:
-            rep.line(f"HEAT FAIL rel={rel:.8g} > tol {tol:.8g}")
-            rc = 1
-    else:
-        rep.line("HEAT NOTE multi-host: eigen gate skipped "
-                 "(shards not addressable); finiteness only")
-        if not np.isfinite(float(np.asarray(
-                zs.addressable_shards[0].data).sum())):
-            rc = 1
-    return rc
+        outer_total = args.n_steps // args.halo_steps
+        # compile + warm: 1 outer body = halo_steps real timesteps, counted
+        zs = block(step(zs, 1))
+
+        t0 = time.perf_counter()
+        zs = block(step(zs, outer_total - 1))
+        seconds = time.perf_counter() - t0
+        timed_steps = (outer_total - 1) * args.halo_steps
+        steps_per_s = timed_steps / seconds if seconds > 0 else float("inf")
+        rep.line(
+            f"HEAT mesh:{px}x{py} n:{nx}x{ny}; steps={args.n_steps} "
+            f"{steps_per_s:0.1f} steps/s",
+            {"kind": "heat", "px": px, "py": py, "nx": nx, "ny": ny,
+             "steps": args.n_steps, "steps_per_s": steps_per_s,
+             "nu": args.nu, "dt": dt, "kernel": kernel},
+        )
+
+        rc = 0
+        if zs.is_fully_addressable:
+            # eigenvalue gate: field == g^T · z0 to roundoff
+            g = (
+                1.0
+                - cx * (2.0 - 2.0 * math.cos(args.kx * dx))
+                - cy * (2.0 - 2.0 * math.cos(args.ky * dy))
+            )
+            want = (g**args.n_steps) * z0
+            got = np.zeros((nx, ny), dtype=np.float64)
+            zg_out = np.asarray(jax.device_get(zs), np.float64)
+            for rx in range(px):
+                for ry in range(py):
+                    got[
+                        rx * args.nx_local:(rx + 1) * args.nx_local,
+                        ry * args.ny_local:(ry + 1) * args.ny_local,
+                    ] = zg_out[
+                        rx * gxs + nb:rx * gxs + nb + args.nx_local,
+                        ry * gys + nb:ry * gys + nb + args.ny_local,
+                    ]
+            denom = float(np.sqrt(np.mean(want**2)))
+            with np.errstate(over="ignore"):  # unstable dt overflows by design;
+                # the gate reports it as inf > tol, not as a warning
+                rel = (
+                    float(np.sqrt(np.mean((got - want) ** 2)))
+                    / max(denom, 1e-300)
+                )
+            tol = args.tol if args.tol is not None else _default_tol(args)
+            rep.line(
+                f"HEAT ERR rel={rel:e} (gate {tol:e})",
+                {"kind": "heat_err", "rel": rel, "tol": tol, "g": g},
+            )
+            if not np.isfinite(rel) or rel > tol:
+                rep.line(f"HEAT FAIL rel={rel:.8g} > tol {tol:.8g}")
+                rc = 1
+        else:
+            rep.line("HEAT NOTE multi-host: eigen gate skipped "
+                     "(shards not addressable); finiteness only")
+            if not np.isfinite(float(np.asarray(
+                    zs.addressable_shards[0].data).sum())):
+                rc = 1
+        return rc
 
 
 def _default_tol(args) -> float:

@@ -37,7 +37,6 @@ def run(args) -> int:
     from tpu_mpi_tests.arrays.spaces import Space, ensure_device, meminfo, place
     from tpu_mpi_tests.comm.mesh import ranks_per_device
     from tpu_mpi_tests.utils import TpuMtError
-    from tpu_mpi_tests.instrument import Reporter
     from tpu_mpi_tests.instrument.timers import block
 
     dtype = _common.jnp_dtype(args)
@@ -57,58 +56,59 @@ def run(args) -> int:
     k = ranks_per_device(world)
     n = check_divisible(args.n_total, world, "n_total over ranks")
 
-    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
-    if k > 1:
-        rep.banner(f"{world} logical ranks over {n_dev} devices "
-                   f"({k} ranks/device)")
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
+        if k > 1:
+            rep.banner(f"{world} logical ranks over {n_dev} devices "
+                       f"({k} ranks/device)")
 
-    # env probe (mpi_daxpy.cc:99-108)
-    mb_per_core = os.environ.get("MEMORY_PER_CORE")
-    if mb_per_core is None:
-        rep.banner("MEMORY_PER_CORE is not set")
-    else:
-        rep.banner(f"MEMORY_PER_CORE={mb_per_core}")
-    rep.banner(device_report(verbose=args.verbose))
+        # env probe (mpi_daxpy.cc:99-108)
+        mb_per_core = os.environ.get("MEMORY_PER_CORE")
+        if mb_per_core is None:
+            rep.banner("MEMORY_PER_CORE is not set")
+        else:
+            rep.banner(f"MEMORY_PER_CORE={mb_per_core}")
+        rep.banner(device_report(verbose=args.verbose))
 
-    # every rank initializes the same local values x=i+1, y=-(i+1)
-    # (mpi_daxpy.cc:94-97) — globally that's the per-rank pattern tiled
-    lx, ly = kd.init_xy_np(n, dtype)
-    h_x = np.tile(lx, world)
-    h_y = np.tile(ly, world)
+        # every rank initializes the same local values x=i+1, y=-(i+1)
+        # (mpi_daxpy.cc:94-97) — globally that's the per-rank pattern tiled
+        lx, ly = kd.init_xy_np(n, dtype)
+        h_x = np.tile(lx, world)
+        h_y = np.tile(ly, world)
 
-    # explicit-device pair AND managed pair (mpi_daxpy.cc:115-119)
-    d_x = C.shard_1d(jnp.asarray(h_x), mesh)
-    d_y = C.shard_1d(jnp.asarray(h_y), mesh)
-    m_x = place(h_x, Space.MANAGED, d_x.sharding)
-    m_y = place(h_y, Space.MANAGED, d_y.sharding)
-    if args.verbose:
-        for name, a in [("d_x", d_x), ("d_y", d_y), ("m_x", m_x),
-                        ("m_y", m_y)]:
-            rep.line(f"MEMINFO {name}: {meminfo(a)}")
+        # explicit-device pair AND managed pair (mpi_daxpy.cc:115-119)
+        d_x = C.shard_1d(jnp.asarray(h_x), mesh)
+        d_y = C.shard_1d(jnp.asarray(h_y), mesh)
+        m_x = place(h_x, Space.MANAGED, d_x.sharding)
+        m_y = place(h_y, Space.MANAGED, d_y.sharding)
+        if args.verbose:
+            for name, a in [("d_x", d_x), ("d_y", d_y), ("m_x", m_x),
+                            ("m_y", m_y)]:
+                rep.line(f"MEMINFO {name}: {meminfo(a)}")
 
-    # kernel runs on the managed pair (mpi_daxpy.cc:140-141); managed
-    # arrays migrate to HBM on first device touch (arrays/spaces.py)
-    m_x, m_y = ensure_device(m_x), ensure_device(m_y)
-    m_y = block(kd.daxpy(jnp.asarray(args.a, dtype), m_x, m_y))
+        # kernel runs on the managed pair (mpi_daxpy.cc:140-141); managed
+        # arrays migrate to HBM on first device touch (arrays/spaces.py)
+        m_x, m_y = ensure_device(m_x), ensure_device(m_y)
+        m_y = block(kd.daxpy(jnp.asarray(args.a, dtype), m_x, m_y))
 
-    # per-rank checksums of the managed result (mpi_daxpy.cc:152-156);
-    # computed as a collective so multi-host processes can all read them
-    sums = (
-        C.per_rank_sums(m_y, mesh, groups_per_shard=k)
-        .astype(np.float64)
-        .reshape(-1)
-    )
-    for r in range(world):
-        rep.sum_line(sums[r], rank=r)
+        # per-rank checksums of the managed result (mpi_daxpy.cc:152-156);
+        # computed as a collective so multi-host processes can all read them
+        sums = (
+            C.per_rank_sums(m_y, mesh, groups_per_shard=k)
+            .astype(np.float64)
+            .reshape(-1)
+        )
+        for r in range(world):
+            rep.sum_line(sums[r], rank=r)
 
-    expected = kd.expected_checksum(n)
-    tol = 0 if args.dtype == "float64" else max(1e-5 * expected, 1.0)
-    ok = all(abs(s - expected) <= tol for s in sums)
-    if not ok:
-        rep.line(f"CHECKSUM FAIL: {sums} != {expected}")
-        return 1
-    del d_x, d_y
-    return 0
+        expected = kd.expected_checksum(n)
+        tol = 0 if args.dtype == "float64" else max(1e-5 * expected, 1.0)
+        ok = all(abs(s - expected) <= tol for s in sums)
+        if not ok:
+            rep.line(f"CHECKSUM FAIL: {sums} != {expected}")
+            return 1
+        del d_x, d_y
+        return 0
 
 
 def main(argv=None) -> int:

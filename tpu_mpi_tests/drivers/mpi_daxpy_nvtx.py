@@ -39,11 +39,7 @@ def run(args) -> int:
         topology,
     )
     from tpu_mpi_tests.arrays.spaces import Space, meminfo, place
-    from tpu_mpi_tests.instrument import (
-        PhaseTimer,
-        ProfilerGate,
-        Reporter,
-    )
+    from tpu_mpi_tests.instrument import PhaseTimer, ProfilerGate
     from tpu_mpi_tests.instrument.timers import block
     from tpu_mpi_tests.instrument.trace import trace_range
 
@@ -59,167 +55,168 @@ def run(args) -> int:
     nall = args.n_per_node * nodes
     n = check_divisible(nall, world, "nall over ranks")
 
-    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
-    rep.banner(
-        f"{nodes} nodes, {world} ranks, {n} elements each, total {nall}"
-    )
-    mb_per_core = os.environ.get("MEMORY_PER_CORE")
-    rep.banner(
-        f"MEMORY_PER_CORE={mb_per_core}"
-        if mb_per_core
-        else "MEMORY_PER_CORE is not set"
-    )
-    rep.banner(device_report(verbose=args.verbose))
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
+        rep.banner(
+            f"{nodes} nodes, {world} ranks, {n} elements each, total {nall}"
+        )
+        mb_per_core = os.environ.get("MEMORY_PER_CORE")
+        rep.banner(
+            f"MEMORY_PER_CORE={mb_per_core}"
+            if mb_per_core
+            else "MEMORY_PER_CORE is not set"
+        )
+        rep.banner(device_report(verbose=args.verbose))
 
-    timer = PhaseTimer()
-    gate = ProfilerGate(args.profile_dir)
-    gate.start()
+        timer = PhaseTimer()
+        gate = ProfilerGate(args.profile_dir)
+        gate.start()
 
-    if args.warmup:
-        # compile outside EVERY timed phase (including total): the
-        # reference's binaries carry no JIT cost, so charging trace+compile
-        # (~1 s) to any phase would measure the compiler, not the op.
-        # Device-created dummies of the real shapes/shardings hit the same
-        # compilation cache; the real (possibly managed) arrays are
-        # untouched so their timed first-touch migration is preserved.
-        with trace_range("compileWarmup"):
-            wx = C.device_init(mesh, lambda r: jnp.zeros(n, dtype), ndim=1)
-            wy = C.device_init(mesh, lambda r: jnp.zeros(n, dtype), ndim=1)
-            block(kd.daxpy(jnp.asarray(args.a, dtype), wx, wy))
-            block(C.all_gather_inplace(jnp.copy(wx), mesh))
-            block(C.all_gather(wy, mesh))
-            del wx, wy
+        if args.warmup:
+            # compile outside EVERY timed phase (including total): the
+            # reference's binaries carry no JIT cost, so charging trace+compile
+            # (~1 s) to any phase would measure the compiler, not the op.
+            # Device-created dummies of the real shapes/shardings hit the same
+            # compilation cache; the real (possibly managed) arrays are
+            # untouched so their timed first-touch migration is preserved.
+            with trace_range("compileWarmup"):
+                wx = C.device_init(mesh, lambda r: jnp.zeros(n, dtype), ndim=1)
+                wy = C.device_init(mesh, lambda r: jnp.zeros(n, dtype), ndim=1)
+                block(kd.daxpy(jnp.asarray(args.a, dtype), wx, wy))
+                block(C.all_gather_inplace(jnp.copy(wx), mesh))
+                block(C.all_gather(wy, mesh))
+                del wx, wy
 
-    with timer.phase("total"):
-        # ── allocateArrays / initializeArrays (+ copyInput if unmanaged) ──
-        if args.init == "device":
-            # on-chip init: every shard computes its own (i+1)/n pattern
-            # (no host staging phases; for tunnel-bound controllers where
-            # H2D of 48Mi/node is slower than the whole benchmark)
-            with trace_range("initializeArrays"), timer.phase("init"):
-                d_x = block(
-                    C.device_init(
-                        mesh,
-                        lambda r: kd.init_xy_scaled_jax(n, dtype)[0],
-                        ndim=1,
-                    )
-                )
-                d_y = block(
-                    C.device_init(
-                        mesh,
-                        lambda r: kd.init_xy_scaled_jax(n, dtype)[1],
-                        ndim=1,
-                    )
-                )
-            h_x = h_y = None
-        else:
-            with trace_range("initializeArrays"), timer.phase("init"):
-                # per-rank pattern (i+1)/n tiled across ranks (:207-217)
-                lx, ly = kd.init_xy_scaled_np(n, dtype)
-                h_x = np.tile(lx, world)
-                h_y = np.tile(ly, world)
-        if args.init == "device":
-            pass
-        elif managed:
-            # managed ≈ host-resident, device reads it implicitly (SURVEY
-            # §2.3 memory-space row): place sharded into host memory kind
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            sh = NamedSharding(mesh, P(mesh.axis_names[0]))
-            with trace_range("allocateArrays"), timer.phase("alloc"):
-                d_x = block(place(h_x, Space.MANAGED, sh))
-                d_y = block(place(h_y, Space.MANAGED, sh))
-        else:
-            with trace_range("copyInput"), timer.phase("copyInput"):
-                d_x = block(C.shard_1d(jnp.asarray(h_x), mesh))
-                d_y = block(C.shard_1d(jnp.asarray(h_y), mesh))
-        if args.verbose:
-            rep.line(f"MEMINFO d_x: {meminfo(d_x)}")
-            rep.line(f"MEMINFO d_y: {meminfo(d_y)}")
-
-        # ── kernel (:242-249) ──
-        with trace_range("daxpy"), timer.phase("kernel"):
-            # managed arrays migrate to HBM on first device touch (TPU has
-            # no page-migrating UVM; see arrays/spaces.ensure_device), so
-            # the migration cost lands in kernel time like UVM page faults
-            from tpu_mpi_tests.arrays.spaces import ensure_device
-
-            d_x = ensure_device(d_x)
-            d_y = ensure_device(d_y)
-            d_y = block(kd.daxpy(jnp.asarray(args.a, dtype), d_x, d_y))
-
-        # ── localSum (+ copyOutput if unmanaged) (:251-268) ──
-        # computed as a collective so multi-host processes can all read it
-        with trace_range("localSum"), timer.phase("localSum"):
-            local_sums = C.per_rank_sums(d_y, mesh).astype(np.float64)
-        local_sums = local_sums.reshape(-1)
-        for r in range(world):
-            rep.sum_line(local_sums[r], rank=r)
-
-        # ── copyPrepAllxInplace (:270-272): own slice into the gather buf ──
-        with trace_range("copyPrepAllxInplace"), timer.phase("copyPrep"):
-            d_allx = block(jnp.copy(d_x))
-
-        # ── optional barrier (:274-280) ──
-        if args.barrier:
-            with trace_range("mpiBarrier"), timer.phase("barrier"):
-                C.barrier(mesh)
-
-        # ── allgather x (IN_PLACE) + y (:282-291) ──
-        with trace_range("mpiAllGather"), timer.phase("gather"):
-            with trace_range("x"):
-                g_allx = C.all_gather_inplace(d_allx, mesh)
-            with trace_range("y"):
-                g_ally = C.all_gather(d_y, mesh)
-            block(g_allx, g_ally)
-
-        # ── allSum global checksum (:293-310) ──
-        # device reductions accumulate at the run's precision: f64 runs are
-        # gated with tol=0 below, which an f32-accumulated sum of 48Mi+
-        # elements cannot meet (x64 is enabled iff --dtype float64)
-        acc_dtype = jnp.float64 if args.dtype == "float64" else jnp.float32
-        with trace_range("allSum"), timer.phase("allSum"):
+        with timer.phase("total"):
+            # ── allocateArrays / initializeArrays (+ copyInput if unmanaged) ──
             if args.init == "device":
-                # device reduction (the gathered array never moves to host)
-                all_sum = float(jnp.sum(g_ally.astype(acc_dtype)))
+                # on-chip init: every shard computes its own (i+1)/n pattern
+                # (no host staging phases; for tunnel-bound controllers where
+                # H2D of 48Mi/node is slower than the whole benchmark)
+                with trace_range("initializeArrays"), timer.phase("init"):
+                    d_x = block(
+                        C.device_init(
+                            mesh,
+                            lambda r: kd.init_xy_scaled_jax(n, dtype)[0],
+                            ndim=1,
+                        )
+                    )
+                    d_y = block(
+                        C.device_init(
+                            mesh,
+                            lambda r: kd.init_xy_scaled_jax(n, dtype)[1],
+                            ndim=1,
+                        )
+                    )
+                h_x = h_y = None
             else:
-                all_sum = float(
-                    C.host_value(g_ally).astype(np.float64).sum()
+                with trace_range("initializeArrays"), timer.phase("init"):
+                    # per-rank pattern (i+1)/n tiled across ranks (:207-217)
+                    lx, ly = kd.init_xy_scaled_np(n, dtype)
+                    h_x = np.tile(lx, world)
+                    h_y = np.tile(ly, world)
+            if args.init == "device":
+                pass
+            elif managed:
+                # managed ≈ host-resident, device reads it implicitly (SURVEY
+                # §2.3 memory-space row): place sharded into host memory kind
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+                with trace_range("allocateArrays"), timer.phase("alloc"):
+                    d_x = block(place(h_x, Space.MANAGED, sh))
+                    d_y = block(place(h_y, Space.MANAGED, sh))
+            else:
+                with trace_range("copyInput"), timer.phase("copyInput"):
+                    d_x = block(C.shard_1d(jnp.asarray(h_x), mesh))
+                    d_y = block(C.shard_1d(jnp.asarray(h_y), mesh))
+            if args.verbose:
+                rep.line(f"MEMINFO d_x: {meminfo(d_x)}")
+                rep.line(f"MEMINFO d_y: {meminfo(d_y)}")
+
+            # ── kernel (:242-249) ──
+            with trace_range("daxpy"), timer.phase("kernel"):
+                # managed arrays migrate to HBM on first device touch (TPU has
+                # no page-migrating UVM; see arrays/spaces.ensure_device), so
+                # the migration cost lands in kernel time like UVM page faults
+                from tpu_mpi_tests.arrays.spaces import ensure_device
+
+                d_x = ensure_device(d_x)
+                d_y = ensure_device(d_y)
+                d_y = block(kd.daxpy(jnp.asarray(args.a, dtype), d_x, d_y))
+
+            # ── localSum (+ copyOutput if unmanaged) (:251-268) ──
+            # computed as a collective so multi-host processes can all read it
+            with trace_range("localSum"), timer.phase("localSum"):
+                local_sums = C.per_rank_sums(d_y, mesh).astype(np.float64)
+            local_sums = local_sums.reshape(-1)
+            for r in range(world):
+                rep.sum_line(local_sums[r], rank=r)
+
+            # ── copyPrepAllxInplace (:270-272): own slice into the gather buf ──
+            with trace_range("copyPrepAllxInplace"), timer.phase("copyPrep"):
+                d_allx = block(jnp.copy(d_x))
+
+            # ── optional barrier (:274-280) ──
+            if args.barrier:
+                with trace_range("mpiBarrier"), timer.phase("barrier"):
+                    C.barrier(mesh)
+
+            # ── allgather x (IN_PLACE) + y (:282-291) ──
+            with trace_range("mpiAllGather"), timer.phase("gather"):
+                with trace_range("x"):
+                    g_allx = C.all_gather_inplace(d_allx, mesh)
+                with trace_range("y"):
+                    g_ally = C.all_gather(d_y, mesh)
+                block(g_allx, g_ally)
+
+            # ── allSum global checksum (:293-310) ──
+            # device reductions accumulate at the run's precision: f64 runs are
+            # gated with tol=0 below, which an f32-accumulated sum of 48Mi+
+            # elements cannot meet (x64 is enabled iff --dtype float64)
+            acc_dtype = jnp.float64 if args.dtype == "float64" else jnp.float32
+            with trace_range("allSum"), timer.phase("allSum"):
+                if args.init == "device":
+                    # device reduction (the gathered array never moves to host)
+                    all_sum = float(jnp.sum(g_ally.astype(acc_dtype)))
+                else:
+                    all_sum = float(
+                        C.host_value(g_ally).astype(np.float64).sum()
+                    )
+            rep.sum_line(all_sum, label="ALLSUM")
+
+        gate.stop()
+        for phase in ("total", "kernel", "barrier", "gather"):
+            if timer.counts[phase]:
+                rep.time_line(phase, timer.seconds[phase])
+
+        # verification: y = x elementwise → ALLSUM = world*(n+1)/2; gathered x
+        # must equal the original global x (in-place parity)
+        expected_all = world * (n + 1) / 2
+        if args.dtype == "float64":
+            # host np.float64 sums reproduce the reference's exact checksums;
+            # device-side f64 reductions may differ by reduction-order rounding
+            tol = 0 if args.init == "host" else 1e-12 * abs(expected_all)
+        else:
+            tol = max(1e-5 * abs(expected_all), 1.0)
+        ok = abs(all_sum - expected_all) <= tol
+        if h_x is not None:
+            if not np.array_equal(C.host_value(g_allx), h_x):
+                rep.line("GATHER PARITY FAIL: gathered x != filled buffer")
+                ok = False
+        else:
+            # device-init path: in-place-gather parity via the x checksum
+            # (x sums to (n+1)/2 per rank, like y)
+            gx_sum = float(jnp.sum(g_allx.astype(acc_dtype)))
+            if abs(gx_sum - expected_all) > tol:
+                rep.line(
+                    f"GATHER PARITY FAIL: x sum {gx_sum} != {expected_all}"
                 )
-        rep.sum_line(all_sum, label="ALLSUM")
-
-    gate.stop()
-    for phase in ("total", "kernel", "barrier", "gather"):
-        if timer.counts[phase]:
-            rep.time_line(phase, timer.seconds[phase])
-
-    # verification: y = x elementwise → ALLSUM = world*(n+1)/2; gathered x
-    # must equal the original global x (in-place parity)
-    expected_all = world * (n + 1) / 2
-    if args.dtype == "float64":
-        # host np.float64 sums reproduce the reference's exact checksums;
-        # device-side f64 reductions may differ by reduction-order rounding
-        tol = 0 if args.init == "host" else 1e-12 * abs(expected_all)
-    else:
-        tol = max(1e-5 * abs(expected_all), 1.0)
-    ok = abs(all_sum - expected_all) <= tol
-    if h_x is not None:
-        if not np.array_equal(C.host_value(g_allx), h_x):
-            rep.line("GATHER PARITY FAIL: gathered x != filled buffer")
-            ok = False
-    else:
-        # device-init path: in-place-gather parity via the x checksum
-        # (x sums to (n+1)/2 per rank, like y)
-        gx_sum = float(jnp.sum(g_allx.astype(acc_dtype)))
-        if abs(gx_sum - expected_all) > tol:
-            rep.line(
-                f"GATHER PARITY FAIL: x sum {gx_sum} != {expected_all}"
-            )
-            ok = False
-    if not ok:
-        rep.line(f"CHECKSUM FAIL: ALLSUM {all_sum} != {expected_all}")
-        return 1
-    return 0
+                ok = False
+        if not ok:
+            rep.line(f"CHECKSUM FAIL: ALLSUM {all_sum} != {expected_all}")
+            return 1
+        return 0
 
 
 def main(argv=None) -> int:

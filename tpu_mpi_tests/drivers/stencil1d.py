@@ -28,7 +28,7 @@ def run(args) -> int:
     from tpu_mpi_tests.comm import collectives as C
     from tpu_mpi_tests.comm import halo as H
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument import ProfilerGate, Reporter
+    from tpu_mpi_tests.instrument import ProfilerGate
     from tpu_mpi_tests.instrument.timers import block
     from tpu_mpi_tests.kernels.stencil import analytic_pairs
     from tpu_mpi_tests.utils import TpuMtError
@@ -44,74 +44,75 @@ def run(args) -> int:
     d = Domain1D(n_global=n_global, n_shards=world, n_bnd=2)
     f, df = analytic_pairs()["1d"]
 
-    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
-    rep.banner(
-        f"stencil1d: n_global={n_global} world={world} "
-        f"n_local={d.n_local} dtype={args.dtype} staging={args.staging}"
-    )
-
-    # shards materialize on their own devices (multi-GB host→device init
-    # transfer is the wrong tool at 32Mi+ scale — see collectives.device_init)
-    zg = block(
-        C.device_init(
-            mesh, lambda r: d.init_shard_jax(f, r, dtype), ndim=1
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
+        rep.banner(
+            f"stencil1d: n_global={n_global} world={world} "
+            f"n_local={d.n_local} dtype={args.dtype} staging={args.staging}"
         )
-    )
 
-    staging = H.Staging.parse(args.staging)
-    with ProfilerGate(args.profile_dir):
-        # untimed warmup so the timed exchange measures communication, not
-        # trace+compile (exchange is idempotent: ghosts are rewritten with
-        # identical values) — async-dispatch discipline, SURVEY §7 part 2
-        zg = block(H.halo_exchange(zg, mesh, staging=staging))
-        # one timed exchange (mpi_stencil_gt.cc:200-205)
-        t0 = time.perf_counter()
-        zg = block(H.halo_exchange(zg, mesh, staging=staging))
-        seconds = time.perf_counter() - t0
+        # shards materialize on their own devices (multi-GB host→device init
+        # transfer is the wrong tool at 32Mi+ scale — see collectives.device_init)
+        zg = block(
+            C.device_init(
+                mesh, lambda r: d.init_shard_jax(f, r, dtype), ndim=1
+            )
+        )
+
+        staging = H.Staging.parse(args.staging)
+        with ProfilerGate(args.profile_dir):
+            # untimed warmup so the timed exchange measures communication, not
+            # trace+compile (exchange is idempotent: ghosts are rewritten with
+            # identical values) — async-dispatch discipline, SURVEY §7 part 2
+            zg = block(H.halo_exchange(zg, mesh, staging=staging))
+            # one timed exchange (mpi_stencil_gt.cc:200-205)
+            t0 = time.perf_counter()
+            zg = block(H.halo_exchange(zg, mesh, staging=staging))
+            seconds = time.perf_counter() - t0
+            if topo.process_index == 0:
+                for r in range(world):
+                    rep.line(
+                        f"{r}/{world} exchange time {seconds:0.8f}",
+                        {"kind": "exchange1d", "rank": r, "seconds": seconds},
+                    )
+
+            deriv = block(H.stencil_fn(mesh, axis_name, 0, 1, d.scale)(zg))
+
+        # per-rank err norms vs analytic derivative, computed shard-local on
+        # device (the full global field never moves to host)
+        actual = C.device_init(
+            mesh, lambda r: d.interior_shard_jax(df, r, dtype), ndim=1
+        )
+        per_rank_err = C.per_rank_err_norms(deriv, actual, mesh)
+        kind = jax.devices()[0].device_kind
         if topo.process_index == 0:
             for r in range(world):
                 rep.line(
-                    f"{r}/{world} exchange time {seconds:0.8f}",
-                    {"kind": "exchange1d", "rank": r, "seconds": seconds},
+                    f"{r}/{world} [{kind}] err_norm = {per_rank_err[r]:.8f}",
+                    {"kind": "err_norm", "rank": r, "err": float(per_rank_err[r])},
                 )
 
-        deriv = block(H.stencil_fn(mesh, axis_name, 0, 1, d.scale)(zg))
-
-    # per-rank err norms vs analytic derivative, computed shard-local on
-    # device (the full global field never moves to host)
-    actual = C.device_init(
-        mesh, lambda r: d.interior_shard_jax(df, r, dtype), ndim=1
-    )
-    per_rank_err = C.per_rank_err_norms(deriv, actual, mesh)
-    kind = jax.devices()[0].device_kind
-    if topo.process_index == 0:
-        for r in range(world):
-            rep.line(
-                f"{r}/{world} [{kind}] err_norm = {per_rank_err[r]:.8f}",
-                {"kind": "err_norm", "rank": r, "err": float(per_rank_err[r])},
+        if args.tol is not None:
+            tol = args.tol
+        elif args.dtype == "float64":
+            # rounding error grows with scale·√n like the f32 case (coordinate
+            # ulps amplified by 1/delta); a broken halo exceeds this by >10⁴
+            eps64 = 2.2e-16
+            tol = max(
+                128 * eps64 * d.length**3 * d.scale * np.sqrt(n_global), 1e-6
             )
-
-    if args.tol is not None:
-        tol = args.tol
-    elif args.dtype == "float64":
-        # rounding error grows with scale·√n like the f32 case (coordinate
-        # ulps amplified by 1/delta); a broken halo exceeds this by >10⁴
-        eps64 = 2.2e-16
-        tol = max(
-            128 * eps64 * d.length**3 * d.scale * np.sqrt(n_global), 1e-6
-        )
-    else:
-        # f32/bf16: cancellation error ≈ eps·max|y|·scale per point
-        # (SURVEY §7 hard part 1); a broken halo exceeds this by >10³
-        eps = float(np.finfo(np.dtype(args.dtype).newbyteorder("=")).eps) if args.dtype != "bfloat16" else 7.8e-3
-        ymax = d.length**3
-        tol = 8 * eps * ymax * d.scale * np.sqrt(n_global)
-    if per_rank_err.max() > tol:
-        rep.line(
-            f"ERR_NORM FAIL: max {per_rank_err.max():.8g} > tol {tol:.8g}"
-        )
-        return 1
-    return 0
+        else:
+            # f32/bf16: cancellation error ≈ eps·max|y|·scale per point
+            # (SURVEY §7 hard part 1); a broken halo exceeds this by >10³
+            eps = float(np.finfo(np.dtype(args.dtype).newbyteorder("=")).eps) if args.dtype != "bfloat16" else 7.8e-3
+            ymax = d.length**3
+            tol = 8 * eps * ymax * d.scale * np.sqrt(n_global)
+        if per_rank_err.max() > tol:
+            rep.line(
+                f"ERR_NORM FAIL: max {per_rank_err.max():.8g} > tol {tol:.8g}"
+            )
+            return 1
+        return 0
 
 
 def main(argv=None) -> int:

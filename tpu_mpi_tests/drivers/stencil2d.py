@@ -241,7 +241,7 @@ def _sum_test(args, mesh, topo, rep, dim: int, space: str) -> int:
     import functools
 
     import jax.numpy as jnp
-    from jax import shard_map
+    from tpu_mpi_tests.compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     dtype = _common.jnp_dtype(args)
@@ -363,41 +363,42 @@ def _sum_test(args, mesh, topo, rep, dim: int, space: str) -> int:
 
 def run(args) -> int:
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument import ProfilerGate, Reporter
+    from tpu_mpi_tests.instrument import ProfilerGate
 
     bootstrap()
     topo = topology()
     mesh = make_mesh()
     world = topo.global_device_count
 
-    rep = Reporter(rank=topo.process_index, size=world, jsonl_path=args.jsonl)
-    rep.banner(
-        f"stencil2d: n_local={args.n_local} n_other={args.n_other} "
-        f"world={world} n_iter={args.n_iter} n_warmup={args.n_warmup} "
-        f"dtype={args.dtype} managed={args.managed}"
-    )
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
+        rep.banner(
+            f"stencil2d: n_local={args.n_local} n_other={args.n_other} "
+            f"world={world} n_iter={args.n_iter} n_warmup={args.n_warmup} "
+            f"dtype={args.dtype} managed={args.managed}"
+        )
 
-    spaces = ["device"] + (["managed"] if args.managed else [])
-    only = None
-    if args.only:
-        only = {
-            (int(d), int(b))
-            for d, b in (pair.split(":") for pair in args.only.split(","))
-        }
-    rc = 0
-    with ProfilerGate(args.profile_dir):
-        for dim in (0, 1):
-            for buf in (True, False):
-                if only is not None and (dim, int(buf)) not in only:
+        spaces = ["device"] + (["managed"] if args.managed else [])
+        only = None
+        if args.only:
+            only = {
+                (int(d), int(b))
+                for d, b in (pair.split(":") for pair in args.only.split(","))
+            }
+        rc = 0
+        with ProfilerGate(args.profile_dir):
+            for dim in (0, 1):
+                for buf in (True, False):
+                    if only is not None and (dim, int(buf)) not in only:
+                        continue
+                    for space in spaces:
+                        rc |= _deriv_test(args, mesh, topo, rep, dim, space, buf)
+            for dim in (0, 1):
+                if only is not None and not any(d == dim for d, _ in only):
                     continue
                 for space in spaces:
-                    rc |= _deriv_test(args, mesh, topo, rep, dim, space, buf)
-        for dim in (0, 1):
-            if only is not None and not any(d == dim for d, _ in only):
-                continue
-            for space in spaces:
-                rc |= _sum_test(args, mesh, topo, rep, dim, space)
-    return rc
+                    rc |= _sum_test(args, mesh, topo, rep, dim, space)
+        return rc
 
 
 def main(argv=None) -> int:

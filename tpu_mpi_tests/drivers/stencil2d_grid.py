@@ -56,7 +56,7 @@ def run(args) -> int:
     from tpu_mpi_tests.arrays.domain import Domain1D
     from tpu_mpi_tests.comm.halo import step2d_fn
     from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument import PhaseTimer, Reporter
+    from tpu_mpi_tests.instrument import PhaseTimer
     from tpu_mpi_tests.kernels.stencil import N_BND, analytic_pairs
 
     dtype = _common.jnp_dtype(args)
@@ -70,77 +70,78 @@ def run(args) -> int:
     px, py = grid
     mesh = make_mesh({"x": px, "y": py})
 
-    rep = Reporter(rank=topo.process_index, size=n_dev, jsonl_path=args.jsonl)
-    rep.banner(
-        f"stencil2d_grid: mesh={px}x{py} nx_local={args.nx_local} "
-        f"ny_local={args.ny_local} n_iter={args.n_iter} dtype={args.dtype}"
-    )
-
-    dx = Domain1D(n_global=px * args.nx_local, n_shards=px)
-    dy = Domain1D(n_global=py * args.ny_local, n_shards=py)
-    zf, _ = analytic_pairs()["2d_dim0"]
-
-    gx, gy = px * dx.n_ghosted, py * dy.n_ghosted
-    zg_host = np.zeros((gx, gy), dtype=dtype)
-    for rx in range(px):
-        for ry in range(py):
-            zg_host[
-                rx * dx.n_ghosted:(rx + 1) * dx.n_ghosted,
-                ry * dy.n_ghosted:(ry + 1) * dy.n_ghosted,
-            ] = _init_block(dx, dy, rx, ry, px, py, zf, dtype)
-    zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
-
-    step, kernel = _common.pick_kernel_tier(
-        lambda k: step2d_fn(
-            mesh, "x", "y", N_BND, float(dx.scale), float(dy.scale),
-            kernel=k,
-        ),
-        (jax.ShapeDtypeStruct(zs.shape, zs.dtype),),
-        args.kernel,
-        rep,
-    )
-
-    timer = PhaseTimer(skip_first=args.n_warmup)
-    out = None
-    for _ in range(args.n_warmup + args.n_iter):
-        out = timer.timed("step", step, zs)
-    dz_dx, dz_dy, residual = out
-    seconds = timer.seconds["step"]
-
-    # err gates vs analytic derivatives over the global interior
-    rc = 0
-    if dz_dx.is_fully_addressable:
-        xs = np.arange(dx.n_global) * dx.delta
-        ys = np.arange(dy.n_global) * dy.delta
-        want_dx = (3.0 * xs[:, None] ** 2) + 0.0 * ys[None, :]
-        want_dy = 0.0 * xs[:, None] + 2.0 * ys[None, :]
-        got_dx = np.asarray(jax.device_get(dz_dx), np.float64)
-        got_dy = np.asarray(jax.device_get(dz_dy), np.float64)
-        err_dx = float(np.sqrt(np.mean((got_dx - want_dx) ** 2)))
-        err_dy = float(np.sqrt(np.mean((got_dy - want_dy) ** 2)))
-    else:  # multi-host: residual finiteness is the (weaker) gate
-        err_dx = err_dy = float("nan")
-    rep.line(
-        f"GRID TEST px:{px} py:{py}; {seconds:f}, "
-        f"err_dx={err_dx:e}, err_dy={err_dy:e}",
-        {"kind": "grid_test", "px": px, "py": py, "seconds": seconds,
-         "err_dx": err_dx, "err_dy": err_dy,
-         "residual": float(residual), "kernel": kernel},
-    )
-    rep.iter_line(0, "device", 0, "step", timer.mean("step"),
-                  timer.mins.get("step", 0.0), timer.maxs.get("step", 0.0))
-
-    if not np.isfinite(float(residual)):
-        rep.line(f"RESIDUAL FAIL: {residual}")
-        return 1
-    tol = args.tol if args.tol is not None else _default_tol(args, dx, dy)
-    if np.isfinite(err_dx) and max(err_dx, err_dy) > tol:
-        rep.line(
-            f"ERR_NORM FAIL grid: dx={err_dx:.8g} dy={err_dy:.8g} > "
-            f"tol {tol:.8g}"
+    rep = _common.make_reporter(args, rank=topo.process_index, size=n_dev)
+    with rep:
+        rep.banner(
+            f"stencil2d_grid: mesh={px}x{py} nx_local={args.nx_local} "
+            f"ny_local={args.ny_local} n_iter={args.n_iter} dtype={args.dtype}"
         )
-        rc = 1
-    return rc
+
+        dx = Domain1D(n_global=px * args.nx_local, n_shards=px)
+        dy = Domain1D(n_global=py * args.ny_local, n_shards=py)
+        zf, _ = analytic_pairs()["2d_dim0"]
+
+        gx, gy = px * dx.n_ghosted, py * dy.n_ghosted
+        zg_host = np.zeros((gx, gy), dtype=dtype)
+        for rx in range(px):
+            for ry in range(py):
+                zg_host[
+                    rx * dx.n_ghosted:(rx + 1) * dx.n_ghosted,
+                    ry * dy.n_ghosted:(ry + 1) * dy.n_ghosted,
+                ] = _init_block(dx, dy, rx, ry, px, py, zf, dtype)
+        zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
+
+        step, kernel = _common.pick_kernel_tier(
+            lambda k: step2d_fn(
+                mesh, "x", "y", N_BND, float(dx.scale), float(dy.scale),
+                kernel=k,
+            ),
+            (jax.ShapeDtypeStruct(zs.shape, zs.dtype),),
+            args.kernel,
+            rep,
+        )
+
+        timer = PhaseTimer(skip_first=args.n_warmup)
+        out = None
+        for _ in range(args.n_warmup + args.n_iter):
+            out = timer.timed("step", step, zs)
+        dz_dx, dz_dy, residual = out
+        seconds = timer.seconds["step"]
+
+        # err gates vs analytic derivatives over the global interior
+        rc = 0
+        if dz_dx.is_fully_addressable:
+            xs = np.arange(dx.n_global) * dx.delta
+            ys = np.arange(dy.n_global) * dy.delta
+            want_dx = (3.0 * xs[:, None] ** 2) + 0.0 * ys[None, :]
+            want_dy = 0.0 * xs[:, None] + 2.0 * ys[None, :]
+            got_dx = np.asarray(jax.device_get(dz_dx), np.float64)
+            got_dy = np.asarray(jax.device_get(dz_dy), np.float64)
+            err_dx = float(np.sqrt(np.mean((got_dx - want_dx) ** 2)))
+            err_dy = float(np.sqrt(np.mean((got_dy - want_dy) ** 2)))
+        else:  # multi-host: residual finiteness is the (weaker) gate
+            err_dx = err_dy = float("nan")
+        rep.line(
+            f"GRID TEST px:{px} py:{py}; {seconds:f}, "
+            f"err_dx={err_dx:e}, err_dy={err_dy:e}",
+            {"kind": "grid_test", "px": px, "py": py, "seconds": seconds,
+             "err_dx": err_dx, "err_dy": err_dy,
+             "residual": float(residual), "kernel": kernel},
+        )
+        rep.iter_line(0, "device", 0, "step", timer.mean("step"),
+                      timer.mins.get("step", 0.0), timer.maxs.get("step", 0.0))
+
+        if not np.isfinite(float(residual)):
+            rep.line(f"RESIDUAL FAIL: {residual}")
+            return 1
+        tol = args.tol if args.tol is not None else _default_tol(args, dx, dy)
+        if np.isfinite(err_dx) and max(err_dx, err_dy) > tol:
+            rep.line(
+                f"ERR_NORM FAIL grid: dx={err_dx:.8g} dy={err_dy:.8g} > "
+                f"tol {tol:.8g}"
+            )
+            rc = 1
+        return rc
 
 
 def _default_tol(args, dx, dy) -> float:

@@ -1,12 +1,21 @@
-"""Instrumentation: phase timers, trace ranges, structured reporting.
+"""Instrumentation: phase timers, trace ranges, structured reporting,
+comm-layer telemetry, run manifests, and hang watchdogs.
 
 TPU-native replacement for the reference's L4 (SURVEY.md §5.1, §5.5):
 NVTX ranges → XProf trace annotations; cudaProfilerStart/Stop gating →
 jax.profiler trace gating; MPI_Wtime/clock_gettime phase timers →
 perf_counter with mandatory block_until_ready discipline; printf result
-lines → stable formatted lines + JSONL.
+lines → stable formatted lines + JSONL. Beyond parity: telemetry spans +
+counters + flight recorder over every comm wrapper (telemetry.py), a
+self-describing run manifest (manifest.py), and cross-rank JSONL
+aggregation (aggregate.py, the ``tpumt-report`` entry point).
 """
 
 from tpu_mpi_tests.instrument.timers import PhaseTimer, block  # noqa: F401
 from tpu_mpi_tests.instrument.trace import ProfilerGate, trace_range  # noqa: F401
 from tpu_mpi_tests.instrument.report import Reporter  # noqa: F401
+from tpu_mpi_tests.instrument.telemetry import (  # noqa: F401
+    comm_span,
+    span_call,
+)
+from tpu_mpi_tests.instrument.manifest import run_manifest  # noqa: F401

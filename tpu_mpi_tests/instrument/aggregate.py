@@ -1,0 +1,254 @@
+"""``tpumt-report``: merge per-rank telemetry JSONL into one run summary.
+
+The reference's whole aggregation story is ``avg.sh`` — grep a pattern in
+``out-*.txt``, average the second field per file (``tpu/avg.py`` keeps that
+contract). This CLI is the structured successor for the JSONL the Reporter
+and the telemetry registry emit: given the per-rank files of one run (the
+auto-suffixed ``base.p<i>.jsonl`` set, or explicit paths), it merges them
+into:
+
+* a run header from the rank-0 manifest record;
+* per-phase stats across ranks (``kind: "time"`` records): mean/min/max of
+  each rank's total seconds, plus the max/min skew;
+* per-op stats across ranks (``kind: "span"`` records): op counts, total
+  payload bytes, mean seconds, bandwidth percentiles (p10/p50/p90 over all
+  ranks' spans), and skew of per-rank totals;
+* straggler detection: any phase/op whose slowest rank exceeds the fastest
+  by more than ``--skew-threshold`` (default 1.5×) is flagged with the
+  offending rank — the cross-rank question avg.sh could never answer.
+
+Pure stdlib (no jax import): usable on a login node against files copied
+off the pod. ``--json`` emits the summary as one JSON document instead of
+text lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from pathlib import Path
+
+
+def expand_rank_files(paths: list[str]) -> list[str]:
+    """Resolve CLI paths to the per-rank file set.
+
+    Each path expands to: the literal file if it exists, plus any
+    ``<stem>.p<i><suffix>`` siblings the multi-process Reporter suffixing
+    produced (so passing the un-suffixed ``--jsonl`` base path finds the
+    whole set). Globs pass through. Order is deterministic (sorted)."""
+    out: list[str] = []
+    for p in paths:
+        hits = set(glob.glob(p))
+        path = Path(p)
+        hits.update(glob.glob(str(path.with_suffix("")) + ".p*" + path.suffix))
+        out.extend(sorted(hits) or [p])
+    # dedupe, keep order
+    seen: set[str] = set()
+    return [f for f in out if not (f in seen or seen.add(f))]
+
+
+def _load_records(path: str) -> list[dict]:
+    records = []
+    try:
+        text = Path(path).read_text()
+    except OSError as e:
+        print(f"tpumt-report: cannot open {path}: {e}", file=sys.stderr)
+        return records
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on pre-sorted values."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(
+        len(sorted_vals) - 1, max(0, round(q / 100 * (len(sorted_vals) - 1)))
+    )
+    return sorted_vals[idx]
+
+
+def _skew(per_rank_totals: dict) -> tuple[float, int | None]:
+    """(max/min ratio, rank holding the max) over per-rank totals."""
+    vals = {r: t for r, t in per_rank_totals.items() if t > 0}
+    if len(vals) < 2:
+        return 1.0, None
+    worst = max(vals, key=vals.get)
+    return vals[worst] / min(vals.values()), worst
+
+
+def summarize(files: list[str]) -> dict:
+    """Merge per-rank record streams into the summary structure."""
+    manifest = None
+    manifests = 0
+    phases: dict[str, dict] = {}
+    ops: dict[str, dict] = {}
+
+    for file_idx, path in enumerate(files):
+        file_rank = file_idx
+        for rec in _load_records(path):
+            kind = rec.get("kind")
+            if kind == "manifest":
+                manifests += 1
+                file_rank = rec.get("process_index", file_rank)
+                if manifest is None or rec.get("process_index") == 0:
+                    manifest = rec
+            elif kind == "time":
+                rank = rec.get("rank", file_rank)
+                secs = float(rec.get("seconds", 0.0))
+                ph = phases.setdefault(
+                    rec.get("phase", "?"), {"per_rank": {}, "count": 0}
+                )
+                ph["per_rank"][rank] = ph["per_rank"].get(rank, 0.0) + secs
+                ph["count"] += 1
+            elif kind == "span":
+                rank = rec.get("rank", file_rank)
+                secs = float(rec.get("seconds") or 0.0)
+                op = ops.setdefault(
+                    rec.get("op", "?"),
+                    {"per_rank": {}, "ops": 0, "bytes": 0, "gbps": []},
+                )
+                op["per_rank"][rank] = op["per_rank"].get(rank, 0.0) + secs
+                op["ops"] += 1
+                op["bytes"] += int(rec.get("nbytes") or 0)
+                if rec.get("gbps"):
+                    op["gbps"].append(float(rec["gbps"]))
+
+    def _stats(per_rank: dict) -> dict:
+        vals = list(per_rank.values())
+        skew, worst = _skew(per_rank)
+        return {
+            "ranks": len(per_rank),
+            "mean_s": sum(vals) / len(vals) if vals else 0.0,
+            "min_s": min(vals) if vals else 0.0,
+            "max_s": max(vals) if vals else 0.0,
+            "skew": skew,
+            "straggler_rank": worst,
+            "per_rank_s": {str(r): per_rank[r] for r in sorted(per_rank)},
+        }
+
+    summary = {
+        "files": list(files),
+        "manifest": manifest,
+        "manifest_count": manifests,
+        "phases": {},
+        "ops": {},
+    }
+    for name in sorted(phases):
+        summary["phases"][name] = {
+            "count": phases[name]["count"],
+            **_stats(phases[name]["per_rank"]),
+        }
+    for name in sorted(ops):
+        o = ops[name]
+        gbps = sorted(o["gbps"])
+        summary["ops"][name] = {
+            "ops": o["ops"],
+            "bytes": o["bytes"],
+            "gbps_p10": _percentile(gbps, 10),
+            "gbps_p50": _percentile(gbps, 50),
+            "gbps_p90": _percentile(gbps, 90),
+            **_stats(o["per_rank"]),
+        }
+    return summary
+
+
+def _print_text(summary: dict, skew_threshold: float) -> None:
+    m = summary["manifest"]
+    if m:
+        kinds = ",".join(m.get("device_kinds", []))
+        print(
+            f"RUN {m.get('platform', '?')}x{m.get('global_device_count', 0)}"
+            f" ({kinds}) procs={m.get('process_count', 1)}"
+            f" jax={m.get('jax', '?')} git={m.get('git_sha') or 'unknown'}"
+        )
+        print(f"ARGV {' '.join(m.get('argv', []))}")
+    print(f"FILES {len(summary['files'])}: {' '.join(summary['files'])}")
+
+    for name, ph in summary["phases"].items():
+        print(
+            f"PHASE {name}: ranks={ph['ranks']} n={ph['count']} "
+            f"mean={ph['mean_s']:.6g} min={ph['min_s']:.6g} "
+            f"max={ph['max_s']:.6g} skew={ph['skew']:.3g}"
+        )
+    for name, op in summary["ops"].items():
+        gb = (
+            f" gbps p10/p50/p90={op['gbps_p10']:.4g}/"
+            f"{op['gbps_p50']:.4g}/{op['gbps_p90']:.4g}"
+            if op["gbps_p50"] == op["gbps_p50"]  # not NaN
+            else ""
+        )
+        print(
+            f"OP {name}: ranks={op['ranks']} ops={op['ops']} "
+            f"bytes={op['bytes']} mean={op['mean_s']:.6g} "
+            f"min={op['min_s']:.6g} max={op['max_s']:.6g} "
+            f"skew={op['skew']:.3g}{gb}"
+        )
+
+    stragglers = 0
+    for label, table in (("PHASE", summary["phases"]),
+                         ("OP", summary["ops"])):
+        for name, st in table.items():
+            if st["skew"] > skew_threshold and st["straggler_rank"] is not None:
+                stragglers += 1
+                print(
+                    f"STRAGGLER {label} {name}: rank "
+                    f"{st['straggler_rank']} is {st['skew']:.3g}x the "
+                    f"fastest rank ({st['max_s']:.6g}s vs {st['min_s']:.6g}s)"
+                )
+    if not stragglers:
+        print(f"OK no stragglers above {skew_threshold:g}x")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpumt-report",
+        description="merge per-rank telemetry JSONL into a run summary "
+        "(per-phase/per-op cross-rank stats + straggler detection)",
+    )
+    p.add_argument(
+        "files",
+        nargs="+",
+        help="per-rank JSONL files; an un-suffixed --jsonl base path "
+        "expands to its .p<i> rank set",
+    )
+    p.add_argument(
+        "--skew-threshold",
+        type=float,
+        default=1.5,
+        metavar="X",
+        help="flag a phase/op when max rank time > X * min (default 1.5)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the summary as one JSON document instead of text",
+    )
+    args = p.parse_args(argv)
+
+    files = [f for f in expand_rank_files(args.files) if Path(f).exists()]
+    if not files:
+        print("tpumt-report: no input files found", file=sys.stderr)
+        return 1
+    summary = summarize(files)
+    if args.json:
+        json.dump(summary, sys.stdout, indent=1)
+        print()
+    else:
+        _print_text(summary, args.skew_threshold)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

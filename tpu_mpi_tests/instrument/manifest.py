@@ -1,0 +1,97 @@
+"""Run manifest: make every result file self-describing.
+
+The reference identified runs by filename convention (``out-<tag>.txt``)
+and tribal knowledge of which node/allocation produced them; nothing in
+the file says what hardware, software, or configuration generated the
+numbers. The manifest is the first JSONL record of every instrumented run
+(``kind: "manifest"``) plus a rank-0 banner line: device topology, process
+index/count, jax/jaxlib/libtpu versions, the relevant ``TPU_MPI_*``/JAX
+environment flags, argv, and the git sha — enough to re-run or disqualify
+a result file months later without asking who produced it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+#: env-var prefixes worth capturing — the framework's own knobs plus the
+#: JAX/XLA/libtpu switches that change what the numbers mean
+ENV_PREFIXES = ("TPU_MPI_", "JAX_", "XLA_", "LIBTPU_", "TPU_")
+
+
+def _git_sha() -> str | None:
+    """Best-effort short sha of the source tree; never raises."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=Path(__file__).resolve().parent,
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def run_manifest(argv: list[str] | None = None, **extra) -> dict:
+    """Build the manifest record. Requires an initialized JAX backend
+    (drivers call it after ``setup_platform``/``bootstrap``); ``extra``
+    key/values are merged in (driver-specific config)."""
+    import platform as _platform
+    import socket
+
+    import jax
+
+    devices = jax.devices()
+    env = {
+        k: v
+        for k, v in sorted(os.environ.items())
+        if k.startswith(ENV_PREFIXES)
+    }
+    record = {
+        "kind": "manifest",
+        "time_unix": time.time(),
+        "time_iso": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "argv": list(sys.argv if argv is None else argv),
+        "hostname": socket.gethostname(),
+        "python": _platform.python_version(),
+        "jax": jax.__version__,
+        "jaxlib": _version_of("jaxlib"),
+        "libtpu": _version_of("libtpu"),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": len(devices),
+        "platform": devices[0].platform,
+        "device_kinds": sorted({d.device_kind for d in devices}),
+        "env": env,
+        "git_sha": _git_sha(),
+    }
+    record.update(extra)
+    return record
+
+
+def _version_of(module: str) -> str | None:
+    try:
+        import importlib
+
+        return getattr(importlib.import_module(module), "__version__", None)
+    except ImportError:
+        return None
+
+
+def manifest_banner(m: dict) -> str:
+    """One-line run identity for the rank-0 banner."""
+    kinds = ",".join(m.get("device_kinds", [])) or "?"
+    sha = m.get("git_sha") or "unknown"
+    return (
+        f"MANIFEST {m.get('platform', '?')}x{m.get('global_device_count', 0)}"
+        f" ({kinds}) proc {m.get('process_index', 0)}/"
+        f"{m.get('process_count', 1)} jax={m.get('jax', '?')} git={sha}"
+    )

@@ -17,7 +17,19 @@ from __future__ import annotations
 
 import json
 import sys
+from pathlib import Path
 from typing import Any, IO
+
+
+def rank_suffixed_path(path: str, proc_index: int) -> str:
+    """``out.jsonl`` → ``out.p<i>.jsonl`` — one file per process.
+
+    Multiple processes appending to one JSONL path interleave partial
+    lines (plain ``open(.., "a")`` writes are not atomic across hosts), so
+    multi-process runs write per-rank files; ``tpumt-report`` and
+    ``tpu/avg.py`` glob the suffixed set back together."""
+    p = Path(path)
+    return str(p.with_suffix("")) + f".p{proc_index}" + p.suffix
 
 
 class Reporter:
@@ -27,6 +39,14 @@ class Reporter:
     multiple ranks in one process pass logical values. Banner lines
     (run-config prints) are rank-0 only, like the reference's
     (``mpi_stencil2d_gt.cc:682-688``).
+
+    A context manager: ``with Reporter(...) as rep`` closes the JSONL
+    file handle on exit (and flushes the telemetry summary when
+    :meth:`attach_telemetry` opted in). ``proc_index``/``proc_count``
+    describe the real process topology (as opposed to the logical
+    ``rank``/``size``, which may be emulated): with more than one process
+    the JSONL path is auto-suffixed per process so ranks never corrupt a
+    shared file.
     """
 
     def __init__(
@@ -35,12 +55,23 @@ class Reporter:
         size: int = 1,
         jsonl_path: str | None = None,
         stream: IO[str] | None = None,
+        proc_index: int = 0,
+        proc_count: int = 1,
     ):
         self.rank = rank
         self.size = size
+        if jsonl_path and proc_count > 1:
+            jsonl_path = rank_suffixed_path(jsonl_path, proc_index)
         self.jsonl_path = jsonl_path
         self.stream = stream or sys.stdout
         self._jsonl_file: IO[str] | None = None
+        self._telemetry = False
+
+    def __enter__(self) -> "Reporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def line(self, text: str, record: dict[str, Any] | None = None):
         print(text, file=self.stream, flush=True)
@@ -110,6 +141,32 @@ class Reporter:
              "ms_per_iter": float(ms_per_iter)},
         )
 
+    def time_lines(self, timer, stats: bool = False):
+        """Emit one ``TIME`` line per accumulated phase of a
+        :class:`~tpu_mpi_tests.instrument.timers.PhaseTimer`; with
+        ``stats`` the line carries count/mean/min/max (the per-iteration
+        distribution the timer already collects), and the JSONL ``time``
+        record always carries them — jitter is diagnosable offline even
+        when the stdout stays in the reference's terse shape."""
+        for text in timer.lines(stats=stats):
+            print(text, file=self.stream, flush=True)
+        for name in timer.seconds:
+            self.jsonl(
+                {"kind": "time", "phase": name,
+                 "seconds": float(timer.seconds[name]),
+                 "count": timer.counts[name],
+                 "mean_s": timer.mean(name),
+                 "min_s": timer.mins.get(name, 0.0),
+                 "max_s": timer.maxs.get(name, 0.0),
+                 "rank": self.rank}
+            )
+
+    def attach_telemetry(self):
+        """Opt in to flushing the telemetry registry on close: per-op
+        counter lines + ``telemetry_summary`` JSONL records, then the
+        registry is disabled (its sink points at this reporter)."""
+        self._telemetry = True
+
     def jsonl(self, record: dict[str, Any]):
         if not self.jsonl_path:
             return
@@ -120,6 +177,18 @@ class Reporter:
         self._jsonl_file.flush()
 
     def close(self):
+        if self._telemetry:
+            self._telemetry = False
+            from tpu_mpi_tests.instrument import telemetry as T
+
+            for op, c in sorted(T.counters().items()):
+                self.line(
+                    f"TELEMETRY {op} : ops={c['ops']} bytes={c['bytes']} "
+                    f"seconds={c['seconds']:0.6f}",
+                    {"kind": "telemetry_summary", "op": op, "rank": self.rank,
+                     **c},
+                )
+            T.disable()
         if self._jsonl_file is not None:
             self._jsonl_file.close()
             self._jsonl_file = None

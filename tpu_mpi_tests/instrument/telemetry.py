@@ -1,0 +1,299 @@
+"""Comm-layer telemetry: spans, cumulative counters, and a flight recorder.
+
+The reference's observability is NVTX ranges plus printf lines averaged
+offline (SURVEY.md §5.5); nothing records what the communication layer —
+the thing this suite exists to measure — actually did. This module is the
+missing recorder, three pieces sharing one process-wide registry:
+
+* **Spans** (:func:`comm_span` / :func:`span_call`): every public
+  collective/halo/ring/alltoall wrapper brackets its dispatch in a span
+  that records op kind, payload bytes, mesh axis, wall seconds, and the
+  derived bandwidth. Spans are *sync-honest* the same way
+  :class:`~tpu_mpi_tests.instrument.timers.PhaseTimer` is: the span blocks
+  (:func:`~tpu_mpi_tests.instrument.timers.block`) on the op's result
+  before reading the clock, otherwise async dispatch would attribute the
+  time to whoever flushes the queue. Recording is OFF by default — a
+  disabled span is one attribute check, so instrumented wrappers cost
+  nothing in benchmarks that did not opt in (``--telemetry``).
+
+* **Counters**: cumulative ops/bytes/seconds per op kind, queryable by
+  drivers and tests (:func:`counters`) and emitted as a
+  ``telemetry_summary`` JSONL record when the driver's Reporter closes.
+
+* **Flight recorder**: a bounded ring buffer of recent comm events that
+  replaces the watchdog's single sticky ``_last_comm_op`` string. Dispatch
+  notes (:func:`note_dispatch` — e.g. the hand-written RDMA ring
+  registering itself before a potentially-wedging DMA) are recorded even
+  when telemetry is disabled: they are one deque append, and they are
+  exactly what a hang dump needs. A watchdog fire or fatal error dumps the
+  last N events with ages (:func:`flight_lines`) instead of one string.
+
+Payload-byte conventions are documented per wrapper; bandwidth is
+``nbytes / seconds`` — an *algorithmic* rate (like nccl-tests' busbw), not
+a per-link measurement.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+#: default flight-recorder depth; the watchdog dumps up to this many events
+#: (the acceptance floor is 8 — keep comfortably above it)
+FLIGHT_CAPACITY = 64
+
+
+@dataclass
+class CommEvent:
+    """One recorded communication event (a completed span or a dispatch
+    note). ``seconds``/``gbps`` are None for dispatch-only notes — the op
+    was handed to the device but never synced through a span."""
+
+    op: str
+    nbytes: int = 0
+    axis_name: str | None = None
+    world: int = 1
+    seconds: float | None = None
+    gbps: float | None = None
+    wall_time: float = 0.0
+    note: str | None = None
+    meta: dict[str, Any] = field(default_factory=dict)
+
+    def describe(self, now: float | None = None) -> str:
+        """Human line for hang dumps: op, payload, axis, duration, age."""
+        age = (now if now is not None else time.time()) - self.wall_time
+        parts = [self.note or self.op]
+        if self.nbytes:
+            parts.append(f"{self.nbytes}B")
+        if self.axis_name:
+            parts.append(f"axis={self.axis_name}x{self.world}")
+        if self.seconds is not None:
+            parts.append(f"{self.seconds * 1e3:.3f}ms")
+            if self.gbps is not None:
+                parts.append(f"{self.gbps:.2f}GB/s")
+        else:
+            parts.append("dispatched")
+        parts.append(f"{age:.1f}s ago")
+        return " ".join(parts)
+
+    def record(self) -> dict[str, Any]:
+        """JSONL record shape (``kind: "span"``)."""
+        rec: dict[str, Any] = {
+            "kind": "span",
+            "op": self.op,
+            "nbytes": self.nbytes,
+            "axis": self.axis_name,
+            "world": self.world,
+            "seconds": self.seconds,
+            "gbps": self.gbps,
+        }
+        if self.meta:
+            rec.update(self.meta)
+        return rec
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring buffer of recent :class:`CommEvent`."""
+
+    def __init__(self, capacity: int = FLIGHT_CAPACITY):
+        self._events: deque[CommEvent] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def push(self, event: CommEvent) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def recent(self, n: int | None = None) -> list[CommEvent]:
+        """Most recent events, oldest first."""
+        with self._lock:
+            events = list(self._events)
+        return events if n is None else events[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+class Telemetry:
+    """Process-wide registry: enable/disable switch, counters, flight
+    recorder, and an optional per-event sink (the Reporter's JSONL)."""
+
+    def __init__(self, flight_capacity: int = FLIGHT_CAPACITY):
+        self.enabled = False
+        self.flight = FlightRecorder(flight_capacity)
+        self._sink: Callable[[dict], None] | None = None
+        self._lock = threading.Lock()
+        # op -> [ops, bytes, seconds]
+        self._counters: dict[str, list] = {}
+
+    def enable(self, sink: Callable[[dict], None] | None = None) -> None:
+        self._sink = sink
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+        self._sink = None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+        self.flight.clear()
+
+    def record(self, event: CommEvent) -> None:
+        """Record a completed span: counters + flight recorder + sink."""
+        with self._lock:
+            c = self._counters.setdefault(event.op, [0, 0, 0.0])
+            c[0] += 1
+            c[1] += event.nbytes
+            c[2] += event.seconds or 0.0
+        self.flight.push(event)
+        if self._sink is not None:
+            self._sink(event.record())
+
+    def counters(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            return {
+                op: {"ops": c[0], "bytes": c[1], "seconds": c[2]}
+                for op, c in self._counters.items()
+            }
+
+
+_TELEMETRY = Telemetry()
+
+
+def registry() -> Telemetry:
+    """The process-wide telemetry registry."""
+    return _TELEMETRY
+
+
+def enable(sink: Callable[[dict], None] | None = None) -> None:
+    _TELEMETRY.enable(sink)
+
+
+def disable() -> None:
+    _TELEMETRY.disable()
+
+
+def counters() -> dict[str, dict[str, Any]]:
+    """Cumulative per-op counters: ``{op: {ops, bytes, seconds}}``."""
+    return _TELEMETRY.counters()
+
+
+def note_dispatch(desc: str, **meta) -> None:
+    """Record a dispatch-only event in the flight recorder (always on —
+    one deque append). Used for ops that may wedge before any span can
+    close, e.g. the hand-written RDMA ring's DMA semaphores."""
+    _TELEMETRY.flight.push(
+        CommEvent(
+            op=meta.pop("op", "dispatch"),
+            note=desc,
+            wall_time=time.time(),
+            meta=meta,
+        )
+    )
+
+
+def flight_events(n: int | None = None) -> list[CommEvent]:
+    return _TELEMETRY.flight.recent(n)
+
+
+def flight_lines(n: int = 16) -> list[str]:
+    """Formatted dump of the last ``n`` comm events, oldest first."""
+    now = time.time()
+    return [e.describe(now) for e in _TELEMETRY.flight.recent(n)]
+
+
+class _Span:
+    """Mutable handle yielded by :func:`comm_span`; set ``result`` to the
+    op's output pytree so the span can sync-honestly block before timing."""
+
+    __slots__ = ("result",)
+
+    def __init__(self):
+        self.result = None
+
+
+def _under_trace() -> bool:
+    """True inside a jax trace (jit/scan/fori_loop body). Spans must not
+    record there: the wrapper runs ONCE at trace time, so its clock reads
+    would fabricate telemetry for the whole compiled loop (ops=1,
+    trace-duration seconds), and blocking on a tracer is meaningless or
+    worse (the hard-sync path reads device buffers that do not exist).
+    Used only on the enabled path — the disabled path never pays it."""
+    try:
+        from jax import core
+
+        return not core.trace_state_clean()
+    except Exception:
+        return False
+
+
+@contextmanager
+def comm_span(
+    op: str,
+    nbytes: int = 0,
+    axis_name: str | None = None,
+    world: int = 1,
+    **meta,
+):
+    """Record one communication op: wall seconds (sync-honest when the
+    body assigns ``span.result``), payload bytes, and derived bandwidth.
+
+    No-ops (yielding an inert span) when telemetry is disabled, so
+    instrumented wrappers are free for benchmarks that did not opt in.
+    Spans nest: each level records its own event and counter line.
+    """
+    reg = _TELEMETRY
+    if not reg.enabled or _under_trace():
+        yield _Span()
+        return
+    from tpu_mpi_tests.instrument.timers import block
+
+    span = _Span()
+    t0 = time.perf_counter()
+    try:
+        yield span
+    finally:
+        if span.result is not None:
+            block(span.result)
+        dt = time.perf_counter() - t0
+        gbps = (nbytes / dt / 1e9) if (nbytes and dt > 0) else None
+        reg.record(
+            CommEvent(
+                op=op,
+                nbytes=int(nbytes),
+                axis_name=axis_name,
+                world=world,
+                seconds=dt,
+                gbps=gbps,
+                wall_time=time.time(),
+                meta=meta,
+            )
+        )
+
+
+def span_call(
+    op: str,
+    fn: Callable,
+    *args,
+    nbytes: int = 0,
+    axis_name: str | None = None,
+    world: int = 1,
+    **meta,
+):
+    """``fn(*args)`` bracketed in a :func:`comm_span`, blocking on the
+    result. The disabled path is a single attribute check plus the call;
+    under a jax trace the call passes through unrecorded (see
+    :func:`_under_trace`)."""
+    if not _TELEMETRY.enabled or _under_trace():
+        return fn(*args)
+    with comm_span(
+        op, nbytes=nbytes, axis_name=axis_name, world=world, **meta
+    ) as span:
+        out = fn(*args)
+        span.result = out
+    return out

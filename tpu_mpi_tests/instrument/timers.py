@@ -205,13 +205,23 @@ class PhaseTimer:
         c = self.counts[name]
         return self.seconds[name] / c if c else 0.0
 
-    def lines(self, prefix: str = "TIME") -> list[str]:
+    def lines(self, prefix: str = "TIME", stats: bool = False) -> list[str]:
         """Stable per-phase lines (≅ ``TIME <phase> : %0.3f``,
-        ``mpi_daxpy_nvtx.cc:333-340``)."""
-        return [
-            f"{prefix} {name} : {self.seconds[name]:0.6f}"
-            for name in self.seconds
-        ]
+        ``mpi_daxpy_nvtx.cc:333-340``). ``stats`` appends the
+        per-entry distribution the timer already accumulates
+        (count/mean/min/max — max≫mean exposes a slow link as jitter)
+        without disturbing the reference-shaped prefix."""
+        out = []
+        for name in self.seconds:
+            line = f"{prefix} {name} : {self.seconds[name]:0.6f}"
+            if stats:
+                line += (
+                    f" count={self.counts[name]} mean={self.mean(name):e}"
+                    f" min={self.mins.get(name, 0.0):e}"
+                    f" max={self.maxs.get(name, 0.0):e}"
+                )
+            out.append(line)
+        return out
 
     def as_dict(self) -> dict[str, float]:
         return dict(self.seconds)

@@ -8,6 +8,13 @@ a process drops out, so the framework provides the missing piece: a
 deadline that dumps a diagnosis and hard-exits the process, turning a
 silent multi-hour stall into an immediate, attributable failure
 (SURVEY.md §5.3 — elastic recovery stays out of scope; detection is in).
+
+Attribution comes from the telemetry flight recorder
+(``instrument/telemetry.py``): every comm wrapper's span and every RDMA
+dispatch note lands in a bounded ring buffer, and a watchdog fire dumps
+the last N events with ages — not just the single most recent op, but the
+recent *history*, which is what distinguishes "wedged on the first
+collective" from "ran 10k exchanges then stalled".
 """
 
 from __future__ import annotations
@@ -15,34 +22,37 @@ from __future__ import annotations
 import os
 import sys
 import threading
-import time
 from contextlib import contextmanager
 
-_last_comm_op: tuple[str, float] | None = None
-_last_comm_lock = threading.Lock()
+from tpu_mpi_tests.instrument import telemetry as _telemetry
+
+#: how many flight-recorder events a watchdog fire dumps
+DUMP_EVENTS = 16
 
 
 def note_comm_op(desc: str) -> None:
-    """Record the most recently *dispatched* communication op (sticky).
+    """Record a *dispatched* communication op in the flight recorder.
 
     Dispatch is async, so a hang surfaces later at a sync point; with
-    in-order device queues the last dispatched comm op is the best available
-    attribution for what wedged. The hand-written RDMA ring records itself
-    here because a stuck DMA semaphore/neighborhood barrier is otherwise a
-    silent hang with no MPI_ERROR analog (VERDICT r1 missing #4; ≅ the
-    per-request ``MPI_ERROR`` prints, ``mpi_stencil2d_gt.cc:230-247``)."""
-    global _last_comm_op
-    with _last_comm_lock:
-        _last_comm_op = (desc, time.time())
+    in-order device queues the recently dispatched comm ops are the best
+    available attribution for what wedged. The hand-written RDMA ring
+    records itself here because a stuck DMA semaphore/neighborhood barrier
+    is otherwise a silent hang with no MPI_ERROR analog (VERDICT r1
+    missing #4; ≅ the per-request ``MPI_ERROR`` prints,
+    ``mpi_stencil2d_gt.cc:230-247``). Recorded even when span telemetry is
+    disabled — one ring-buffer append."""
+    _telemetry.note_dispatch(desc)
 
 
 def last_comm_op() -> str | None:
-    """Human-readable last-dispatched comm op, with age."""
-    with _last_comm_lock:
-        if _last_comm_op is None:
-            return None
-        desc, ts = _last_comm_op
-        return f"{desc} (dispatched {time.time() - ts:.1f}s ago)"
+    """Human-readable most recent comm event, with age."""
+    lines = _telemetry.flight_lines(1)
+    return lines[-1] if lines else None
+
+
+def comm_op_history(n: int = DUMP_EVENTS) -> list[str]:
+    """The last ``n`` recorded comm events (oldest first), formatted."""
+    return _telemetry.flight_lines(n)
 
 
 class Watchdog:
@@ -59,10 +69,15 @@ class Watchdog:
         self._timer: threading.Timer | None = None
 
     def _fire(self):
-        op = last_comm_op()
-        attribution = (
-            f" last dispatched comm op: {op};" if op is not None else ""
-        )
+        history = comm_op_history()
+        if history:
+            attribution = (
+                f" last {len(history)} comm ops (newest last):\n    "
+                + "\n    ".join(history)
+                + "\n "
+            )
+        else:
+            attribution = ""
         msg = (
             f"WATCHDOG: phase '{self.phase}' exceeded {self.seconds}s — "
             f"likely a hung collective (dead peer / mismatched mesh / "

@@ -29,6 +29,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_mpi_tests.compat import axis_size, tpu_compiler_params
 from tpu_mpi_tests.kernels.stencil import N_BND, STENCIL5
 
 
@@ -1704,7 +1705,7 @@ def _ring_edge_kernel(cur_lo_ref, cur_hi_ref, lo_edge_ref, hi_edge_ref,
     sends + barrier) unchanged.
     """
     del cur_lo_ref, cur_hi_ref  # alias donors; their data is already in new_*
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     # idx is int32; keep the modulus int32 too (x64 would promote the int)
     right = jax.lax.rem(idx + 1, jnp.int32(n_dev))
@@ -1844,7 +1845,7 @@ def ring_halo_pallas(
             pltpu.SemaphoreType.DMA((2,)),
         ],
         input_output_aliases={0: 0, 1: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interp,
@@ -1852,7 +1853,7 @@ def ring_halo_pallas(
     if serial and not periodic:
         # symmetric interpret mode sent the wrap-around pair too; put the
         # physical ghosts back on the ring-edge ranks
-        n_dev = jax.lax.axis_size(axis_name)
+        n_dev = axis_size(axis_name)
         idx = jax.lax.axis_index(axis_name)
         new_lo = jnp.where(idx == 0, cur_lo, new_lo)
         new_hi = jnp.where(idx == n_dev - 1, cur_hi, new_hi)
@@ -1890,7 +1891,7 @@ def _ring_allgather_kernel(x_ref, out_ref, copy_sem, send_sem, recv_sem,
         my = jnp.int32(0)
         right = left = jax.lax.axis_index(axis_name)  # myself
     else:
-        n_dev = jax.lax.axis_size(axis_name)
+        n_dev = axis_size(axis_name)
         my = jax.lax.axis_index(axis_name)
         right = jax.lax.rem(my + 1, jnp.int32(n_dev))
         left = jax.lax.rem(my - 1 + jnp.int32(n_dev), jnp.int32(n_dev))
@@ -2003,7 +2004,7 @@ def ring_allgather_pallas(
             f"{jnp.dtype(x.dtype).name} (sublane tile), got {n}"
         )
     interp = _auto_interpret(interpret)
-    n_dev = jax.lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     if self_ring is not None:
         if n_dev != 1 or self_ring < 2:
             raise ValueError(
@@ -2030,7 +2031,7 @@ def ring_allgather_pallas(
             pltpu.SemaphoreType.DMA((max(1, n_dev - 1),)),
             pltpu.SemaphoreType.DMA((max(1, n_dev - 1),)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interp,
@@ -2224,7 +2225,7 @@ def ring_reduce_scatter_pallas(
     credits would be unsafe still holds — the negative control
     demonstrates the hazard class)."""
     sublane = max(8, 8 * 4 // jnp.dtype(x.dtype).itemsize)
-    w = jax.lax.axis_size(axis_name)
+    w = axis_size(axis_name)
     if self_ring is not None:
         if w != 1 or self_ring < 2:
             raise ValueError(
@@ -2317,7 +2318,7 @@ def ring_reduce_scatter_pallas(
             pltpu.SemaphoreType.DMA((credits,)),
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interp,
@@ -2351,7 +2352,7 @@ def ring_allreduce_pallas(
         interpret=interpret,
         credits=credits,
     )
-    if jax.lax.axis_size(axis_name) == 1:
+    if axis_size(axis_name) == 1:
         return rs
     # the reduce-scatter's n % w·128·sublane floor implies the allgather's
     # n % 128·sublane, so the chunk always re-enters cleanly (1-D included:
