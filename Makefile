@@ -1,6 +1,6 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
-.PHONY: test verify native bench smoke clean
+.PHONY: test verify native bench smoke trace-smoke ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -22,6 +22,25 @@ smoke:
 	TPU_MPI_BENCH_ITERS_LONG=1050 TPU_MPI_BENCH_FAKE_DEVICES=4 \
 	python bench.py
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# timeline-pipeline smoke: a 2-fake-device daxpy run records telemetry
+# JSONL and auto-merges it into a Chrome trace on exit; the check
+# asserts the trace is non-empty valid JSON with placeable events
+trace-smoke:
+	rm -f /tmp/_tpumt_trace_smoke*.json*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.daxpy \
+		--fake-devices 2 --n 4096 --telemetry \
+		--jsonl /tmp/_tpumt_trace_smoke.jsonl \
+		--trace-out /tmp/_tpumt_trace_smoke.trace.json
+	python -c "import json; \
+		d = json.load(open('/tmp/_tpumt_trace_smoke.trace.json')); \
+		evs = [e for e in d['traceEvents'] if e['ph'] != 'M']; \
+		assert evs, 'trace has no placeable events'; \
+		assert all('ts' in e and 'pid' in e for e in evs); \
+		print('trace-smoke OK:', len(evs), 'events')"
+
+# CI umbrella: the tier-1 gate plus the timeline-pipeline smoke
+ci: verify trace-smoke
 
 clean:
 	$(MAKE) -C native clean

@@ -109,6 +109,25 @@ def test_graft_entry_single_chip():
     assert "OK" in r.stdout
 
 
+def test_tpumt_trace_help():
+    """The tpumt-trace console script parses --help (and pyproject maps
+    the script to the module entry, so the installed binary and the
+    ``python -m`` form stay one implementation)."""
+    r = run_py(
+        "import sys, tpu_mpi_tests.instrument.timeline as t\n"
+        "try:\n"
+        "    t.main(['--help'])\n"
+        "except SystemExit as e:\n"
+        "    sys.exit(e.code or 0)\n"
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "tpumt-trace" in r.stdout
+    assert "Perfetto" in r.stdout
+    pyproject = (REPO / "pyproject.toml").read_text()
+    assert ('tpumt-trace = "tpu_mpi_tests.instrument.timeline:main"'
+            in pyproject)
+
+
 def test_graft_dryrun_multichip():
     r = run_py(
         "import __graft_entry__ as g\n"

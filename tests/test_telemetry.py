@@ -42,6 +42,16 @@ class TestCommSpan:
         assert r1["gbps"] == pytest.approx(
             1024 / r1["seconds"] / 1e9
         )
+        # timeline placement: wall + monotonic bounds; the wall pair is
+        # start + dt by construction, exact only to double resolution at
+        # epoch magnitude (~1 us), the monotonic pair exactly spans dt
+        assert r1["t_end"] - r1["t_start"] == pytest.approx(
+            r1["seconds"], abs=2e-6
+        )
+        assert r1["mono_end"] - r1["mono_start"] == pytest.approx(
+            r1["seconds"]
+        )
+        assert r2["t_start"] >= r1["t_end"]
 
     def test_nesting_records_each_level(self, fresh):
         fresh.enable()
@@ -219,6 +229,49 @@ class TestManifest:
         assert "jax=" in banner and "git=" in banner
 
 
+def test_clock_sync_single_process_is_zero_offset():
+    """One process = one clock: the alignment record is offset 0 with no
+    collective round (fake-device meshes share the host clock)."""
+    from tpu_mpi_tests.instrument.manifest import clock_sync_record
+
+    rec = clock_sync_record()
+    assert rec["kind"] == "clock_sync"
+    assert rec["offset_s"] == 0.0 and rec["spread_s"] == 0.0
+    assert rec["method"] == "single_process"
+    json.dumps(rec)  # JSONL-safe
+
+
+def test_dispatch_note_reaches_sink_when_enabled(fresh):
+    """Enabled telemetry mirrors flight-recorder dispatch notes into the
+    JSONL sink (kind "dispatch") so the timeline can show a wedged op's
+    last dispatch; disabled telemetry keeps them flight-only."""
+    records = []
+    T.note_dispatch("pre_enable_dma")  # disabled: flight only
+    fresh.enable(sink=records.append)
+    T.note_dispatch("ring_halo_pallas(world=8)", op="rdma")
+    assert [e.note for e in T.flight_events()] == [
+        "pre_enable_dma", "ring_halo_pallas(world=8)"
+    ]
+    (rec,) = records
+    assert rec["kind"] == "dispatch" and rec["op"] == "rdma"
+    assert rec["note"] == "ring_halo_pallas(world=8)"
+    assert rec["t"] > 0
+
+
+def test_watchdog_fire_emits_timeline_record(fresh):
+    """A watchdog fire lands a kind="watchdog" record in the sink — the
+    flow-terminating marker the trace renders — before the hang dump."""
+    from tpu_mpi_tests.instrument.watchdog import Watchdog
+
+    records = []
+    fresh.enable(sink=records.append)
+    Watchdog(30.0, "allgather", _on_timeout=lambda m: None)._fire()
+    wd = [r for r in records if r["kind"] == "watchdog"]
+    assert len(wd) == 1
+    assert wd[0]["phase"] == "allgather" and wd[0]["deadline_s"] == 30.0
+    assert wd[0]["t"] > 0
+
+
 def test_driver_telemetry_end_to_end(tmp_path, capsys, fresh):
     """--telemetry --jsonl: manifest first, span records per comm op,
     TELEMETRY counter lines + summary records on close (acceptance)."""
@@ -239,6 +292,12 @@ def test_driver_telemetry_end_to_end(tmp_path, capsys, fresh):
     halo = [r for r in spans if r["op"] == "halo_exchange"]
     assert halo and all(r["nbytes"] > 0 and r["seconds"] > 0 for r in halo)
     assert all("rank" in r for r in spans)
+    # acceptance: every span record is timeline-placeable
+    assert all(
+        r["t_start"] is not None and r["t_end"] >= r["t_start"]
+        for r in spans
+    )
+    assert any(r.get("kind") == "clock_sync" for r in recs)
     summaries = [r for r in recs if r.get("kind") == "telemetry_summary"]
     assert any(s["op"] == "halo_exchange" for s in summaries)
     assert "MANIFEST cpu" in out

@@ -1,0 +1,414 @@
+"""tpumt-trace (instrument/timeline.py): cross-rank timeline merging —
+Chrome trace-event export with clock offsets applied, the ASCII swimlane
+behind ``tpumt-report --timeline``, pre-timeline JSONL compatibility, and
+the driver ``--trace-out`` auto-merge."""
+
+import json
+
+import pytest
+
+from tpu_mpi_tests.instrument import timeline
+from tpu_mpi_tests.instrument.aggregate import (
+    expand_rank_files,
+    main as report_main,
+    summarize,
+)
+
+
+def _write_jsonl(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+@pytest.fixture()
+def two_rank_run(tmp_path):
+    """Two synthetic per-rank streams with KNOWN clock offsets: rank 1's
+    wall clock runs 0.5 s ahead of rank 0's. After alignment both ranks'
+    first all_gather starts at the same instant; the second starts 100 ms
+    later on rank 1 (a true 100 ms barrier skew at step 1)."""
+    _write_jsonl(tmp_path / "run.p0.jsonl", [
+        {"kind": "manifest", "process_index": 0, "process_count": 2},
+        {"kind": "clock_sync", "rank": 0, "offset_s": 0.0,
+         "method": "barrier_echo"},
+        {"kind": "span", "op": "all_gather", "nbytes": 1 << 20,
+         "gbps": 4.0, "axis": "shard", "world": 2, "seconds": 0.25,
+         "t_start": 100.0, "t_end": 100.25, "rank": 0},
+        {"kind": "span", "op": "all_gather", "nbytes": 1 << 20,
+         "seconds": 0.25, "t_start": 101.0, "t_end": 101.25, "rank": 0},
+        {"kind": "time", "phase": "exchange", "seconds": 1.0,
+         "t_start": 100.0, "t_end": 101.3, "rank": 0},
+        {"kind": "dispatch", "note": "ring_halo_pallas(world=2)",
+         "t": 100.9, "rank": 0},
+    ])
+    _write_jsonl(tmp_path / "run.p1.jsonl", [
+        {"kind": "manifest", "process_index": 1, "process_count": 2},
+        {"kind": "clock_sync", "rank": 1, "offset_s": 0.5,
+         "method": "barrier_echo"},
+        {"kind": "span", "op": "all_gather", "nbytes": 1 << 20,
+         "seconds": 0.25, "t_start": 100.5, "t_end": 100.75, "rank": 1},
+        {"kind": "span", "op": "all_gather", "nbytes": 1 << 20,
+         "seconds": 0.25, "t_start": 101.6, "t_end": 101.85, "rank": 1},
+        {"kind": "time", "phase": "exchange", "seconds": 1.1,
+         "t_start": 100.5, "t_end": 101.9, "rank": 1},
+        {"kind": "watchdog", "phase": "driver", "deadline_s": 60.0,
+         "t": 101.95, "rank": 1},
+    ])
+    return [str(tmp_path / "run.p0.jsonl"), str(tmp_path / "run.p1.jsonl")]
+
+
+class TestChromeTrace:
+    def test_golden_merge_offsets_applied(self, two_rank_run):
+        """The acceptance golden: valid trace-event fields, pid/tid per
+        rank, ts/dur in microseconds, rank 1 shifted by its 0.5 s
+        offset."""
+        doc = timeline.chrome_trace(two_rank_run)
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        for e in evs:  # schema every viewer requires
+            assert set(e) >= {"ph", "ts", "dur", "pid", "tid", "name"}
+        gather = sorted(
+            [e for e in evs if e["name"] == "all_gather"],
+            key=lambda e: (e["ts"], e["pid"]),
+        )
+        assert [e["pid"] for e in gather] == [0, 1, 0, 1]
+        assert all(e["tid"] == timeline.TID_COMM for e in gather)
+        # offsets applied: both step-0 gathers align at ts=0 even though
+        # rank 1 stamped t_start=100.5 on its (fast) local clock...
+        assert gather[0]["ts"] == 0.0
+        assert gather[1]["ts"] == pytest.approx(0.0, abs=1e-6)
+        # ...and step 1 keeps its REAL 100 ms skew (101.6-0.5 vs 101.0)
+        assert gather[2]["ts"] == pytest.approx(1.0e6)
+        assert gather[3]["ts"] == pytest.approx(1.1e6)
+        assert all(e["dur"] == pytest.approx(0.25e6) for e in gather)
+        # span annotations survive into args
+        assert gather[0]["args"]["nbytes"] == 1 << 20
+        assert gather[0]["args"]["gbps"] == 4.0
+        assert gather[0]["args"]["axis"] == "shard"
+        # phases land on the nested phase track
+        phases = [e for e in evs if e["name"] == "exchange"]
+        assert {e["pid"] for e in phases} == {0, 1}
+        assert all(e["tid"] == timeline.TID_PHASE for e in phases)
+        assert phases[0]["dur"] == pytest.approx(1.3e6)
+        # dispatch note -> thread instant; watchdog -> process instant
+        inst = {e["cat"]: e for e in doc["traceEvents"] if e["ph"] == "i"}
+        assert inst["dispatch"]["name"] == "ring_halo_pallas(world=2)"
+        assert inst["dispatch"]["s"] == "t"
+        assert inst["watchdog"]["name"] == "WATCHDOG driver"
+        assert inst["watchdog"]["s"] == "p" and inst["watchdog"]["pid"] == 1
+        assert inst["watchdog"]["ts"] == pytest.approx(1.45e6)
+        # per-rank track metadata
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert {(m["name"], m["pid"]) for m in meta} >= {
+            ("process_name", 0), ("process_name", 1)
+        }
+        assert doc["otherData"]["clock_offsets_s"] == {"0": 0.0, "1": 0.5}
+
+    def test_write_trace_round_trips_through_json_load(
+        self, two_rank_run, tmp_path
+    ):
+        out = tmp_path / "trace.json"
+        n = timeline.write_trace(two_rank_run, str(out))
+        doc = json.load(open(out))  # acceptance: json.load accepts it
+        assert n == 8  # 4 comm spans + 2 phases + 1 dispatch + 1 watchdog
+        assert len([e for e in doc["traceEvents"] if e["ph"] != "M"]) == n
+
+    def test_cli_main_expands_rank_set(self, two_rank_run, tmp_path):
+        base = two_rank_run[0].replace(".p0", "")
+        out = tmp_path / "t.json"
+        rc = timeline.main([base, "-o", str(out)])
+        assert rc == 0
+        doc = json.load(open(out))
+        assert {e["pid"] for e in doc["traceEvents"]} == {0, 1}
+
+    def test_cli_missing_files(self, tmp_path):
+        assert timeline.main([str(tmp_path / "nope.jsonl")]) == 1
+
+
+class TestPreTimelineCompat:
+    """Pre-PR JSONL (no t_start/t_end, no clock_sync) must neither crash
+    the trace merge nor the stats aggregation."""
+
+    @pytest.fixture()
+    def old_files(self, tmp_path):
+        _write_jsonl(tmp_path / "old.p0.jsonl", [
+            {"kind": "manifest", "process_index": 0},
+            {"kind": "time", "phase": "exchange", "seconds": 1.0,
+             "rank": 0},
+            {"kind": "span", "op": "all_gather", "nbytes": 64,
+             "seconds": 0.5, "gbps": 1.0, "rank": 0},
+        ])
+        return [str(tmp_path / "old.p0.jsonl")]
+
+    def test_trace_valid_but_empty(self, old_files, tmp_path):
+        out = tmp_path / "trace.json"
+        n = timeline.write_trace(old_files, str(out))
+        assert n == 0
+        doc = json.load(open(out))
+        assert [e for e in doc["traceEvents"] if e["ph"] != "M"] == []
+        assert doc["otherData"]["unplaced_records"] == 2
+
+    def test_report_still_aggregates(self, old_files):
+        s = summarize(old_files)
+        assert s["phases"]["exchange"]["mean_s"] == 1.0
+        assert s["ops"]["all_gather"]["ops"] == 1
+
+    def test_swimlane_says_no_timestamps(self, old_files):
+        (line,) = timeline.ascii_swimlane(old_files)
+        assert "no timestamped records" in line
+
+
+class TestAsciiSwimlane:
+    def test_lanes_and_skew_series(self, two_rank_run):
+        lines = timeline.ascii_swimlane(two_rank_run, width=40)
+        text = "\n".join(lines)
+        assert lines[0].startswith("TIMELINE ranks=2")
+        assert "PHASE exchange" in text
+        lanes = [ln for ln in lines if ln.strip().startswith("r")]
+        assert len(lanes) == 2
+        assert all("|" in ln and "#" in ln for ln in lanes)
+        # the known per-step skews: step0 aligned, step1 off by 100 ms
+        (skew,) = [ln for ln in lines if ln.startswith("SKEW all_gather")]
+        assert "over 2 steps" in skew
+        assert "0 100" in skew
+        assert "max 100ms @step 1" in skew
+
+    def test_report_timeline_mode(self, two_rank_run, capsys):
+        base = two_rank_run[0].replace(".p0", "")
+        rc = report_main(["--timeline", "--width", "32", base])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("TIMELINE ranks=2")
+        assert "SKEW all_gather" in out
+        # stats mode still works on the same files (both CLIs share the
+        # rank-set expansion)
+        assert report_main([base]) == 0
+        assert "OP all_gather" in capsys.readouterr().out
+
+
+def test_driver_trace_out_end_to_end(tmp_path, capsys):
+    """--trace-out: the daxpy driver merges its own JSONL into a valid
+    Perfetto-loadable trace on reporter close (phase spans placed, rank
+    track present, clock_sync recorded with offset 0 single-process)."""
+    from tpu_mpi_tests.drivers import daxpy
+
+    jl = tmp_path / "run.jsonl"
+    tr = tmp_path / "trace.json"
+    rc = daxpy.main(
+        ["--n", "256", "--dtype", "float32", "--telemetry",
+         "--jsonl", str(jl), "--trace-out", str(tr)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"TRACE {tr}" in out
+    recs = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    sync = [r for r in recs if r.get("kind") == "clock_sync"]
+    assert len(sync) == 1 and sync[0]["offset_s"] == 0.0
+    assert sync[0]["method"] == "single_process"
+    times = [r for r in recs if r.get("kind") == "time"]
+    assert times and all(
+        r["t_start"] is not None and r["t_end"] >= r["t_start"]
+        for r in times
+    )
+    doc = json.load(open(tr))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"copyInput", "kernel", "copyOutput"} <= names
+
+
+def test_trace_out_without_jsonl_notes_and_skips(capsys, tmp_path):
+    from tpu_mpi_tests.drivers import daxpy
+
+    tr = tmp_path / "trace.json"
+    rc = daxpy.main(["--n", "64", "--dtype", "float32",
+                     "--trace-out", str(tr)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "--trace-out needs --jsonl" in out
+    assert not tr.exists()
+
+
+def _ghost_siblings(tmp_path, run_sync_us=None):
+    """Two .p<i> rank files at the base path, from some OTHER run."""
+    for i in range(2):
+        recs = [{"kind": "manifest", "process_index": i}]
+        if run_sync_us is not None:
+            recs.append({"kind": "clock_sync", "rank": i, "offset_s": 0.0,
+                         "run_sync_us": run_sync_us})
+        recs.append({"kind": "time", "phase": "ghost", "seconds": 1.0,
+                     "t_start": 50.0, "t_end": 51.0, "rank": i})
+        _write_jsonl(tmp_path / f"out.p{i}.jsonl", recs)
+
+
+def test_trace_out_ignores_stale_rank_siblings_by_mtime(tmp_path):
+    """Siblings from an OLD run with no run-identity stamp fall to the
+    mtime filter: yesterday's 2-process files at the base path must not
+    become ghost rank tracks under today's single-process merge."""
+    import io
+    import os
+    import time as _time
+
+    from tpu_mpi_tests.instrument.report import Reporter
+
+    _ghost_siblings(tmp_path)
+    for i in range(2):
+        p = tmp_path / f"out.p{i}.jsonl"
+        os.utime(p, (_time.time() - 3600, _time.time() - 3600))
+    tr = tmp_path / "trace.json"
+    with Reporter(stream=io.StringIO(),
+                  jsonl_path=str(tmp_path / "out.jsonl"),
+                  trace_out=str(tr)) as r:
+        r.time_line("fresh", 0.5)
+    doc = json.load(open(tr))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert names == {"fresh"}
+
+
+def test_trace_out_run_identity_beats_fresh_mtimes(tmp_path):
+    """Back-to-back reruns (< 5 s apart) leave stale siblings with FRESH
+    mtimes, where an mtime window cannot help; the shared clock_sync
+    run_sync_us stamp still tells this run's files from the ghosts —
+    and still admits a true same-run sibling."""
+    import io
+
+    from tpu_mpi_tests.instrument.report import Reporter
+
+    _ghost_siblings(tmp_path, run_sync_us=111)  # other run, fresh mtime
+    # a genuine same-run sibling rank file (matching stamp)
+    _write_jsonl(tmp_path / "out.p9.jsonl", [
+        {"kind": "manifest", "process_index": 9},
+        {"kind": "clock_sync", "rank": 9, "offset_s": 0.0,
+         "run_sync_us": 222},
+        {"kind": "time", "phase": "peer", "seconds": 1.0,
+         "t_start": 60.0, "t_end": 61.0, "rank": 9},
+    ])
+    tr = tmp_path / "trace.json"
+    with Reporter(stream=io.StringIO(),
+                  jsonl_path=str(tmp_path / "out.jsonl"),
+                  trace_out=str(tr)) as r:
+        r.run_sync_us = 222
+        r.jsonl({"kind": "clock_sync", "rank": 0, "offset_s": 0.0,
+                 "run_sync_us": 222})
+        r.time_line("fresh", 0.5)
+    doc = json.load(open(tr))
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert names == {"fresh", "peer"}
+
+
+def test_clock_sync_digits_survive_float32():
+    """The barrier-echo handshake ships timestamps through
+    process_allgather, which canonicalizes to float32 when x64 is off;
+    the base-2^24 digit codec must reconstruct epoch microseconds
+    exactly through that round-trip (a raw float32 epoch is only
+    ~128 s-accurate)."""
+    import numpy as np
+
+    from tpu_mpi_tests.instrument.manifest import _join_us, _split_us
+
+    for t in (1785738694.948360, 0.0, 2_000_000_000.123456):
+        through_f32 = _split_us(t).astype(np.float32).astype(np.float64)
+        assert _join_us(through_f32) == pytest.approx(t, abs=1e-6)
+    assert abs(float(np.float32(1785738694.948360)) - 1785738694.948360) > 1
+
+
+def test_cli_tools_import_and_run_without_jax(two_rank_run, tmp_path):
+    """tpumt-trace / tpumt-report are advertised for login nodes with no
+    jax install: both must import and run with jax BLOCKED (the package
+    __init__ re-exports resolve lazily)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    base = two_rank_run[0].replace(".p0", "")
+    out = str(tmp_path / "nojax_trace.json")
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.instrument import aggregate, timeline\n"
+        f"assert timeline.main([{base!r}, '-o', {out!r}]) == 0\n"
+        f"assert aggregate.main([{base!r}]) == 0\n"
+        f"assert aggregate.main(['--timeline', {base!r}]) == 0\n"
+        "print('NOJAX OK')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=Path(__file__).resolve().parent.parent,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "NOJAX OK" in r.stdout
+    assert json.load(open(out))["traceEvents"]
+
+
+def test_trace_out_rerun_appends_select_current_run_segment(tmp_path):
+    """Append-mode JSONL reuse: rerunning with the same --jsonl base
+    appends a second run to every rank file. The merge must (a) still
+    include siblings — the current stamp is NOT the file's first — and
+    (b) select only the current run's segment, not bleed run 1's events
+    through run 2's clock offset."""
+    import io
+
+    from tpu_mpi_tests.instrument.report import Reporter
+
+    sib = tmp_path / "out.p1.jsonl"
+    _write_jsonl(sib, [
+        {"kind": "manifest", "process_index": 1},
+        {"kind": "clock_sync", "rank": 1, "offset_s": 0.0,
+         "run_sync_us": 111},
+        {"kind": "time", "phase": "old_phase", "seconds": 1.0,
+         "t_start": 10.0, "t_end": 11.0, "rank": 1},
+    ])
+    with sib.open("a") as fh:  # run 2 appends
+        for rec in (
+            {"kind": "manifest", "process_index": 1},
+            {"kind": "clock_sync", "rank": 1, "offset_s": 0.25,
+             "run_sync_us": 222},
+            {"kind": "time", "phase": "new_phase", "seconds": 1.0,
+             "t_start": 100.25, "t_end": 101.25, "rank": 1},
+        ):
+            fh.write(json.dumps(rec) + "\n")
+    tr = tmp_path / "trace.json"
+    with Reporter(stream=io.StringIO(),
+                  jsonl_path=str(tmp_path / "out.jsonl"),
+                  trace_out=str(tr)) as r:
+        r.run_sync_us = 222
+        r.jsonl({"kind": "clock_sync", "rank": 0, "offset_s": 0.0,
+                 "run_sync_us": 222})
+        r.time_line("fresh", 0.5)
+    doc = json.load(open(tr))
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in evs} == {"fresh", "new_phase"}
+    # run 2's offset applied to run 2's segment only
+    (new,) = [e for e in evs if e["name"] == "new_phase"]
+    assert new["pid"] == 1 and new["dur"] == pytest.approx(1.0e6)
+
+
+def test_rank_streams_picks_newest_segment_by_default(tmp_path):
+    """Offline tpumt-trace on a multi-run file: with no run id the
+    newest run's segment is used (older runs' events would be misplaced
+    by the newest clock offset)."""
+    p = tmp_path / "multi.jsonl"
+    _write_jsonl(p, [
+        {"kind": "manifest", "process_index": 3},
+        {"kind": "time", "phase": "old", "seconds": 1.0,
+         "t_start": 10.0, "t_end": 11.0},
+        {"kind": "manifest", "process_index": 3},
+        {"kind": "clock_sync", "rank": 3, "offset_s": 0.5,
+         "run_sync_us": 9},
+        {"kind": "time", "phase": "new", "seconds": 1.0,
+         "t_start": 100.5, "t_end": 101.5},
+    ])
+    ((rank, offset, records),) = timeline.rank_streams([str(p)])
+    assert rank == 3 and offset == 0.5
+    assert [r.get("phase") for r in records
+            if r.get("kind") == "time"] == ["new"]
+    assert timeline.run_sync_ids(str(p)) == {9}
+
+
+def test_expand_rank_files_shared_with_report(two_rank_run):
+    base = two_rank_run[0].replace(".p0", "")
+    assert [f.rsplit("/", 1)[-1] for f in expand_rank_files([base])] == [
+        "run.p0.jsonl", "run.p1.jsonl"
+    ]

@@ -738,23 +738,22 @@ def bench_vpu(results):
     # delta was ~10 us: it measured noise, NaN rates). bf16 probes
     # (round 4) put a measured ceiling under the OFFICIAL bf16 headline's
     # claimed VPU plateau; its schedule is dim-1, so step5_d1 is the mix
-    PROBES = {
-        ("fma", "float32"): (2, (512, 2048, 8192)),
-        ("step5_d0", "float32"): (7, (256, 1024, 4096)),
-        ("step5_d1", "float32"): (7, (64, 256, 1024)),
-        ("fma", "bfloat16"): (2, (512, 2048, 8192)),
-        ("step5_d0", "bfloat16"): (7, (256, 1024, 4096)),
-        ("step5_d1", "bfloat16"): (7, (64, 256, 1024)),
-    }
-    if os.environ.get("TPU_MPI_VPU_STEP5FMA", "") not in ("", "0"):
-        # opt-in reproduction of the round-5 diff-vs-fma form A/B
-        # (BASELINE VPU note: the raw 4-tap se-folded form measured
-        # SLOWER on every axis/dtype; to interleave the forms
-        # per-reps-point as the recorded A/B did, run this twice and
-        # pair same-window readings — a single pass still reproduces
-        # the form ratio to the window band)
-        for dname in ("float32", "bfloat16"):
+    step5fma = os.environ.get("TPU_MPI_VPU_STEP5FMA", "") not in ("", "0")
+    # insertion order IS measurement order (the loop below walks the
+    # dict): each opt-in step5fma form A/B probe (round-5 diff-vs-fma —
+    # BASELINE VPU note: the raw 4-tap se-folded form measured SLOWER on
+    # every axis/dtype) sits immediately after its step5 counterpart, so
+    # the two forms share one contention window per (axis, dtype) like
+    # the recorded A/B did, instead of running in separate sequential
+    # passes minutes apart on the shared chip
+    PROBES = {}
+    for dname in ("float32", "bfloat16"):
+        PROBES[("fma", dname)] = (2, (512, 2048, 8192))
+        PROBES[("step5_d0", dname)] = (7, (256, 1024, 4096))
+        if step5fma:
             PROBES[("step5fma_d0", dname)] = (7, (256, 1024, 4096))
+        PROBES[("step5_d1", dname)] = (7, (64, 256, 1024))
+        if step5fma:
             PROBES[("step5fma_d1", dname)] = (7, (64, 256, 1024))
     probe_rate = {}
     for (mix, dname), (ops, reps3) in PROBES.items():
@@ -914,11 +913,15 @@ def bench_roofline2(results):
         )
         return per
 
+    # nominal op counts use the mask-op convention of the probe mixes
+    # (pallas_kernels.vpu_probe_pallas): each reduction-feeding `where`
+    # select counts one op/elt — dualdim's 22 includes its TWO row
+    # masks exactly as dualdim_lean's 14 includes its one
     PROBES = {
         ("heat5", "float32"): (11, (64, 256, 1024)),
         ("heat5", "bfloat16"): (11, (64, 256, 1024)),
-        ("dualdim", "float32"): (20, (32, 128, 512)),
-        ("dualdim", "bfloat16"): (20, (32, 128, 512)),
+        ("dualdim", "float32"): (22, (32, 128, 512)),
+        ("dualdim", "bfloat16"): (22, (32, 128, 512)),
         ("dualdim_lean", "float32"): (14, (32, 128, 512)),
         ("dualdim_lean", "bfloat16"): (14, (32, 128, 512)),
     }
@@ -1447,10 +1450,12 @@ def bench_stripebalance(results):
               f"{grids['striped'].sum() / grids['striped_coupled'].sum():.3f}")
 
     # layout conversion cost at the same global (L, d) — what a caller
-    # pays once before/after the whole ring pass, not per step
+    # pays once before/after the whole ring pass, not per step; measured
+    # at the sweep's dtype (a bf16 run must not silently re-measure the
+    # f32 conversion and double-record against f32 history)
     L = w * lq
     rng = np.random.default_rng(0)
-    xg = jnp.asarray(rng.normal(size=(L, d)).astype(np.float32))
+    xg = jnp.asarray(rng.normal(size=(L, d)), dtype=sdtype)
     for nm, fn in (("to_striped", to_striped), ("from_striped",
                                                from_striped)):
         @functools.partial(jax.jit, donate_argnums=0)
@@ -1468,8 +1473,8 @@ def bench_stripebalance(results):
         x = block(run(x, 1))
         x = block(run(x, 1))
         sec, x = chain_rate(run, x, n_short=50, n_long=550)
-        _emit(results, f"stripe_{nm}_ms", sec * 1e3, "ms",
-              f"({L}, {d}) f32 permute, one-off per ring pass")
+        _emit(results, f"stripe_{nm}{dtag}_ms", sec * 1e3, "ms",
+              f"({L}, {d}) {sdtype} permute, one-off per ring pass")
         del x
 
 

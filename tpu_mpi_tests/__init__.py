@@ -19,9 +19,18 @@ repo root beside this package):
 
 __version__ = "0.1.0"
 
-from tpu_mpi_tests.comm.mesh import (  # noqa: F401
-    Topology,
-    bootstrap,
-    make_mesh,
-    topology,
-)
+# mesh re-exports resolve lazily (PEP 562): comm.mesh imports jax at
+# module scope, and the stdlib-only CLI tools (tpumt-report/tpumt-trace,
+# advertised for login nodes without jax) import through this package
+_MESH_EXPORTS = ("Topology", "bootstrap", "make_mesh", "topology")
+__all__ = list(_MESH_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _MESH_EXPORTS:
+        from tpu_mpi_tests.comm import mesh
+
+        return getattr(mesh, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -49,6 +49,15 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         help="capture an XProf trace to this dir (≅ nsys -c cudaProfilerApi)",
     )
     p.add_argument(
+        "--trace-out",
+        default=None,
+        metavar="TRACE.json",
+        help="on driver exit, merge the --jsonl record stream(s) into "
+        "Chrome trace-event JSON here (rank 0 only; one track per rank, "
+        "clock offsets applied) — open in Perfetto/chrome://tracing, or "
+        "run tpumt-trace offline for the same merge",
+    )
+    p.add_argument(
         "--verbose", action="store_true", help="extra per-device reporting"
     )
     p.add_argument(
@@ -86,31 +95,50 @@ def make_reporter(args, rank: int = 0, size: int = 1):
     * a run-manifest record (``kind: "manifest"``) as the first JSONL
       line whenever a sink is configured, so every result file is
       self-describing;
+    * a clock-alignment record (``kind: "clock_sync"``): multi-process
+      runs estimate each rank's wall-clock offset from rank 0 via the
+      barrier-echo handshake so ``tpumt-trace``/``--trace-out`` can
+      merge the per-rank streams onto one time axis (single-process
+      runs record offset 0);
     * with ``--telemetry``: the telemetry registry is enabled with the
       reporter's JSONL as its span sink, a rank-0 manifest banner is
       printed, and closing the reporter (drivers hold it in a ``with``
       block) flushes per-op counter lines and disables the registry.
+      ``--trace-out`` makes that close also merge the run's JSONL into
+      a Perfetto-loadable trace (rank 0).
     """
     import jax
 
     from tpu_mpi_tests.instrument.report import Reporter
 
+    trace_out = getattr(args, "trace_out", None)
+    if trace_out and not args.jsonl:
+        print("NOTE --trace-out needs --jsonl (the trace is merged from "
+              "the JSONL record stream); no trace will be written")
+        trace_out = None
     rep = Reporter(
         rank=rank,
         size=size,
         jsonl_path=args.jsonl,
         proc_index=jax.process_index(),
         proc_count=jax.process_count(),
+        trace_out=trace_out,
     )
     telemetry_on = getattr(args, "telemetry", False)
     if rep.jsonl_path or telemetry_on:
         from tpu_mpi_tests.instrument.manifest import (
+            clock_sync_record,
             manifest_banner,
             run_manifest,
         )
 
         m = run_manifest()
         rep.jsonl(m)
+        if rep.jsonl_path:
+            cs = clock_sync_record()
+            rep.jsonl(cs)
+            # run identity for the --trace-out merge's stale-file filter
+            rep.run_sync_us = cs.get("run_sync_us")
         if telemetry_on:
             rep.banner(manifest_banner(m))
     if telemetry_on:

@@ -188,7 +188,8 @@ def run(args) -> int:
         gate.stop()
         for phase in ("total", "kernel", "barrier", "gather"):
             if timer.counts[phase]:
-                rep.time_line(phase, timer.seconds[phase])
+                rep.time_line(phase, timer.seconds[phase],
+                              *timer.wall_span(phase))
 
         # verification: y = x elementwise → ALLSUM = world*(n+1)/2; gathered x
         # must equal the original global x (in-place parity)

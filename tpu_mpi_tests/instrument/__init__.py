@@ -11,11 +11,31 @@ self-describing run manifest (manifest.py), and cross-rank JSONL
 aggregation (aggregate.py, the ``tpumt-report`` entry point).
 """
 
-from tpu_mpi_tests.instrument.timers import PhaseTimer, block  # noqa: F401
-from tpu_mpi_tests.instrument.trace import ProfilerGate, trace_range  # noqa: F401
-from tpu_mpi_tests.instrument.report import Reporter  # noqa: F401
-from tpu_mpi_tests.instrument.telemetry import (  # noqa: F401
-    comm_span,
-    span_call,
-)
-from tpu_mpi_tests.instrument.manifest import run_manifest  # noqa: F401
+# re-exports resolve lazily (PEP 562): timers.py and trace.py import jax
+# at module scope, and the stdlib-only CLIs in this package
+# (aggregate.py/timeline.py — tpumt-report/tpumt-trace) must import on
+# login nodes that have no jax at all
+_EXPORTS = {
+    "PhaseTimer": "timers",
+    "block": "timers",
+    "ProfilerGate": "trace",
+    "trace_range": "trace",
+    "Reporter": "report",
+    "comm_span": "telemetry",
+    "span_call": "telemetry",
+    "run_manifest": "manifest",
+}
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"tpu_mpi_tests.instrument.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

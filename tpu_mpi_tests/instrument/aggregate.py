@@ -235,12 +235,34 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="emit the summary as one JSON document instead of text",
     )
+    p.add_argument(
+        "--timeline",
+        action="store_true",
+        help="render a per-phase ASCII swimlane (one lane per rank, "
+        "clock offsets applied) plus per-step comm-op start-skew "
+        "series instead of the stats summary; needs the timestamped "
+        "records new runs emit (instrument/timeline.py renders; "
+        "tpumt-trace exports the same merge for Perfetto)",
+    )
+    p.add_argument(
+        "--width",
+        type=int,
+        default=64,
+        metavar="COLS",
+        help="swimlane width in columns for --timeline (default 64)",
+    )
     args = p.parse_args(argv)
 
     files = [f for f in expand_rank_files(args.files) if Path(f).exists()]
     if not files:
         print("tpumt-report: no input files found", file=sys.stderr)
         return 1
+    if args.timeline:
+        from tpu_mpi_tests.instrument.timeline import ascii_swimlane
+
+        for line in ascii_swimlane(files, width=max(args.width, 8)):
+            print(line)
+        return 0
     summary = summarize(files)
     if args.json:
         json.dump(summary, sys.stdout, indent=1)

@@ -77,6 +77,101 @@ def run_manifest(argv: list[str] | None = None, **extra) -> dict:
     return record
 
 
+def _split_us(t: float):
+    """Epoch seconds -> three base-2^24 digits of integer microseconds
+    (float64 array). Each digit < 2^24 is exactly representable in
+    float32, so the value survives ``process_allgather``'s device
+    round-trip even when x64 is off (jax.device_put canonicalizes
+    float64 -> float32, whose ulp at epoch magnitude is ~128 s — a raw
+    ``time.time()`` gather would be pure quantization noise)."""
+    import numpy as np
+
+    us = int(round(t * 1e6))
+    return np.array(
+        [(us >> 48) & 0xFFFFFF, (us >> 24) & 0xFFFFFF, us & 0xFFFFFF],
+        np.float64,
+    )
+
+
+def _join_us(digits) -> float:
+    """Inverse of :func:`_split_us` (exact at 1 us resolution)."""
+    d = [int(round(float(v))) for v in digits]
+    return ((d[0] << 48) | (d[1] << 24) | d[2]) / 1e6
+
+
+def clock_sync_record(rounds: int = 5) -> dict:
+    """Estimate this rank's wall-clock offset from rank 0 (``kind:
+    "clock_sync"``) so per-rank JSONL merges onto one time axis.
+
+    Barrier-echo handshake: every process enters a global barrier, reads
+    ``time.time()`` at barrier exit, and all-gathers the readings — at
+    each round the exits are simultaneous to within the barrier's own
+    skew, so ``t_local − t_rank0`` samples the clock offset plus that
+    skew noise. The median over ``rounds`` is the estimate and the
+    sample spread is recorded as its quality bound (``spread_s``); the
+    timeline merger subtracts ``offset_s`` from every timestamp of the
+    rank. Single-process runs (including fake-device meshes — one clock)
+    record offset 0 without any collective. Requires an initialized
+    backend, like :func:`run_manifest`; never raises — an environment
+    where the handshake cannot run yields offset 0 tagged
+    ``method: "unavailable"`` (timestamps then merge uncorrected,
+    exactly the pre-handshake behavior)."""
+    import jax
+
+    now = time.time()
+    rec = {
+        "kind": "clock_sync",
+        "rank": jax.process_index(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "offset_s": 0.0,
+        "spread_s": 0.0,
+        "rounds": 0,
+        "method": "single_process",
+        "time_unix": now,
+        # run identity: rank 0's first-barrier timestamp, identical on
+        # every rank of one handshake — the --trace-out auto-merge uses
+        # it to tell this run's sibling rank files from stale ones at
+        # the same base path (single-process runs have no same-run
+        # siblings, so their own timestamp serves); None when the
+        # handshake could not run (merge falls back to an mtime filter)
+        "run_sync_us": int(round(now * 1e6)),
+    }
+    if jax.process_count() <= 1:
+        return rec
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        # timestamps cross the gather as f32-exact base-2^24 digits
+        # (see _split_us — raw epoch float64s would be canonicalized to
+        # float32 with ~128 s resolution when x64 is off)
+        samples = []
+        run_sync_us = None
+        for k in range(rounds):
+            multihost_utils.sync_global_devices(f"tpumt_clock_sync_{k}")
+            t_local = time.time()
+            ts = np.asarray(
+                multihost_utils.process_allgather(_split_us(t_local))
+            ).reshape(-1, 3)
+            t_rank0 = _join_us(ts[0])
+            if run_sync_us is None:
+                run_sync_us = int(round(t_rank0 * 1e6))
+            samples.append(t_local - t_rank0)
+        samples.sort()
+        rec.update(
+            offset_s=samples[len(samples) // 2],
+            spread_s=samples[-1] - samples[0],
+            rounds=len(samples),
+            method="barrier_echo",
+            run_sync_us=run_sync_us,
+        )
+    except Exception as e:  # noqa: BLE001 — diagnostic record, not control
+        rec.update(method=f"unavailable: {type(e).__name__}",
+                   run_sync_us=None)
+    return rec
+
+
 def _version_of(module: str) -> str | None:
     try:
         import importlib

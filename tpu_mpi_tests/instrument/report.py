@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
+import time
 from pathlib import Path
 from typing import Any, IO
 
@@ -57,15 +59,26 @@ class Reporter:
         stream: IO[str] | None = None,
         proc_index: int = 0,
         proc_count: int = 1,
+        trace_out: str | None = None,
     ):
         self.rank = rank
         self.size = size
+        self.proc_index = proc_index
+        # pre-suffix base path: the trace merge globs the whole rank set
+        # from it (the suffixed path would find only this rank's file)
+        self._jsonl_base = jsonl_path
         if jsonl_path and proc_count > 1:
             jsonl_path = rank_suffixed_path(jsonl_path, proc_index)
         self.jsonl_path = jsonl_path
+        self.trace_out = trace_out
         self.stream = stream or sys.stdout
         self._jsonl_file: IO[str] | None = None
+        self._jsonl_lock = threading.Lock()
         self._telemetry = False
+        self._created_at = time.time()  # trace merge excludes older files
+        # this run's clock_sync identity (set by make_reporter): the
+        # trace merge uses it to recognize same-run sibling rank files
+        self.run_sync_us: int | None = None
 
     def __enter__(self) -> "Reporter":
         return self
@@ -90,11 +103,22 @@ class Reporter:
              "value": float(value)},
         )
 
-    def time_line(self, phase: str, seconds: float):
+    def time_line(self, phase: str, seconds: float,
+                  t_start: float | None = None, t_end: float | None = None):
+        """One ``TIME`` line + ``time`` record. ``t_start``/``t_end`` are
+        the phase's wall-clock bounds (``PhaseTimer.wall_span``); when the
+        caller has none they are synthesized as ``[now - seconds, now]``
+        so every ``time`` record is placeable on the merged timeline —
+        exact when emitted right after the phase, and never worse than
+        the pre-timeline records that carried no placement at all."""
+        if t_end is None:
+            t_end = time.time()
+        if t_start is None:
+            t_start = t_end - seconds
         self.line(
             f"TIME {phase} : {seconds:0.6f}",
             {"kind": "time", "phase": phase, "seconds": float(seconds),
-             "rank": self.rank},
+             "t_start": t_start, "t_end": t_end, "rank": self.rank},
         )
 
     def test_line(self, dim: int, space: str, buf, seconds: float, err: float,
@@ -151,6 +175,8 @@ class Reporter:
         for text in timer.lines(stats=stats):
             print(text, file=self.stream, flush=True)
         for name in timer.seconds:
+            # wall + monotonic phase bounds (getattr: duck-typed timers
+            # without the round-2 timestamp fields still report)
             self.jsonl(
                 {"kind": "time", "phase": name,
                  "seconds": float(timer.seconds[name]),
@@ -158,6 +184,10 @@ class Reporter:
                  "mean_s": timer.mean(name),
                  "min_s": timer.mins.get(name, 0.0),
                  "max_s": timer.maxs.get(name, 0.0),
+                 "t_start": getattr(timer, "t_starts", {}).get(name),
+                 "t_end": getattr(timer, "t_ends", {}).get(name),
+                 "mono_start": getattr(timer, "mono_starts", {}).get(name),
+                 "mono_end": getattr(timer, "mono_ends", {}).get(name),
                  "rank": self.rank}
             )
 
@@ -168,13 +198,18 @@ class Reporter:
         self._telemetry = True
 
     def jsonl(self, record: dict[str, Any]):
+        # serialized under a lock and written as ONE write() call: the
+        # watchdog emits its timeline record from a timer thread, and an
+        # interleaved json.dump (many small writes) with a main-thread
+        # span record would corrupt both lines
         if not self.jsonl_path:
             return
-        if self._jsonl_file is None:
-            self._jsonl_file = open(self.jsonl_path, "a")
-        json.dump(record, self._jsonl_file)
-        self._jsonl_file.write("\n")
-        self._jsonl_file.flush()
+        line = json.dumps(record) + "\n"
+        with self._jsonl_lock:
+            if self._jsonl_file is None:
+                self._jsonl_file = open(self.jsonl_path, "a")
+            self._jsonl_file.write(line)
+            self._jsonl_file.flush()
 
     def close(self):
         if self._telemetry:
@@ -189,6 +224,59 @@ class Reporter:
                      **c},
                 )
             T.disable()
-        if self._jsonl_file is not None:
-            self._jsonl_file.close()
-            self._jsonl_file = None
+        with self._jsonl_lock:
+            if self._jsonl_file is not None:
+                self._jsonl_file.close()
+                self._jsonl_file = None
+        self._write_trace()
+
+    def _write_trace(self):
+        """--trace-out auto-merge: after this rank's JSONL is closed,
+        process 0 merges the rank set into Chrome trace-event JSON.
+        Only THIS run's records are merged — the base-path glob would
+        otherwise resurrect stale ``.p<i>`` siblings from an earlier run
+        as ghost rank tracks, and append-mode JSONL can hold several
+        runs per file. Run identity is the shared ``clock_sync``
+        handshake stamp (``run_sync_us``, identical on every rank of one
+        run): a file is included when ANY of its runs carries the stamp
+        (reruns append — the current run need not be first), and the
+        merger then selects exactly that run's segment per file. Files
+        without any stamp (older format, or a run whose handshake was
+        unavailable) fall back to an mtime window, which cannot
+        distinguish a run finished seconds earlier. Still best-effort:
+        sibling ranks that have not flushed yet contribute fewer events
+        — re-run ``tpumt-trace`` offline for the complete/curated set."""
+        if not self.trace_out or self.proc_index != 0:
+            return
+        self.trace_out, out = None, self.trace_out  # once per close
+        if not self._jsonl_base:
+            self.line(f"TRACE SKIPPED {out}: --trace-out needs --jsonl "
+                      f"records to merge")
+            return
+        from tpu_mpi_tests.instrument.aggregate import expand_rank_files
+        from tpu_mpi_tests.instrument.timeline import (
+            run_sync_ids,
+            write_trace,
+        )
+
+        def current(f: str) -> bool:
+            if self.jsonl_path and Path(f) == Path(self.jsonl_path):
+                return True  # this rank's own file
+            sibling_ids = run_sync_ids(f)
+            if self.run_sync_us is not None and sibling_ids:
+                return self.run_sync_us in sibling_ids
+            try:
+                return Path(f).stat().st_mtime >= self._created_at - 5.0
+            except OSError:
+                return False
+
+        files = [f for f in expand_rank_files([self._jsonl_base])
+                 if Path(f).exists() and current(f)]
+        try:
+            n = write_trace(files, out, run_sync_us=self.run_sync_us)
+        except OSError as e:
+            self.line(f"TRACE ERROR {out}: {e}")
+            return
+        self.line(f"TRACE {out}: {n} events from {len(files)} "
+                  f"file{'s' if len(files) != 1 else ''} "
+                  f"(open in Perfetto / chrome://tracing)")

@@ -51,7 +51,14 @@ FLIGHT_CAPACITY = 64
 class CommEvent:
     """One recorded communication event (a completed span or a dispatch
     note). ``seconds``/``gbps`` are None for dispatch-only notes — the op
-    was handed to the device but never synced through a span."""
+    was handed to the device but never synced through a span.
+
+    ``t_start``/``t_end`` are wall-clock (Unix epoch) bounds and
+    ``mono_start``/``mono_end`` the matching ``perf_counter`` reads; the
+    timeline merger (``instrument/timeline.py``) places the span on a
+    cross-rank time axis from the wall pair (clock-offset-corrected) and
+    keeps the monotonic pair as the drift-free duration witness. None on
+    dispatch-only notes and on records from pre-timeline JSONL."""
 
     op: str
     nbytes: int = 0
@@ -61,6 +68,10 @@ class CommEvent:
     gbps: float | None = None
     wall_time: float = 0.0
     note: str | None = None
+    t_start: float | None = None
+    t_end: float | None = None
+    mono_start: float | None = None
+    mono_end: float | None = None
     meta: dict[str, Any] = field(default_factory=dict)
 
     def describe(self, now: float | None = None) -> str:
@@ -90,6 +101,10 @@ class CommEvent:
             "world": self.world,
             "seconds": self.seconds,
             "gbps": self.gbps,
+            "t_start": self.t_start,
+            "t_end": self.t_end,
+            "mono_start": self.mono_start,
+            "mono_end": self.mono_end,
         }
         if self.meta:
             rec.update(self.meta)
@@ -154,6 +169,20 @@ class Telemetry:
         if self._sink is not None:
             self._sink(event.record())
 
+    def emit(self, record: dict[str, Any]) -> None:
+        """Best-effort raw record to the sink (no counters, no flight
+        entry) — for non-span observability records that belong on the
+        timeline: dispatch notes (``kind: "dispatch"``) and watchdog
+        fires (``kind: "watchdog"``). Never raises: the callers are hang
+        dumps and teardown paths where a sink error must not mask the
+        real failure."""
+        if not self.enabled or self._sink is None:
+            return
+        try:
+            self._sink(record)
+        except Exception:
+            pass
+
     def counters(self) -> dict[str, dict[str, Any]]:
         with self._lock:
             return {
@@ -186,15 +215,27 @@ def counters() -> dict[str, dict[str, Any]]:
 def note_dispatch(desc: str, **meta) -> None:
     """Record a dispatch-only event in the flight recorder (always on —
     one deque append). Used for ops that may wedge before any span can
-    close, e.g. the hand-written RDMA ring's DMA semaphores."""
-    _TELEMETRY.flight.push(
-        CommEvent(
-            op=meta.pop("op", "dispatch"),
-            note=desc,
-            wall_time=time.time(),
-            meta=meta,
-        )
+    close, e.g. the hand-written RDMA ring's DMA semaphores. When span
+    telemetry is enabled the note also lands in the JSONL sink
+    (``kind: "dispatch"``) so the timeline can mark a wedged op's last
+    dispatch as an instant event."""
+    event = CommEvent(
+        op=meta.pop("op", "dispatch"),
+        note=desc,
+        wall_time=time.time(),
+        meta=meta,
     )
+    _TELEMETRY.flight.push(event)
+    _TELEMETRY.emit(
+        {"kind": "dispatch", "note": desc, "op": event.op,
+         "t": event.wall_time, **event.meta}
+    )
+
+
+def emit(record: dict[str, Any]) -> None:
+    """Raw record to the enabled registry's sink (see
+    :meth:`Telemetry.emit`)."""
+    _TELEMETRY.emit(record)
 
 
 def flight_events(n: int | None = None) -> list[CommEvent]:
@@ -254,14 +295,19 @@ def comm_span(
     from tpu_mpi_tests.instrument.timers import block
 
     span = _Span()
+    t0_wall = time.time()
     t0 = time.perf_counter()
     try:
         yield span
     finally:
         if span.result is not None:
             block(span.result)
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         gbps = (nbytes / dt / 1e9) if (nbytes and dt > 0) else None
+        # wall end is start + the monotonic duration, not a second
+        # time.time() read: an NTP step mid-span would otherwise make
+        # t_end - t_start disagree with `seconds` on the merged timeline
         reg.record(
             CommEvent(
                 op=op,
@@ -270,7 +316,11 @@ def comm_span(
                 world=world,
                 seconds=dt,
                 gbps=gbps,
-                wall_time=time.time(),
+                wall_time=t0_wall + dt,
+                t_start=t0_wall,
+                t_end=t0_wall + dt,
+                mono_start=t0,
+                mono_end=t1,
                 meta=meta,
             )
         )

@@ -1,0 +1,395 @@
+"""``tpumt-trace``: merge per-rank telemetry JSONL into one cross-rank
+timeline — Chrome trace-event JSON for Perfetto / ``chrome://tracing``.
+
+``tpumt-report`` answers "how much / how skewed"; this module answers
+"what happened *when*" — the reference's NVTX + ``nsys`` pillar
+(``mpi_daxpy_nvtx.cc:177-325``, ``summit/run.sh:15-19``), rebuilt on the
+records the telemetry layer already streams. Given the per-rank file set
+of one run (the auto-suffixed ``out.p<i>.jsonl`` files, or explicit
+paths), it:
+
+* assigns each stream to its rank (manifest ``process_index``, file
+  order fallback) and aligns every timestamp to rank 0's wall clock via
+  the ``clock_sync`` record (``instrument/manifest.py`` barrier-echo
+  handshake; single-process runs carry offset 0);
+* renders one Perfetto process ("track") per rank with two threads —
+  ``comm`` (telemetry spans, named by op, annotated with bytes / GB/s /
+  mesh axis; flight-recorder dispatch notes as thread-scoped instants,
+  so a wedged op's last dispatch is visible at its place on the
+  timeline) and ``phases`` (PhaseTimer windows) — as complete events
+  (``ph: "X"``) with ``ts``/``dur`` in microseconds;
+* marks watchdog fires as process-scoped instant events — the point
+  where a rank's flow terminated.
+
+Records without ``t_start`` (pre-timeline JSONL) are counted and
+skipped: old files still merge into a *valid* (possibly empty) trace,
+and keep aggregating under ``tpumt-report`` unchanged.
+
+Also provides the terminal-only fallback behind ``tpumt-report
+--timeline``: a per-phase ASCII swimlane (one lane per rank on a shared
+axis) plus per-step start-skew series per comm op — which rank entered
+step k late, without leaving the shell.
+
+Pure stdlib (no jax import): usable on a login node against files
+copied off the pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tpu_mpi_tests.instrument.aggregate import (
+    _load_records,
+    expand_rank_files,
+)
+
+#: thread ids within each rank's trace process
+TID_COMM = 0
+TID_PHASE = 1
+
+_US = 1e6  # trace-event ts/dur unit is microseconds
+
+
+def _run_segments(records: list[dict]) -> list[list[dict]]:
+    """Split one file's record stream at manifest boundaries. JSONL
+    opens in append mode, so a reused ``--jsonl`` path holds several
+    runs back to back; each run starts with its manifest. Records
+    before the first manifest (or a file with none) form one leading
+    segment, so manifest-less streams pass through whole."""
+    segments: list[list[dict]] = [[]]
+    for rec in records:
+        if rec.get("kind") == "manifest" and segments[-1]:
+            segments.append([])
+        segments[-1].append(rec)
+    return segments
+
+
+def _segment_run_id(segment: list[dict]):
+    for rec in segment:
+        if rec.get("kind") == "clock_sync":
+            return rec.get("run_sync_us")
+    return None
+
+
+def run_sync_ids(path: str) -> set:
+    """All ``run_sync_us`` stamps present in a JSONL file (one per run
+    appended to it) — the run-identity probe the ``--trace-out`` merge
+    uses to tell sibling rank files of the current run from stale ones."""
+    return {
+        rid
+        for seg in _run_segments(_load_records(path))
+        if (rid := _segment_run_id(seg)) is not None
+    }
+
+
+def rank_streams(
+    files: list[str], run_sync_us: int | None = None
+) -> list[tuple[int, float, list[dict]]]:
+    """``[(rank, offset_s, records)]`` per file — ONE run's records per
+    file. A file reused across runs (append mode) is segmented at its
+    manifests: with ``run_sync_us`` the segment carrying that
+    ``clock_sync`` stamp is chosen (newest segment when absent), else
+    the newest segment — earlier runs' events must not bleed onto the
+    merged timeline, where the chosen run's clock offset would misplace
+    them. Rank comes from the segment's manifest ``process_index``
+    (file order as fallback), the clock offset from its ``clock_sync``
+    record (0 when absent — old files merge uncorrected rather than
+    erroring)."""
+    streams = []
+    for idx, path in enumerate(files):
+        segments = _run_segments(_load_records(path))
+        chosen = segments[-1]
+        if run_sync_us is not None:
+            for seg in segments:
+                if _segment_run_id(seg) == run_sync_us:
+                    chosen = seg
+                    break
+        rank, offset = idx, 0.0
+        for rec in chosen:
+            kind = rec.get("kind")
+            if kind == "manifest" and "process_index" in rec:
+                rank = rec["process_index"]
+            elif kind == "clock_sync":
+                offset = float(rec.get("offset_s") or 0.0)
+        streams.append((rank, offset, chosen))
+    return streams
+
+
+def _collect(streams):
+    """Split aligned records into (spans, instants, n_unplaced).
+
+    spans:    (rank, tid, name, cat, t_start, dur_s, args)
+    instants: (rank, tid, name, cat, t, scope, args)
+    Timestamps are wall-clock seconds already shifted onto rank 0's
+    clock (``t - offset``); records with no ``t_start``/``t`` cannot be
+    placed and are only counted (pre-timeline JSONL compatibility)."""
+    spans, instants, unplaced = [], [], 0
+
+    def args_from(rec, keys):
+        return {k: rec[k] for k in keys if rec.get(k) is not None}
+
+    for rank, offset, records in streams:
+        for rec in records:
+            kind = rec.get("kind")
+            if kind == "span":
+                if rec.get("t_start") is None:
+                    unplaced += 1
+                    continue
+                start = float(rec["t_start"]) - offset
+                end = float(rec.get("t_end") or rec["t_start"]) - offset
+                spans.append((
+                    rank, TID_COMM, rec.get("op", "?"), "comm", start,
+                    max(end - start, 0.0),
+                    args_from(rec, ("nbytes", "gbps", "axis", "world",
+                                    "seconds")),
+                ))
+            elif kind == "time":
+                if rec.get("t_start") is None:
+                    unplaced += 1
+                    continue
+                start = float(rec["t_start"]) - offset
+                end = float(rec.get("t_end") or rec["t_start"]) - offset
+                spans.append((
+                    rank, TID_PHASE, rec.get("phase", "?"), "phase",
+                    start, max(end - start, 0.0),
+                    args_from(rec, ("seconds", "count", "mean_s", "min_s",
+                                    "max_s")),
+                ))
+            elif kind == "dispatch":
+                if rec.get("t") is None:
+                    unplaced += 1
+                    continue
+                instants.append((
+                    rank, TID_COMM,
+                    rec.get("note") or rec.get("op", "dispatch"),
+                    "dispatch", float(rec["t"]) - offset, "t", {},
+                ))
+            elif kind == "watchdog":
+                if rec.get("t") is None:
+                    unplaced += 1
+                    continue
+                instants.append((
+                    rank, TID_COMM,
+                    f"WATCHDOG {rec.get('phase', '?')}", "watchdog",
+                    float(rec["t"]) - offset, "p",
+                    args_from(rec, ("deadline_s",)),
+                ))
+    return spans, instants, unplaced
+
+
+def chrome_trace(
+    files: list[str], run_sync_us: int | None = None
+) -> dict:
+    """Merge the per-rank files into a Chrome trace-event document
+    (the JSON-object form: ``{"traceEvents": [...], ...}``). ``ts`` is
+    microseconds from the earliest aligned event; the absolute epoch is
+    kept in ``otherData.t0_unix_s``. ``run_sync_us`` selects one run's
+    segment in files appended to across runs (see
+    :func:`rank_streams`)."""
+    streams = rank_streams(files, run_sync_us)
+    spans, instants, unplaced = _collect(streams)
+    starts = [s[4] for s in spans] + [i[4] for i in instants]
+    t0 = min(starts) if starts else 0.0
+
+    events = []
+    for rank in sorted({r for r, _, _ in streams}):
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "tid": 0, "args": {"name": f"rank {rank}"}})
+        events.append({"ph": "M", "name": "thread_name", "pid": rank,
+                       "tid": TID_COMM, "args": {"name": "comm"}})
+        events.append({"ph": "M", "name": "thread_name", "pid": rank,
+                       "tid": TID_PHASE, "args": {"name": "phases"}})
+    for rank, tid, name, cat, start, dur, args in sorted(
+        spans, key=lambda s: s[4]
+    ):
+        events.append({"ph": "X", "name": name, "cat": cat, "pid": rank,
+                       "tid": tid, "ts": (start - t0) * _US,
+                       "dur": dur * _US, "args": args})
+    for rank, tid, name, cat, t, scope, args in sorted(
+        instants, key=lambda s: s[4]
+    ):
+        events.append({"ph": "i", "name": name, "cat": cat, "pid": rank,
+                       "tid": tid, "ts": (t - t0) * _US, "s": scope,
+                       "args": args})
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "files": list(files),
+            "t0_unix_s": t0,
+            "unplaced_records": unplaced,
+            "clock_offsets_s": {
+                str(r): off for r, off, _ in streams
+            },
+        },
+    }
+
+
+def placed_events(doc: dict) -> int:
+    """Placed (non-metadata) event count of a trace document."""
+    return sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+
+
+def write_trace(
+    files: list[str], out_path: str, run_sync_us: int | None = None
+) -> int:
+    """Merge ``files`` and write the trace document to ``out_path``.
+    Returns the number of placed events (metadata excluded)."""
+    doc = chrome_trace(files, run_sync_us)
+    Path(out_path).write_text(json.dumps(doc))
+    return placed_events(doc)
+
+
+# ---------------------------------------------------------------------------
+# terminal fallback: ASCII swimlane + per-step skew (tpumt-report --timeline)
+# ---------------------------------------------------------------------------
+
+
+def _bar(start: float, end: float, lo: float, hi: float,
+         width: int) -> str:
+    """One swimlane cell: ``#`` over [start, end) on the [lo, hi) axis,
+    at least one ``#`` so a short phase never disappears."""
+    span = max(hi - lo, 1e-12)
+    a = int((start - lo) / span * width)
+    b = int((end - lo) / span * width)
+    a = min(max(a, 0), width - 1)
+    b = min(max(b, a + 1), width)
+    return "." * a + "#" * (b - a) + "." * (width - b)
+
+
+def ascii_swimlane(files: list[str], width: int = 64,
+                   max_steps: int = 12) -> list[str]:
+    """Compact per-phase swimlane + per-step comm-op start-skew series.
+
+    One lane per rank per phase on the run's shared (offset-corrected)
+    time axis; below, for every comm op seen on 2+ ranks, the per-step
+    start-time skew (max − min across ranks of the k-th call's
+    ``t_start``) — the barrier-skew series that shows *which step*
+    desynchronized, not just that some step did."""
+    streams = rank_streams(files)
+    spans, _, unplaced = _collect(streams)
+    ranks = sorted({r for r, _, _ in streams})
+    phase_spans = [s for s in spans if s[1] == TID_PHASE]
+    comm_spans = [s for s in spans if s[1] == TID_COMM]
+    if not phase_spans and not comm_spans:
+        return [
+            "TIMELINE no timestamped records"
+            + (f" ({unplaced} pre-timeline records without t_start)"
+               if unplaced else "")
+            + " — record with --telemetry --jsonl on this version"
+        ]
+    lo = min(s[4] for s in spans)
+    hi = max(s[4] + s[5] for s in spans)
+    lines = [
+        f"TIMELINE ranks={len(ranks)} window={hi - lo:.6g}s "
+        f"axis=[0, {hi - lo:.6g}]s ('#' spans, {width} cols)"
+    ]
+    if unplaced:
+        lines.append(f"NOTE {unplaced} records without timestamps "
+                     f"not drawn (pre-timeline JSONL)")
+
+    # phase lanes, ordered by each phase's earliest appearance
+    by_phase: dict[str, dict[int, tuple[float, float]]] = {}
+    for rank, _, name, _, start, dur, _ in phase_spans:
+        cur = by_phase.setdefault(name, {}).get(rank)
+        end = start + dur
+        if cur is None:
+            by_phase[name][rank] = (start, end)
+        else:  # several records per phase: draw the covering window
+            by_phase[name][rank] = (min(cur[0], start), max(cur[1], end))
+    for name in sorted(
+        by_phase, key=lambda n: min(v[0] for v in by_phase[n].values())
+    ):
+        lines.append(f"PHASE {name}")
+        for rank in ranks:
+            if rank not in by_phase[name]:
+                continue
+            start, end = by_phase[name][rank]
+            lines.append(
+                f"  r{rank:<3d} |{_bar(start, end, lo, hi, width)}| "
+                f"{end - start:.6g}s"
+            )
+
+    # per-step start-skew series per comm op
+    op_starts: dict[str, dict[int, list[float]]] = {}
+    for rank, _, name, _, start, _, _ in comm_spans:
+        op_starts.setdefault(name, {}).setdefault(rank, []).append(start)
+    for op in sorted(op_starts):
+        per_rank = op_starts[op]
+        if len(per_rank) < 2:
+            continue
+        for starts in per_rank.values():
+            starts.sort()
+        n_steps = min(len(s) for s in per_rank.values())
+        skews = [
+            (max(s[k] for s in per_rank.values())
+             - min(s[k] for s in per_rank.values())) * 1e3
+            for k in range(n_steps)
+        ]
+        worst = max(range(n_steps), key=skews.__getitem__)
+        shown = " ".join(f"{v:.3g}" for v in skews[:max_steps])
+        more = (f" ... ({n_steps - max_steps} more)"
+                if n_steps > max_steps else "")
+        lines.append(
+            f"SKEW {op} start-skew ms over {n_steps} steps: {shown}{more}"
+            f" | max {skews[worst]:.3g}ms @step {worst}"
+        )
+    return lines
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpumt-trace",
+        description="merge per-rank telemetry JSONL into Chrome "
+        "trace-event JSON (one track per rank, clock offsets applied); "
+        "open the output in Perfetto (ui.perfetto.dev) or "
+        "chrome://tracing",
+    )
+    p.add_argument(
+        "files",
+        nargs="+",
+        help="per-rank JSONL files; an un-suffixed --jsonl base path "
+        "expands to its .p<i> rank set",
+    )
+    p.add_argument(
+        "-o", "--out",
+        default="trace.json",
+        help="output trace path (default trace.json)",
+    )
+    p.add_argument(
+        "--stdout",
+        action="store_true",
+        help="write the trace document to stdout instead of --out",
+    )
+    args = p.parse_args(argv)
+
+    files = [f for f in expand_rank_files(args.files) if Path(f).exists()]
+    if not files:
+        print("tpumt-trace: no input files found", file=sys.stderr)
+        return 1
+    if args.stdout:
+        doc = chrome_trace(files)
+        n = placed_events(doc)
+        json.dump(doc, sys.stdout)
+        print()
+    else:
+        n = write_trace(files, args.out)
+        print(
+            f"tpumt-trace: wrote {args.out}: {n} events from "
+            f"{len(files)} files",
+            file=sys.stderr,
+        )
+    if n == 0:
+        print(
+            "tpumt-trace: no timestamped records (pre-timeline JSONL?) "
+            "— trace is valid but empty",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,6 +174,15 @@ class PhaseTimer:
         self.counts: dict[str, int] = defaultdict(int)
         self.mins: dict[str, float] = {}
         self.maxs: dict[str, float] = {}
+        # wall-clock (Unix) + monotonic bounds of each phase's lifetime:
+        # first entry's start through last exit's end, warmup entries
+        # included — the timeline (instrument/timeline.py) draws the
+        # phase as the window it really occupied, while seconds/counts
+        # keep the reference's warmup-skipping accumulation semantics
+        self.t_starts: dict[str, float] = {}
+        self.t_ends: dict[str, float] = {}
+        self.mono_starts: dict[str, float] = {}
+        self.mono_ends: dict[str, float] = {}
         self._entries: dict[str, int] = defaultdict(int)
         self.skip_first = skip_first
 
@@ -185,9 +194,16 @@ class PhaseTimer:
         them via :func:`block` inside the body before exit."""
         if sync is not None:
             block(sync)
+        t0_wall = time.time()
         t0 = time.perf_counter()
         yield
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
+        self.t_starts.setdefault(name, t0_wall)
+        # wall end anchored to the monotonic duration (NTP-step-proof)
+        self.t_ends[name] = t0_wall + dt
+        self.mono_starts.setdefault(name, t0)
+        self.mono_ends[name] = t1
         self._entries[name] += 1
         if self._entries[name] > self.skip_first:
             self.seconds[name] += dt
@@ -204,6 +220,13 @@ class PhaseTimer:
     def mean(self, name: str) -> float:
         c = self.counts[name]
         return self.seconds[name] / c if c else 0.0
+
+    def wall_span(self, name: str) -> tuple[float | None, float | None]:
+        """Wall-clock ``(t_start, t_end)`` of the phase's full lifetime
+        (first entry to last exit), or ``(None, None)`` if never entered
+        — the pair every JSONL ``time`` record carries for the cross-rank
+        timeline."""
+        return self.t_starts.get(name), self.t_ends.get(name)
 
     def lines(self, prefix: str = "TIME", stats: bool = False) -> list[str]:
         """Stable per-phase lines (≅ ``TIME <phase> : %0.3f``,

@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 from contextlib import contextmanager
 
 from tpu_mpi_tests.instrument import telemetry as _telemetry
@@ -69,6 +70,17 @@ class Watchdog:
         self._timer: threading.Timer | None = None
 
     def _fire(self):
+        # place the fire on the cross-rank timeline before dying: with a
+        # JSONL sink enabled this lands a ``kind: "watchdog"`` record the
+        # trace merger renders as the marker terminating this rank's flow
+        # (telemetry.emit is best-effort — a sink error cannot mask the
+        # hang diagnosis below)
+        _telemetry.emit({
+            "kind": "watchdog",
+            "phase": self.phase,
+            "deadline_s": self.seconds,
+            "t": time.time(),
+        })
         history = comm_op_history()
         if history:
             attribution = (

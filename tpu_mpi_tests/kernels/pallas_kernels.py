@@ -273,8 +273,11 @@ def _vpu_probe_kernel(z_ref, out_ref, *, reps, mix, se):
     elif mix == "dualdim":
         # the EXACT dual-dim step body (_dual_step_kernel: 4-tap
         # derivative accumulations on BOTH axes from one window read,
-        # per-axis scale, f32 squared-residual reduction) — ~20 nominal
-        # ops/elt/rep. The derivatives fold back into the interior and
+        # per-axis scale, TWO row-masked f32 squared-residual
+        # reductions) — ~22 nominal ops/elt/rep, each mask's `where`
+        # counted as one op (the same convention as dualdim_lean's 14,
+        # which counts its single mask). The derivatives fold back into
+        # the interior and
         # the residual scalar folds in ``se``-scaled so every output
         # element depends on the whole reduction (nothing dead-codes);
         # tests replicate this recurrence in numpy
@@ -483,10 +486,13 @@ def vpu_probe_pallas(z, reps: int, mix: str = "fma", se: float = 1e-9,
     #6 — ``heat5`` (the heat Laplacian streamer's exact per-step body:
     4 concat shifts + two-axis Euler update + border mask, ~11 nominal
     ops/elt) and ``dualdim`` (the dual-dim step kernel's body: 4-tap
-    derivatives on both axes + f32 squared-residual reduction, ~20
-    nominal ops/elt; ``dualdim_lean`` is the op-diet body —
-    difference-form taps with the scale folded into the coefficients
-    plus ONE fused masked residual reduction, ~14 nominal ops/elt).
+    derivatives on both axes + TWO row-masked f32 squared-residual
+    reductions, ~22 nominal ops/elt; ``dualdim_lean`` is the op-diet
+    body — difference-form taps with the scale folded into the
+    coefficients plus ONE fused masked residual reduction, ~14 nominal
+    ops/elt). Mask-op convention for both counts: each ``where`` select
+    feeding a reduction counts as one op/elt — dualdim's 22 includes
+    its two masks exactly as dualdim_lean's 14 includes its one.
     The ratio of a kernel mix's rate to the fma rate
     prices its shifts/reductions; each hand kernel's marginal element
     rate over its own mix's probe rate is the fraction of the VPU
