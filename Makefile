@@ -1,6 +1,6 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
-.PHONY: test verify native bench smoke trace-smoke ci clean
+.PHONY: test verify native bench smoke trace-smoke lint ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -39,8 +39,18 @@ trace-smoke:
 		assert all('ts' in e and 'pid' in e for e in evs); \
 		print('trace-smoke OK:', len(evs), 'events')"
 
-# CI umbrella: the tier-1 gate plus the timeline-pipeline smoke
-ci: verify trace-smoke
+# self-clean gate: the repo's own code must raise zero tpumt-lint
+# findings (stable TPMxxx codes — README "Static analysis"); unused
+# suppressions are findings too, so stale ignores also fail here. The
+# golden fixtures (analysis/fixtures/) are deliberately bad and are
+# excluded from recursive walks by the linter itself.
+lint:
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests tpu tests __graft_entry__.py
+
+# CI umbrella: the tier-1 gate, the timeline-pipeline smoke, and the
+# lint self-clean gate
+ci: verify trace-smoke lint
 
 clean:
 	$(MAKE) -C native clean

@@ -1,0 +1,126 @@
+"""Direct unit coverage for the ``compat.py`` jax-version shims.
+
+Every internal module imports ``shard_map``/``axis_size``/
+``pcast_varying``/``tpu_compiler_params`` from ``tpu_mpi_tests.compat``;
+when the installed jax drifts past what the shims paper over, the
+failure mode used to be mass import/trace errors across the whole suite.
+These tests pin each shim's contract on the installed jax so drift
+fails HERE, loudly and attributably, first.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_mpi_tests import compat
+
+
+def test_shard_map_check_vma_spelling(mesh8):
+    """The shim accepts the current ``check_vma`` kwarg name on every
+    jax version (older jax spells it ``check_rep``)."""
+    x = jnp.arange(8.0)
+
+    def body(v):
+        return v * 2
+
+    out = compat.shard_map(
+        body, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0) * 2)
+
+
+def test_shard_map_default_checking(mesh8):
+    """Default (check_vma=True) path traces and runs too — the flag
+    rename is the compat risk, not the value."""
+    x = jnp.arange(8.0)
+    out = compat.shard_map(
+        lambda v: v + 1, mesh=mesh8, in_specs=P("shard"),
+        out_specs=P("shard"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0) + 1)
+
+
+def test_axis_size_inside_shard_map(mesh8):
+    """``axis_size`` resolves the bound mesh axis size inside a
+    shard_map body (lax.axis_size on current jax, axis_frame on 0.4.x)."""
+    x = jnp.zeros(8)
+
+    def body(v):
+        n = compat.axis_size("shard")
+        return v + n
+
+    out = compat.shard_map(
+        body, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 8.0))
+
+
+def test_pcast_varying_value_preserving(mesh8):
+    """``pcast_varying`` must be a value-level identity on every
+    version (on new jax it only changes the varying-axes tracking; on
+    old jax it IS the identity) — and its output must be consumable by
+    a collective over the same axis."""
+    from jax import lax
+
+    x = jnp.arange(8.0)
+
+    def body(v):
+        cast = compat.pcast_varying(jnp.sum(v), "shard")
+        return v + 0 * lax.psum(cast, "shard")
+
+    out = compat.shard_map(
+        body, mesh=mesh8, in_specs=P("shard"), out_specs=P("shard"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+
+
+def test_tpu_compiler_params_known_and_unknown_fields():
+    """The shim constructs the installed jax's params class; fields it
+    knows must round-trip, fields it lacks (older jax) must be dropped,
+    not raised — with the repo's real call shape
+    (``has_side_effects=True, collective_id=...``, pallas_kernels.py)."""
+    pltpu = pytest.importorskip("jax.experimental.pallas.tpu")
+    params = compat.tpu_compiler_params(
+        has_side_effects=True, collective_id=0
+    )
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    assert isinstance(params, cls)
+    for field in ("has_side_effects", "collective_id"):
+        if hasattr(params, field):
+            assert getattr(params, field) in (True, 0)
+
+
+def test_tpu_compiler_params_rejects_nothing_silently_on_current_api():
+    """On a jax new enough to have ``CompilerParams``, unknown-field
+    dropping must NOT be active: a typo'd field should raise there (the
+    drop path exists only for the legacy class)."""
+    pltpu = pytest.importorskip("jax.experimental.pallas.tpu")
+    if getattr(pltpu, "CompilerParams", None) is None:
+        pytest.skip("legacy TPUCompilerParams: drop path is by design")
+    with pytest.raises(TypeError):
+        compat.tpu_compiler_params(definitely_not_a_field=1)
+
+
+def test_exports_match_internal_consumers():
+    """The four shim names every internal module imports must exist —
+    a rename here is the mass-import-failure mode this file guards."""
+    for name in ("shard_map", "axis_size", "pcast_varying",
+                 "tpu_compiler_params"):
+        assert callable(getattr(compat, name)), name
+
+
+def test_installed_jax_has_exactly_one_shard_map_home():
+    """Sanity on the shim's version probe: whichever branch was taken,
+    the wrapped callable is the installed jax's shard_map."""
+    if hasattr(jax, "shard_map"):
+        assert compat._shard_map is jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as legacy
+
+        assert compat._shard_map is legacy
+        assert compat._VMA_FLAG == "check_rep"

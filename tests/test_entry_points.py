@@ -128,6 +128,44 @@ def test_tpumt_trace_help():
             in pyproject)
 
 
+def test_tpumt_lint_runs_without_jax(tmp_path):
+    """The tpumt-lint console script must import, parse --help, AND
+    produce findings in a process where ``import jax`` raises — the
+    same login-node guarantee tpumt-report/tpumt-trace already claim
+    (the linter is pure stdlib: ast + tokenize)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = jnp.sin(x)\n"
+        "    return y, time.perf_counter() - t0\n"
+    )
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.analysis import cli\n"
+        "try:\n"
+        "    cli.main(['--help'])\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        f"assert cli.main([{str(bad)!r}]) == 1\n"
+        f"assert cli.main(['--ignore', 'TPM1', {str(bad)!r}]) == 0\n"
+        "print('LINT NOJAX OK')\n"
+    )
+    r = run_py(code)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "LINT NOJAX OK" in r.stdout
+    assert "tpumt-lint" in r.stdout  # --help went to stdout
+    pyproject = (REPO / "pyproject.toml").read_text()
+    assert 'tpumt-lint = "tpu_mpi_tests.analysis.cli:main"' in pyproject
+
+
 def test_graft_dryrun_multichip():
     r = run_py(
         "import __graft_entry__ as g\n"

@@ -1,0 +1,262 @@
+"""tpumt-lint golden tests: every rule family fails on its bad fixture,
+passes on its good fixture, and respects ``# tpumt: ignore[...]``;
+engine behaviors (suppressions, select/ignore, output formats, exit
+codes, self-clean gate) on top.
+
+The fixtures live in ``tpu_mpi_tests/analysis/fixtures/`` — excluded
+from recursive walks (deliberately-bad code must not fail the
+self-clean gate) but always linted when passed explicitly, which is
+what these tests do.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_mpi_tests.analysis import cli
+from tpu_mpi_tests.analysis.core import (
+    collect_suppressions,
+    lint_paths,
+    rule_table,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tpu_mpi_tests" / "analysis" / "fixtures"
+
+#: (family prefix, fixture stem) for the single-file families
+FILE_FAMILIES = [
+    ("TPM1", "tpm1"),
+    ("TPM2", "tpm2"),
+    ("TPM3", "tpm3"),
+    ("TPM5", "tpm5"),
+    ("TPM6", "tpm6"),
+]
+
+
+def codes_of(findings):
+    return [f.code for f in findings]
+
+
+@pytest.mark.parametrize("family,stem", FILE_FAMILIES)
+def test_family_bad_good_suppressed(family, stem):
+    bad = lint_paths([str(FIXTURES / f"{stem}_bad.py")])
+    assert any(c.startswith(family) for c in codes_of(bad)), (
+        f"{stem}_bad.py must raise a {family}xx finding, got {bad}"
+    )
+
+    good = lint_paths([str(FIXTURES / f"{stem}_good.py")])
+    assert not any(c.startswith(family) for c in codes_of(good)), (
+        f"{stem}_good.py must be clean of {family}xx, got {good}"
+    )
+
+    sup = lint_paths([str(FIXTURES / f"{stem}_suppressed.py")])
+    assert not any(c.startswith(family) for c in codes_of(sup)), (
+        f"suppression comment must silence {family}xx, got {sup}"
+    )
+    # a suppression that fired is used: no TPM900 on the same file
+    assert "TPM900" not in codes_of(sup), sup
+
+
+@pytest.mark.parametrize("variant,expect", [
+    ("tpm4_bad", True),
+    ("tpm4_good", False),
+    ("tpm4_suppressed", False),
+])
+def test_import_hygiene_mini_trees(variant, expect):
+    findings = lint_paths(
+        [str(FIXTURES / variant)],
+        entry_modules={"app.cli": "app.cli"},
+    )
+    has = any(c == "TPM401" for c in codes_of(findings))
+    assert has == expect, findings
+    if variant == "tpm4_suppressed":
+        assert "TPM900" not in codes_of(findings), findings
+
+
+def test_import_hygiene_duplicate_module_names_all_scanned():
+    """Linting the bad and good mini-trees TOGETHER must still report
+    the bad tree's TPM401: both define module 'app.cli', and collapsing
+    duplicates would silently drop one tree from the reachability scan
+    (the gate must widen, never under-report)."""
+    findings = lint_paths(
+        [str(FIXTURES / "tpm4_bad"), str(FIXTURES / "tpm4_good")],
+        entry_modules={"app.cli": "app.cli"},
+    )
+    assert "TPM401" in codes_of(findings), findings
+    assert all("tpm4_bad" in f.path for f in findings
+               if f.code == "TPM401"), findings
+
+
+def test_import_hygiene_exempts_importerror_guarded_try(tmp_path):
+    """`try: import jax / except ImportError:` imports fine where jax
+    is absent — the canonical safe optional import must not be flagged.
+    An import in the HANDLER still is: it runs exactly when the body
+    already failed."""
+    pkg = tmp_path / "app"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "cli.py").write_text(
+        "try:\n"
+        "    import jax\n"
+        "except ImportError:\n"
+        "    jax = None\n"
+    )
+    findings = lint_paths([str(tmp_path)],
+                          entry_modules={"app.cli": "app.cli"})
+    assert "TPM401" not in codes_of(findings), findings
+
+    (pkg / "cli.py").write_text(
+        "try:\n"
+        "    import jax\n"
+        "except ImportError:\n"
+        "    from jax.experimental import compat as jax\n"
+    )
+    findings = lint_paths([str(tmp_path)],
+                          entry_modules={"app.cli": "app.cli"})
+    assert codes_of(findings).count("TPM401") == 1, findings
+
+
+def test_missing_py_file_reports_one_finding(tmp_path):
+    """A nonexistent explicit .py path must yield exactly ONE TPM902
+    (the existence check), not a second contradictory parse error."""
+    findings = lint_paths([str(tmp_path / "ghost.py")])
+    assert codes_of(findings) == ["TPM902"], findings
+    assert "does not exist" in findings[0].message
+
+
+def test_bad_fixture_findings_carry_lines_and_messages():
+    findings = lint_paths([str(FIXTURES / "tpm1_bad.py")])
+    f = next(f for f in findings if f.code == "TPM101")
+    assert f.line == 10  # the dispatch line, where the fix goes
+    assert "block" in f.message
+    assert str(FIXTURES / "tpm1_bad.py") == f.path
+
+
+def test_unused_suppression_is_a_finding():
+    findings = lint_paths([str(FIXTURES / "tpm9_unused.py")])
+    assert codes_of(findings) == ["TPM900"]
+    assert "TPM101" in findings[0].message
+
+
+def test_malformed_suppression_is_a_finding(tmp_path):
+    p = tmp_path / "mal.py"
+    p.write_text("x = 1  # tpumt: ignore TPM101 (missing brackets)\n")
+    findings = lint_paths([str(p)])
+    assert codes_of(findings) == ["TPM901"]
+
+
+def test_suppression_marker_inside_string_is_not_parsed():
+    # tokenize-based collection: the marker in a string literal is data
+    src = 's = "# tpumt: ignore[TPM101]"\n'
+    supps, malformed = collect_suppressions(src)
+    assert supps == [] and malformed == []
+
+
+def test_suppression_on_closing_paren_of_multiline_call(tmp_path):
+    """Findings anchor to a multi-line call's FIRST line; a trailing
+    suppression on the closing paren must still silence it (matched via
+    the logical statement's start line) and count as used."""
+    p = tmp_path / "multi.py"
+    p.write_text(
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = jnp.sin(\n"
+        "        x\n"
+        "    )  # tpumt: ignore[TPM101]\n"
+        "    return y, time.perf_counter() - t0\n"
+    )
+    assert lint_paths([str(p)]) == []
+
+
+def test_missing_path_is_a_finding_not_a_clean_pass(tmp_path):
+    """A lint gate pointed at a renamed/missing directory must fail
+    loudly, never lint nothing and exit 0."""
+    findings = lint_paths([str(tmp_path / "no_such_dir")])
+    assert codes_of(findings) == ["TPM902"]
+    assert "vacuously" in findings[0].message
+    notes = tmp_path / "notes.txt"
+    notes.write_text("not python\n")
+    findings = lint_paths([str(notes)])
+    assert codes_of(findings) == ["TPM902"]
+
+
+def test_select_and_ignore_filter_families():
+    bad = str(FIXTURES / "tpm2_bad.py")
+    assert lint_paths([bad], select=["TPM1xx"]) == []
+    assert lint_paths([bad], ignore=["TPM2"]) == []
+    kept = lint_paths([bad], select=["TPM2"])
+    assert kept and all(c == "TPM201" for c in codes_of(kept))
+
+
+def test_ignored_family_does_not_warn_unused_suppression():
+    sup = str(FIXTURES / "tpm1_suppressed.py")
+    assert lint_paths([sup], ignore=["TPM1"]) == []
+
+
+def test_syntax_error_reports_tpm902(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = lint_paths([str(p)])
+    assert codes_of(findings) == ["TPM902"]
+
+
+def test_recursive_walk_skips_fixtures_dir(tmp_path):
+    sub = tmp_path / "pkg" / "fixtures"
+    sub.mkdir(parents=True)
+    (sub / "bad.py").write_text(
+        (FIXTURES / "tpm1_bad.py").read_text()
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+def test_cli_human_output_and_exit_codes(capsys):
+    rc = cli.main([str(FIXTURES / "tpm1_bad.py")])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "TPM101" in out.out
+    assert "finding" in out.err
+
+    rc = cli.main([str(FIXTURES / "tpm1_good.py")])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert out.out == ""
+
+
+def test_cli_json_output(capsys):
+    rc = cli.main(["--format", "json", str(FIXTURES / "tpm3_bad.py")])
+    out = capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.out)
+    assert doc["version"] == 1
+    assert doc["count"] == len(doc["findings"]) > 0
+    f = doc["findings"][0]
+    assert set(f) == {"path", "line", "col", "code", "message"}
+    assert {x["code"] for x in doc["findings"]} == {"TPM301", "TPM302"}
+
+
+def test_cli_list_rules_covers_every_family(capsys):
+    rc = cli.main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for code in ("TPM101", "TPM201", "TPM301", "TPM302", "TPM401",
+                 "TPM501", "TPM601", "TPM900"):
+        assert code in out
+    # table rows match the registry (README is hand-synced to this)
+    assert len(rule_table()) >= 8
+
+
+def test_self_clean_gate():
+    """The acceptance gate: the repo's own code lints clean — the same
+    invocation ``make lint`` runs. A finding here means either new code
+    regressed a gated hazard class or a rule grew a false positive;
+    both block CI by design."""
+    findings = lint_paths([
+        str(REPO / "tpu_mpi_tests"),
+        str(REPO / "tpu"),
+        str(REPO / "tests"),
+        str(REPO / "__graft_entry__.py"),
+    ])
+    assert findings == [], "\n".join(f.format() for f in findings)

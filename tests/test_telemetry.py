@@ -83,8 +83,12 @@ class TestCommSpan:
         @jax.jit
         def loop(x):
             def body(_, xx):
-                return T.span_call("traced_op", lambda a: a + 1, xx,
-                                   nbytes=1024)
+                # suppressed: deliberately calling the telemetry layer
+                # under a trace is this test's point — it asserts the
+                # passthrough no-op the lint rule enforces elsewhere
+                return T.span_call(  # tpumt: ignore[TPM201]
+                    "traced_op", lambda a: a + 1, xx, nbytes=1024
+                )
             return lax.fori_loop(0, 1000, body, x)
 
         out = loop(jnp.zeros(4))

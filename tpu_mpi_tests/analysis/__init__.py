@@ -1,0 +1,34 @@
+"""Static analysis: ``tpumt-lint``, the repo's JAX/TPU correctness linter.
+
+Encodes the host-side hazard classes this repo has shipped and fixed
+(sync-dishonest timing, telemetry recorded under a jax trace, float64
+values silently canonicalized to f32, eager ``import jax`` in login-node
+CLIs, mesh-axis mismatches, unlocked cross-thread JSONL writes) as
+mechanically-enforced AST rules with stable ``TPMxxx`` codes. The repo
+itself must lint clean (``make lint``, part of ``make ci``).
+
+Pure stdlib (``ast`` + ``tokenize``): like ``tpumt-report`` and
+``tpumt-trace``, the linter is part of the login-node CLI set and must
+import and run where ``import jax`` raises.
+"""
+
+# lazy re-exports (PEP 562), same discipline as the sibling packages:
+# nothing here imports anything at module load beyond the stdlib, and the
+# rule modules only load when the linter actually runs
+_EXPORTS = {
+    "Finding": "core",
+    "lint_paths": "core",
+    "all_rules": "core",
+}
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"tpu_mpi_tests.analysis.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

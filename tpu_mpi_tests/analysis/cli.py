@@ -1,0 +1,95 @@
+"""``tpumt-lint``: the repo's JAX/TPU correctness linter (console script).
+
+Also runnable uninstalled as ``python -m tpu_mpi_tests.analysis.cli``.
+Pure stdlib like the sibling login-node CLIs (tpumt-report/tpumt-trace):
+imports and runs where ``import jax`` raises.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_mpi_tests.analysis.core import lint_paths, rule_table
+
+_EPILOG = """\
+rule families (stable codes; see README "Static analysis" for the table):
+  TPM1xx sync-honesty     timed jax dispatch without a device sync
+  TPM2xx trace-purity     host side effects inside traced functions
+  TPM3xx x64-safety       float64 silently canonicalized to float32
+  TPM4xx import-hygiene   eager `import jax` in login-node CLI closures
+  TPM5xx axis-consistency collective axis names vs shard_map/mesh
+  TPM6xx concurrency      unlocked cross-thread file-handle writes
+  TPM9xx engine           unused/malformed suppressions, parse errors
+
+suppress one finding on its line (unused suppressions are themselves
+findings):   x = jnp.asarray(2.0)  # tpumt: ignore[TPM301]
+"""
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpumt-lint",
+        description="tpumt-lint: static analyzer for this repo's "
+        "JAX/TPU correctness hazard classes (stdlib-only; runs on "
+        "login nodes without jax).",
+        epilog=_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("paths", nargs="*", metavar="PATH",
+                    help="files or directories to lint (directories "
+                    "recurse over *.py, skipping fixtures/ and "
+                    "__pycache__/)")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human", help="output format")
+    ap.add_argument("--select", action="append", metavar="CODES",
+                    help="only these codes/families (comma list; "
+                    "TPM1, TPM1xx and TPM101 all work); repeatable")
+    ap.add_argument("--ignore", action="append", metavar="CODES",
+                    help="drop these codes/families (comma list); "
+                    "repeatable")
+    ap.add_argument("--entry-module", action="append", metavar="MOD",
+                    help="override the TPM4xx stdlib-only entry-module "
+                    "set (default: the tpumt-* console scripts); "
+                    "repeatable")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every registered code and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, summary in rule_table():
+            print(f"{code}  {summary}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: tpumt-lint tpu_mpi_tests tpu "
+                 "tests __graft_entry__.py)")
+
+    entry_modules = None
+    if args.entry_module:
+        entry_modules = {m: m for m in args.entry_module}
+    findings = lint_paths(
+        args.paths,
+        select=args.select,
+        ignore=args.ignore,
+        entry_modules=entry_modules,
+    )
+
+    if args.format == "json":
+        print(json.dumps(
+            {"version": 1, "count": len(findings),
+             "findings": [f.as_dict() for f in findings]},
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            print(f"tpumt-lint: {len(findings)} finding"
+                  f"{'s' if len(findings) != 1 else ''}",
+                  file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,387 @@
+"""``tpumt-lint`` engine: file walking, rule registry, suppressions.
+
+The engine is deliberately small: it parses each file once (``ast``),
+hands the tree to every registered file-scope rule, hands the whole file
+set to project-scope rules (import-reachability needs the graph), then
+applies ``# tpumt: ignore[TPMxxx]`` suppression comments and reports any
+suppression that silenced nothing (an unused suppression is itself a
+finding — stale ignores are how gated bug classes sneak back in).
+
+Stdlib-only by contract (verified by ``tests/test_entry_points.py``):
+the linter must run on login nodes where ``import jax`` raises.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator
+
+#: console-script entry points whose import closure must stay jax-free
+#: (the TPM4xx reachability roots; tests may substitute their own set)
+DEFAULT_ENTRY_MODULES = {
+    "tpu_mpi_tests.instrument.aggregate": "tpumt-report",
+    "tpu_mpi_tests.instrument.timeline": "tpumt-trace",
+    "tpu_mpi_tests.analysis.cli": "tpumt-lint",
+    # the rule modules load lazily at lint time (all_rules()), which the
+    # static reachability walk cannot see — root them explicitly so an
+    # eager jax import in a rule module is still caught
+    "tpu_mpi_tests.analysis.rules": "tpumt-lint",
+}
+
+#: directory names never descended into on a recursive walk. ``fixtures``
+#: keeps the rule golden files (deliberately-bad code under
+#: ``analysis/fixtures/``) out of the self-clean gate; explicit file
+#: arguments are always linted, which is how the golden tests reach them.
+SKIP_DIRS = {"__pycache__", "fixtures", "node_modules"}
+
+_ENGINE_CODES = {
+    "TPM900": "unused suppression: the silenced finding is gone",
+    "TPM901": "malformed `# tpumt:` comment",
+    "TPM902": "file cannot be read or parsed",
+}
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} " \
+               f"{self.message}"
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "code": self.code, "message": self.message}
+
+
+def attr_parts(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` → ``["a", "b", "c"]``; None when the chain's root is not
+    a plain name (e.g. ``f(x).block_until_ready``)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def last_attr(node: ast.AST) -> str | None:
+    """Final component of a call target (method/function name)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class ImportMap:
+    """Local-name → origin-module resolution for one file.
+
+    Imports are collected from the WHOLE tree (drivers import jax inside
+    ``run()`` by convention, and those bindings are what the rule
+    heuristics need to resolve)."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, str] = {}  # alias -> dotted module
+        self.names: dict[str, tuple[str, str]] = {}  # name -> (mod, orig)
+
+    @classmethod
+    def collect(cls, tree: ast.AST) -> "ImportMap":
+        m = cls()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        m.modules[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        m.modules.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    m.names[a.asname or a.name] = (mod, a.name)
+        return m
+
+    def origin(self, root: str) -> str | None:
+        """Dotted origin of a local name: the module it aliases, or
+        ``module.original`` for a from-import; None if unknown."""
+        if root in self.modules:
+            return self.modules[root]
+        if root in self.names:
+            mod, orig = self.names[root]
+            return f"{mod}.{orig}" if mod else orig
+        return None
+
+    def resolve(self, func: ast.AST) -> str | None:
+        """Canonical dotted name of a call target with the root alias
+        substituted by its import origin (``jnp.asarray`` →
+        ``jax.numpy.asarray``). None for non-name roots."""
+        parts = attr_parts(func)
+        if not parts:
+            return None
+        origin = self.origin(parts[0])
+        if origin:
+            return ".".join([origin] + parts[1:])
+        return ".".join(parts)
+
+
+def module_name(path: str) -> str:
+    """Importable dotted name of a file, anchored at the topmost enclosing
+    directory that still has an ``__init__.py`` (so fixture mini-packages
+    resolve relative to themselves, not the repo)."""
+    p = Path(path).resolve()
+    parts = [] if p.name == "__init__.py" else [p.stem]
+    d = p.parent
+    while (d / "__init__.py").exists():
+        parts.insert(0, d.name)
+        d = d.parent
+    return ".".join(parts)
+
+
+class FileContext:
+    """One parsed file plus the lookups every rule shares."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.module = module_name(path)
+        self.imports = ImportMap.collect(tree)
+
+
+class ProjectContext:
+    """The full linted file set, for cross-file rules. Module names map
+    to LISTS of contexts: two linted roots can legitimately contain
+    same-named modules (e.g. fixture mini-trees), and collapsing them
+    to one would silently drop files from the reachability scan."""
+
+    def __init__(self, contexts: list[FileContext],
+                 entry_modules: dict[str, str]):
+        self.contexts = contexts
+        self.entry_modules = entry_modules
+        self.by_module: dict[str, list[FileContext]] = {}
+        for c in contexts:
+            if c.module:
+                self.by_module.setdefault(c.module, []).append(c)
+
+
+_SUPPRESS_RE = re.compile(r"tpumt:\s*ignore\[([A-Za-z0-9_,\s]*)\]")
+
+
+@dataclass
+class Suppression:
+    """One ``# tpumt: ignore[...]`` comment: the codes it silences, the
+    physical lines it applies to (the comment's own line plus the first
+    line of its logical statement — findings anchor to a multi-line
+    call's first line, while the trailing comment often sits on the
+    closing paren), and whether any finding consumed it."""
+
+    codes: set[str]
+    lines: set[int]
+    comment_line: int
+    used_codes: set[str] | None = None
+
+    def __post_init__(self):
+        if self.used_codes is None:
+            self.used_codes = set()
+
+
+def collect_suppressions(
+    source: str,
+) -> tuple[list[Suppression], list[int]]:
+    """``# tpumt: ignore[TPM101,TPM201]`` comments plus the lines of
+    malformed ``# tpumt:`` comments. Tokenized, not regexed over raw
+    lines, so string literals containing the marker (e.g. this linter's
+    own tests) cannot false-match."""
+    supps: list[Suppression] = []
+    malformed: list[int] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return supps, malformed
+    _SKIP = (tokenize.NL, tokenize.NEWLINE, tokenize.COMMENT,
+             tokenize.INDENT, tokenize.DEDENT, tokenize.ENCODING)
+    logical_start: int | None = None
+    for tok in tokens:
+        if logical_start is None and tok.type not in _SKIP:
+            logical_start = tok.start[0]
+        if tok.type == tokenize.NEWLINE:
+            logical_start = None
+        if tok.type != tokenize.COMMENT or "tpumt:" not in tok.string:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        codes = {c.strip().upper() for c in m.group(1).split(",")
+                 if c.strip()} if m else set()
+        if codes:
+            lines = {tok.start[0]}
+            if logical_start is not None:
+                lines.add(logical_start)
+            supps.append(Suppression(codes, lines, tok.start[0]))
+        else:
+            malformed.append(tok.start[0])
+    return supps, malformed
+
+
+class CodeFilter:
+    """``--select``/``--ignore`` semantics: comma lists of codes or
+    family prefixes (``TPM1``, ``TPM1xx``, ``TPM101`` all work)."""
+
+    def __init__(self, select: Iterable[str] | None = None,
+                 ignore: Iterable[str] | None = None):
+        self.select = self._norm(select)
+        self.ignore = self._norm(ignore)
+
+    @staticmethod
+    def _norm(values: Iterable[str] | None) -> list[str]:
+        out: list[str] = []
+        for v in values or ():
+            for piece in v.split(","):
+                piece = piece.strip().upper()
+                if piece.endswith("XX"):
+                    piece = piece[:-2]
+                if piece:
+                    out.append(piece)
+        return out
+
+    def selected(self, code: str) -> bool:
+        if self.select and not any(code.startswith(p) for p in self.select):
+            return False
+        return not any(code.startswith(p) for p in self.ignore)
+
+
+def all_rules() -> list:
+    """The registered rule instances (imported lazily so ``--help`` and
+    suppression parsing never load the rule modules)."""
+    from tpu_mpi_tests.analysis.rules import ALL_RULES
+
+    return ALL_RULES
+
+
+def rule_table() -> list[tuple[str, str]]:
+    """``(code, summary)`` rows for every registered code, engine codes
+    included — the ``--list-rules`` and README source of truth."""
+    rows: list[tuple[str, str]] = []
+    for rule in all_rules():
+        rows.extend(sorted(rule.codes.items()))
+    rows.extend(sorted(_ENGINE_CODES.items()))
+    return rows
+
+
+def iter_files(paths: Iterable[str]) -> Iterator[Path]:
+    seen: set[Path] = set()
+    for p in paths:
+        path = Path(p)
+        if path.is_dir():
+            for f in sorted(path.rglob("*.py")):
+                rel = f.relative_to(path)
+                if any(part in SKIP_DIRS or part.startswith(".")
+                       for part in rel.parts[:-1]):
+                    continue
+                if f not in seen:
+                    seen.add(f)
+                    yield f
+        elif path.is_file() and path.suffix == ".py":
+            if path not in seen:
+                seen.add(path)
+                yield path
+
+
+def lint_paths(
+    paths: Iterable[str],
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+    entry_modules: dict[str, str] | None = None,
+) -> list[Finding]:
+    """Lint files/directories; returns sorted, suppression-filtered
+    findings (unused/malformed suppressions included as findings)."""
+    code_filter = CodeFilter(select, ignore)
+    contexts: list[FileContext] = []
+    raw: set[Finding] = set()
+
+    # a missing or non-.py path is a broken gate, never a clean one: a
+    # renamed directory in the `make lint` path list must fail loudly,
+    # not lint nothing and exit 0
+    for p in paths:
+        path = Path(p)
+        if not path.exists():
+            raw.add(Finding(str(p), 1, 0, "TPM902",
+                            "path does not exist — a lint gate over a "
+                            "missing path would pass vacuously"))
+        elif path.is_file() and path.suffix != ".py":
+            raw.add(Finding(str(p), 1, 0, "TPM902",
+                            "not a python file"))
+
+    for f in iter_files(paths):
+        path = str(f)
+        try:
+            source = f.read_text()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError, ValueError) as e:
+            line = getattr(e, "lineno", None) or 1
+            raw.add(Finding(path, line, 0, "TPM902",
+                            f"cannot parse: {e}"))
+            continue
+        contexts.append(FileContext(path, source, tree))
+
+    rules = all_rules()
+    for ctx in contexts:
+        for rule in rules:
+            if rule.scope != "file":
+                continue
+            for line, col, code, msg in rule.check(ctx):
+                raw.add(Finding(ctx.path, line, col, code, msg))
+    proj = ProjectContext(contexts, entry_modules or DEFAULT_ENTRY_MODULES)
+    for rule in rules:
+        if rule.scope != "project":
+            continue
+        for path, line, col, code, msg in rule.check_project(proj):
+            raw.add(Finding(path, line, col, code, msg))
+
+    suppressions = {
+        ctx.path: collect_suppressions(ctx.source) for ctx in contexts
+    }
+    findings: list[Finding] = []
+    for f in raw:
+        if not code_filter.selected(f.code):
+            continue
+        matched = False
+        for supp in suppressions.get(f.path, ((), ()))[0]:
+            if f.line in supp.lines and f.code in supp.codes:
+                supp.used_codes.add(f.code)
+                matched = True
+        if not matched:
+            findings.append(f)
+
+    for path, (supps, malformed) in suppressions.items():
+        for supp in supps:
+            for code in sorted(supp.codes - supp.used_codes):
+                if not (code_filter.selected(code)
+                        and code_filter.selected("TPM900")):
+                    continue
+                findings.append(Finding(
+                    path, supp.comment_line, 0, "TPM900",
+                    f"unused suppression for {code} — the finding it "
+                    f"silenced is gone; remove the comment",
+                ))
+        for line in malformed:
+            if code_filter.selected("TPM901"):
+                findings.append(Finding(
+                    path, line, 0, "TPM901",
+                    "malformed tpumt comment — expected "
+                    "`# tpumt: ignore[TPM101]` (comma-list of codes)",
+                ))
+
+    findings.sort()
+    return findings

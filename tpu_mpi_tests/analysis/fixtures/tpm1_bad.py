@@ -1,0 +1,12 @@
+"""TPM101 bad: the clock pair times an async dispatch, not the compute."""
+
+import time
+
+import jax.numpy as jnp
+
+
+def timed_daxpy(a, x, y):
+    t0 = time.perf_counter()
+    out = jnp.add(a * x, y)
+    seconds = time.perf_counter() - t0
+    return out, seconds

@@ -1,0 +1,15 @@
+"""TPM101 good: the timed region blocks on the op before reading the
+clock (the reference's kernel-then-synchronize discipline)."""
+
+import time
+
+import jax.numpy as jnp
+
+from tpu_mpi_tests.instrument.timers import block
+
+
+def timed_daxpy(a, x, y):
+    t0 = time.perf_counter()
+    out = block(jnp.add(a * x, y))
+    seconds = time.perf_counter() - t0
+    return out, seconds

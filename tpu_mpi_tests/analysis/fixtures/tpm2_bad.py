@@ -1,0 +1,13 @@
+"""TPM201 bad: host side effects inside a jitted function run once at
+trace time (and a reporter record there fabricates telemetry)."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(x, rep):
+    print("stepping", time.time())
+    rep.line("STEP")
+    return x + 1

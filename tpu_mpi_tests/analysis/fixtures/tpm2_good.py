@@ -1,0 +1,22 @@
+"""TPM201 good: in-trace printing goes through jax.debug.print, and
+host-side records are guarded by the trace check telemetry.py uses."""
+
+import jax
+
+
+def _under_trace():
+    from jax import core
+
+    return not core.trace_state_clean()
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("stepping {}", x)
+    return x + 1
+
+
+def record(rep, x):
+    if not _under_trace():
+        rep.line("STEP")
+    return step(x)

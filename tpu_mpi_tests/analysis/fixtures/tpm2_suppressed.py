@@ -1,0 +1,9 @@
+"""TPM201 suppressed: a deliberate trace-time print (compile marker)."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    print("TRACING step")  # tpumt: ignore[TPM201]
+    return x + 1

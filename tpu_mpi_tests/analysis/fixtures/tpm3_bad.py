@@ -1,0 +1,13 @@
+"""TPM3xx bad: a width-ambiguous float literal and an epoch crossing
+the device boundary (the PR 2 clock-sync quantization bug shape)."""
+
+import time
+
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+
+def record_clock():
+    scale = jnp.asarray(2.5)
+    stamp = multihost_utils.process_allgather(time.time())
+    return scale, stamp

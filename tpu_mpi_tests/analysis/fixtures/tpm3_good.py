@@ -1,0 +1,15 @@
+"""TPM3xx good: explicit dtype on the literal; the epoch crosses as
+f32-exact integer microsecond digits (manifest._split_us discipline)."""
+
+import time
+
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+from tpu_mpi_tests.instrument.manifest import _join_us, _split_us
+
+
+def record_clock():
+    scale = jnp.asarray(2.5, jnp.float32)
+    digits = multihost_utils.process_allgather(_split_us(time.time()))
+    return scale, _join_us(digits)

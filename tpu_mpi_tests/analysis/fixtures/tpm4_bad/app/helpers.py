@@ -1,0 +1,7 @@
+"""Helper that eagerly imports jax at module level (the hazard)."""
+
+import jax.numpy as jnp
+
+
+def mean(xs):
+    return jnp.mean(jnp.asarray(xs, jnp.float32))

@@ -1,0 +1,7 @@
+"""Helper that imports jax lazily, inside the function that needs it."""
+
+
+def mean(xs):
+    import jax.numpy as jnp
+
+    return jnp.mean(jnp.asarray(xs, jnp.float32))

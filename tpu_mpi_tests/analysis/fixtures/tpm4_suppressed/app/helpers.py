@@ -1,0 +1,8 @@
+"""Helper whose eager jax import is acknowledged (e.g. a module being
+migrated to the lazy idiom)."""
+
+import jax.numpy as jnp  # tpumt: ignore[TPM401]
+
+
+def mean(xs):
+    return jnp.mean(jnp.asarray(xs, jnp.float32))

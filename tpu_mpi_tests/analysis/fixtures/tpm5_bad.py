@@ -1,0 +1,15 @@
+"""TPM501 bad: psum over an axis the file's shard_map never binds."""
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_mpi_tests.compat import shard_map
+
+
+def total(mesh, x):
+    def body(v):
+        return lax.psum(v, "ring")
+
+    return shard_map(
+        body, mesh=mesh, in_specs=P("shard"), out_specs=P()
+    )(x)

@@ -1,0 +1,15 @@
+"""TPM501 good: the collective axis matches the shard_map binding."""
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_mpi_tests.compat import shard_map
+
+
+def total(mesh, x):
+    def body(v):
+        return lax.psum(v, "shard")
+
+    return shard_map(
+        body, mesh=mesh, in_specs=P("shard"), out_specs=P()
+    )(x)

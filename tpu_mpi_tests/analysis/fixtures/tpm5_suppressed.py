@@ -1,0 +1,16 @@
+"""TPM501 suppressed: the axis is bound by the CALLER's mesh (a
+cross-file pattern the same-file rule cannot see)."""
+
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_mpi_tests.compat import shard_map
+
+
+def total(mesh, x):
+    def body(v):
+        return lax.psum(v, "ring")  # tpumt: ignore[TPM501]
+
+    return shard_map(
+        body, mesh=mesh, in_specs=P("shard"), out_specs=P()
+    )(x)

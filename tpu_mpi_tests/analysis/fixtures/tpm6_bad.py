@@ -1,0 +1,18 @@
+"""TPM601 bad: the timer thread and the main thread write the same
+handle with no lock — records interleave (the watchdog JSONL bug)."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self, path):
+        self._f = open(path, "a")
+
+    def arm(self, seconds):
+        threading.Timer(seconds, self._dump).start()
+
+    def _dump(self):
+        self._f.write("timer fired\n")
+
+    def record(self, line):
+        self._f.write(line + "\n")

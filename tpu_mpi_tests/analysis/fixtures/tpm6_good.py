@@ -1,0 +1,21 @@
+"""TPM601 good: every write of the shared handle holds the lock, one
+write per record (the Reporter.jsonl discipline)."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self, path):
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+
+    def arm(self, seconds):
+        threading.Timer(seconds, self._dump).start()
+
+    def _dump(self):
+        with self._lock:
+            self._f.write("timer fired\n")
+
+    def record(self, line):
+        with self._lock:
+            self._f.write(line + "\n")

@@ -1,0 +1,3 @@
+"""TPM900: a suppression whose finding is gone must itself be flagged."""
+
+x = 1  # tpumt: ignore[TPM101]

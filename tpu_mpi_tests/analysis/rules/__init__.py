@@ -1,0 +1,22 @@
+"""Rule registry: one module per hazard family, aggregated here.
+
+Each rule module documents the shipped bug its family encodes; codes are
+stable (a code is never reused for a different hazard) so suppression
+comments stay meaningful across releases.
+"""
+
+from tpu_mpi_tests.analysis.rules.axis_consistency import AxisConsistency
+from tpu_mpi_tests.analysis.rules.concurrency import UnlockedSharedWrite
+from tpu_mpi_tests.analysis.rules.import_hygiene import ImportHygiene
+from tpu_mpi_tests.analysis.rules.sync_honesty import SyncHonesty
+from tpu_mpi_tests.analysis.rules.trace_purity import TracePurity
+from tpu_mpi_tests.analysis.rules.x64_safety import X64Safety
+
+ALL_RULES = [
+    SyncHonesty(),
+    TracePurity(),
+    X64Safety(),
+    ImportHygiene(),
+    AxisConsistency(),
+    UnlockedSharedWrite(),
+]

@@ -1,0 +1,113 @@
+"""TPM5xx — mesh-axis consistency.
+
+The bug class: collective axis names are stringly-typed; a ``lax.psum``
+over an axis the enclosing ``shard_map`` never bound fails only at trace
+time on a real mesh — and on a 1-device CI mesh some mismatches trace
+fine and ship. The rule is same-file by design (the comm layer threads
+``axis_name`` variables through, which the linter leaves alone): a
+string-literal axis in a collective must appear among the axis-name
+literals bound by a ``shard_map``/``Mesh``/``make_mesh``/
+``PartitionSpec`` in the same file. Files with no mesh/shard_map context
+are skipped — there is nothing to check against.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import (
+    FileContext,
+    attr_parts,
+    last_attr,
+)
+from tpu_mpi_tests.analysis.rules import _util
+
+#: calls whose string literals BIND axis names for the file
+AXIS_DEF_CALLS = {
+    "shard_map", "Mesh", "AbstractMesh", "make_mesh", "NamedSharding",
+    "PartitionSpec", "P",
+}
+
+#: collective/axis-query calls checked, with the axis argument position
+AXIS_USES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "ppermute": 1, "all_gather": 1, "all_to_all": 1, "pshuffle": 1,
+    "pbroadcast": 1, "axis_index": 0, "axis_size": 0,
+    "pcast_varying": 1, "pcast": 1,
+}
+
+#: origins whose AXIS_USES calls are real collectives (a local helper
+#: coincidentally named `all_gather` is not checked)
+USE_ORIGINS = ("jax", "tpu_mpi_tests.compat")
+
+
+def _axis_literals(node: ast.AST) -> list[tuple[str, ast.AST]]:
+    """String constants in an axis argument: ``"x"`` or ``("x", "y")``."""
+    out: list[tuple[str, ast.AST]] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append((node.value, node))
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                elt.value, str
+            ):
+                out.append((elt.value, elt))
+    return out
+
+
+class AxisConsistency:
+    name = "axis-consistency"
+    scope = "file"
+    codes = {
+        "TPM501": "collective axis name not bound by any shard_map/mesh "
+                  "in this file",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[tuple]:
+        bound: set[str] = set()
+        for call in _util.walk_calls(ctx.tree):
+            if last_attr(call.func) in AXIS_DEF_CALLS:
+                for n in ast.walk(call):
+                    if isinstance(n, ast.Constant) and isinstance(
+                        n.value, str
+                    ):
+                        bound.add(n.value)
+            # axis_name= kwargs bind too: compiled-fn factories take the
+            # axis they will shard_map over (e.g. iterate_pallas_fn)
+            for kw in call.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    bound.update(a for a, _ in _axis_literals(kw.value))
+        if not bound:
+            return
+
+        for call in _util.walk_calls(ctx.tree):
+            name = last_attr(call.func)
+            if name not in AXIS_USES:
+                continue
+            chain = attr_parts(call.func)
+            if not chain:
+                continue
+            origin = ctx.imports.origin(chain[0]) or ""
+            if not origin.startswith(USE_ORIGINS):
+                continue
+            axis_arg = None
+            pos = AXIS_USES[name]
+            if len(call.args) > pos:
+                axis_arg = call.args[pos]
+            else:
+                for kw in call.keywords:
+                    if kw.arg == "axis_name":
+                        axis_arg = kw.value
+            if axis_arg is None:
+                continue
+            for axis, node in _axis_literals(axis_arg):
+                if axis not in bound:
+                    known = ", ".join(sorted(bound))
+                    yield (
+                        node.lineno, node.col_offset, "TPM501",
+                        f"axis '{axis}' in {name}() is not bound by any "
+                        f"shard_map/mesh in this file (bound here: "
+                        f"{known}) — a mismatched axis fails only at "
+                        f"trace time on a real mesh",
+                    )

@@ -1,0 +1,107 @@
+"""TPM1xx — sync-honest timing.
+
+The bug class: JAX dispatch is async, so a ``perf_counter`` pair around
+a jax call times the *dispatch*, not the compute — the time lands on
+whichever later operation flushes the queue (the dispatch-vs-compute
+trap ``mpi_daxpy_nvtx`` exists to demonstrate; SURVEY §7 hard part 2).
+The reference suite brackets every timed phase with a device sync
+(``cudaDeviceSynchronize`` before ``MPI_Wtime``); this repo's analog is
+``instrument.timers.block`` / ``block_until_ready`` / ``comm_span`` /
+``PhaseTimer.timed`` — a monotonic-clock pair whose timed region
+dispatches device work without any of them is dishonest timing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import FileContext, last_attr
+from tpu_mpi_tests.analysis.rules import _util
+
+#: clock reads that start/stop a timing region
+CLOCKS = {"time.perf_counter", "time.monotonic"}
+
+#: call targets (final component) that synchronize device work before the
+#: clock is read again — chain_rate/dispatch_rate embed the discipline
+SYNC_NAMES = {
+    "block", "block_until_ready", "comm_span", "span_call", "timed",
+    "host_value", "device_get", "chain_rate", "dispatch_rate",
+    "sync_global_devices", "barrier",
+}
+
+
+def _clock_assign(ctx: FileContext, stmt: ast.stmt) -> str | None:
+    """``t0 = time.perf_counter()`` → ``"t0"``; else None."""
+    if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Call)):
+        return None
+    if ctx.imports.resolve(stmt.value.func) in CLOCKS:
+        return stmt.targets[0].id
+    return None
+
+
+def _uses_in_sub(stmt: ast.stmt, name: str) -> bool:
+    """Does the statement read the clock delta (``... - t0``)?"""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+            for side in (n.left, n.right):
+                if isinstance(side, ast.Name) and side.id == name:
+                    return True
+    return False
+
+
+def _rebinds(stmt: ast.stmt, name: str) -> bool:
+    if isinstance(stmt, ast.Assign):
+        return any(isinstance(t, ast.Name) and t.id == name
+                   for t in stmt.targets)
+    return False
+
+
+class SyncHonesty:
+    name = "sync-honesty"
+    scope = "file"
+    codes = {
+        "TPM101": "monotonic-clock pair times a jax dispatch with no "
+                  "block()/block_until_ready/comm_span in the region",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[tuple]:
+        local_device = _util.device_callables(ctx)
+        for stmts in _util.stmt_lists(ctx.tree):
+            yield from self._scan_list(ctx, stmts, local_device)
+
+    def _scan_list(self, ctx, stmts, local_device):
+        for i, stmt in enumerate(stmts):
+            t = _clock_assign(ctx, stmt)
+            if not t:
+                continue
+            region: list[ast.stmt] = []
+            for j in range(i + 1, len(stmts)):
+                region.append(stmts[j])
+                if _uses_in_sub(stmts[j], t):
+                    yield from self._check_region(
+                        ctx, region, local_device
+                    )
+                    break
+                if _rebinds(stmts[j], t):
+                    break  # clock restarted before any delta read
+
+    def _check_region(self, ctx, region, local_device):
+        dispatches: list[ast.Call] = []
+        for stmt in region:
+            for call in _util.walk_calls(stmt):
+                if last_attr(call.func) in SYNC_NAMES:
+                    return  # region synchronizes; timing is honest
+                if _util.is_device_call(ctx, call, local_device):
+                    dispatches.append(call)
+        for call in dispatches[:1]:
+            yield (
+                call.lineno, call.col_offset, "TPM101",
+                f"timed region dispatches "
+                f"'{_util.call_name(call.func)}' without a device sync "
+                f"— async dispatch makes this a queue-flush "
+                f"measurement; wrap the result in block()/"
+                f"block_until_ready() or use comm_span/PhaseTimer.timed",
+            )

@@ -1,0 +1,105 @@
+"""TPM2xx — trace purity.
+
+The bug class: a function handed to ``jax.jit`` / ``shard_map`` /
+``pallas_call`` runs ONCE at trace time. Host side effects inside it —
+``print``, ``time.*`` reads, Reporter lines, telemetry records — do not
+happen per execution; they fabricate telemetry (a span recorded under a
+trace claims ops=1 with trace-duration seconds, the exact hazard
+``telemetry._under_trace`` exists to gate) or silently vanish from the
+compiled loop. ``jax.debug.print`` and ``pl.debug_print`` are the
+sanctioned in-trace prints and are not flagged; code guarded by an
+``under_trace()``/``trace_state_clean`` check is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import FileContext, attr_parts
+from tpu_mpi_tests.analysis.rules import _util
+
+TIME_FNS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.perf_counter_ns", "time.monotonic_ns", "time.time_ns",
+}
+
+#: Reporter record methods (instrument/report.py) — flagged when called
+#: on a receiver that looks like a reporter (``rep``/``reporter``)
+REPORTER_METHODS = {
+    "line", "jsonl", "banner", "sum_line", "time_line", "test_line",
+    "iter_line", "exchange_line", "time_lines",
+}
+
+TELEMETRY_MODULE = "tpu_mpi_tests.instrument.telemetry"
+
+GUARD_MARKERS = ("under_trace", "trace_state_clean")
+
+
+def _is_guard(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        name = None
+        if isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Name):
+            name = n.id
+        if name and any(m in name for m in GUARD_MARKERS):
+            return True
+    return False
+
+
+class TracePurity:
+    name = "trace-purity"
+    scope = "file"
+    codes = {
+        "TPM201": "host side effect (print/time/Reporter/telemetry) "
+                  "inside a traced function without an under_trace() "
+                  "guard",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[tuple]:
+        seen: set[tuple[int, int]] = set()
+        for fn in _util.traced_functions(ctx):
+            # an under_trace()-tested `if` exempts its whole span (both
+            # branches are trace-awareness-gated by construction)
+            guard_spans = [
+                (n.lineno, n.end_lineno or n.lineno)
+                for n in ast.walk(fn)
+                if isinstance(n, ast.If) and _is_guard(n.test)
+            ]
+            for call in _util.walk_calls(fn):
+                if any(lo <= call.lineno <= hi for lo, hi in guard_spans):
+                    continue
+                msg = self._effect(ctx, call)
+                if msg and (call.lineno, call.col_offset) not in seen:
+                    seen.add((call.lineno, call.col_offset))
+                    yield (call.lineno, call.col_offset, "TPM201", msg)
+
+    def _effect(self, ctx: FileContext, call: ast.Call) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            return ("print() inside a traced function runs once at "
+                    "trace time, not per execution — use "
+                    "jax.debug.print or move it out of the traced body")
+        resolved = ctx.imports.resolve(func)
+        if resolved in TIME_FNS:
+            return (f"{resolved}() inside a traced function reads the "
+                    f"clock once at trace time — its value is a "
+                    f"compile-time constant, not a per-step timestamp")
+        parts = attr_parts(func)
+        if parts:
+            origin = ctx.imports.origin(parts[0]) or ""
+            if (origin.startswith(TELEMETRY_MODULE)
+                    or (origin + "." + ".".join(parts[1:])).startswith(
+                        TELEMETRY_MODULE)):
+                return (f"telemetry call '{'.'.join(parts)}' inside a "
+                        f"traced function fabricates records (one "
+                        f"trace-time event for the whole compiled "
+                        f"loop) — guard with under_trace() like "
+                        f"instrument/telemetry.py does")
+            if (len(parts) >= 2 and parts[-1] in REPORTER_METHODS
+                    and parts[-2].startswith("rep")):
+                return (f"Reporter call '{'.'.join(parts)}' inside a "
+                        f"traced function records once at trace time — "
+                        f"report from the host side of the step loop")
+        return None

@@ -1,0 +1,108 @@
+"""TPM3xx — x64 safety.
+
+The bug class: with x64 off (the TPU default), jax canonicalizes python
+floats and float64 arrays to float32 on the way to the device. For most
+values that is intended weak typing; for wall-clock epochs it is fatal —
+float32's ulp at epoch magnitude is ~128 seconds, so a raw
+``time.time()`` crossing ``jnp.asarray``/``process_allgather`` comes
+back as pure quantization noise (the PR 2 clock-sync bug, fixed by
+``instrument/manifest._split_us``'s f32-exact base-2^24 integer
+microsecond digits). Two codes:
+
+* TPM301: a bare float literal into ``jnp.asarray``/``jnp.array`` with
+  no dtype — the produced dtype silently depends on the x64 flag;
+  state the intended width.
+* TPM302: a ``time.time()`` epoch value lexically flowing into a device
+  conversion or collective — precision is lost regardless of intent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import FileContext
+from tpu_mpi_tests.analysis.rules import _util
+
+NARROW_SINKS = {"jax.numpy.asarray", "jax.numpy.array"}
+
+#: additional device-boundary sinks checked for epoch flow
+EPOCH_SINKS = NARROW_SINKS | {
+    "jax.device_put",
+    "jax.experimental.multihost_utils.process_allgather",
+}
+
+
+def _is_float_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_float_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_float_literal(node.left) and _is_float_literal(
+            node.right
+        )
+    return False
+
+
+class X64Safety:
+    name = "x64-safety"
+    scope = "file"
+    codes = {
+        "TPM301": "float literal into jnp.asarray/jnp.array without an "
+                  "explicit dtype (width silently depends on the x64 "
+                  "flag)",
+        "TPM302": "time.time() epoch value crosses the device boundary "
+                  "(f32 canonicalization quantizes it to ~128 s)",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[tuple]:
+        for call in _util.walk_calls(ctx.tree):
+            resolved = ctx.imports.resolve(call.func)
+            if resolved in NARROW_SINKS:
+                yield from self._check_narrow(call, resolved)
+            if resolved in EPOCH_SINKS:
+                yield from self._check_epoch(ctx, call, resolved)
+
+    def _check_narrow(self, call: ast.Call, resolved: str):
+        has_dtype = len(call.args) >= 2 or any(
+            kw.arg == "dtype" for kw in call.keywords
+        )
+        if has_dtype or not call.args:
+            return
+        if _is_float_literal(call.args[0]):
+            short = resolved.replace("jax.numpy", "jnp")
+            yield (
+                call.lineno, call.col_offset, "TPM301",
+                f"float literal into {short} without an explicit dtype "
+                f"— canonicalizes to float32 when x64 is off and to "
+                f"float64 when on; pass dtype= to state the intended "
+                f"width",
+            )
+
+    def _check_epoch(self, ctx: FileContext, call: ast.Call,
+                     resolved: str):
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if self._raw_epoch(ctx, arg):
+                yield (
+                    call.lineno, call.col_offset, "TPM302",
+                    f"time.time() epoch value into "
+                    f"{resolved.rsplit('.', 1)[-1]} — float32 "
+                    f"canonicalization (x64 off) quantizes epochs "
+                    f"to ~128 s; encode as integer microsecond "
+                    f"digits (instrument/manifest._split_us) or "
+                    f"keep the timestamp on host",
+                )
+                return
+
+    def _raw_epoch(self, ctx: FileContext, expr: ast.AST) -> bool:
+        """``time.time()`` reaching the sink raw or through arithmetic
+        only. A nested call (``_split_us(time.time())``) is assumed to
+        encode the value — that wrapper is exactly the sanctioned fix,
+        and an un-encoding wrapper is beyond lexical analysis."""
+        if isinstance(expr, ast.Call):
+            return ctx.imports.resolve(expr.func) == "time.time"
+        return any(self._raw_epoch(ctx, child)
+                   for child in ast.iter_child_nodes(expr))
