@@ -1,6 +1,6 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
-.PHONY: test verify native bench smoke trace-smoke lint ci clean
+.PHONY: test verify native bench smoke trace-smoke tune-smoke lint ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -39,6 +39,39 @@ trace-smoke:
 		assert all('ts' in e and 'pid' in e for e in evs); \
 		print('trace-smoke OK:', len(evs), 'events')"
 
+# autotuner-pipeline smoke: a 2-fake-device stencil1d sweeps the halo
+# schedule space (--staging auto --tune), persists the winner into a
+# fresh cache (checked: valid JSON, non-empty), and a second invocation
+# resolves as a PURE cache hit — asserted via the JSONL tune records
+# (run 1: tune measurements + tune_result; run 2: tune_hit only)
+tune-smoke:
+	rm -f /tmp/_tpumt_tune_smoke*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.stencil1d \
+		--fake-devices 2 --n-global 65536 --staging auto \
+		--tune --tune-cache /tmp/_tpumt_tune_smoke.cache.json \
+		--tune-budget 300 \
+		--jsonl /tmp/_tpumt_tune_smoke.r1.jsonl
+	python -c "import json; \
+		d = json.load(open('/tmp/_tpumt_tune_smoke.cache.json')); \
+		assert d['version'] == 1 and d['entries'], 'empty cache'; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_tune_smoke.r1.jsonl')]; \
+		kinds = [r.get('kind') for r in recs]; \
+		assert kinds.count('tune') >= 2, kinds; \
+		assert 'tune_result' in kinds, kinds; \
+		print('tune-smoke sweep OK:', len(d['entries']), 'entries')"
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.stencil1d \
+		--fake-devices 2 --n-global 65536 --staging auto \
+		--tune --tune-cache /tmp/_tpumt_tune_smoke.cache.json \
+		--jsonl /tmp/_tpumt_tune_smoke.r2.jsonl
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_tune_smoke.r2.jsonl')]; \
+		kinds = [r.get('kind') for r in recs]; \
+		assert 'tune_hit' in kinds, kinds; \
+		assert 'tune' not in kinds and 'tune_result' not in kinds, kinds; \
+		print('tune-smoke cache-hit OK')"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -46,11 +79,11 @@ trace-smoke:
 # excluded from recursive walks by the linter itself.
 lint:
 	python -m tpu_mpi_tests.analysis.cli \
-		tpu_mpi_tests tpu tests __graft_entry__.py
+		tpu_mpi_tests tpu tests __graft_entry__.py bench.py
 
-# CI umbrella: the tier-1 gate, the timeline-pipeline smoke, and the
-# lint self-clean gate
-ci: verify trace-smoke lint
+# CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
+# autotuner sweep→persist→cache-hit smoke, and the lint self-clean gate
+ci: verify trace-smoke tune-smoke lint
 
 clean:
 	$(MAKE) -C native clean

@@ -48,41 +48,76 @@ V100_HBM_GBPS = 810.0  # STREAM-class HBM2 measured-class bandwidth
 V100_F64_ITERS_PER_S = 503.0  # 810e9 / (3 * 8 * 8192**2), reference dtype
 
 
-def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
-             blocks_env: str | None):
-    """One dtype's full measurement: build the schedule, chain-time it,
-    median-of-samples. Returns the JSON-ready dict (top-level field shapes;
-    the caller nests the secondary dtype's copy)."""
+def _tune_emit(rec) -> None:
+    # stdout stays the one JSON result line; sweep records go to stderr
+    import sys
+
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+
+
+def _resolve_steps(env_val: "str | None", *, n: int, world: int) -> int:
+    """Temporal-blocking depth: explicit env > cached winner > shipped
+    prior (tune/priors.BENCH_STEPS) — the bench precedence contract,
+    pinned by tests/test_tune.py."""
+    if env_val is not None:
+        return int(env_val)
+    from tpu_mpi_tests.tune import priors, registry
+
+    return int(registry.resolve(
+        "stencil/steps", prior=priors.BENCH_STEPS,
+        device_fallback=False, n=n, world=world,
+    ))
+
+
+def _resolve_blocks(blocks_env: "str | None", dtype_name: str, *, n: int,
+                    world: int) -> int:
+    """Resident-block count: explicit TPU_MPI_BENCH_BLOCKS > cached
+    winner > the dtype's shipped prior (tune/priors.BENCH_BLOCKS —
+    BASELINE round-3/5 measured-best: S=2 at f32, single-buffer dim-1
+    at bf16)."""
+    if blocks_env is not None:
+        return int(blocks_env)
+    from tpu_mpi_tests.tune import priors, registry
+
+    prior = priors.BENCH_BLOCKS.get(
+        dtype_name, priors.BENCH_BLOCKS["float32"]
+    )
+    # device_fallback=False: the block count is dtype-keyed (f32 wants
+    # S=2, bf16 wants the single-buffer schedule) — the other dtype's
+    # winner must not leak in through the device-only slot
+    return int(registry.resolve(
+        "stencil/blocks", prior=prior, device_fallback=False,
+        dtype=dtype_name, n=n, world=world,
+    ))
+
+
+def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
+                    topo, n_blocks: int, report_declined: bool = False):
+    """Build one per-iteration schedule: ``(run, state, use_blocks)``.
+
+    The resident-block schedule (TPU, k>1): S separate buffers per shard
+    run the fast full-height dim-0 (sublane-tap) kernel; the inter-block
+    ghost refresh is a narrow in-chip band copy and, on a multi-device
+    mesh, the outermost ghost bands ride a ppermute ring over ICI
+    (round-3 generalization). Measured 3021 vs 2087 iter/s against the
+    single-buffer dim-1 kernel in the same contention window
+    (BASELINE.md)."""
     import jax.numpy as jnp
     import numpy as np
 
     from tpu_mpi_tests.arrays.domain import Domain2D
     from tpu_mpi_tests.comm.collectives import shard_blocks
     from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
-    from tpu_mpi_tests.instrument.timers import chain_rate
     from tpu_mpi_tests.kernels.stencil import N_BND, analytic_pairs
 
     dtype = np.dtype(jnp.bfloat16) if dtype_name == "bfloat16" \
         else np.dtype(np.float32)
     eps = 1e-6
-    if topo.platform != "tpu":
-        steps = 1  # CPU smoke path uses the XLA iterate (shallow halos)
-
-    # resident-block schedule (TPU, k>1): S separate buffers per shard
-    # run the fast full-height dim-0 (sublane-tap) kernel; the
-    # inter-block ghost refresh is a narrow in-chip band copy and, on a
-    # multi-device mesh, the outermost ghost bands ride a ppermute ring
-    # over ICI (round-3 generalization). Measured 3021 vs 2087 iter/s
-    # against the single-buffer dim-1 kernel in the same contention
-    # window (BASELINE.md). bf16 default: no blocks — the dim-1
-    # single-buffer kernel is the measured-best 16-bit schedule.
-    default_blocks = "0" if dtype_name == "bfloat16" else "2"
-    n_blocks = int(blocks_env if blocks_env is not None else default_blocks)
     use_blocks = (
         topo.platform == "tpu" and steps > 1
         and n_blocks >= 2 and (n // world) % n_blocks == 0
     )
-    if blocks_env is not None and n_blocks >= 2 and not use_blocks:
+    if report_declined and n_blocks >= 2 and not use_blocks:
         # never silently mis-attribute a schedule: a requested block count
         # that fails the gate is reported (stderr — stdout stays the one
         # JSON line) and the JSON records the schedule that actually ran
@@ -129,6 +164,70 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         )
     else:  # CPU smoke path: interpret-mode pallas is far too slow
         run = iterate_fused_fn(mesh, axis_name, 1, 2, d.n_bnd, d.scale, eps)
+    return run, zg, use_blocks
+
+
+def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
+             blocks_env: str | None):
+    """One dtype's full measurement: resolve the schedule (explicit env >
+    cached winner > prior; TPU_MPI_BENCH_TUNE=1 sweeps block-count
+    candidates on a cache miss first), chain-time it, median-of-samples.
+    Returns the JSON-ready dict (top-level field shapes; the caller
+    nests the secondary dtype's copy)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_mpi_tests.instrument.timers import chain_rate
+    from tpu_mpi_tests.tune import registry as _tr
+
+    dtype = np.dtype(jnp.bfloat16) if dtype_name == "bfloat16" \
+        else np.dtype(np.float32)
+    if topo.platform != "tpu":
+        steps = 1  # CPU smoke path uses the XLA iterate (shallow halos)
+
+    n_blocks = _resolve_blocks(blocks_env, dtype_name, n=n, world=world)
+    cache_miss = _tr.lookup(
+        "stencil/blocks", device_fallback=False,
+        dtype=dtype_name, n=n, world=world,
+    ) is None
+    if blocks_env is None and cache_miss and _tr.tuning_enabled():
+        # on-miss only (a warmed cache entry IS the swept winner), and
+        # prior-first: the budget-exempt first slot must measure THIS
+        # dtype's shipped prior, never a value inherited elsewhere
+        from tpu_mpi_tests.tune import priors as _priors
+        from tpu_mpi_tests.tune.sweep import sweep as _sweep
+
+        sp = _tr.space("stencil/blocks")
+        prior = _priors.BENCH_BLOCKS.get(
+            dtype_name, _priors.BENCH_BLOCKS["float32"]
+        )
+        cands = [prior] + [c for c in sp.candidates if c != prior]
+
+        def measure_blocks(cand):
+            run_c, zg_c, ub = _build_schedule(
+                dtype_name, n=n, steps=steps, world=world, mesh=mesh,
+                axis_name=axis_name, topo=topo, n_blocks=int(cand),
+            )
+            if int(cand) >= 2 and not ub:
+                raise ValueError(
+                    f"blocks={cand} not applicable "
+                    f"(platform={topo.platform} steps={steps} n={n} "
+                    f"world={world})"
+                )
+            sec, zg_c = chain_rate(run_c, zg_c, n_short=5, n_long=55)
+            del zg_c
+            return sec
+
+        n_blocks = int(_sweep(
+            "stencil/blocks", measure_blocks, candidates=cands,
+            emit=_tune_emit, dtype=dtype_name, n=n, world=world,
+        ))
+
+    run, zg, use_blocks = _build_schedule(
+        dtype_name, n=n, steps=steps, world=world, mesh=mesh,
+        axis_name=axis_name, topo=topo, n_blocks=n_blocks,
+        report_declined=blocks_env is not None,
+    )
 
     n_short = int(os.environ.get("TPU_MPI_BENCH_ITERS_SHORT", 100))
     # 2100 (2000-iteration delta ≈ 1.7 s device time) keeps the shared
@@ -192,11 +291,6 @@ def main() -> None:
             f"TPU_MPI_BENCH_DTYPE={dtype_name!r} unsupported "
             "(float32 | bfloat16)"
         )
-    # temporal blocking: k timesteps per HBM pass over deep (k·2-wide)
-    # halos — interior-identical to per-step exchange (tested in
-    # tests/test_pallas.py::test_iterate_multistep_*); the exchanged volume
-    # per timestep is unchanged, messages drop k-fold
-    steps = int(os.environ.get("TPU_MPI_BENCH_STEPS", 4))
     n_fake = int(os.environ.get("TPU_MPI_BENCH_FAKE_DEVICES", "0"))
     if n_fake > 0:  # 0 = off, matching the drivers' --fake-devices default
         from tpu_mpi_tests.drivers._common import force_cpu_devices
@@ -208,6 +302,34 @@ def main() -> None:
     mesh = make_mesh()
     axis_name = mesh.axis_names[0]
     check_divisible(n, world, "bench domain over devices")
+
+    # schedule cache: bench consults a warmed cache (default path or
+    # TPU_MPI_TUNE_CACHE) so the headline number is the tuned schedule;
+    # TPU_MPI_BENCH_TUNE=1 arms the on-miss block-count sweep. With no
+    # cache file and no tune flag the registry stays unconfigured and
+    # every schedule resolves from the shipped priors — byte-identical
+    # to the pinned era (tests/test_tune.py parity gate).
+    from tpu_mpi_tests.tune import cache as _tc, registry as _tr
+
+    bench_tune = os.environ.get("TPU_MPI_BENCH_TUNE", "").lower() not in (
+        "", "0", "false"
+    )
+    cache_path = _tc.default_cache_path()
+    if bench_tune or os.path.exists(cache_path):
+        budget = os.environ.get("TPU_MPI_TUNE_BUDGET")
+        _tr.configure(
+            cache_path=cache_path,
+            enabled=bench_tune,
+            budget_s=float(budget) if budget else None,
+        )
+    # temporal blocking: k timesteps per HBM pass over deep (k·2-wide)
+    # halos — interior-identical to per-step exchange (tested in
+    # tests/test_pallas.py::test_iterate_multistep_*); the exchanged volume
+    # per timestep is unchanged, messages drop k-fold. Explicit
+    # TPU_MPI_BENCH_STEPS > cached winner > prior (4).
+    steps = _resolve_steps(
+        os.environ.get("TPU_MPI_BENCH_STEPS"), n=n, world=world
+    )
 
     rec = {"metric": "stencil2d_fullstep_8192_iters_per_s"}
     rec.update(_measure(

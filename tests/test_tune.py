@@ -1,0 +1,492 @@
+"""Autotuner gates: cache round-trip + corruption fallback, fingerprint
+stability across process restarts, a stubbed-timer CPU sweep that
+completes under budget with a deterministic winner, the pinned-prior
+parity contract (no cache + no --tune == the hand-pinned era, exactly),
+and the precedence order (explicit > cached > prior) at every resolver.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_mpi_tests.tune import priors
+from tpu_mpi_tests.tune import registry as tr
+from tpu_mpi_tests.tune.cache import CACHE_VERSION, ScheduleCache
+from tpu_mpi_tests.tune.fingerprint import (
+    compose,
+    device_fingerprint,
+    fingerprint,
+    shape_bucket,
+)
+from tpu_mpi_tests.tune.sweep import ensure_tuned, sweep
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry(monkeypatch):
+    """Every test starts (and ends) unconfigured, and a developer's real
+    ~/.cache/tpumt/tune.json can never leak in."""
+    monkeypatch.delenv("TPU_MPI_TUNE_CACHE", raising=False)
+    tr.deconfigure()
+    yield
+    tr.deconfigure()
+
+
+# ---------------------------------------------------------------- cache
+
+
+def test_cache_round_trip(tmp_path):
+    """write → reload → same schedule."""
+    path = tmp_path / "tune.json"
+    c = ScheduleCache.load(str(path))  # missing file: empty, no error
+    assert len(c) == 0
+    c.store("flash_tiles/contig", "fp1", {"k_tile": 1024, "skip_tile": 0},
+            seconds=0.5)
+    c.store("halo/staging", "fp2", "device", seconds=0.25)
+    c.save()
+
+    c2 = ScheduleCache.load(str(path))
+    assert c2.lookup("flash_tiles/contig", "fp1") == {
+        "k_tile": 1024, "skip_tile": 0,
+    }
+    assert c2.lookup("halo/staging", "fp2") == "device"
+    assert c2.lookup("halo/staging", "other-fp") is None
+    doc = json.loads(path.read_text())
+    assert doc["version"] == CACHE_VERSION
+
+
+def test_cache_save_merges_concurrent_writers(tmp_path):
+    """Two caches writing disjoint knobs to one file compose instead of
+    last-writer-wins clobbering."""
+    path = str(tmp_path / "tune.json")
+    a, b = ScheduleCache.load(path), ScheduleCache.load(path)
+    a.store("knob/a", "fp", 1)
+    b.store("knob/b", "fp", 2)
+    a.save()
+    b.save()
+    c = ScheduleCache.load(path)
+    assert c.lookup("knob/a", "fp") == 1
+    assert c.lookup("knob/b", "fp") == 2
+
+
+@pytest.mark.parametrize("content", [
+    "not json at all{{{",
+    '{"version": 999, "entries": {"k|f": {"value": 7}}}',  # stale format
+    '[1, 2, 3]',
+    '{"version": 1, "entries": "not-a-dict"}',
+])
+def test_corrupted_or_stale_cache_falls_back_to_priors(tmp_path, content):
+    path = tmp_path / "tune.json"
+    path.write_text(content)
+    c = ScheduleCache.load(str(path))
+    assert len(c) == 0
+    # and end-to-end: a configured-but-garbage cache resolves priors
+    tr.configure(cache_path=str(path))
+    from tpu_mpi_tests.comm.ring import _resolve_k_tile
+
+    assert _resolve_k_tile(None, False) == \
+        priors.MEASURED_BEST_K_TILE["contig"]
+
+
+def test_malformed_cached_value_degrades_to_prior(tmp_path):
+    """A hand-edited entry of the wrong shape must not crash resolution."""
+    path = tmp_path / "tune.json"
+    tr.configure(cache_path=str(path))
+    cache = tr.configured_cache()
+    from tpu_mpi_tests.comm.ring import _resolve_k_tile
+
+    cache.store("flash_tiles/contig", device_fingerprint(), "garbage")
+    assert _resolve_k_tile(None, False) == \
+        priors.MEASURED_BEST_K_TILE["contig"]
+
+    from tpu_mpi_tests.comm.halo import Staging, resolve_staging
+
+    cache.store("halo/staging", _staging_fp(), "bogus-mode")
+    assert resolve_staging("auto", _fake_zg(), 0, 2) is Staging.DIRECT
+    # a cache must never silently select the host measurement mode
+    cache.store("halo/staging", _staging_fp(), "host")
+    assert resolve_staging("auto", _fake_zg(), 0, 2) is Staging.DIRECT
+
+
+# ---------------------------------------------------------- fingerprint
+
+
+def test_shape_bucket_powers_of_two():
+    assert [shape_bucket(v) for v in (1, 2, 3, 4, 1000, 8192, 8193)] == \
+        [1, 2, 4, 4, 1024, 8192, 16384]
+
+
+def test_fingerprint_composition_is_pure_and_sorted():
+    base = {"platform": "tpu", "device": "v5e", "ndev": "4", "procs": "1"}
+    fp = compose(base, dtype="float32", lq=8192)
+    assert fp == ("device=v5e;dtype=float32;lq=8192;ndev=4;"
+                  "platform=tpu;procs=1")
+    assert compose(base, lq=8192, dtype="float32") == fp  # order-free
+    assert compose(base, lq=5000) == compose(base, lq=8192)  # bucketed
+
+
+def test_fingerprint_stable_across_process_restarts():
+    """Same inputs → same key in fresh interpreters: nothing
+    process-local (id/hash randomization/time) may leak into the key,
+    or a persisted winner would never be found again."""
+    snippet = (
+        "from tpu_mpi_tests.tune.fingerprint import compose; "
+        "print(compose({'platform': 'cpu', 'device': 'cpu', 'ndev': '2',"
+        " 'procs': '1'}, dtype='bfloat16', lq=4096))"
+    )
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", snippet], cwd=REPO,
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        for _ in range(2)
+    }
+    assert len(outs) == 1
+    assert outs.pop() == ("device=cpu;dtype=bfloat16;lq=4096;ndev=2;"
+                          "platform=cpu;procs=1")
+
+
+def test_live_fingerprint_includes_context(tmp_path):
+    fp = fingerprint(dtype="float32", lq=4096)
+    assert "dtype=float32" in fp and "lq=4096" in fp
+    assert "platform=" in fp and "device=" in fp
+    # the device-only key is a strict prefix-set of the full one
+    for field in device_fingerprint().split(";"):
+        assert field in fp.split(";")
+
+
+# ---------------------------------------------------------------- sweep
+
+
+def test_stubbed_sweep_picks_deterministic_winner(tmp_path):
+    """A CPU sweep with a stubbed timer: completes within budget, picks
+    the argmin, persists it under the full AND device-only fingerprints,
+    and a later resolve() serves the winner."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True,
+                 budget_s=60.0)
+    timing = {"a": 0.5, "b": 0.125, "c": 0.25}
+    records = []
+    winner = sweep(
+        "demo/knob", lambda cand: timing[cand],
+        candidates=("a", "b", "c"), emit=records.append,
+        dtype="float32", lq=128,
+    )
+    assert winner == "b"
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["tune", "tune", "tune", "tune_result"]
+    assert records[-1]["value"] == "b"
+    assert records[-1]["seconds"] == 0.125
+    assert records[-1]["measured"] == 3 and records[-1]["skipped"] == 0
+
+    cache = ScheduleCache.load(str(tmp_path / "t.json"))
+    assert cache.lookup(
+        "demo/knob", fingerprint(dtype="float32", lq=128)
+    ) == "b"
+    assert cache.lookup("demo/knob", device_fingerprint()) == "b"
+    assert tr.resolve("demo/knob", prior="a", dtype="float32", lq=128) == "b"
+
+
+def test_sweep_budget_skips_are_reported_not_silent(tmp_path):
+    """budget_s=0: the prior is still measured (always), the rest are
+    emitted as skipped — a bounded sweep must never read as exhaustive."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    records = []
+    winner = sweep(
+        "demo/knob2", lambda cand: 1.0,
+        candidates=("prior", "x", "y"), budget_s=0.0,
+        emit=records.append,
+    )
+    assert winner == "prior"
+    skipped = [r for r in records if r.get("skipped") == "budget"]
+    assert {r["candidate"] for r in skipped} == {"x", "y"}
+    assert records[-1]["skipped"] == 2
+
+
+def test_sweep_survives_erroring_candidate(tmp_path):
+    """An infeasible candidate (e.g. an RDMA ring below its lane floor)
+    records its error and loses; it must not kill the sweep."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+
+    def measure(cand):
+        if cand == "bad":
+            raise ValueError("lane floor")
+        return 1.0
+
+    records = []
+    winner = sweep("demo/knob3", measure, candidates=("bad", "ok"),
+                   emit=records.append)
+    assert winner == "ok"
+    errs = [r for r in records if r.get("error")]
+    assert len(errs) == 1 and "lane floor" in errs[0]["error"]
+
+
+def test_sweep_all_invalid_keeps_prior_and_does_not_persist(tmp_path):
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    records = []
+    winner = sweep(
+        "demo/knob4", lambda c: float("nan"), candidates=("p", "q"),
+        emit=records.append,
+    )
+    assert winner == "p"
+    assert records[-1]["measured"] == 0
+    assert ScheduleCache.load(str(tmp_path / "t.json")).lookup(
+        "demo/knob4", device_fingerprint()
+    ) is None
+
+
+def test_ensure_tuned_hit_skips_measure_and_emits_tune_hit(tmp_path):
+    """The make tune-smoke contract in-process: first call sweeps, the
+    second is a pure cache hit (no measurement, a tune_hit record)."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    calls = []
+    records = []
+
+    def measure(cand):
+        calls.append(cand)
+        return {"slow": 1.0, "fast": 0.5}[cand]
+
+    first = ensure_tuned("demo/knob5", measure,
+                         candidates=("slow", "fast"),
+                         emit=records.append, dtype="float32")
+    assert first == "fast" and calls == ["slow", "fast"]
+
+    calls.clear()
+    records.clear()
+    again = ensure_tuned("demo/knob5", measure,
+                         candidates=("slow", "fast"),
+                         emit=records.append, dtype="float32")
+    assert again == "fast"
+    assert calls == []  # pure cache hit: nothing measured
+    assert [r["kind"] for r in records] == ["tune_hit"]
+
+
+def test_ensure_tuned_disabled_returns_prior_without_measuring(tmp_path):
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=False)
+    out = ensure_tuned(
+        "demo/knob6", lambda c: pytest.fail("must not measure"),
+        candidates=("p", "q"), prior="p",
+    )
+    assert out == "p"
+
+
+# ------------------------------------------------- pinned-prior parity
+
+
+def test_pinned_prior_parity_unconfigured():
+    """With no cache and no --tune, every schedule resolves exactly as
+    the hand-pinned era: the acceptance contract of the whole demotion."""
+    from tpu_mpi_tests.comm.halo import Staging, resolve_staging
+    from tpu_mpi_tests.comm.ring import (
+        MEASURED_BEST_K_TILE,
+        MEASURED_BEST_SKIP_TILE,
+        _resolve_k_tile,
+        _resolve_skip_tile,
+    )
+
+    assert tr.configured_cache() is None
+    assert MEASURED_BEST_K_TILE == priors.MEASURED_BEST_K_TILE
+    assert MEASURED_BEST_SKIP_TILE == priors.MEASURED_BEST_SKIP_TILE
+    for stripe in (False, True):
+        layout = "striped" if stripe else "contig"
+        assert _resolve_k_tile(None, stripe) == \
+            priors.MEASURED_BEST_K_TILE[layout]
+        assert _resolve_skip_tile(None, stripe) == \
+            priors.MEASURED_BEST_SKIP_TILE[layout]
+    assert resolve_staging("direct", _fake_zg(), 0, 2) is Staging.DIRECT
+    assert resolve_staging("auto", _fake_zg(), 0, 2) is Staging.DIRECT
+
+    bench = _import_bench()
+    assert bench._resolve_steps(None, n=8192, world=1) == \
+        priors.BENCH_STEPS
+    assert bench._resolve_blocks(None, "float32", n=8192, world=1) == \
+        priors.BENCH_BLOCKS["float32"]
+    assert bench._resolve_blocks(None, "bfloat16", n=8192, world=1) == \
+        priors.BENCH_BLOCKS["bfloat16"]
+
+    from tpu_mpi_tests.kernels import pallas_kernels as PK
+
+    assert PK._STREAM_SKIP_TILE_DEFAULT == priors.STREAM_SKIP_TILE
+
+
+# ---------------------------------------------------------- precedence
+
+
+def test_precedence_explicit_over_cached_over_prior(tmp_path):
+    """The satellite contract: attnbench --k-tile/--skip-tile and
+    TPU_MPI_BENCH_BLOCKS win over a cache entry, which wins over the
+    prior."""
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    cache = tr.configured_cache()
+    from tpu_mpi_tests.comm.ring import _resolve_k_tile, _resolve_skip_tile
+
+    cache.store("flash_tiles/contig", device_fingerprint(),
+                {"k_tile": 1024, "skip_tile": 128})
+    # cached beats prior (prior is 2048/0)
+    assert _resolve_k_tile(None, False) == 1024
+    assert _resolve_skip_tile(None, False) == 128
+    # explicit beats cached
+    assert _resolve_k_tile(512, False) == 512
+    assert _resolve_skip_tile(0, False) == 0
+
+    bench = _import_bench()
+    cache.store("stencil/blocks",
+                fingerprint(dtype="float32", n=8192, world=1), 4)
+    assert bench._resolve_blocks(None, "float32", n=8192, world=1) == 4
+    assert bench._resolve_blocks("8", "float32", n=8192, world=1) == 8
+    cache.store("stencil/steps", fingerprint(n=8192, world=1), 2)
+    assert bench._resolve_steps(None, n=8192, world=1) == 2
+    assert bench._resolve_steps("8", n=8192, world=1) == 8
+
+    from tpu_mpi_tests.comm.halo import Staging, resolve_staging
+
+    cache.store("halo/staging", _staging_fp(), "device")
+    assert resolve_staging("auto", _fake_zg(), 0, 2) is \
+        Staging.DEVICE_STAGED
+    # explicit staging never consults the cache
+    assert resolve_staging("pallas", _fake_zg(), 0, 2) is \
+        Staging.PALLAS_RDMA
+
+
+def test_context_sensitive_knobs_ignore_device_slot(tmp_path):
+    """A dtype-keyed knob must not inherit another context's winner via
+    the device-only slot: the f32 sweep's S=2 leaking into the bf16
+    resolution would override bf16's measured-best single-buffer prior."""
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    cache = tr.configured_cache()
+    cache.store("stencil/blocks", device_fingerprint(), 2)
+    bench = _import_bench()
+    assert bench._resolve_blocks(None, "bfloat16", n=8192, world=1) == \
+        priors.BENCH_BLOCKS["bfloat16"]
+    # the flash-tile knob keeps the fallback: its in-kernel resolve
+    # site is context-free by construction
+    cache.store("flash_tiles/contig", device_fingerprint(),
+                {"k_tile": 512, "skip_tile": 0})
+    from tpu_mpi_tests.comm.ring import _resolve_k_tile
+
+    assert _resolve_k_tile(None, False, dtype="bfloat16", lq=64) == 512
+
+
+def test_sweep_refuses_to_measure_multiprocess(tmp_path, monkeypatch):
+    """Per-rank budget cutoffs / winners would diverge across ranks
+    mid-collective: a multi-process sweep must not measure — it records
+    the skip and resolves cached > prior."""
+    import importlib
+
+    sweep_mod = importlib.import_module("tpu_mpi_tests.tune.sweep")
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    monkeypatch.setattr(sweep_mod, "_process_count", lambda: 2)
+    records = []
+    winner = sweep(
+        "demo/mp", lambda c: pytest.fail("must not measure"),
+        candidates=("p", "q"), emit=records.append,
+    )
+    assert winner == "p"
+    assert [r["kind"] for r in records] == ["tune_result"]
+    assert "multi-process" in records[0]["note"]
+    # a warmed cache still serves its winner
+    tr.configured_cache().store("demo/mp", device_fingerprint(), "q")
+    assert sweep("demo/mp", lambda c: 0.0, candidates=("p", "q"),
+                 emit=records.append) == "q"
+
+
+def test_full_fingerprint_beats_device_slot(tmp_path):
+    """lookup() prefers the exact-context entry over the device-only
+    fallback slot when both exist."""
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    cache = tr.configured_cache()
+    cache.store("demo/knob7", device_fingerprint(), "generic")
+    cache.store("demo/knob7", fingerprint(dtype="float32"), "exact")
+    assert tr.lookup("demo/knob7", dtype="float32") == "exact"
+    assert tr.lookup("demo/knob7", dtype="bfloat16") == "generic"
+
+
+# ------------------------------------------------------ report plumbing
+
+
+def test_report_tuning_table(tmp_path):
+    """tpumt-report renders a tuning table from the sweep's JSONL."""
+    from tpu_mpi_tests.instrument.aggregate import summarize
+
+    f = tmp_path / "run.jsonl"
+    recs = [
+        {"kind": "tune", "knob": "halo/staging", "candidate": "direct",
+         "seconds": 2e-4, "fingerprint": "f"},
+        {"kind": "tune", "knob": "halo/staging", "candidate": "device",
+         "seconds": 1e-4, "fingerprint": "f"},
+        {"kind": "tune", "knob": "halo/staging", "candidate": "pallas",
+         "seconds": None, "error": "ValueError: floor",
+         "fingerprint": "f"},
+        {"kind": "tune", "knob": "halo/staging", "candidate": "x",
+         "skipped": "budget", "fingerprint": "f"},
+        # NaN measurement (seconds=null, no error): invalid, never
+        # countable as measured — the table must match the raw records
+        {"kind": "tune", "knob": "halo/staging", "candidate": "host",
+         "seconds": None, "fingerprint": "f"},
+        {"kind": "tune_result", "knob": "halo/staging",
+         "value": "device", "seconds": 1e-4, "measured": 2,
+         "skipped": 1, "fingerprint": "f"},
+        {"kind": "tune_hit", "knob": "flash_tiles/contig",
+         "value": {"k_tile": 1024, "skip_tile": 0}, "fingerprint": "f"},
+    ]
+    f.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    summary = summarize([str(f)])
+    t = summary["tuning"]["halo/staging"]
+    assert t == {"measured": 2, "skipped": 1, "errors": 1, "invalid": 1,
+                 "hits": 0, "winner": "device", "winner_seconds": 1e-4}
+    hit = summary["tuning"]["flash_tiles/contig"]
+    assert hit["hits"] == 1
+    assert hit["winner"] == {"k_tile": 1024, "skip_tile": 0}
+    # and the whole summary stays JSON-serializable (--json path)
+    json.dumps(summary)
+
+
+def test_driver_flags_exist():
+    """Every driver inherits --tune/--tune-cache/--tune-budget/
+    --compile-cache through the shared base parser."""
+    from tpu_mpi_tests.drivers._common import base_parser
+
+    p = base_parser("t")
+    args = p.parse_args([
+        "--tune", "--tune-cache", "/tmp/x.json", "--tune-budget", "5",
+        "--compile-cache", "/tmp/cc",
+    ])
+    assert args.tune and args.tune_cache == "/tmp/x.json"
+    assert args.tune_budget == 5.0
+    assert args.compile_cache == "/tmp/cc"
+    defaults = p.parse_args([])
+    assert not defaults.tune and defaults.tune_cache is None
+
+
+# -------------------------------------------------------------- helpers
+
+
+class _FakeZg:
+    """Just enough array surface for the staging-context composer."""
+
+    shape = (1024, 64)
+    dtype = "float32"
+
+
+def _fake_zg():
+    return _FakeZg()
+
+
+def _staging_fp():
+    """The exact key resolve_staging composes for _fake_zg (the staging
+    knob is context-sensitive: no device-only fallback)."""
+    from tpu_mpi_tests.comm.halo import _staging_context
+
+    return fingerprint(**_staging_context(_fake_zg(), 0, 2))
+
+
+def _import_bench():
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench

@@ -21,6 +21,8 @@ rule families (stable codes; see README "Static analysis" for the table):
   TPM4xx import-hygiene   eager `import jax` in login-node CLI closures
   TPM5xx axis-consistency collective axis names vs shard_map/mesh
   TPM6xx concurrency      unlocked cross-thread file-handle writes
+  TPM7xx schedule-consts  pinned tile/schedule constants bypassing the
+                          autotuner's registry/cache (tpu_mpi_tests/tune)
   TPM9xx engine           unused/malformed suppressions, parse errors
 
 suppress one finding on its line (unused suppressions are themselves
@@ -63,7 +65,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     if not args.paths:
         ap.error("no paths given (try: tpumt-lint tpu_mpi_tests tpu "
-                 "tests __graft_entry__.py)")
+                 "tests __graft_entry__.py bench.py)")
 
     entry_modules = None
     if args.entry_module:

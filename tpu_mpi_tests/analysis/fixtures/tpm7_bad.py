@@ -1,0 +1,7 @@
+"""TPM7xx bad: hand-pinned numeric schedule constants outside the
+tuner — one machine's measured optimum frozen for every topology (the
+pre-autotuner MEASURED_BEST_* shape)."""
+
+MEASURED_BEST_TILE = {"contig": 2048, "striped": 256}
+HALO_BLOCK_COUNT = 2
+_STREAM_STEPS_DEFAULT = 4

@@ -1,0 +1,22 @@
+"""TPM7xx good: the knob routes through the tuner. Numeric candidates
+appear only inside the ``declare_space`` registration (the sanctioned
+way to state a space where the knob lives), reads go through
+``resolve`` (explicit > cached > prior), and schedule-named constants
+without numeric values (pure config strings) are out of scope."""
+
+from tpu_mpi_tests.tune import priors
+from tpu_mpi_tests.tune.registry import declare_space, resolve
+
+DEMO_TILE_SPACE = declare_space(
+    "demo/tile",
+    ({"k_tile": priors.MEASURED_BEST_K_TILE["contig"]}, {"k_tile": 512}),
+    describe="demo tile space: prior first, alternative second",
+)
+
+DEFAULT_STAGING = "direct"  # string config, not a numeric schedule pin
+
+
+def pick_tile(explicit=None):
+    return resolve(
+        "demo/tile", explicit=explicit, prior=DEMO_TILE_SPACE.prior
+    )

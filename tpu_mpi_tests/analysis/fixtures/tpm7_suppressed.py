@@ -1,0 +1,5 @@
+"""TPM7xx suppressed: a deliberate pin with its why. A reference-parity
+A/B needs the frozen round-5 value regardless of what the schedule
+cache holds — tuning it away would change what the comparison measures."""
+
+LEGACY_K_TILE = 2048  # tpumt: ignore[TPM701]

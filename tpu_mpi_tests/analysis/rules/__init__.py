@@ -8,6 +8,9 @@ comments stay meaningful across releases.
 from tpu_mpi_tests.analysis.rules.axis_consistency import AxisConsistency
 from tpu_mpi_tests.analysis.rules.concurrency import UnlockedSharedWrite
 from tpu_mpi_tests.analysis.rules.import_hygiene import ImportHygiene
+from tpu_mpi_tests.analysis.rules.schedule_constants import (
+    ScheduleConstants,
+)
 from tpu_mpi_tests.analysis.rules.sync_honesty import SyncHonesty
 from tpu_mpi_tests.analysis.rules.trace_purity import TracePurity
 from tpu_mpi_tests.analysis.rules.x64_safety import X64Safety
@@ -19,4 +22,5 @@ ALL_RULES = [
     ImportHygiene(),
     AxisConsistency(),
     UnlockedSharedWrite(),
+    ScheduleConstants(),
 ]

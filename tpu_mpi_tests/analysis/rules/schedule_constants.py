@@ -1,0 +1,106 @@
+"""TPM7xx — schedule-constant hygiene.
+
+The bug class: a hand-pinned tile/block/staging constant freezes ONE
+machine's measured optimum for every topology. The repo shipped years of
+that shape (``MEASURED_BEST_K_TILE``, ``TPU_MPI_BENCH_BLOCKS`` defaults,
+the streaming skip-tile) until the autotuner (``tpu_mpi_tests/tune/``)
+demoted them to cold-start priors behind a persistent per-fingerprint
+schedule cache. This rule keeps the door shut: a numeric schedule
+constant assigned at module level OUTSIDE the tuner's registry/resolver
+modules is a finding — future knobs must declare their candidate space
+(:func:`~tpu_mpi_tests.tune.registry.declare_space`) and resolve through
+the cache (explicit > cached > prior), not re-pin.
+
+Sanctioned homes, exempt by construction:
+
+* modules under ``tpu_mpi_tests.tune`` (the priors tables and the
+  registry itself);
+* assignments whose value routes through ``declare_space(...)`` — the
+  numeric candidates INSIDE a space declaration are the API working as
+  designed (that is how a knob's candidates are stated where the knob
+  lives).
+
+Heuristic scope: ALL-CAPS module-level names containing a schedule
+keyword (TILE/BLOCK/STEP/STAGING/SCHEDULE/CREDIT/MEASURED/K_GROUP)
+whose value carries a numeric literal. String-valued config names and
+function-local values are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import FileContext, last_attr
+
+#: module-name prefix of the sanctioned schedule-constant home
+TUNE_PREFIX = "tpu_mpi_tests.tune"
+
+_CONST_NAME = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+_SCHEDULE_WORD = re.compile(
+    r"(TILE|BLOCK|STEP|STAGING|SCHEDULE|CREDIT|MEASURED|K_GROUP|KGROUP)"
+)
+
+
+def _has_numeric_literal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(
+            sub.value, (int, float)
+        ) and not isinstance(sub.value, bool):
+            return True
+    return False
+
+
+def _routes_through_registry(node: ast.AST) -> bool:
+    """True when the assigned value contains a ``declare_space`` call —
+    numerics inside a space declaration are candidates being registered,
+    which is exactly the sanctioned alternative to pinning."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and last_attr(sub.func) == (
+            "declare_space"
+        ):
+            return True
+    return False
+
+
+class ScheduleConstants:
+    name = "schedule-constants"
+    scope = "file"
+    codes = {
+        "TPM701": "hand-pinned numeric schedule constant outside the "
+                  "tuner's registry/resolver modules "
+                  "(tpu_mpi_tests/tune/)",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[tuple]:
+        if ctx.module.startswith(TUNE_PREFIX):
+            return
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            else:
+                continue
+            names = [
+                t.id for t in targets
+                if isinstance(t, ast.Name)
+                and _CONST_NAME.match(t.id)
+                and _SCHEDULE_WORD.search(t.id)
+            ]
+            if not names:
+                continue
+            if not _has_numeric_literal(value):
+                continue
+            if _routes_through_registry(value):
+                continue
+            yield (
+                stmt.lineno, stmt.col_offset, "TPM701",
+                f"hand-pinned schedule constant {names[0]!r} — one "
+                f"machine's optimum frozen for every topology; move the "
+                f"value into tune/priors.py, declare the candidate "
+                f"space with tune.declare_space where the knob lives, "
+                f"and resolve through the schedule cache (explicit > "
+                f"cached > prior; README 'Autotuning')",
+            )

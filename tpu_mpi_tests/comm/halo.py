@@ -42,6 +42,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpu_mpi_tests.compat import axis_size, shard_map
 from tpu_mpi_tests.instrument.telemetry import span_call
 from tpu_mpi_tests.kernels.pack import pack_edges, unpack_ghosts
+from tpu_mpi_tests.tune import priors as _priors
+from tpu_mpi_tests.tune.registry import (
+    declare_space,
+    resolve as _tune_resolve,
+)
 
 
 class Staging(enum.Enum):
@@ -49,6 +54,9 @@ class Staging(enum.Enum):
     DEVICE_STAGED = "device"
     HOST_STAGED = "host"
     PALLAS_RDMA = "pallas"
+    #: resolve through the schedule cache (tuned winner for this
+    #: topology/shape, else the DIRECT prior) — README "Autotuning"
+    AUTO = "auto"
 
     @classmethod
     def parse(cls, s: "str | Staging") -> "Staging":
@@ -63,6 +71,74 @@ class Staging(enum.Enum):
                 f"unknown staging mode {s!r}; valid: "
                 f"{[m.value for m in cls]}"
             ) from None
+
+
+#: the halo exchange schedule space: staging strategy AND exchange
+#: flavor in one knob — direct/device ride ppermute, pallas is the
+#: hand-written RDMA ring (HOST_STAGED is a measurement mode, never a
+#: candidate). Declared here because the knob lives here.
+HALO_STAGING_SPACE = declare_space(
+    "halo/staging",
+    (_priors.HALO_STAGING, "device", "pallas"),
+    describe="halo staging strategy + ppermute-vs-RDMA exchange flavor",
+)
+
+#: resident-block schedule spaces for the k-step stencil hot loop
+#: (``iterate_pallas_blocks_fn``): temporal block count (0 = dim-1
+#: single-buffer schedule) and fused-timestep depth. Priors are the
+#: BASELINE measured-best (f32; bench.py resolves the bf16 prior from
+#: the same table).
+STENCIL_BLOCKS_SPACE = declare_space(
+    "stencil/blocks",
+    (_priors.BENCH_BLOCKS["float32"], 0, 4),
+    describe="resident row-block count per shard (0 = single buffer)",
+)
+STENCIL_STEPS_SPACE = declare_space(
+    "stencil/steps",
+    (_priors.BENCH_STEPS, 2, 8, 1),
+    describe="temporal-blocking depth (timesteps fused per HBM pass)",
+)
+
+
+def _staging_context(zg, axis: int, world: int) -> dict:
+    """Cache context for the halo/staging knob: what moves the optimum
+    — dtype, decomposed extent (bucketed), ring size. Shared by
+    ``halo_exchange``'s AUTO resolution and the drivers' sweep sites so
+    the stored winner and the lookup always compose the same key."""
+    return {
+        "dtype": str(np.dtype(zg.dtype)),
+        "extent": int(zg.shape[axis]),
+        "world": int(world),
+    }
+
+
+def resolve_staging(staging: "Staging | str", zg, axis: int,
+                    world: int) -> Staging:
+    """``Staging.AUTO`` → the tuned winner for this configuration (or
+    the DIRECT prior); concrete modes pass through (explicit > cached >
+    prior — the explicit arm is simply not asking for AUTO)."""
+    staging = Staging.parse(staging)
+    if staging is not Staging.AUTO:
+        return staging
+    from tpu_mpi_tests.utils import TpuMtError
+
+    val = _tune_resolve(
+        "halo/staging",
+        prior=_priors.HALO_STAGING,
+        # context-sensitive: a winner tuned at one extent/dtype/ring
+        # size must not leak to another via the device-only slot
+        device_fallback=False,
+        **_staging_context(zg, axis, world),
+    )
+    try:
+        resolved = Staging.parse(val)
+    except TpuMtError:
+        resolved = Staging.DIRECT  # malformed cache value → prior
+    if resolved in (Staging.AUTO, Staging.HOST_STAGED):
+        # AUTO can't resolve to itself, and HOST_STAGED is a measurement
+        # mode a cache must never silently select
+        resolved = Staging.DIRECT
+    return resolved
 
 
 def _ring_rotate(lo_edge, hi_edge, cur_lo, cur_hi, *, axis_name: str,
@@ -239,12 +315,12 @@ def halo_exchange(
     the mode ``tests/test_ring_sync.py`` uses to execute the ring's
     barrier under race detection).
     """
-    staging = Staging.parse(staging)
     axis_name = axis_name or mesh.axis_names[0]
     from tpu_mpi_tests.arrays.spaces import ensure_device
 
     zg = ensure_device(zg)
     world = mesh.shape[axis_name]
+    staging = resolve_staging(staging, zg, axis, world)
     # telemetry payload: 2 directions × one ghost band per neighbor pair
     # (world pairs on a periodic ring, world−1 otherwise); band = n_bnd
     # slabs of the non-decomposed extent. Computed before the call — the

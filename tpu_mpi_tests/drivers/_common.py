@@ -58,6 +58,40 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         "run tpumt-trace offline for the same merge",
     )
     p.add_argument(
+        "--tune",
+        action="store_true",
+        help="arm the measured autotuner: a hot-path knob with no cache "
+        "entry for this topology runs an on-device candidate sweep and "
+        "persists the winner (README 'Autotuning'); without this flag "
+        "cached winners still apply but misses fall back to the shipped "
+        "priors",
+    )
+    p.add_argument(
+        "--tune-cache",
+        default=None,
+        metavar="PATH",
+        help="schedule cache file (default: $TPU_MPI_TUNE_CACHE, else "
+        "~/.cache/tpumt/tune.json); corrupted/stale files fall back to "
+        "priors",
+    )
+    p.add_argument(
+        "--tune-budget",
+        type=float,
+        default=60.0,
+        metavar="S",
+        help="wall-clock budget per sweep in seconds: the prior is "
+        "always measured, later candidates are dropped (and reported "
+        "as skipped) once the budget is spent (default 60)",
+    )
+    p.add_argument(
+        "--compile-cache",
+        default=None,
+        metavar="DIR",
+        help="enable jax's persistent compilation cache in DIR "
+        "($TPU_MPI_COMPILE_CACHE) so repeat runs skip XLA recompiles — "
+        "measured warmup delta in README 'Autotuning'",
+    )
+    p.add_argument(
         "--verbose", action="store_true", help="extra per-device reporting"
     )
     p.add_argument(
@@ -146,7 +180,40 @@ def make_reporter(args, rank: int = 0, size: int = 1):
 
         T.enable(sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}))
         rep.attach_telemetry()
+    _attach_tune_sink(rep)
     return rep
+
+
+def _attach_tune_sink(rep) -> None:
+    """Point the autotuner's sweep records at this run's Reporter: every
+    ``tune``/``tune_result``/``tune_hit`` record lands in the JSONL
+    stream (``tpumt-report`` renders the tuning table from them) and
+    winners/hits get a stable ``TUNE`` stdout line."""
+    from tpu_mpi_tests.tune import registry as tr
+
+    if tr.configured_cache() is None:
+        return
+
+    import json as _json
+
+    def emit(rec):
+        rep.jsonl({**rec, "rank": rep.rank})
+        kind = rec.get("kind")
+        if kind == "tune_result":
+            sec = rec.get("seconds")
+            rep.line(
+                f"TUNE {rec['knob']} winner={_json.dumps(rec['value'])} "
+                f"seconds={'-' if sec is None else f'{sec:.6g}'} "
+                f"measured={rec.get('measured', 0)} "
+                f"skipped={rec.get('skipped', 0)}"
+            )
+        elif kind == "tune_hit":
+            rep.line(
+                f"TUNE {rec['knob']} cache-hit "
+                f"value={_json.dumps(rec['value'])}"
+            )
+
+    tr.set_emit(emit)
 
 
 def force_cpu_devices(n: int) -> None:
@@ -172,6 +239,25 @@ def force_cpu_devices(n: int) -> None:
         pass  # backend already initialized; device check happens downstream
 
 
+def enable_compile_cache(path: str) -> None:
+    """Point jax's persistent compilation cache at ``path`` (created if
+    missing) with the thresholds floored so even CPU-fast compiles
+    cache. Unknown config names on older jax are skipped — the cache is
+    an accelerant, never a hard dependency."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    for key, val in (
+        ("jax_compilation_cache_dir", path),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(key, val)
+        except (AttributeError, ValueError):
+            pass
+
+
 def setup_platform(args) -> None:
     """Apply platform/dtype config. Must run before any JAX backend use."""
     import jax
@@ -182,6 +268,35 @@ def setup_platform(args) -> None:
         jax.config.update("jax_enable_x64", True)
     if getattr(args, "debug_nans", False):
         jax.config.update("jax_debug_nans", True)
+    compile_cache = getattr(args, "compile_cache", None) or os.environ.get(
+        "TPU_MPI_COMPILE_CACHE"
+    )
+    if compile_cache:
+        enable_compile_cache(compile_cache)
+    setup_tuning(args)
+
+
+def setup_tuning(args) -> None:
+    """Configure the schedule-cache registry for this run (idempotent;
+    ``make_reporter`` re-configures with the reporter's JSONL sink).
+
+    The cache loads when the run asked for tuning (``--tune`` /
+    ``--tune-cache``) or when the default cache file already exists —
+    so a warmed machine benefits without flags, while a pristine
+    machine (no cache, no ``--tune``) resolves every schedule from the
+    shipped priors, byte-identical to the pre-autotuner behavior."""
+    from tpu_mpi_tests.tune import cache as tc
+    from tpu_mpi_tests.tune import registry as tr
+
+    path = getattr(args, "tune_cache", None) or tc.default_cache_path()
+    wants = getattr(args, "tune", False) or getattr(args, "tune_cache", None)
+    if not wants and not os.path.exists(path):
+        return
+    tr.configure(
+        cache_path=path,
+        enabled=getattr(args, "tune", False),
+        budget_s=getattr(args, "tune_budget", None),
+    )
 
 
 def jnp_dtype(args):

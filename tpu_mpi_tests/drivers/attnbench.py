@@ -96,60 +96,106 @@ def run(args) -> int:
             return jnp.matmul(jax.nn.softmax(s, axis=-1), v, precision=prec)
 
         rc = 0
+        tuned_layouts: set = set()
         for tier in tiers:
-            key = jax.random.PRNGKey(0)
-            if tier in ("ring", "ulysses"):
-                check_divisible(L, world, "sequence over mesh axis")
-                shape = (L, world, d) if tier == "ulysses" else (L, d)
-                q, k, v = (
-                    jax.random.normal(kk, shape, dtype)
-                    for kk in jax.random.split(key, 3)
-                )
-                if tier == "ring" and args.stripe:
-                    # striped causal layout (comm.ring.to_striped): balanced
-                    # ring — every rank ~half-live at every step; the chained
-                    # output stays in the striped layout, position-consistent
-                    # with the next query
-                    from tpu_mpi_tests.comm.ring import to_striped
+            striped = tier == "ring" and args.stripe
 
-                    q, k, v = (to_striped(t, world) for t in (q, k, v))
-                q, k, v = (shard_1d(t, mesh) for t in (q, k, v))
-                if tier == "ring":
-                    attn = ring_attention_fn(
-                        mesh, axis_name, causal=args.causal, flash=True,
-                        precision=prec, stripe=args.stripe,
-                        k_tile=args.k_tile, skip_tile=args.skip_tile,
+            def make_qkv(tier=tier):
+                key = jax.random.PRNGKey(0)
+                if tier in ("ring", "ulysses"):
+                    check_divisible(L, world, "sequence over mesh axis")
+                    shape = (L, world, d) if tier == "ulysses" else (L, d)
+                    q, k, v = (
+                        jax.random.normal(kk, shape, dtype)
+                        for kk in jax.random.split(key, 3)
                     )
-                else:
-                    attn = ulysses_attention_fn(
-                        mesh, axis_name, causal=args.causal, flash=True,
-                        precision=prec, k_tile=args.k_tile,
-                        skip_tile=args.skip_tile,
-                    )
-            else:
-                q, k, v = (
+                    if tier == "ring" and args.stripe:
+                        # striped causal layout (comm.ring.to_striped):
+                        # balanced ring — every rank ~half-live at every
+                        # step; the chained output stays in the striped
+                        # layout, position-consistent with the next query
+                        from tpu_mpi_tests.comm.ring import to_striped
+
+                        q, k, v = (
+                            to_striped(t, world) for t in (q, k, v)
+                        )
+                    return tuple(shard_1d(t, mesh) for t in (q, k, v))
+                return tuple(
                     jax.random.normal(kk, (L, d), dtype)
                     for kk in jax.random.split(key, 3)
                 )
-                if tier == "flash":
-                    attn = functools.partial(
-                        flash_attention_pallas, causal=args.causal,
-                        precision=prec, k_tile=args.k_tile,
-                        skip_tile=args.skip_tile,
+
+            def make_attn(kt, st, tier=tier):
+                if tier == "ring":
+                    return ring_attention_fn(
+                        mesh, axis_name, causal=args.causal, flash=True,
+                        precision=prec, stripe=args.stripe,
+                        k_tile=kt, skip_tile=st,
                     )
-                else:
-                    attn = xla_attn
+                if tier == "ulysses":
+                    return ulysses_attention_fn(
+                        mesh, axis_name, causal=args.causal, flash=True,
+                        precision=prec, k_tile=kt, skip_tile=st,
+                    )
+                if tier == "flash":
+                    return functools.partial(
+                        flash_attention_pallas, causal=args.causal,
+                        precision=prec, k_tile=kt, skip_tile=st,
+                    )
+                return xla_attn
 
-            @functools.partial(jax.jit, donate_argnums=0)
-            def loop(state, n, attn=attn):
-                def body(_, st):
-                    qq, kk, vv = st
-                    return attn(qq, kk, vv), kk, vv
+            def make_loop(attn):
+                @functools.partial(jax.jit, donate_argnums=0)
+                def loop(state, n):
+                    def body(_, st):
+                        qq, kk, vv = st
+                        return attn(qq, kk, vv), kk, vv
 
-                return lax.fori_loop(0, jnp.asarray(n, jnp.int32), body, state)
+                    return lax.fori_loop(
+                        0, jnp.asarray(n, jnp.int32), body, state
+                    )
 
+                return loop
+
+            # the flash-kernel tiers' local block length: what the tile
+            # fit (and therefore the tuned optimum) actually sees
+            lq_local = L // world if tier == "ring" else L
+            if (
+                args.tune and tier != "xla"
+                and args.k_tile is None and args.skip_tile is None
+            ):
+                # measured tile sweep (cache miss only): each candidate
+                # runs the REAL tier pipeline at a shortened chain, so
+                # the winner prices ring pacing/skip behavior, not just
+                # the local kernel. Explicit --k-tile/--skip-tile skip
+                # the sweep entirely — explicit > cached > prior.
+                from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+                layout = "striped" if striped else "contig"
+                if (layout, lq_local) not in tuned_layouts:
+                    tuned_layouts.add((layout, lq_local))
+                    n_long = max(11, args.n_iter // 10)
+
+                    def measure(cand):
+                        loop = make_loop(
+                            make_attn(cand["k_tile"], cand["skip_tile"])
+                        )
+                        sec, st = chain_rate(
+                            loop, make_qkv(),
+                            n_short=n_long // 10 or 1, n_long=n_long,
+                        )
+                        del st
+                        return sec
+
+                    ensure_tuned(
+                        f"flash_tiles/{layout}", measure,
+                        dtype=args.dtype, lq=lq_local,
+                    )
+
+            attn = make_attn(args.k_tile, args.skip_tile)
             sec, state = chain_rate(
-                loop, (q, k, v), n_short=args.n_iter // 10 or 1,
+                make_loop(attn), make_qkv(),
+                n_short=args.n_iter // 10 or 1,
                 n_long=args.n_iter,
             )
             del state
@@ -162,7 +208,9 @@ def run(args) -> int:
                    "tflops": tflops * heads, "us_per_iter": sec * 1e6,
                    "world": world}
             if tier != "xla":  # flash-kernel tiers only
-                row["k_tile_ceiling"] = _resolve_k_tile(args.k_tile, striped)
+                row["k_tile_ceiling"] = _resolve_k_tile(
+                    args.k_tile, striped, dtype=args.dtype, lq=lq_local
+                )
                 if args.skip_tile is not None:
                     # explicit request: operative on both kernel paths
                     # (modulo the divisor snap)
@@ -199,18 +247,22 @@ def main(argv=None) -> int:
     p.add_argument(
         "--k-tile", type=int, default=None,
         help="flash kernel key-tile ceiling (auto-shrinks to fit). "
-        "Default: the measured-best width for the layout "
+        "Default: the schedule cache's tuned winner for this topology, "
+        "else the measured-best prior for the layout "
         "(comm.ring.MEASURED_BEST_K_TILE, pinned to BASELINE.md by "
-        "tests/test_ring.py) - since round 5's skip/rescale decoupling "
-        "the causal skip granularity is the separate --skip-tile knob",
+        "tests/test_ring.py); an explicit value always wins over the "
+        "cache. Since round 5's skip/rescale decoupling the causal "
+        "skip granularity is the separate --skip-tile knob",
     )
     p.add_argument(
         "--skip-tile", type=int, default=None,
         help="causal sub-span skip granularity for the diagonal band "
         "(round 5, VERDICT r4 #1); 0 = coupled path (full-width "
-        "masking). Default: the measured-best per layout "
+        "masking). Default: the schedule cache's tuned winner, else "
+        "the measured-best prior per layout "
         "(comm.ring.MEASURED_BEST_SKIP_TILE - striped wants 256-wide "
-        "sub-span skipping, contiguous/self-causal runs best coupled)",
+        "sub-span skipping, contiguous/self-causal runs best coupled); "
+        "an explicit value always wins over the cache",
     )
     p.add_argument(
         "--fast", action="store_true",

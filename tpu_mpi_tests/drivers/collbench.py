@@ -35,6 +35,8 @@ import functools
 import sys
 
 from tpu_mpi_tests.drivers import _common
+from tpu_mpi_tests.tune import priors as _priors
+from tpu_mpi_tests.tune.registry import declare_space
 
 COLLECTIVES = (
     "allgather", "allreduce", "reducescatter", "ppermute", "alltoall"
@@ -43,6 +45,19 @@ COLLECTIVES = (
 # rather than default because their lane-alignment rules skip the smallest
 # ladder sizes (the skip is reported, not silent)
 COLLECTIVES_RDMA = ("allgather_rdma", "allreduce_rdma")
+
+#: collectives with a hand-ring twin: the variant (XLA lowering vs
+#: explicit-RDMA ring) is a tunable schedule — ``--collectives auto``
+#: resolves each through the cache (prior: xla), ``--tune`` sweeps both
+#: on a miss. Declared here because the variant choice lives here.
+COLL_VARIANT_SPACES = {
+    base: declare_space(
+        f"coll_variant/{base}",
+        (_priors.COLL_VARIANT, "rdma"),
+        describe="XLA collective vs hand-written RDMA ring twin",
+    )
+    for base in ("allgather", "allreduce")
+}
 
 # the COLL line's parse pattern lives NEXT TO its format string (below) so
 # a format change is a one-site edit; both test files import this
@@ -132,6 +147,54 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int,
     return run
 
 
+def _resolve_variant(base, args, mesh, axis_name, world, n, dtype,
+                     shard_bytes) -> str:
+    """The collective name to actually run for an ``auto`` entry:
+    explicit names never reach here; the variant knob resolves cached >
+    prior, and with ``--tune`` a miss prices BOTH twins on-device at
+    this payload size (the rdma twin's lane-alignment floor surfaces as
+    a recorded error candidate, leaving the XLA tier the winner)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.instrument.timers import chain_rate
+    from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+    def eff_of(variant: str) -> str:
+        return base if variant == "xla" else f"{base}_rdma"
+
+    def measure(variant):
+        eff = eff_of(variant)
+        fn = _loop_fn(mesh, axis_name, eff, world,
+                      rdma_credits=args.rdma_credits)
+        if eff in COLLECTIVES_RDMA:
+            # trace-time feasibility probe: below the ring kernel's
+            # lane-alignment floor this raises, and the sweep records
+            # the candidate as errored instead of crashing
+            jax.eval_shape(
+                fn, jax.ShapeDtypeStruct((n * world,), dtype), 1
+            )
+        x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
+        n_meas = max(10, args.n_iter // 10)
+        sec, x = chain_rate(
+            fn, x, n_short=n_meas // 10 or 1, n_long=n_meas
+        )
+        del x
+        return sec
+
+    variant = ensure_tuned(
+        f"coll_variant/{base}", measure,
+        # payload-size-sensitive: the 16 MiB winner must not decide the
+        # 4 KiB row through the device-only slot
+        device_fallback=False,
+        dtype=args.dtype, bytes=shard_bytes, world=world,
+    )
+    if variant not in ("xla", "rdma"):
+        variant = "xla"  # malformed cache value degrades to the prior
+    return eff_of(variant)
+
+
 def _busbw_bytes(name: str, shard_bytes: int, world: int) -> float:
     name = name.removesuffix("_rdma")  # ring twins move the same bytes
     if world < 2:
@@ -171,17 +234,37 @@ def run(args) -> int:
         )
 
         names = _common.parse_choice_list(
-            args.collectives, COLLECTIVES + COLLECTIVES_RDMA, "collective"
+            args.collectives,
+            COLLECTIVES + COLLECTIVES_RDMA + ("auto",),
+            "collective",
         )
         if names is None:
             return 2
+        # "auto" expands to the twin-backed collectives with per-size
+        # variant resolution (explicit names never re-resolve)
+        names = [
+            m
+            for n in names
+            for m in (
+                [f"{b}:auto" for b in COLL_VARIANT_SPACES]
+                if n == "auto" else [n]
+            )
+        ]
 
         dtype = _common.jnp_dtype(args)
         itemsize = jnp.dtype(dtype).itemsize
-        for name in names:
+        for spec_name in names:
+            base, _, mode = spec_name.partition(":")
+            auto = mode == "auto"
             for kib in (int(s) for s in args.sizes_kib.split(",")):
                 shard_bytes = kib * 1024
                 n = shard_bytes // itemsize
+                name = base
+                if auto:
+                    name = _resolve_variant(
+                        base, args, mesh, axis_name, world, n, dtype,
+                        shard_bytes,
+                    )
                 if name in ("alltoall", "reducescatter"):
                     # the alltoall reshape and the psum_scatter chunking both
                     # split the shard w ways
@@ -238,6 +321,9 @@ def run(args) -> int:
                     {"kind": "coll", "collective": name, "dtype": args.dtype,
                      "shard_bytes": shard_bytes, "us_per_iter": sec * 1e6,
                      "busbw_gbps": busbw, "world": world, "n_iter": n_eff,
+                     # auto rows record the resolution so merged results
+                     # distinguish a tuned pick from an explicit request
+                     **({"auto": True} if auto else {}),
                      **cred_rec},
                 )
                 del x
@@ -252,7 +338,10 @@ def main(argv=None) -> int:
         help="comma list of collectives to sweep; beyond the default XLA "
         f"tier, {'/'.join(COLLECTIVES_RDMA)} select the hand-written "
         "RDMA ring twins (sizes below their lane-alignment floor are "
-        "reported as COLL-SKIP)",
+        "reported as COLL-SKIP); 'auto' runs the twin-backed "
+        "collectives with each size's variant resolved from the "
+        "schedule cache (with --tune, a cache miss prices both twins "
+        "on-device first)",
     )
     p.add_argument(
         "--rdma-credits", type=int, default=1, choices=(1, 2),

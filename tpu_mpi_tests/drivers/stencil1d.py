@@ -60,6 +60,32 @@ def run(args) -> int:
         )
 
         staging = H.Staging.parse(args.staging)
+        if staging is H.Staging.AUTO:
+            if args.tune:
+                # measured sweep over the halo schedule space (staging
+                # strategy + ppermute-vs-RDMA flavor) on this exact
+                # buffer: each candidate prices a donated feedback chain
+                # (state = exchange(state)), sync-honest via block();
+                # the winner persists to the schedule cache and a rerun
+                # is a pure cache hit (make tune-smoke gates this)
+                from tpu_mpi_tests.tune.sweep import (
+                    ensure_tuned,
+                    feedback_rate,
+                )
+
+                def measure(cand):
+                    sec, _ = feedback_rate(
+                        lambda z: H.halo_exchange(z, mesh, staging=cand),
+                        zg + 0,  # fresh copy: the exchange donates
+                    )
+                    return sec
+
+                ensure_tuned(
+                    "halo/staging", measure, device_fallback=False,
+                    **H._staging_context(zg, 0, world),
+                )
+            staging = H.resolve_staging("auto", zg, 0, world)
+            rep.banner(f"TUNE halo/staging resolved -> {staging.value}")
         with ProfilerGate(args.profile_dir):
             # untimed warmup so the timed exchange measures communication, not
             # trace+compile (exchange is idempotent: ghosts are rewritten with
@@ -132,9 +158,11 @@ def main(argv=None) -> int:
     p.add_argument(
         "--staging",
         default="direct",
-        choices=["direct", "device", "host", "pallas"],
+        choices=["direct", "device", "host", "pallas", "auto"],
         help="halo staging mode (≅ reference stage_host/device variants; "
-        "'pallas' = hand-written inter-chip RDMA ring kernel)",
+        "'pallas' = hand-written inter-chip RDMA ring kernel; 'auto' = "
+        "the schedule cache's tuned winner for this topology — with "
+        "--tune a cache miss runs the measured sweep first)",
     )
     p.add_argument(
         "--tol",

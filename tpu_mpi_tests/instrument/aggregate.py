@@ -15,7 +15,11 @@ into:
   ranks' spans), and skew of per-rank totals;
 * straggler detection: any phase/op whose slowest rank exceeds the fastest
   by more than ``--skew-threshold`` (default 1.5×) is flagged with the
-  offending rank — the cross-rank question avg.sh could never answer.
+  offending rank — the cross-rank question avg.sh could never answer;
+* a tuning table (``kind: "tune"/"tune_result"/"tune_hit"`` records from
+  the autotuner's sweeps — README "Autotuning"): per knob, how many
+  candidates were measured/skipped/errored, the persisted winner and its
+  measured seconds, and how many later resolutions were pure cache hits.
 
 Pure stdlib (no jax import): usable on a login node against files copied
 off the pod. ``--json`` emits the summary as one JSON document instead of
@@ -94,6 +98,7 @@ def summarize(files: list[str]) -> dict:
     manifests = 0
     phases: dict[str, dict] = {}
     ops: dict[str, dict] = {}
+    tuning: dict[str, dict] = {}
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -124,6 +129,31 @@ def summarize(files: list[str]) -> dict:
                 op["bytes"] += int(rec.get("nbytes") or 0)
                 if rec.get("gbps"):
                     op["gbps"].append(float(rec["gbps"]))
+            elif kind in ("tune", "tune_result", "tune_hit"):
+                t = tuning.setdefault(
+                    rec.get("knob", "?"),
+                    {"measured": 0, "skipped": 0, "errors": 0,
+                     "invalid": 0, "hits": 0,
+                     "winner": None, "winner_seconds": None},
+                )
+                if kind == "tune":
+                    if rec.get("skipped"):
+                        t["skipped"] += 1
+                    elif rec.get("error") is not None:
+                        t["errors"] += 1
+                    elif rec.get("seconds") is not None:
+                        t["measured"] += 1
+                    else:
+                        # NaN measurement: seconds=null with no error —
+                        # invalid, never countable as measured
+                        t["invalid"] += 1
+                elif kind == "tune_result":
+                    t["winner"] = rec.get("value")
+                    t["winner_seconds"] = rec.get("seconds")
+                else:  # tune_hit: a resolution served from the cache
+                    t["hits"] += 1
+                    if t["winner"] is None:
+                        t["winner"] = rec.get("value")
 
     def _stats(per_rank: dict) -> dict:
         vals = list(per_rank.values())
@@ -144,6 +174,7 @@ def summarize(files: list[str]) -> dict:
         "manifest_count": manifests,
         "phases": {},
         "ops": {},
+        "tuning": {name: tuning[name] for name in sorted(tuning)},
     }
     for name in sorted(phases):
         summary["phases"][name] = {
@@ -194,6 +225,16 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
             f"bytes={op['bytes']} mean={op['mean_s']:.6g} "
             f"min={op['min_s']:.6g} max={op['max_s']:.6g} "
             f"skew={op['skew']:.3g}{gb}"
+        )
+
+    for name, t in summary.get("tuning", {}).items():
+        sec = t["winner_seconds"]
+        print(
+            f"TUNE {name}: winner={json.dumps(t['winner'])} "
+            f"seconds={'-' if sec is None else format(sec, '.6g')} "
+            f"measured={t['measured']} skipped={t['skipped']} "
+            f"errors={t['errors']} invalid={t['invalid']} "
+            f"cache_hits={t['hits']}"
         )
 
     stragglers = 0

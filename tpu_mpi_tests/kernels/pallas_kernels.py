@@ -2489,15 +2489,14 @@ def _fit_flash_tiles(L, Lk, d, itemsize, q_tile, k_tile,
     return _shrink_tiles_to_budget(live, L, Lk, q_tile, k_tile)
 
 
-# Streaming-path skip_tile default, MEASURED on chip (BASELINE round-5
-# streaming-decoupling note): the self-causal stream A/B reads coupled
-# 2.424/2.459 ms vs decoupled 2.637/2.663 at L=32K bf16 (alternated
-# min-of-2) — the boundary cell is 1 of ~8 live cells per q tile and
-# the sub-span machinery costs more than the ~half-cell waste it saves,
-# the same verdict as the resident contiguous diagonal. 0 = coupled
-# full-width masking; the striped ring never reaches this path at
-# production sizes (its blocks stay VMEM-resident), so no striped entry.
-_STREAM_SKIP_TILE_DEFAULT = 0
+# Streaming-path skip_tile default: the measured-on-chip value now
+# lives in tune/priors.py (STREAM_SKIP_TILE, with the BASELINE round-5
+# streaming-decoupling rationale) — schedule constants are pinned only
+# in the tuner's prior tables (rule TPM701). The kernel keeps the alias
+# its callers and tests know.
+from tpu_mpi_tests.tune.priors import (  # noqa: E402
+    STREAM_SKIP_TILE as _STREAM_SKIP_TILE_DEFAULT,
+)
 
 
 def _fit_stream_tiles(L, Lk, d, itemsize, q_tile, k_tile,

@@ -1,0 +1,48 @@
+"""Measured autotuner + persistent schedule cache for every hot-path knob.
+
+The paper's core observation is that the fastest comm/compute schedule is
+machine- and layout-dependent (staging strategy, tile widths, exchange
+flavor — SURVEY §2), yet the repo's fastest numbers historically rode
+hand-pinned constants from one-off sweeps (``MEASURED_BEST_*`` tables,
+``TPU_MPI_BENCH_BLOCKS``, halo ``Staging``). This package is the
+XLA/Triton-style answer: an on-device sweep engine whose results persist,
+so every topology re-derives its own optimum once and reuses it forever.
+
+Pieces (each importable without jax at module scope — jax loads lazily
+only when a fingerprint actually needs the live backend):
+
+* :mod:`~tpu_mpi_tests.tune.priors` — the shipped measured-best tables,
+  demoted to cold-start priors: the first candidates a sweep tries, and
+  the fallback when tuning is disabled or the cache is absent, so
+  behavior without ``--tune`` and without a cache is byte-identical to
+  the hand-pinned era. The ONLY sanctioned home for numeric schedule
+  constants (enforced by lint rule TPM701).
+* :mod:`~tpu_mpi_tests.tune.fingerprint` — the cache key: device kind,
+  platform, mesh/topology shape, dtype, shape-bucket.
+* :mod:`~tpu_mpi_tests.tune.cache` — JSON persistence
+  (``~/.cache/tpumt/tune.json`` or ``--tune-cache PATH``); corrupted or
+  version-mismatched files fall back to priors, never crash.
+* :mod:`~tpu_mpi_tests.tune.registry` — tunable-space declarations
+  (spaces are declared WHERE THE KNOB LIVES — comm/ring.py declares the
+  flash tile spaces, comm/halo.py the staging/blocks/steps spaces,
+  drivers/collbench.py the collective variants) plus the process-wide
+  resolution state. Precedence at every site: explicit > cached > prior.
+* :mod:`~tpu_mpi_tests.tune.sweep` — the measured sweep: sync-honest
+  candidate timing windows (``instrument.timers.block`` discipline,
+  ``comm_span`` wrapping so ``tpumt-trace`` shows sweep windows), a
+  ``--tune-budget`` wall-clock cap with reported (never silent) skips,
+  JSONL ``tune``/``tune_result``/``tune_hit`` records for
+  ``tpumt-report``'s tuning table, and winner persistence.
+"""
+
+from tpu_mpi_tests.tune.registry import (  # noqa: F401
+    configure,
+    configured_cache,
+    declare_space,
+    lookup,
+    resolve,
+    space,
+    spaces,
+    tuning_enabled,
+)
+from tpu_mpi_tests.tune.sweep import ensure_tuned, sweep  # noqa: F401

@@ -1,0 +1,135 @@
+"""Persistent schedule cache: one JSON file mapping (knob, fingerprint)
+to a measured winner.
+
+Default location ``~/.cache/tpumt/tune.json`` (override:
+``--tune-cache PATH`` / ``TPU_MPI_TUNE_CACHE``). The file is versioned;
+a corrupted, unreadable, or version-mismatched file degrades to an
+empty cache — resolvers then fall back to the shipped priors, never
+crash a run over a stale artifact (gated by ``tests/test_tune.py``).
+Writes are atomic (tmp + rename) so a killed sweep cannot leave a
+half-written file for the next run to choke on.
+
+Entry shape (JSON-serializable by contract — candidates are ints,
+strings, or flat dicts of those)::
+
+    {"version": 1,
+     "entries": {"<knob>|<fingerprint>": {
+         "value": <winner>, "seconds": <measured best>,
+         "knob": ..., "fingerprint": ...}}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from pathlib import Path
+from typing import Any
+
+CACHE_VERSION = 1
+
+#: env override for every consumer (drivers expose ``--tune-cache`` on
+#: top; ``bench.py`` has no argparse and reads only this)
+CACHE_ENV = "TPU_MPI_TUNE_CACHE"
+
+
+def default_cache_path() -> str:
+    """``$TPU_MPI_TUNE_CACHE``, else ``~/.cache/tpumt/tune.json``
+    (honoring ``XDG_CACHE_HOME``)."""
+    env = os.environ.get(CACHE_ENV)
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "tpumt", "tune.json")
+
+
+def _key(knob: str, fingerprint: str) -> str:
+    return f"{knob}|{fingerprint}"
+
+
+class ScheduleCache:
+    """In-memory view of one cache file. ``load`` never raises on bad
+    content; ``save`` is atomic and merge-on-write (a concurrent sweep
+    of a DIFFERENT knob on the same file loses nothing)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self.entries: dict[str, dict[str, Any]] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def load(cls, path: str) -> "ScheduleCache":
+        cache = cls(path)
+        cache.entries = cls._read_entries(path)
+        return cache
+
+    @staticmethod
+    def _read_entries(path: str) -> dict[str, dict[str, Any]]:
+        try:
+            doc = json.loads(Path(path).read_text())
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            return {}
+        if (
+            not isinstance(doc, dict)
+            or doc.get("version") != CACHE_VERSION
+            or not isinstance(doc.get("entries"), dict)
+        ):
+            return {}  # stale/foreign format: priors, not a crash
+        return {
+            k: v for k, v in doc["entries"].items() if isinstance(v, dict)
+        }
+
+    def lookup(self, knob: str, fingerprint: str):
+        """The cached winner value, or None. (None is never a valid
+        winner — candidates are concrete schedules.)"""
+        entry = self.entries.get(_key(knob, fingerprint))
+        return None if entry is None else entry.get("value")
+
+    def store(
+        self,
+        knob: str,
+        fingerprint: str,
+        value,
+        seconds: float | None = None,
+        **extra,
+    ) -> None:
+        entry = {
+            "value": value,
+            "seconds": seconds,
+            "knob": knob,
+            "fingerprint": fingerprint,
+            **extra,
+        }
+        with self._lock:
+            self.entries[_key(knob, fingerprint)] = entry
+
+    def save(self) -> None:
+        """Atomic write, merged over the file's current content so
+        concurrent writers of disjoint keys compose."""
+        with self._lock:
+            merged = self._read_entries(self.path)
+            merged.update(self.entries)
+            doc = {"version": CACHE_VERSION, "entries": merged}
+            directory = os.path.dirname(self.path) or "."
+            os.makedirs(directory, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=directory, prefix=".tune.", suffix=".tmp"
+            )
+            try:
+                with os.fdopen(fd, "w") as fh:
+                    json.dump(doc, fh, indent=1, sort_keys=True)
+                    fh.write("\n")
+                os.replace(tmp, self.path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            self.entries = merged
+
+    def __len__(self) -> int:
+        return len(self.entries)

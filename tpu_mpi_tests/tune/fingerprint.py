@@ -1,0 +1,90 @@
+"""Schedule-cache keys: what has to match for a tuned winner to transfer.
+
+A tuned schedule is only valid on the configuration it was measured on:
+the paper's whole point is that the optimum moves with the hardware and
+the layout. The fingerprint pins the axes that move it — device kind,
+platform, mesh/topology shape (device + process counts), dtype, and a
+power-of-two shape bucket (a 8192-row sweep should serve 8192 exactly,
+not 8193; bucketing keeps near-identical shapes from fragmenting the
+cache) — into one canonical ``k=v;k=v`` string.
+
+Two layers:
+
+* :func:`device_fields` — the live backend's identity (lazy ``import
+  jax``; lru-cached per process). Callers that never consult a cache
+  never touch it, so library-level resolution stays backend-free on the
+  prior fast path.
+* :func:`compose` — pure string composition from explicit fields, used
+  directly by tests (fingerprint stability across process restarts is a
+  gate: same inputs MUST give the same string, no id()/hash()/time
+  leakage).
+
+Call sites differ in how much context they have (the flash kernel knows
+neither layout nor mesh; a driver knows everything), so lookups fall
+back from the full fingerprint to the device-only fingerprint — sweeps
+store their winner under both (:mod:`~tpu_mpi_tests.tune.sweep`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def shape_bucket(n: int) -> int:
+    """Round ``n`` up to the next power of two (1 stays 1): the shape
+    axis of the fingerprint. Exact shapes would fragment the cache over
+    trivially-different lengths; pow2 buckets match how the schedules
+    themselves scale (tile divisors, VMEM fits)."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+@functools.lru_cache(maxsize=None)
+def device_fields() -> tuple[tuple[str, str], ...]:
+    """The live backend's identity fields, probed once per process:
+    platform, device kind, global device count, process count. Requires
+    an initialized jax backend — only reached when a cache lookup or a
+    sweep actually needs a key."""
+    import jax
+
+    devs = jax.devices()
+    return (
+        ("platform", devs[0].platform),
+        ("device", devs[0].device_kind.replace(";", ",")),
+        # named ndev, not world: knob contexts pass their mesh-axis ring
+        # size as `world` and must not silently overwrite the device count
+        ("ndev", str(len(devs))),
+        ("procs", str(jax.process_count())),
+    )
+
+
+def compose(base: dict[str, str] | None = None, **ctx) -> str:
+    """Canonical fingerprint string from explicit fields: sorted
+    ``k=v`` pairs joined with ``;``. ``shape``-named integer fields are
+    bucketed (:func:`shape_bucket`); everything else is stringified.
+    Pure — the process-restart stability gate tests exactly this."""
+    fields = dict(base or ())
+    for k, v in ctx.items():
+        if v is None:
+            continue
+        if k in ("shape", "lq", "n", "extent", "bytes") and not isinstance(
+            v, str
+        ):
+            v = shape_bucket(v)
+        fields[k] = str(v)
+    return ";".join(f"{k}={fields[k]}" for k in sorted(fields))
+
+
+def fingerprint(**ctx) -> str:
+    """Full cache key: live device fields + the caller's context
+    (dtype, shape bucket, layout, …)."""
+    return compose(dict(device_fields()), **ctx)
+
+
+def device_fingerprint() -> str:
+    """Device-only key — the fallback slot context-free resolution
+    sites (e.g. inside the flash kernel, which knows neither layout nor
+    shape at its resolve point) can still hit."""
+    return compose(dict(device_fields()))

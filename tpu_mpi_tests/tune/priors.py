@@ -1,0 +1,68 @@
+"""Shipped measured-best schedules, demoted to cold-start priors.
+
+Every constant here came from an on-chip sweep recorded in BASELINE.md;
+they used to be pinned at their point of use (``comm/ring.py``,
+``kernels/pallas_kernels.py``, ``bench.py``). The autotuner demotes them
+to PRIORS: the first candidate a sweep tries, and the value every
+resolver returns when tuning is disabled and no cache entry exists — so
+a run with no cache and no ``--tune`` resolves every schedule exactly as
+the hand-pinned era did (pinned-prior parity, gated by
+``tests/test_tune.py`` and the table pin in ``tests/test_ring.py``).
+
+This module (and the rest of ``tpu_mpi_tests/tune/``) is the ONLY
+sanctioned home for numeric tile/schedule constants: rule TPM701
+(``analysis/rules/schedule_constants.py``) flags any such assignment
+elsewhere, so future knobs must route through
+:func:`~tpu_mpi_tests.tune.registry.declare_space` /
+:func:`~tpu_mpi_tests.tune.registry.resolve` and get cached per
+topology instead of re-pinning one machine's optimum for everyone.
+"""
+
+from __future__ import annotations
+
+# Flash-attention tile configuration per ring layout (BASELINE.md round-5
+# stripebalance, three grids interleaved same-window): wide k_tiles win
+# for BOTH layouts, and the causal-skip granularity is LAYOUT-DEPENDENT —
+# the striped layout's spread diagonal band wants 256-wide sub-span
+# skipping (paced 1.645 vs 1.859 ms coupled, 18% less total work,
+# same-window), while the contiguous/self-causal narrow band (only
+# q_tile wide) trades within window noise with a slight coupled edge
+# (3/5 alternated windows), so contig keeps the simpler homogeneous
+# full-width masked loop. Re-exported by ``comm.ring`` under the same
+# names; ``k_tile=None`` / ``skip_tile=None`` resolve through the cache
+# with these as priors.
+MEASURED_BEST_K_TILE = {"contig": 2048, "striped": 2048}
+MEASURED_BEST_SKIP_TILE = {"contig": 0, "striped": 256}
+
+# Streaming-path skip_tile prior, MEASURED on chip (BASELINE round-5
+# streaming-decoupling note): the self-causal stream A/B reads coupled
+# 2.424/2.459 ms vs decoupled 2.637/2.663 at L=32K bf16 (alternated
+# min-of-2) — the boundary cell is 1 of ~8 live cells per q tile and
+# the sub-span machinery costs more than the ~half-cell waste it saves,
+# the same verdict as the resident contiguous diagonal. 0 = coupled
+# full-width masking; the striped ring never reaches this path at
+# production sizes (its blocks stay VMEM-resident), so no striped entry.
+STREAM_SKIP_TILE = 0
+
+# Resident-block schedule priors for the headline stencil loop
+# (BASELINE.md): S=2 resident blocks measured 3021 vs 2087 iter/s
+# against the single-buffer dim-1 kernel at 8192² f32 k=4 (S≥4 loses to
+# per-call launch overhead); bf16 runs best with NO blocks (the dim-1
+# single-buffer kernel is the measured-best 16-bit schedule). k=4
+# temporal blocking is the shipped default depth. ``bench.py`` resolves
+# both through the cache with these priors; ``TPU_MPI_BENCH_BLOCKS`` /
+# ``TPU_MPI_BENCH_STEPS`` stay the explicit overrides.
+BENCH_BLOCKS = {"float32": 2, "bfloat16": 0}
+BENCH_STEPS = 4
+
+# Halo exchange schedule prior: DIRECT (plain ppermute on edge slices,
+# XLA packs as needed) is the measured-best default on every topology
+# benchmarked so far; DEVICE_STAGED and the hand-written PALLAS_RDMA
+# ring are the candidates a ``--tune`` sweep prices against it
+# (HOST_STAGED is a measurement mode, never a candidate).
+HALO_STAGING = "direct"
+
+# Collective variant prior: the XLA lowering ("xla"), with the
+# hand-written RDMA ring twin ("rdma") as the sweep alternative where
+# one exists (allgather/allreduce).
+COLL_VARIANT = "xla"

@@ -1,0 +1,194 @@
+"""Tunable-space declarations + the process-wide resolution state.
+
+Spaces are declared WHERE THE KNOB LIVES (``comm/ring.py`` declares the
+flash tile spaces, ``comm/halo.py`` the halo staging / resident-block /
+k-group spaces, ``drivers/collbench.py`` the collective variants) by
+calling :func:`declare_space` at import time; the numeric candidate
+values come from :mod:`~tpu_mpi_tests.tune.priors` (rule TPM701 keeps
+pinned schedule constants out of everywhere else). The first candidate
+is the PRIOR: what a sweep tries first, and what resolution returns
+when tuning is off and the cache has no entry — so a run with no cache
+resolves byte-identically to the hand-pinned era.
+
+Resolution precedence at EVERY site (gated by ``tests/test_tune.py``):
+
+    explicit argument  >  cached winner  >  shipped prior
+
+The cache is consulted only after :func:`configure` loaded one (drivers
+do this from ``--tune-cache``/``TPU_MPI_TUNE_CACHE``; ``bench.py`` from
+the env/default path) — bare library use never reads a cache file, so
+tests and embedders see pure prior behavior unless they opt in.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+from tpu_mpi_tests.tune.cache import ScheduleCache, default_cache_path
+
+
+@dataclass(frozen=True)
+class TunableSpace:
+    """One declared knob: its candidate schedules (prior FIRST) and a
+    one-line rationale. Candidates are JSON-serializable by contract
+    (ints, strings, or flat dicts of those) — they round-trip through
+    the cache file."""
+
+    knob: str
+    candidates: tuple
+    describe: str = ""
+
+    @property
+    def prior(self):
+        """Cold-start winner: the shipped measured-best."""
+        return self.candidates[0]
+
+
+_SPACES: dict[str, TunableSpace] = {}
+_SPACES_LOCK = threading.Lock()
+
+
+def declare_space(
+    knob: str, candidates: Iterable, describe: str = ""
+) -> TunableSpace:
+    """Register a tunable space (idempotent: redeclaring the same knob
+    returns the existing space — modules declaring at import time may be
+    re-imported under test runners)."""
+    with _SPACES_LOCK:
+        existing = _SPACES.get(knob)
+        if existing is not None:
+            return existing
+        sp = TunableSpace(knob, tuple(candidates), describe)
+        if not sp.candidates:
+            raise ValueError(f"tunable space {knob!r} has no candidates")
+        _SPACES[knob] = sp
+        return sp
+
+
+def space(knob: str) -> TunableSpace:
+    # import for side effect: the knob owners declare their spaces at
+    # import time, and asking for a space must not depend on whether the
+    # caller happened to import the owning module first
+    _import_knob_owners()
+    return _SPACES[knob]
+
+
+def spaces() -> dict[str, TunableSpace]:
+    _import_knob_owners()
+    return dict(_SPACES)
+
+
+def _import_knob_owners() -> None:
+    """Import every module that declares spaces. Lazy (not at this
+    module's import) so the registry itself stays importable without
+    jax; the owners all import jax."""
+    import tpu_mpi_tests.comm.halo  # noqa: F401
+    import tpu_mpi_tests.comm.ring  # noqa: F401
+    import tpu_mpi_tests.drivers.collbench  # noqa: F401
+
+
+class _State:
+    def __init__(self):
+        self.cache: ScheduleCache | None = None
+        self.enabled = False
+        self.budget_s: float | None = None
+        self.emit: Callable[[dict], None] | None = None
+
+
+_STATE = _State()
+_STATE_LOCK = threading.Lock()
+
+
+def configure(
+    cache_path: str | None = None,
+    enabled: bool = False,
+    budget_s: float | None = None,
+    emit: Callable[[dict], None] | None = None,
+) -> ScheduleCache:
+    """Load the schedule cache and set the process-wide tuning switches.
+
+    ``cache_path=None`` resolves ``TPU_MPI_TUNE_CACHE`` then the default
+    ``~/.cache/tpumt/tune.json``; a missing/corrupted file loads as
+    empty (priors apply). ``enabled`` arms on-miss sweeps
+    (:func:`~tpu_mpi_tests.tune.sweep.ensure_tuned`); lookups of an
+    existing cache work regardless, which is how ``bench.py`` consults a
+    warmed cache without any flag. ``emit`` is the default JSONL sink
+    for sweep records (a driver passes its Reporter's)."""
+    with _STATE_LOCK:
+        _STATE.cache = ScheduleCache.load(cache_path or default_cache_path())
+        _STATE.enabled = bool(enabled)
+        _STATE.budget_s = budget_s
+        _STATE.emit = emit
+        return _STATE.cache
+
+
+def deconfigure() -> None:
+    """Back to the unconfigured state (tests)."""
+    with _STATE_LOCK:
+        _STATE.cache = None
+        _STATE.enabled = False
+        _STATE.budget_s = None
+        _STATE.emit = None
+
+
+def configured_cache() -> ScheduleCache | None:
+    return _STATE.cache
+
+
+def tuning_enabled() -> bool:
+    return _STATE.enabled
+
+
+def tune_budget_s() -> float | None:
+    return _STATE.budget_s
+
+
+def default_emit() -> Callable[[dict], None] | None:
+    return _STATE.emit
+
+
+def set_emit(emit: Callable[[dict], None] | None) -> None:
+    """Install the default sweep-record sink after configuration (the
+    driver's Reporter exists only later than ``setup_platform``)."""
+    with _STATE_LOCK:
+        _STATE.emit = emit
+
+
+def lookup(knob: str, device_fallback: bool = True, **ctx) -> Any | None:
+    """The cached winner for ``knob`` under the caller's context, or
+    None. Tries the full fingerprint first, then (``device_fallback``,
+    the default) the device-only fingerprint — sweeps store both, so
+    context-free sites like the flash kernel can still hit a winner
+    tuned with full context. Sites whose optimum is context-SENSITIVE
+    (a dtype-keyed block count: the f32 winner is measured-wrong at
+    bf16) pass ``device_fallback=False`` so a sibling context's winner
+    can never leak in. Touches the jax backend only when a non-empty
+    cache is actually loaded."""
+    cache = _STATE.cache
+    if cache is None or not len(cache):
+        return None
+    from tpu_mpi_tests.tune.fingerprint import device_fingerprint, fingerprint
+
+    val = cache.lookup(knob, fingerprint(**ctx))
+    if val is None and ctx and device_fallback:
+        val = cache.lookup(knob, device_fingerprint())
+    return val
+
+
+def resolve(knob: str, explicit=None, prior=None,
+            device_fallback: bool = True, **ctx):
+    """The value a knob site should use: ``explicit`` when the caller
+    was given one (CLI flag / env var / function argument), else the
+    cached winner, else ``prior`` (defaulting to the declared space's
+    first candidate). This is THE precedence order — explicit > cached
+    > prior — at every site."""
+    if explicit is not None:
+        return explicit
+    cached = lookup(knob, device_fallback=device_fallback, **ctx)
+    if cached is not None:
+        return cached
+    if prior is not None:
+        return prior
+    return space(knob).prior

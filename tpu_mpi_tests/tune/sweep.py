@@ -1,0 +1,211 @@
+"""The measured sweep: price candidate schedules on-device, persist the
+winner, report every measurement.
+
+Measurement discipline is the repo's standing one (SURVEY §7 hard part
+2): candidates are timed sync-honestly — the caller's ``measure``
+callable must end on a real device sync (``instrument.timers.block`` /
+``chain_rate``; :func:`feedback_rate` below packages the donated-
+feedback loop shape) — and each candidate window is wrapped in a
+telemetry ``comm_span`` so ``tpumt-trace`` shows the sweep windows on
+the cross-rank timeline when ``--telemetry`` is on.
+
+Budget (``--tune-budget``) is a wall-clock cap across the candidate
+list: the prior (first candidate) is ALWAYS measured, later candidates
+are dropped when the budget is exhausted, and every drop is emitted as
+a ``skipped`` record — a bounded sweep must never read as an exhaustive
+one. An erroring candidate (e.g. a hand-ring kernel below its
+lane-alignment floor on this shape) records its error and scores NaN
+rather than killing the sweep.
+
+JSONL records (rendered by ``tpumt-report``'s tuning table):
+
+* ``{"kind": "tune", knob, candidate, seconds|skipped|error,
+  fingerprint}`` — one per candidate;
+* ``{"kind": "tune_result", knob, value, seconds, measured, skipped,
+  fingerprint}`` — the persisted winner;
+* ``{"kind": "tune_hit", knob, value, fingerprint}`` — a resolution
+  served from the cache with no sweep (what ``make tune-smoke`` asserts
+  on its second invocation).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable
+
+from tpu_mpi_tests.instrument.telemetry import comm_span
+from tpu_mpi_tests.tune import registry
+from tpu_mpi_tests.tune.fingerprint import device_fingerprint, fingerprint
+
+
+def feedback_rate(fn, state, n_short: int = 4, n_long: int = 12):
+    """Seconds per call of a donated single-step function, measured by
+    feeding its output back as the next input (``state = fn(state)``)
+    and differencing two run lengths — the host-loop analog of
+    ``chain_rate`` for ops that can't carry a device-side ``fori_loop``
+    (e.g. one ``halo_exchange`` dispatch, which donates its operand).
+    Returns ``(seconds_per_call, final_state)``; NaN on a non-positive
+    delta, like every other invalid measurement in this repo."""
+    from tpu_mpi_tests.instrument.timers import block
+
+    state = block(fn(state))  # compile + warm
+
+    def run(state, n):
+        for _ in range(n):
+            state = fn(state)
+        return block(state), None
+
+    t0 = time.perf_counter()
+    state, _ = run(state, n_short)
+    t_short = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    state, _ = run(state, n_long)
+    t_long = time.perf_counter() - t0
+    delta = t_long - t_short
+    per = delta / (n_long - n_short) if delta > 0 else float("nan")
+    return per, state
+
+
+def _process_count() -> int:
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def sweep(
+    knob: str,
+    measure: Callable[[object], float],
+    *,
+    candidates: Iterable | None = None,
+    budget_s: float | None = None,
+    emit: Callable[[dict], None] | None = None,
+    persist: bool = True,
+    **ctx,
+):
+    """Measure every candidate within budget, persist and return the
+    winner. ``measure(candidate) -> seconds`` (NaN = invalid). The
+    winner lands in the configured cache under the full fingerprint AND
+    the device-only fingerprint, so context-free resolution sites still
+    benefit from a sweep run with full context.
+
+    Single-process only: candidate measurements dispatch collectives,
+    and every per-rank decision in a sweep — the wall-clock budget
+    cutoff, an errored candidate, the winner itself — is local, so two
+    processes could execute different candidate programs and hang the
+    pod on a collective only a subset of ranks entered. A multi-process
+    run therefore never measures: it records the skip and resolves
+    cached > prior (warm the cache in a single-process run on one host
+    of the same topology, or point every process at one shared
+    ``--tune-cache`` file)."""
+    if candidates is None:
+        candidates = registry.space(knob).candidates
+    candidates = list(candidates)
+    if budget_s is None:
+        budget_s = registry.tune_budget_s()
+    emit = emit or registry.default_emit() or (lambda rec: None)
+    fp = fingerprint(**ctx)
+
+    if _process_count() > 1:
+        fallback = registry.lookup(knob, **ctx)
+        if fallback is None:
+            fallback = candidates[0]
+        emit({"kind": "tune_result", "knob": knob, "value": fallback,
+              "seconds": None, "measured": 0,
+              "skipped": len(candidates), "fingerprint": fp,
+              "note": "sweep skipped: multi-process run (per-rank "
+                      "budget/winner decisions would diverge across "
+                      "ranks mid-collective); warm the cache "
+                      "single-process"})
+        return fallback
+
+    t_begin = time.perf_counter()
+    best = None
+    best_sec = float("inf")
+    measured = 0
+    skipped = 0
+    for i, cand in enumerate(candidates):
+        if (
+            i
+            and budget_s is not None
+            and time.perf_counter() - t_begin >= budget_s
+        ):
+            # budget exhausted: report the drop, never truncate silently
+            skipped = len(candidates) - i
+            for c in candidates[i:]:
+                emit({"kind": "tune", "knob": knob, "candidate": c,
+                      "skipped": "budget", "fingerprint": fp})
+            break
+        err = None
+        sec = float("nan")
+        with comm_span(f"tune:{knob}", candidate=cand):
+            try:
+                sec = float(measure(cand))
+            except Exception as e:  # infeasible candidate, not a dead sweep
+                err = f"{type(e).__name__}: {e}"
+        rec = {"kind": "tune", "knob": knob, "candidate": cand,
+               "seconds": None if sec != sec else sec, "fingerprint": fp}
+        if err is not None:
+            rec["error"] = err
+        emit(rec)
+        if sec == sec:  # finite/valid
+            measured += 1
+            if sec < best_sec:
+                best, best_sec = cand, sec
+
+    if best is None:
+        # nothing measured validly: the prior stays the schedule, and the
+        # non-result is recorded (not persisted — a later run retries)
+        emit({"kind": "tune_result", "knob": knob, "value": candidates[0],
+              "seconds": None, "measured": 0, "skipped": skipped,
+              "fingerprint": fp, "note": "no valid measurement"})
+        return candidates[0]
+
+    cache = registry.configured_cache()
+    if persist and cache is not None:
+        cache.store(knob, fp, best, seconds=best_sec)
+        if ctx:
+            cache.store(knob, device_fingerprint(), best, seconds=best_sec)
+        cache.save()
+    emit({"kind": "tune_result", "knob": knob, "value": best,
+          "seconds": best_sec, "measured": measured, "skipped": skipped,
+          "fingerprint": fp})
+    return best
+
+
+def ensure_tuned(
+    knob: str,
+    measure: Callable[[object], float],
+    *,
+    explicit=None,
+    prior=None,
+    candidates: Iterable | None = None,
+    budget_s: float | None = None,
+    emit: Callable[[dict], None] | None = None,
+    device_fallback: bool = True,
+    **ctx,
+):
+    """The driver-side resolution entry point: explicit > cached (a
+    ``tune_hit`` record) > sweep-on-miss when ``--tune`` armed the
+    registry > prior. Returns the schedule to run.
+    ``device_fallback=False`` for context-sensitive knobs (see
+    :func:`~tpu_mpi_tests.tune.registry.lookup`)."""
+    if explicit is not None:
+        return explicit
+    cached = registry.lookup(knob, device_fallback=device_fallback, **ctx)
+    if cached is not None:
+        (emit or registry.default_emit() or (lambda rec: None))(
+            {"kind": "tune_hit", "knob": knob, "value": cached,
+             "fingerprint": fingerprint(**ctx)}
+        )
+        return cached
+    if not registry.tuning_enabled():
+        if prior is not None:
+            return prior
+        return registry.space(knob).prior
+    return sweep(
+        knob, measure, candidates=candidates, budget_s=budget_s,
+        emit=emit, **ctx,
+    )
