@@ -1,6 +1,7 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
-.PHONY: test verify native bench smoke trace-smoke tune-smoke lint ci clean
+.PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
+	lint ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -72,6 +73,37 @@ tune-smoke:
 		assert 'tune' not in kinds and 'tune_result' not in kinds, kinds; \
 		print('tune-smoke cache-hit OK')"
 
+# memory/compile-observability smoke: a 2-fake-device daxpy with
+# --memwatch + --telemetry must (a) record kind:"mem" (census-only on
+# CPU — no memory_stats) and kind:"compile" JSONL records, (b) merge
+# them into a trace with at least one Perfetto counter track, and
+# (c) render non-empty MEMORY and COMPILE tables under tpumt-report
+mem-smoke:
+	rm -f /tmp/_tpumt_mem_smoke*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.daxpy \
+		--fake-devices 2 --n 4096 --telemetry --memwatch \
+		--mem-interval 0.05 \
+		--jsonl /tmp/_tpumt_mem_smoke.jsonl \
+		--trace-out /tmp/_tpumt_mem_smoke.trace.json
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_mem_smoke.jsonl')]; \
+		kinds = [r.get('kind') for r in recs]; \
+		assert 'mem' in kinds and 'compile' in kinds, kinds; \
+		mems = [r for r in recs if r.get('kind') == 'mem']; \
+		assert any(r.get('event') == 'phase' for r in mems), mems; \
+		d = json.load(open('/tmp/_tpumt_mem_smoke.trace.json')); \
+		cs = [e for e in d['traceEvents'] if e['ph'] == 'C']; \
+		assert cs, 'no counter track'; \
+		print('mem-smoke records OK:', kinds.count('mem'), 'mem,', \
+			kinds.count('compile'), 'compile,', len(cs), \
+			'counter events')"
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_mem_smoke.jsonl > /tmp/_tpumt_mem_smoke.report.txt
+	grep -q '^MEM ' /tmp/_tpumt_mem_smoke.report.txt
+	grep -q '^COMPILE ' /tmp/_tpumt_mem_smoke.report.txt
+	@echo "mem-smoke report OK: MEMORY + COMPILE tables render"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -82,8 +114,9 @@ lint:
 		tpu_mpi_tests tpu tests __graft_entry__.py bench.py
 
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
-# autotuner sweep→persist→cache-hit smoke, and the lint self-clean gate
-ci: verify trace-smoke tune-smoke lint
+# autotuner sweep→persist→cache-hit smoke, the memory/compile
+# observability smoke, and the lint self-clean gate
+ci: verify trace-smoke tune-smoke mem-smoke lint
 
 clean:
 	$(MAKE) -C native clean

@@ -253,7 +253,29 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
     # + 1 write) × itemsize — 1006 iter/s f32, 2012 at 16-bit
     equal_width_baseline = V100_HBM_GBPS * 1e9 / (3 * dtype.itemsize
                                                   * 8192**2)
+    # HBM watermark at the end of this dtype's measurement window —
+    # present only where the backend reports allocator stats (absent on
+    # CPU/fake devices, never a fake zero). The peak is the process
+    # watermark so far (no reset hook on current jaxlibs): the primary
+    # dtype's field is its own window; the secondary's includes the
+    # primary's footprint — the per-dtype sub-records stay comparable
+    # across rounds because the dtype order is fixed.
+    hbm = {}
+    try:
+        from tpu_mpi_tests.instrument.memwatch import device_memory_stats
+
+        stats = device_memory_stats()
+        if stats:
+            hbm["hbm_peak_bytes"] = max(
+                s.get("peak_bytes_in_use", 0) for s in stats.values()
+            )
+            hbm["hbm_bytes_in_use"] = sum(
+                s.get("bytes_in_use", 0) for s in stats.values()
+            )
+    except Exception:
+        hbm = {}
     return {
+        **hbm,
         "value": round(iters_per_s, 2),
         "unit": "iter/s",
         "vs_baseline": round(iters_per_s / equal_width_baseline, 3),

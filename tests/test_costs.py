@@ -1,0 +1,208 @@
+"""Compile-cost capture (instrument/costs.py): the AOT probe's record
+shape, telemetry gating + dedupe, the span cost provider (roofline
+fields on matching spans), and graceful failure on un-AOT-able fns."""
+
+import pytest
+
+from tpu_mpi_tests.instrument import costs
+from tpu_mpi_tests.instrument import telemetry as T
+
+
+@pytest.fixture(autouse=True)
+def fresh_state(monkeypatch):
+    monkeypatch.setattr(T, "_TELEMETRY", T.Telemetry())
+    monkeypatch.setattr(T, "_COST_PROVIDER", None)
+    costs.reset()
+    yield
+    costs.reset()
+    T.set_cost_provider(None)
+
+
+def _enable(records):
+    T._TELEMETRY.enable(sink=records.append)
+
+
+def test_probe_noop_when_telemetry_disabled():
+    import jax
+    import jax.numpy as jnp
+
+    records = []
+    info = costs.compile_probe(
+        jax.jit(lambda x: x * 2), (jnp.ones((8,)),), label="f",
+        emit=records.append,
+    )
+    assert info is None and records == []
+    assert costs.cost_info("f") is None
+
+
+def test_probe_records_compile_span_and_cost_model():
+    import jax
+    import jax.numpy as jnp
+
+    records = []
+    _enable(records)
+    x = jnp.ones((1024,), jnp.float32)
+    info = costs.compile_probe(
+        jax.jit(lambda a, b: a * 2.0 + b), (x, x), label="axpb",
+        phase="kernel", n=1024, dtype="float32",
+    )
+    assert info is not None
+    (rec,) = [r for r in records if r.get("kind") == "compile"]
+    assert rec["label"] == "axpb" and rec["phase"] == "kernel"
+    assert rec["seconds"] > 0
+    # PR-2 clock: placeable on the merged timeline
+    assert rec["t_end"] == pytest.approx(
+        rec["t_start"] + rec["seconds"], abs=1e-6
+    )
+    assert rec["mono_end"] > rec["mono_start"]
+    # the compiler's cost model: flops + bytes for a 1024-elt a*2+b
+    assert rec["flops"] and rec["flops"] >= 1024
+    assert rec["bytes_accessed"] and rec["bytes_accessed"] >= 3 * 4096
+    assert rec["output_bytes"] == 4096
+    # tune-layer fingerprint carries the caller's context
+    assert "dtype=float32" in rec["fingerprint"]
+    assert "platform=cpu" in rec["fingerprint"]
+    # CPU: no peak table entry -> no fabricated roofline denominator
+    assert "peak_gbps" not in rec
+
+
+def test_probe_dedupes_per_label_and_shapes():
+    import jax
+    import jax.numpy as jnp
+
+    records = []
+    _enable(records)
+    f = jax.jit(lambda x: x + 1)
+    costs.compile_probe(f, (jnp.ones((8,)),), label="g")
+    costs.compile_probe(f, (jnp.ones((8,)),), label="g")  # dup: skipped
+    costs.compile_probe(f, (jnp.ones((16,)),), label="g")  # new shape
+    assert len([r for r in records if r.get("kind") == "compile"]) == 2
+
+
+def test_probe_wraps_unjitted_and_survives_failure():
+    import jax.numpy as jnp
+
+    records = []
+    _enable(records)
+    # plain python fn: wrapped in jax.jit internally
+    assert costs.compile_probe(
+        lambda x: x * 3, (jnp.ones((4,)),), label="plain"
+    ) is not None
+    # un-AOT-able garbage: swallowed, nothing emitted under that label
+    assert costs.compile_probe(
+        lambda: (_ for _ in ()).throw(RuntimeError("no")), (), label="bad"
+    ) is None
+    labels = [r.get("label") for r in records
+              if r.get("kind") == "compile"]
+    assert labels == ["plain"]
+
+
+def test_cost_fields_and_span_attachment():
+    """After a probe, spans whose op matches the label carry the cost
+    model + model-implied rates; unknown ops stay untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    records = []
+    _enable(records)
+    x = jnp.ones((4096,), jnp.float32)
+    f = jax.jit(lambda a: a * 2.0)
+    costs.compile_probe(f, (x,), label="scale")
+
+    fields = costs.cost_fields("scale", 1e-3)
+    assert fields["cost_bytes"] >= 2 * 16384
+    assert fields["model_gbps"] == pytest.approx(
+        fields["cost_bytes"] / 1e-3 / 1e9
+    )
+    assert "roofline_frac" not in fields  # no CPU peak
+    assert costs.cost_fields("scale", 0) == {}
+    assert costs.cost_fields("unknown", 1e-3) == {}
+
+    out = T.span_call("scale", f, x)
+    jax.block_until_ready(out)
+    span = [r for r in records if r.get("kind") == "span"
+            and r.get("op") == "scale"][-1]
+    assert span["cost_bytes"] == fields["cost_bytes"]
+    assert span["model_gbps"] > 0
+
+    # a non-jitted fn is not auto-probed, so its op has no cost model
+    out2 = T.span_call("other_op", lambda a: a, x)
+    jax.block_until_ready(out2)
+    span2 = [r for r in records if r.get("kind") == "span"
+             and r.get("op") == "other_op"][-1]
+    assert "cost_bytes" not in span2
+
+
+def test_span_call_auto_probes_jitted_fns():
+    """The comm wrappers all route through span_call: a jitted fn
+    flowing through it gets its compile record without per-wrapper
+    wiring — one probe per (op, shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    records = []
+    _enable(records)
+    f = jax.jit(lambda x: x - 1)
+    x = jnp.ones((32,))
+    for _ in range(3):
+        jax.block_until_ready(T.span_call("auto_op", f, x))
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    assert len(compiles) == 1 and compiles[0]["label"] == "auto_op"
+    assert len([r for r in records if r.get("kind") == "span"]) == 3
+
+
+def test_roofline_frac_with_known_peak(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("TPU_MPI_PEAK_GBPS", "100")
+    records = []
+    _enable(records)
+    x = jnp.ones((4096,), jnp.float32)
+    costs.compile_probe(jax.jit(lambda a: a + 1), (x,), label="peaked")
+    info = costs.cost_info("peaked")
+    assert info["peak_gbps"] == 100.0
+    fields = costs.cost_fields("peaked", 1e-3)
+    assert fields["roofline_frac"] == pytest.approx(
+        fields["model_gbps"] / 100.0, rel=1e-6
+    )
+
+
+def test_provider_error_never_breaks_span(monkeypatch):
+    records = []
+    _enable(records)
+    T.set_cost_provider(lambda op, s: (_ for _ in ()).throw(ValueError()))
+    with T.comm_span("op", nbytes=8) as span:
+        span.result = None
+    assert [r["kind"] for r in records] == ["span"]
+
+
+def test_peak_gbps_env_override(monkeypatch):
+    monkeypatch.setenv("TPU_MPI_PEAK_GBPS", "123.5")
+    assert costs.peak_gbps() == 123.5
+    monkeypatch.setenv("TPU_MPI_PEAK_GBPS", "not-a-number")
+    assert costs.peak_gbps() is None  # CPU device kind not in the table
+
+
+def test_multi_shape_label_is_ambiguous_no_span_attachment():
+    """A label probed at several shapes (collbench sweeping payload
+    sizes) has no single cost model: spans must get NOTHING attached
+    rather than the last shape's numbers (review fix)."""
+    import jax
+    import jax.numpy as jnp
+
+    records = []
+    _enable(records)
+    f = jax.jit(lambda x: x + 1)
+    costs.compile_probe(f, (jnp.ones((8,)),), label="swept")
+    assert costs.cost_fields("swept", 1e-3)  # single shape: attaches
+    costs.compile_probe(f, (jnp.ones((1024,)),), label="swept")
+    assert costs.cost_info("swept")["ambiguous"] is True
+    assert costs.cost_fields("swept", 1e-3) == {}
+    out = T.span_call("swept", f, jnp.ones((8,)))
+    jax.block_until_ready(out)
+    span = [r for r in records if r.get("kind") == "span"][-1]
+    assert "cost_bytes" not in span and "model_gbps" not in span
+    # both per-shape compile records were still emitted (each is
+    # correct for its own shape)
+    assert len([r for r in records if r.get("kind") == "compile"]) == 2

@@ -1,0 +1,308 @@
+"""Memory observability (instrument/memwatch.py): live-array census,
+``kind: "mem"`` record shapes, the no-``memory_stats`` degrade path
+(CPU/fake devices), MemWatch sampler + phase hooks, and the end-to-end
+driver → JSONL → counter-track pipeline."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_mpi_tests.instrument import memwatch
+from tpu_mpi_tests.instrument import timeline
+
+
+def test_census_buckets_by_shape_dtype():
+    import jax.numpy as jnp
+
+    a = jnp.ones((128, 8), jnp.float32)
+    b = jnp.ones((128, 8), jnp.float32)
+    c = jnp.ones((64,), jnp.bfloat16)
+    census = memwatch.live_array_census(top_k=8)
+    assert census is not None
+    by_key = {e["key"]: e for e in census["top"]}
+    assert by_key["128x8·float32"]["count"] >= 2
+    assert by_key["128x8·float32"]["bytes"] >= 2 * 128 * 8 * 4
+    assert by_key["64·bfloat16"]["bytes"] >= 64 * 2
+    assert census["count"] >= 3
+    assert census["bytes"] >= sum(e["bytes"] for e in census["top"][:2])
+    # top is sorted by bytes, descending
+    tops = [e["bytes"] for e in census["top"]]
+    assert tops == sorted(tops, reverse=True)
+    del a, b, c
+
+
+def test_census_top_k_truncates():
+    import jax.numpy as jnp
+
+    keep = [jnp.ones((n + 1,), jnp.float32) for n in range(6)]
+    census = memwatch.live_array_census(top_k=2)
+    assert len(census["top"]) == 2
+    assert census["count"] >= 6  # totals still cover everything
+    del keep
+
+
+def test_mem_record_degrades_to_census_only_on_cpu():
+    """CPU/fake devices return None/{} from memory_stats(): the record
+    must carry the census and OMIT the watermark fields — absent, not
+    zero (the acceptance contract for the no-memory_stats path)."""
+    import jax.numpy as jnp
+
+    keep = jnp.ones((256,), jnp.float32)
+    assert memwatch.device_memory_stats() == {}
+    rec = memwatch.mem_record(event="sample", top_k=4)
+    assert rec["kind"] == "mem" and rec["event"] == "sample"
+    assert "devices" not in rec
+    assert "bytes_in_use" not in rec and "peak_bytes_in_use" not in rec
+    assert rec["live_bytes"] >= 256 * 4
+    assert rec["t"] == pytest.approx(time.time(), abs=60)
+    assert rec["census"]["top"]
+    del keep
+
+
+def test_mem_record_with_fake_device_stats(monkeypatch):
+    """Where the backend DOES report stats, the record carries per-device
+    watermarks + the cross-device aggregates."""
+    monkeypatch.setattr(
+        memwatch, "device_memory_stats",
+        lambda: {"0": {"bytes_in_use": 100, "peak_bytes_in_use": 150,
+                       "bytes_limit": 1000},
+                 "1": {"bytes_in_use": 40, "peak_bytes_in_use": 60,
+                       "bytes_limit": 1000}},
+    )
+    rec = memwatch.mem_record(event="phase", phase="kernel")
+    assert rec["devices"]["1"]["peak_bytes_in_use"] == 60
+    assert rec["bytes_in_use"] == 140
+    assert rec["peak_bytes_in_use"] == 150
+    assert rec["phase"] == "kernel"
+
+
+def test_watermark_lines_census_only():
+    import jax.numpy as jnp
+
+    keep = jnp.ones((512,), jnp.float32)
+    lines = memwatch.watermark_lines(top_k=8)
+    text = "\n".join(lines)
+    assert "LIVE census:" in text
+    assert "512·float32" in text
+    del keep
+
+
+class TestMemWatch:
+    def test_sampler_and_lifecycle_records(self):
+        records = []
+        mw = memwatch.MemWatch(sink=records.append, interval_s=0.03)
+        mw.start()
+        time.sleep(0.15)
+        mw.stop()
+        mw.stop()  # idempotent
+        events = [r["event"] for r in records]
+        assert events[0] == "start" and events[-1] == "final"
+        assert events.count("sample") >= 1
+        assert all(r["kind"] == "mem" and "t" in r for r in records)
+        # census on the start/final records, not on samples
+        assert "census" in records[0] and "census" in records[-1]
+        assert all("census" not in r for r in records
+                   if r["event"] == "sample")
+
+    def test_phase_hooks_emit_first_exit_only(self):
+        """A hot-loop phase re-enters thousands of times; the phase
+        record is emitted at the FIRST exit (with census) and not again
+        unless the peak watermark grows — bounded JSONL by design."""
+        from tpu_mpi_tests.instrument.timers import PhaseTimer
+
+        records = []
+        mw = memwatch.MemWatch(sink=records.append, interval_s=60.0)
+        mw.start()
+        try:
+            timer = PhaseTimer()
+            for _ in range(5):
+                with timer.phase("hot"):
+                    pass
+        finally:
+            mw.stop()
+        phase_recs = [r for r in records if r.get("event") == "phase"]
+        assert len(phase_recs) == 1
+        (rec,) = phase_recs
+        assert rec["phase"] == "hot"
+        assert rec["t_start"] <= rec["t_end"]
+        assert "census" in rec
+
+    def test_phase_hooks_detached_after_stop(self):
+        from tpu_mpi_tests.instrument import timers
+        from tpu_mpi_tests.instrument.timers import PhaseTimer
+
+        records = []
+        mw = memwatch.MemWatch(sink=records.append, interval_s=60.0)
+        mw.start()
+        mw.stop()
+        n = len(records)
+        timer = PhaseTimer()
+        with timer.phase("after"):
+            pass
+        assert len(records) == n
+        assert mw._on_phase not in timers._PHASE_HOOKS
+
+    def test_sink_errors_never_propagate(self):
+        def bad_sink(rec):
+            raise OSError("disk full")
+
+        mw = memwatch.MemWatch(sink=bad_sink, interval_s=0.02)
+        mw.start()
+        time.sleep(0.06)
+        mw.stop()  # no raise anywhere
+
+
+def test_phase_hook_error_does_not_break_timer():
+    from tpu_mpi_tests.instrument import timers
+    from tpu_mpi_tests.instrument.timers import PhaseTimer
+
+    def bad_hook(name, event):
+        raise RuntimeError("observer bug")
+
+    timers.add_phase_hook(bad_hook)
+    try:
+        timer = PhaseTimer()
+        with timer.phase("p"):
+            pass
+        assert timer.counts["p"] == 1
+    finally:
+        timers.remove_phase_hook(bad_hook)
+
+
+def test_driver_memwatch_end_to_end(tmp_path, capsys):
+    """daxpy --memwatch --telemetry: mem + compile records land in the
+    JSONL, merge into a VALID trace with a counter track, and the report
+    renders MEMORY + COMPILE tables — the mem-smoke contract, in-suite."""
+    from tpu_mpi_tests.drivers import daxpy
+    from tpu_mpi_tests.instrument import aggregate
+
+    jl = tmp_path / "run.jsonl"
+    tr = tmp_path / "trace.json"
+    rc = daxpy.main(
+        ["--n", "512", "--dtype", "float32", "--telemetry", "--memwatch",
+         "--mem-interval", "0.05", "--jsonl", str(jl),
+         "--trace-out", str(tr)]
+    )
+    assert rc == 0
+    recs = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    mems = [r for r in recs if r.get("kind") == "mem"]
+    assert mems and all("t" in r for r in mems)
+    # CPU degrade path: census-only, no fabricated watermarks
+    assert all("devices" not in r for r in mems)
+    assert any(r.get("event") == "phase" for r in mems)
+    assert any(r.get("kind") == "compile" for r in recs)
+    # manifest says memory_stats was unavailable (self-describing runs)
+    (manifest,) = [r for r in recs if r.get("kind") == "manifest"]
+    assert manifest["memory_stats_available"] is False
+
+    doc = json.load(open(tr))
+    counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert counters
+    assert all("ts" in e and "pid" in e for e in counters)
+    assert {e["name"] for e in counters} == {"live bytes"}
+    compile_spans = [e for e in doc["traceEvents"]
+                    if e["ph"] == "X" and e.get("cat") == "compile"]
+    assert compile_spans and compile_spans[0]["tid"] == timeline.TID_COMPILE
+
+    capsys.readouterr()
+    assert aggregate.main([str(jl)]) == 0
+    out = capsys.readouterr().out
+    assert "MEM phase=kernel:" in out
+    assert "MEMTOP" in out
+    assert "COMPILE daxpy:" in out
+
+
+def test_memwatch_without_jsonl_notes_and_runs(capsys):
+    from tpu_mpi_tests.drivers import daxpy
+
+    rc = daxpy.main(["--n", "64", "--dtype", "float32", "--memwatch"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "--memwatch needs --jsonl" in out
+
+
+def test_concurrent_sink_writes_stay_line_atomic(tmp_path):
+    """The sampler thread and main-thread phase hooks write through the
+    Reporter's locked jsonl sink concurrently; every line must stay
+    valid JSON (the TPM601 hazard class, exercised live)."""
+    import io
+
+    from tpu_mpi_tests.instrument.report import Reporter
+    from tpu_mpi_tests.instrument.timers import PhaseTimer
+
+    jl = tmp_path / "c.jsonl"
+    with Reporter(stream=io.StringIO(), jsonl_path=str(jl)) as rep:
+        mw = memwatch.MemWatch(
+            sink=lambda rec: rep.jsonl({**rec, "rank": 0}),
+            interval_s=0.005,
+        )
+        mw.start()
+        timer = PhaseTimer()
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                rep.jsonl({"kind": "span", "op": "x", "seconds": 1e-6})
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        for i in range(20):
+            with timer.phase(f"p{i}"):
+                time.sleep(0.002)
+        stop.set()
+        t.join(timeout=2)
+        mw.stop()
+    for line in jl.read_text().splitlines():
+        json.loads(line)  # raises on any interleaved write
+
+
+def test_phase_record_device_stats_deltas(monkeypatch):
+    """Where the backend reports allocator stats, the phase record
+    carries per-device watermarks + in-use delta + peak raise across
+    the phase body (begin snapshot vs end)."""
+    from tpu_mpi_tests.instrument.timers import PhaseTimer
+
+    base = {"0": {"bytes_in_use": 100, "peak_bytes_in_use": 150}}
+    stats = [
+        base,  # start(): has-stats probe
+        base,  # start(): the "start" mem_record
+        base,  # phase begin snapshot
+        {"0": {"bytes_in_use": 160, "peak_bytes_in_use": 400}},  # end
+        {},  # stop(): the "final" mem_record
+    ]
+    seq = iter(stats)
+    monkeypatch.setattr(
+        memwatch, "device_memory_stats",
+        lambda: next(seq, {}),
+    )
+    records = []
+    mw = memwatch.MemWatch(sink=records.append, interval_s=60.0)
+    mw.start()
+    try:
+        timer = PhaseTimer()
+        with timer.phase("alloc"):
+            pass
+    finally:
+        mw.stop()
+    (rec,) = [r for r in records if r.get("event") == "phase"]
+    assert rec["devices"]["0"]["peak_bytes_in_use"] == 400
+    assert rec["delta_bytes"] == 60
+    assert rec["peak_delta"] == 250
+    assert rec["peak_bytes_in_use"] == 400
+
+
+def test_census_only_runs_report_degrade_note(tmp_path, capsys):
+    """End-to-end on CPU: the report explains the missing watermarks."""
+    from tpu_mpi_tests.drivers import daxpy
+    from tpu_mpi_tests.instrument import aggregate
+
+    jl = tmp_path / "r.jsonl"
+    assert daxpy.main(["--n", "64", "--memwatch",
+                       "--jsonl", str(jl)]) == 0
+    capsys.readouterr()
+    assert aggregate.main([str(jl)]) == 0
+    out = capsys.readouterr().out
+    assert "MEM census-only:" in out
+    assert "no device memory_stats" in out

@@ -143,3 +143,348 @@ def test_avg_py_expands_rank_suffixed_jsonl(two_rank_run, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "run.p0.jsonl" in out and "run.p1.jsonl" in out
+
+
+# ---------------------------------------------------------------------------
+# MEMORY / COMPILE / VMEM tables (PR 5) — canned JSONL, stdlib-only path
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def mem_cost_run(tmp_path):
+    """Canned two-rank run with mem/compile/vmem records: rank 1 holds
+    the HBM peak; the daxpy compile record joins the kernel phase."""
+    _write_jsonl(tmp_path / "m.p0.jsonl", [
+        {"kind": "manifest", "process_index": 0, "process_count": 2},
+        {"kind": "time", "phase": "kernel", "seconds": 0.2, "count": 100,
+         "rank": 0},
+        {"kind": "mem", "event": "phase", "phase": "kernel", "t": 10.0,
+         "t_start": 9.0, "t_end": 10.0, "rank": 0,
+         "devices": {"0": {"bytes_in_use": 100, "peak_bytes_in_use": 150,
+                           "bytes_limit": 1000}},
+         "bytes_in_use": 100, "peak_bytes_in_use": 150,
+         "delta_bytes": 50, "peak_delta": 25,
+         "census": {"count": 2, "bytes": 90, "top": [
+             {"key": "8x8·float32", "count": 1, "bytes": 64},
+             {"key": "scalar·float32", "count": 1, "bytes": 4}]}},
+        {"kind": "compile", "label": "daxpy", "phase": "kernel",
+         "seconds": 0.5, "flops": 2048.0, "bytes_accessed": 1.0e6,
+         "temp_bytes": 0, "output_bytes": 4096, "peak_gbps": 100.0,
+         "t_start": 8.0, "t_end": 8.5, "rank": 0},
+        {"kind": "vmem", "config": "heat_k4", "model_bytes": 100,
+         "actual_bytes": 96, "ratio": 1.042},
+        {"kind": "vmem", "config": "stream_d0", "model_bytes": 90,
+         "actual_bytes": 100, "ratio": 0.9},
+    ])
+    _write_jsonl(tmp_path / "m.p1.jsonl", [
+        {"kind": "manifest", "process_index": 1, "process_count": 2},
+        {"kind": "time", "phase": "kernel", "seconds": 0.2, "count": 100,
+         "rank": 1},
+        {"kind": "mem", "event": "sample", "t": 9.5, "rank": 1,
+         "devices": {"0": {"bytes_in_use": 300,
+                           "peak_bytes_in_use": 400}},
+         "bytes_in_use": 300, "peak_bytes_in_use": 400},
+    ])
+    return tmp_path
+
+
+def test_memory_table_summary_and_text(mem_cost_run, capsys):
+    files = aggregate.expand_rank_files([str(mem_cost_run / "m.jsonl")])
+    s = aggregate.summarize(files)
+    mem = s["memory"]
+    assert mem["records"] == 2
+    ph = mem["phases"]["kernel"]
+    assert ph["peak_bytes"] == 150 and ph["delta_bytes"] == 50
+    assert ph["peak_delta"] == 25 and ph["ranks"] == 1
+    # run-wide peak held by rank 1's sample
+    assert mem["peak"]["peak_bytes_in_use"] == {"bytes": 400, "rank": 1}
+    assert mem["top"]["8x8·float32"]["bytes"] == 64
+
+    rc = aggregate.main([str(mem_cost_run / "m.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "MEM phase=kernel: peak=150 delta=50 peak_delta=25" in out
+    assert "peak_bytes_in_use=400 (r1)" in out
+    assert "MEMTOP 8x8·float32: bytes=64 count=1 (r0)" in out
+
+
+def test_compile_table_roofline_join(mem_cost_run, capsys):
+    files = aggregate.expand_rank_files([str(mem_cost_run / "m.jsonl")])
+    s = aggregate.summarize(files)
+    c = s["compile"]["daxpy"]
+    assert c["compiles"] == 1 and c["seconds"] == 0.5
+    # phase join: 0.4 s over 200 calls -> 2 ms/call
+    assert c["mean_call_s"] == pytest.approx(0.002)
+    assert c["model_gbps"] == pytest.approx(1.0e6 / 0.002 / 1e9)
+    assert c["roofline_frac"] == pytest.approx(0.005)
+
+    aggregate.main([str(mem_cost_run / "m.jsonl")])
+    out = capsys.readouterr().out
+    assert "COMPILE daxpy: n=1 compile=0.5s" in out
+    assert "roofline=0.5%" in out
+
+
+def test_compile_table_joins_span_op_over_phase(tmp_path, capsys):
+    """When the probed label matches a span op, the per-call seconds
+    come from the span table (the op IS the fn), not the phase."""
+    _write_jsonl(tmp_path / "c.jsonl", [
+        {"kind": "span", "op": "halo_exchange", "nbytes": 1000,
+         "seconds": 0.01, "rank": 0},
+        {"kind": "span", "op": "halo_exchange", "nbytes": 1000,
+         "seconds": 0.03, "rank": 0},
+        {"kind": "compile", "label": "halo_exchange", "seconds": 0.2,
+         "bytes_accessed": 2.0e6, "rank": 0},
+    ])
+    s = aggregate.summarize([str(tmp_path / "c.jsonl")])
+    c = s["compile"]["halo_exchange"]
+    assert c["mean_call_s"] == pytest.approx(0.02)
+    assert c["model_gbps"] == pytest.approx(2.0e6 / 0.02 / 1e9)
+    assert "roofline_frac" not in c  # no peak recorded
+
+
+def test_vmem_table_flags_unsafe(mem_cost_run, capsys):
+    rc = aggregate.main([str(mem_cost_run / "m.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "VMEM heat_k4: model=100 actual=96 model/actual=1.04" in out
+    (unsafe,) = [l for l in out.splitlines()
+                 if l.startswith("VMEM stream_d0")]
+    assert unsafe.endswith("UNSAFE")
+
+
+def test_old_files_report_shape_unchanged(two_rank_run, capsys):
+    """Runs without mem/compile/vmem records must not grow MEMORY /
+    COMPILE / VMEM lines (pre-PR report shape preserved)."""
+    rc = aggregate.main([str(two_rank_run / "run.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "MEM" not in out and "COMPILE" not in out
+    assert "VMEM" not in out
+
+
+def test_tables_render_without_jax(mem_cost_run, tmp_path):
+    """The MEMORY/COMPILE/VMEM golden under a blocked jax import: the
+    aggregate path must stay stdlib-only (TPM401-clean) so login nodes
+    render the new tables too."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    base = str(mem_cost_run / "m.jsonl")
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.instrument import aggregate\n"
+        f"assert aggregate.main([{base!r}]) == 0\n"
+        "print('NOJAX TABLES OK')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=Path(__file__).resolve().parent.parent,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "NOJAX TABLES OK" in r.stdout
+    assert "MEM phase=kernel:" in r.stdout
+    assert "COMPILE daxpy:" in r.stdout
+    assert "VMEM heat_k4:" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# --diff: bench JSON + JSONL comparison with noise bands
+# ---------------------------------------------------------------------------
+
+
+def _bench_doc(value, samples, hbm=None, bf16=None):
+    doc = {"metric": "stencil2d_fullstep_8192_iters_per_s",
+           "value": value, "unit": "iter/s", "samples": samples}
+    if hbm is not None:
+        doc["hbm_peak_bytes"] = hbm
+    if bf16 is not None:
+        doc["bfloat16"] = bf16
+    return doc
+
+
+def test_diff_bench_regression_beyond_noise(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_doc(
+        2500.0, [2480.0, 2500.0, 2520.0], hbm=1000,
+        bf16={"value": 3000.0, "unit": "iter/s",
+              "samples": [2990.0, 3000.0, 3010.0]},
+    )))
+    b.write_text(json.dumps(_bench_doc(
+        2000.0, [1980.0, 2000.0, 2020.0], hbm=1000,
+        bf16={"value": 2990.0, "unit": "iter/s",
+              "samples": [2980.0, 2990.0, 3000.0]},
+    )))
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 1  # the -20% primary drop is a regression
+    assert "DIFF iter/s: A=2500 B=2000 change=-20.00%" in out
+    (line,) = [l for l in out.splitlines()
+               if l.startswith("DIFF iter/s:")]
+    assert line.endswith("REGRESSION")
+    # the bf16 -0.3% drift sits inside the 5% floor: not flagged
+    (bf,) = [l for l in out.splitlines()
+             if l.startswith("DIFF bfloat16.iter/s:")]
+    assert "REGRESSION" not in bf
+    # equal memory: no flag
+    (hbm,) = [l for l in out.splitlines()
+              if l.startswith("DIFF hbm_peak_bytes:")]
+    assert "REGRESSION" not in hbm
+    assert "DIFF REGRESSIONS 1" in out
+
+
+def test_diff_bench_within_noise_ok(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    # ±10% sample spread: the 8% drop is inside the run's own noise
+    a.write_text(json.dumps(_bench_doc(
+        2500.0, [2250.0, 2500.0, 2750.0])))
+    b.write_text(json.dumps(_bench_doc(
+        2300.0, [2070.0, 2300.0, 2530.0])))
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DIFF OK within noise" in out
+
+
+def test_diff_reads_bench_r_wrapper(tmp_path, capsys):
+    """BENCH_r*.json wraps the result line in a driver capture object:
+    --diff must parse the last JSON line out of its tail."""
+    inner = json.dumps(_bench_doc(100.0, [99.0, 100.0, 101.0]))
+    a = tmp_path / "BENCH_rA.json"
+    b = tmp_path / "BENCH_rB.json"
+    a.write_text(json.dumps(
+        {"n": 5, "cmd": "python bench.py", "rc": 0,
+         "tail": "WARNING: noise line\n" + inner}))
+    b.write_text(json.dumps(_bench_doc(120.0, [119.0, 120.0, 121.0])))
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DIFF iter/s: A=100 B=120 change=+20.00%" in out
+    assert "improved" in out
+
+
+def test_diff_jsonl_runs(two_rank_run, tmp_path, capsys):
+    """JSONL-vs-JSONL diff: per-phase means compared, a 2x slower phase
+    beyond the cross-rank band flagged, rc 1."""
+    slow = tmp_path / "slow"
+    slow.mkdir()
+    _write_jsonl(slow / "run.p0.jsonl", [
+        {"kind": "manifest", "process_index": 0},
+        {"kind": "time", "phase": "exchange", "seconds": 3.1, "rank": 0},
+        {"kind": "time", "phase": "kernel", "seconds": 0.5, "rank": 0},
+    ])
+    _write_jsonl(slow / "run.p1.jsonl", [
+        {"kind": "manifest", "process_index": 1},
+        {"kind": "time", "phase": "exchange", "seconds": 3.2, "rank": 1},
+        {"kind": "time", "phase": "kernel", "seconds": 0.5, "rank": 1},
+    ])
+    rc = aggregate.main([
+        "--diff", str(two_rank_run / "run.jsonl"), str(slow / "run.jsonl")
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    (ex,) = [l for l in out.splitlines()
+             if l.startswith("DIFF phase:exchange:")]
+    assert "REGRESSION" in ex
+    (kn,) = [l for l in out.splitlines()
+             if l.startswith("DIFF phase:kernel:")]
+    assert "REGRESSION" not in kn
+
+
+def test_diff_needs_two_paths(tmp_path, capsys):
+    assert aggregate.main(["--diff", str(tmp_path / "only.json")]) == 1
+
+
+def test_vmemprobe_emits_reporter_compatible_jsonl(tmp_path, monkeypatch,
+                                                   capsys):
+    """tpu/vmemprobe.py --jsonl: kind:"vmem" records (manifest first)
+    land next to the unchanged stdout lines, and tpumt-report renders
+    the model-vs-actual table from them. Measurement is stubbed — the
+    real probe needs Mosaic on a TPU; the record contract does not."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_vmemprobe",
+        Path(__file__).resolve().parent.parent / "tpu" / "vmemprobe.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    monkeypatch.setattr(mod, "configs", lambda: [
+        ("cfg_ok", lambda: None, 100),
+        ("cfg_rejected", None, "width exceeds budget"),
+    ])
+    monkeypatch.setattr(mod, "measure_scoped_bytes", lambda fn: 96)
+
+    jl = tmp_path / "vmem.jsonl"
+    rc = mod.main(["--jsonl", str(jl)])
+    out = capsys.readouterr().out
+    assert rc == 1  # the rejected config still counts unsafe
+    assert '"model_over_actual": 1.042' in out  # stdout contract intact
+
+    recs = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    assert recs[0]["kind"] == "manifest"
+    vmems = [r for r in recs if r["kind"] == "vmem"]
+    assert {r["config"] for r in vmems} == {"cfg_ok", "cfg_rejected"}
+    (ok,) = [r for r in vmems if r["config"] == "cfg_ok"]
+    assert ok["model_bytes"] == 100 and ok["actual_bytes"] == 96
+    assert ok["ratio"] == 1.042
+
+    capsys.readouterr()
+    assert aggregate.main([str(jl)]) == 0
+    out = capsys.readouterr().out
+    assert "VMEM cfg_ok: model=100 actual=96 model/actual=1.04" in out
+    assert "VMEM cfg_rejected: ERROR width exceeds budget" in out
+
+
+def test_compile_table_skips_model_join_for_multi_shape_labels(
+    tmp_path, capsys
+):
+    """Two compile records under one label with different cost models
+    (a payload-size sweep): the table must NOT divide one shape's bytes
+    by every shape's mean seconds (review fix) — mean_call still shown,
+    model_gbps/roofline withheld, cost_models surfaced."""
+    _write_jsonl(tmp_path / "s.jsonl", [
+        {"kind": "span", "op": "coll_allgather", "seconds": 0.01,
+         "rank": 0},
+        {"kind": "span", "op": "coll_allgather", "seconds": 0.02,
+         "rank": 0},
+        {"kind": "compile", "label": "coll_allgather", "seconds": 0.1,
+         "bytes_accessed": 4096.0, "peak_gbps": 100.0, "rank": 0},
+        {"kind": "compile", "label": "coll_allgather", "seconds": 0.1,
+         "bytes_accessed": 1.0e6, "peak_gbps": 100.0, "rank": 0},
+    ])
+    s = aggregate.summarize([str(tmp_path / "s.jsonl")])
+    c = s["compile"]["coll_allgather"]
+    assert c["cost_models"] == 2 and c["compiles"] == 2
+    assert c["mean_call_s"] == pytest.approx(0.015)
+    assert "model_gbps" not in c and "roofline_frac" not in c
+    aggregate.main([str(tmp_path / "s.jsonl")])
+    out = capsys.readouterr().out
+    (line,) = [l for l in out.splitlines() if l.startswith("COMPILE")]
+    assert "cost_models=2" in line and "model_gbps" not in line
+
+
+def test_memory_census_only_note(tmp_path, capsys):
+    """Census-only runs (CPU/fake devices) must say WHY there are no
+    watermark numbers — live totals alone must not read as real HBM
+    (review fix: the note used to be unreachable)."""
+    _write_jsonl(tmp_path / "c.jsonl", [
+        {"kind": "mem", "event": "sample", "t": 1.0, "live_bytes": 4096,
+         "live_count": 2, "rank": 0},
+    ])
+    aggregate.main([str(tmp_path / "c.jsonl")])
+    out = capsys.readouterr().out
+    assert "MEM census-only: 1 records, no device memory_stats" in out
+    assert "live_bytes=4096" in out

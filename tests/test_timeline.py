@@ -412,3 +412,81 @@ def test_expand_rank_files_shared_with_report(two_rank_run):
     assert [f.rsplit("/", 1)[-1] for f in expand_rank_files([base])] == [
         "run.p0.jsonl", "run.p1.jsonl"
     ]
+
+
+class TestMemCountersAndCompileTrack:
+    """PR 5: ``kind:"mem"`` records become Perfetto counter tracks and
+    ``kind:"compile"`` records a compile track, both clock-aligned."""
+
+    @pytest.fixture()
+    def mem_run(self, tmp_path):
+        _write_jsonl(tmp_path / "mem.p0.jsonl", [
+            {"kind": "manifest", "process_index": 0, "process_count": 2},
+            {"kind": "clock_sync", "rank": 0, "offset_s": 0.0},
+            {"kind": "span", "op": "all_gather", "seconds": 0.1,
+             "t_start": 100.0, "t_end": 100.1, "rank": 0},
+            {"kind": "mem", "event": "sample", "t": 100.05, "rank": 0,
+             "devices": {"0": {"bytes_in_use": 64},
+                         "1": {"bytes_in_use": 32}},
+             "bytes_in_use": 96},
+            {"kind": "compile", "label": "daxpy", "seconds": 0.5,
+             "flops": 2048.0, "bytes_accessed": 4096.0,
+             "t_start": 100.2, "t_end": 100.7, "rank": 0},
+        ])
+        _write_jsonl(tmp_path / "mem.p1.jsonl", [
+            {"kind": "manifest", "process_index": 1, "process_count": 2},
+            {"kind": "clock_sync", "rank": 1, "offset_s": 0.5},
+            # census-only rank (CPU degrade path): still a counter
+            {"kind": "mem", "event": "sample", "t": 100.55, "rank": 1,
+             "live_bytes": 4096, "live_count": 3},
+        ])
+        return [str(tmp_path / "mem.p0.jsonl"),
+                str(tmp_path / "mem.p1.jsonl")]
+
+    def test_counter_events_offsets_applied(self, mem_run):
+        doc = timeline.chrome_trace(mem_run)
+        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert len(counters) == 2
+        hbm = [e for e in counters if e["name"] == "HBM bytes_in_use"]
+        live = [e for e in counters if e["name"] == "live bytes"]
+        assert hbm[0]["pid"] == 0
+        assert hbm[0]["args"] == {"dev0": 64, "dev1": 32}
+        # rank 1's 0.5 s clock offset applied: both samples land at the
+        # same aligned instant (100.05 on rank 0's axis)
+        assert live[0]["pid"] == 1
+        assert live[0]["ts"] == pytest.approx(hbm[0]["ts"])
+        assert live[0]["args"] == {"bytes": 4096}
+
+    def test_compile_track(self, mem_run):
+        doc = timeline.chrome_trace(mem_run)
+        evs = [e for e in doc["traceEvents"]
+               if e["ph"] == "X" and e.get("cat") == "compile"]
+        (c,) = evs
+        assert c["name"] == "compile daxpy"
+        assert c["tid"] == timeline.TID_COMPILE and c["pid"] == 0
+        assert c["dur"] == pytest.approx(0.5e6)
+        assert c["args"]["flops"] == 2048.0
+        # the compile thread is named, but only on ranks that compiled
+        meta = {(m["pid"], m["tid"]): m["args"]["name"]
+                for m in doc["traceEvents"]
+                if m["ph"] == "M" and m["name"] == "thread_name"}
+        assert meta[(0, timeline.TID_COMPILE)] == "compile"
+        assert (1, timeline.TID_COMPILE) not in meta
+
+    def test_counters_count_as_placed_events(self, mem_run, tmp_path):
+        out = tmp_path / "t.json"
+        n = timeline.write_trace(mem_run, str(out))
+        doc = json.load(open(out))
+        assert n == len(
+            [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        )
+        # 1 comm span + 1 compile span + 2 counters
+        assert n == 4
+
+    def test_mem_record_without_t_counts_unplaced(self, tmp_path):
+        _write_jsonl(tmp_path / "u.p0.jsonl", [
+            {"kind": "mem", "event": "sample", "live_bytes": 1},
+        ])
+        doc = timeline.chrome_trace([str(tmp_path / "u.p0.jsonl")])
+        assert doc["otherData"]["unplaced_records"] == 1
+        assert [e for e in doc["traceEvents"] if e["ph"] != "M"] == []

@@ -122,3 +122,49 @@ def test_watchdog_cancel_prevents_firing():
     wd.cancel()
     time.sleep(0.15)
     assert not fired.is_set()
+
+
+def test_watchdog_dumps_memory_state(monkeypatch):
+    """The fire dump carries the memory axis: live-array census buckets
+    (census-only on CPU — memory_stats is absent there) alongside the
+    comm-op history, and mirrors a kind:"mem" record to the sink."""
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.instrument import telemetry as T
+
+    monkeypatch.setattr(T, "_TELEMETRY", T.Telemetry())
+    records = []
+    T._TELEMETRY.enable(sink=records.append)
+    keep = jnp.ones((333,), jnp.float32)
+    fired = threading.Event()
+    msgs = []
+
+    def on_timeout(msg):
+        msgs.append(msg)
+        fired.set()
+
+    wd = Watchdog(0.05, "hung-oom", _on_timeout=on_timeout).start()
+    assert fired.wait(timeout=5.0)
+    wd.cancel()
+    assert "memory at fire:" in msgs[0]
+    assert "LIVE census:" in msgs[0]
+    assert "333·float32" in msgs[0]
+    mems = [r for r in records if r.get("kind") == "mem"]
+    assert mems and mems[0]["event"] == "watchdog"
+    assert mems[0]["census"]["top"]
+    del keep
+
+
+def test_watchdog_memory_dump_includes_device_stats(monkeypatch):
+    """Where the backend reports memory_stats, per-device watermark
+    lines appear (top-8 census entries stay alongside)."""
+    from tpu_mpi_tests.instrument import memwatch
+    from tpu_mpi_tests.instrument import watchdog as W
+
+    monkeypatch.setattr(
+        memwatch, "device_memory_stats",
+        lambda: {"0": {"bytes_in_use": 123, "peak_bytes_in_use": 456}},
+    )
+    lines = W.memory_state_lines(top_k=8)
+    text = "\n".join(lines)
+    assert "HBM dev0: bytes_in_use=123 peak_bytes_in_use=456" in text

@@ -10,14 +10,20 @@ fail — and parses the real requested size out of the diagnostic
 ("Scoped allocation with size <X> and limit 1.0K exceeded ..."), then
 reports model/actual per config.
 
-Usage (on a TPU): python tpu/vmemprobe.py [--json]
+Usage (on a TPU): python tpu/vmemprobe.py [--jsonl OUT.jsonl]
 Emits one JSON line per config: {config, model_bytes, actual_bytes,
 ratio}; exits 1 if any config's model UNDER-estimates Mosaic (the unsafe
 direction) by more than 5%.
+
+``--jsonl`` additionally appends Reporter-compatible ``kind: "vmem"``
+records (config/model_bytes/actual_bytes/ratio, manifest first) so
+``tpumt-report`` renders the model-vs-actual table from the same file
+set as every other run artifact instead of this tool being stdout-only.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import re
@@ -260,11 +266,46 @@ def configs():
     return out
 
 
-def main() -> int:
+def _make_reporter(jsonl_path):
+    """Reporter sink for ``--jsonl`` (manifest first, like every driver
+    file). None when no path was asked for; manifest emission is
+    best-effort — the probe's stdout contract must survive a backend
+    where the manifest cannot be built."""
+    if not jsonl_path:
+        return None
+    from tpu_mpi_tests.instrument.report import Reporter
+
+    rep = Reporter(jsonl_path=jsonl_path)
+    try:
+        from tpu_mpi_tests.instrument.manifest import run_manifest
+
+        rep.jsonl(run_manifest())
+    except Exception:
+        pass
+    return rep
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--jsonl",
+        default=None,
+        metavar="PATH",
+        help="append kind:'vmem' JSONL records here (tpumt-report "
+        "renders them as the VMEM model-vs-actual table)",
+    )
+    args = ap.parse_args(argv)
+    rep = _make_reporter(args.jsonl)
+
+    def emit(rec):
+        if rep is not None:
+            rep.jsonl({"kind": "vmem", **rec})
+
     unsafe = 0
     for name, fn, model in configs():
         if fn is None:  # the fit itself rejected this hand-listed shape
             print(json.dumps({"config": name, "error": model}), flush=True)
+            emit({"config": name, "error": model})
             unsafe += 1
             continue
         try:
@@ -272,6 +313,7 @@ def main() -> int:
         except RuntimeError as e:
             print(json.dumps({"config": name, "error": str(e)[:200]}),
                   flush=True)
+            emit({"config": name, "error": str(e)[:200]})
             unsafe += 1
             continue
         ratio = model / actual
@@ -281,8 +323,16 @@ def main() -> int:
             "actual_bytes": actual,
             "model_over_actual": round(ratio, 3),
         }), flush=True)
+        emit({
+            "config": name,
+            "model_bytes": model,
+            "actual_bytes": actual,
+            "ratio": round(ratio, 3),
+        })
         if ratio < 0.95:  # model under-estimates → OOM risk
             unsafe += 1
+    if rep is not None:
+        rep.close()
     return 1 if unsafe else 0
 
 

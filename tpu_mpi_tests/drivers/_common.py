@@ -44,6 +44,25 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         "so leave this off for pure-throughput timing runs",
     )
     p.add_argument(
+        "--memwatch",
+        action="store_true",
+        help="record HBM watermarks + live-array census as kind:'mem' "
+        "JSONL records (instrument/memwatch.py): a low-rate sampler "
+        "thread plus per-phase begin/end snapshots; needs --jsonl. "
+        "tpumt-trace renders them as per-device counter tracks, "
+        "tpumt-report as the MEMORY table; degrades to census-only "
+        "where device.memory_stats() is unavailable (CPU/fake devices)",
+    )
+    p.add_argument(
+        "--mem-interval",
+        type=float,
+        default=0.5,
+        metavar="S",
+        help="memwatch sampler period in seconds (default 0.5; the "
+        "sampler exists to draw a counter track, not to profile "
+        "allocation churn)",
+    )
+    p.add_argument(
         "--profile-dir",
         default=None,
         help="capture an XProf trace to this dir (≅ nsys -c cudaProfilerApi)",
@@ -180,6 +199,19 @@ def make_reporter(args, rank: int = 0, size: int = 1):
 
         T.enable(sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}))
         rep.attach_telemetry()
+    if getattr(args, "memwatch", False):
+        if rep.jsonl_path:
+            from tpu_mpi_tests.instrument.memwatch import MemWatch
+
+            rep.attach_memwatch(
+                MemWatch(
+                    sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}),
+                    interval_s=getattr(args, "mem_interval", 0.5),
+                ).start()
+            )
+        else:
+            print("NOTE --memwatch needs --jsonl (mem records stream to "
+                  "the JSONL sink); no memory records will be written")
     _attach_tune_sink(rep)
     return rep
 
@@ -352,21 +384,32 @@ def parse_choice_list(spec: str, valid, what: str = "entries"):
     return names
 
 
-def pick_kernel_tier(build, probe_args, kernel: str, rep):
+def pick_kernel_tier(build, probe_args, kernel: str, rep, label: str = "step"):
     """Return ``(step, effective_kernel)`` for drivers with an XLA/pallas
     update-body choice. The pallas tier is probed at trace time (no
     execution); only the documented "VMEM budget" width limit falls back
     to XLA — with a visible NOTE, never silently — and the probed step is
-    reused, not rebuilt. Any other trace error still raises."""
+    reused, not rebuilt. Any other trace error still raises.
+
+    With telemetry enabled the chosen step is also AOT compile-probed
+    (instrument/costs.py): compile wall time + the compiler's
+    flops/bytes model land as a ``kind: "compile"`` record under
+    ``label``, the shared wrap point for every tiered driver."""
     import jax
+
+    from tpu_mpi_tests.instrument import costs
 
     if kernel == "pallas":
         step = build("pallas")
         try:
             jax.eval_shape(step, *probe_args)
+            costs.compile_probe(step, tuple(probe_args), label=label,
+                                kernel="pallas")
             return step, "pallas"
         except ValueError as e:
             if "VMEM budget" not in str(e):
                 raise
             rep.line(f"NOTE pallas kernel unavailable, using xla ({e})")
-    return build("xla"), "xla"
+    step = build("xla")
+    costs.compile_probe(step, tuple(probe_args), label=label, kernel="xla")
+    return step, "xla"

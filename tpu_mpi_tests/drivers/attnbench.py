@@ -193,8 +193,21 @@ def run(args) -> int:
                     )
 
             attn = make_attn(args.k_tile, args.skip_tile)
+            loop = make_loop(attn)
+            state0 = make_qkv()
+            # compile-cost probe (telemetry runs only): the chained loop
+            # is THE hot fn of this tier — record its compile wall time
+            # + cost model before chain_rate donates the state away
+            # (lower/compile never execute, so the buffers survive)
+            from tpu_mpi_tests.instrument import costs
+
+            costs.compile_probe(
+                loop, (state0, args.n_iter),
+                label=f"attn_{tier}{'[striped]' if striped else ''}",
+                dtype=args.dtype, lq=lq_local, world=world,
+            )
             sec, state = chain_rate(
-                make_loop(attn), make_qkv(),
+                loop, state0,
                 n_short=args.n_iter // 10 or 1,
                 n_long=args.n_iter,
             )

@@ -290,6 +290,16 @@ def run(args) -> int:
                         )
                         continue
                 x = shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
+                # compile-cost probe (telemetry runs only): compile wall
+                # time + cost model per collective×size, fingerprinted
+                # like the variant knob (lower/compile never execute, so
+                # the donated buffer is untouched)
+                from tpu_mpi_tests.instrument import costs
+
+                costs.compile_probe(
+                    run_fn, (x, 1), label=f"coll_{name}",
+                    dtype=args.dtype, bytes=shard_bytes, world=world,
+                )
                 # scale the chain length inversely with payload so small
                 # messages accumulate enough device time to clear host-timer
                 # noise (a fixed count yields NaN/garbage under ~ms jitter:

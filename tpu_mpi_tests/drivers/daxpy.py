@@ -39,8 +39,20 @@ def run(args) -> int:
                 d_x = block(to_device(place(h_x, Space.HOST)))
                 d_y = block(to_device(place(h_y, Space.HOST)))
 
+            # compile-cost probe (telemetry runs only): AOT-compiles the
+            # kernel once, recording compile wall time + the compiler's
+            # flops/bytes model as a kind:"compile" record; phase="kernel"
+            # lets tpumt-report join it against the measured phase time
+            # for the roofline column (instrument/costs.py)
+            a_dev = jnp.asarray(a, dtype)
+            from tpu_mpi_tests.instrument import costs
+
+            costs.compile_probe(
+                kd.daxpy, (a_dev, d_x, d_y), label="daxpy",
+                phase="kernel", n=n, dtype=args.dtype,
+            )
             with trace_range("daxpy"), timer.phase("kernel"):
-                d_y = block(kd.daxpy(jnp.asarray(a, dtype), d_x, d_y))
+                d_y = block(kd.daxpy(a_dev, d_x, d_y))
 
             with trace_range("copyOutput"), timer.phase("copyOutput"):
                 y = np.asarray(d_y)

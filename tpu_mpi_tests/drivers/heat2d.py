@@ -101,6 +101,7 @@ def run(args) -> int:
             (jax.ShapeDtypeStruct(zs.shape, zs.dtype), 1),
             args.kernel,
             rep,
+            label="heat2d_step",
         )
         outer_total = args.n_steps // args.halo_steps
         # compile + warm: 1 outer body = halo_steps real timesteps, counted

@@ -102,7 +102,17 @@ def run(args) -> int:
                         {"kind": "exchange1d", "rank": r, "seconds": seconds},
                     )
 
-            deriv = block(H.stencil_fn(mesh, axis_name, 0, 1, d.scale)(zg))
+            # compile-cost probe on the derivative kernel (the halo
+            # exchange is probed automatically through span_call); the
+            # fingerprint context keys the record to this layout
+            from tpu_mpi_tests.instrument import costs
+
+            deriv_fn = H.stencil_fn(mesh, axis_name, 0, 1, d.scale)
+            costs.compile_probe(
+                deriv_fn, (zg,), label="stencil1d_deriv",
+                dtype=args.dtype, n=n_global, world=world,
+            )
+            deriv = block(deriv_fn(zg))
 
         # per-rank err norms vs analytic derivative, computed shard-local on
         # device (the full global field never moves to host)

@@ -99,6 +99,7 @@ def run(args) -> int:
             (jax.ShapeDtypeStruct(zs.shape, zs.dtype),),
             args.kernel,
             rep,
+            label="stencil2d_step",
         )
 
         timer = PhaseTimer(skip_first=args.n_warmup)

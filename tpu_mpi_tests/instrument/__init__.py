@@ -24,6 +24,9 @@ _EXPORTS = {
     "comm_span": "telemetry",
     "span_call": "telemetry",
     "run_manifest": "manifest",
+    "MemWatch": "memwatch",
+    "mem_record": "memwatch",
+    "compile_probe": "costs",
 }
 __all__ = list(_EXPORTS)
 

@@ -19,7 +19,23 @@ into:
 * a tuning table (``kind: "tune"/"tune_result"/"tune_hit"`` records from
   the autotuner's sweeps — README "Autotuning"): per knob, how many
   candidates were measured/skipped/errored, the persisted winner and its
-  measured seconds, and how many later resolutions were pure cache hits.
+  measured seconds, and how many later resolutions were pure cache hits;
+* a MEMORY table (``kind: "mem"`` records from ``--memwatch`` —
+  instrument/memwatch.py): per-phase peak/delta HBM watermarks, the
+  run-wide peak, and the top live shape·dtype buffer buckets;
+* a COMPILE table (``kind: "compile"`` records from the AOT probe —
+  instrument/costs.py): per-fn compile wall time, the compiler's
+  flops/bytes-accessed/temp-allocation model, and — joined against the
+  measured span/phase seconds — the model-implied achieved GB/s plus
+  roofline utilization where the device's peak bandwidth is known;
+* a VMEM table (``kind: "vmem"`` records from ``tpu/vmemprobe.py``):
+  model-vs-actual scoped-VMEM per kernel config, under-estimates
+  flagged UNSAFE.
+
+``--diff A B`` compares two runs instead: two JSONL sets (per-phase /
+per-op / memory metrics) or two bench JSON files (``bench.py`` output or
+the driver-captured ``BENCH_r*.json`` wrappers), flagging changes beyond
+the cross-sample noise band and exiting 1 when a regression is found.
 
 Pure stdlib (no jax import): usable on a login node against files copied
 off the pod. ``--json`` emits the summary as one JSON document instead of
@@ -92,6 +108,50 @@ def _skew(per_rank_totals: dict) -> tuple[float, int | None]:
     return vals[worst] / min(vals.values()), worst
 
 
+def _merge_mem(memory: dict, rec: dict, rank) -> None:
+    """Fold one ``kind: "mem"`` record into the MEMORY accumulator:
+    run-wide watermark maxima (with the holding rank), per-phase
+    peak/delta from the phase-boundary records, and the live-buffer
+    bucket maxima from the censuses."""
+    memory["records"] += 1
+    for key in ("bytes_in_use", "peak_bytes_in_use", "live_bytes"):
+        v = rec.get(key)
+        if isinstance(v, (int, float)):
+            cur = memory["peak"].get(key)
+            if cur is None or v > cur["bytes"]:
+                memory["peak"][key] = {"bytes": int(v), "rank": rank}
+    if rec.get("event") == "phase" and rec.get("phase"):
+        ph = memory["phases"].setdefault(
+            rec["phase"],
+            {"peak_bytes": None, "delta_bytes": None, "peak_delta": None,
+             "records": 0, "_ranks": set()},
+        )
+        ph["records"] += 1
+        ph["_ranks"].add(rank)
+        peak = rec.get("peak_bytes_in_use", rec.get("live_bytes"))
+        if isinstance(peak, (int, float)):
+            ph["peak_bytes"] = max(ph["peak_bytes"] or 0, int(peak))
+        for key, field in (("delta_bytes", "delta_bytes"),
+                           ("peak_delta", "peak_delta")):
+            v = rec.get(field)
+            if isinstance(v, (int, float)):
+                cur = ph[key]
+                ph[key] = int(v) if cur is None else max(cur, int(v))
+    census = rec.get("census") or {}
+    for entry in census.get("top", []):
+        key = entry.get("key")
+        b = entry.get("bytes")
+        if not key or not isinstance(b, (int, float)):
+            continue
+        cur = memory["top"].get(key)
+        if cur is None or b > cur["bytes"]:
+            memory["top"][key] = {
+                "bytes": int(b),
+                "count": int(entry.get("count") or 0),
+                "rank": rank,
+            }
+
+
 def summarize(files: list[str]) -> dict:
     """Merge per-rank record streams into the summary structure."""
     manifest = None
@@ -99,6 +159,9 @@ def summarize(files: list[str]) -> dict:
     phases: dict[str, dict] = {}
     ops: dict[str, dict] = {}
     tuning: dict[str, dict] = {}
+    memory: dict = {"phases": {}, "peak": {}, "top": {}, "records": 0}
+    compiles: dict[str, dict] = {}
+    vmem: dict[str, dict] = {}
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -113,10 +176,16 @@ def summarize(files: list[str]) -> dict:
                 rank = rec.get("rank", file_rank)
                 secs = float(rec.get("seconds", 0.0))
                 ph = phases.setdefault(
-                    rec.get("phase", "?"), {"per_rank": {}, "count": 0}
+                    rec.get("phase", "?"),
+                    {"per_rank": {}, "count": 0,
+                     "call_count": 0, "call_seconds": 0.0},
                 )
                 ph["per_rank"][rank] = ph["per_rank"].get(rank, 0.0) + secs
                 ph["count"] += 1
+                # per-call denominator for the COMPILE roofline join: a
+                # PhaseTimer record's `count` is its iteration count
+                ph["call_count"] += int(rec.get("count") or 1)
+                ph["call_seconds"] += secs
             elif kind == "span":
                 rank = rec.get("rank", file_rank)
                 secs = float(rec.get("seconds") or 0.0)
@@ -154,6 +223,32 @@ def summarize(files: list[str]) -> dict:
                     t["hits"] += 1
                     if t["winner"] is None:
                         t["winner"] = rec.get("value")
+            elif kind == "mem":
+                _merge_mem(memory, rec, rec.get("rank", file_rank))
+            elif kind == "compile":
+                c = compiles.setdefault(
+                    rec.get("label", "?"),
+                    {"compiles": 0, "seconds": 0.0, "phase": None,
+                     "flops": None, "bytes_accessed": None,
+                     "temp_bytes": None, "output_bytes": None,
+                     "peak_gbps": None, "fingerprint": None,
+                     "_ba_seen": set()},
+                )
+                c["compiles"] += 1
+                c["seconds"] += float(rec.get("seconds") or 0.0)
+                for k in ("phase", "flops", "bytes_accessed",
+                          "temp_bytes", "output_bytes", "peak_gbps",
+                          "fingerprint"):
+                    if rec.get(k) is not None:
+                        c[k] = rec[k]
+                if rec.get("bytes_accessed") is not None:
+                    c["_ba_seen"].add(float(rec["bytes_accessed"]))
+            elif kind == "vmem":
+                v = vmem.setdefault(rec.get("config", "?"), {})
+                for k in ("model_bytes", "actual_bytes", "ratio",
+                          "error"):
+                    if rec.get(k) is not None:
+                        v[k] = rec[k]
 
     def _stats(per_rank: dict) -> dict:
         vals = list(per_rank.values())
@@ -168,6 +263,12 @@ def summarize(files: list[str]) -> dict:
             "per_rank_s": {str(r): per_rank[r] for r in sorted(per_rank)},
         }
 
+    for name, ph in memory["phases"].items():
+        ph["ranks"] = len(ph.pop("_ranks"))
+    memory["top"] = dict(sorted(
+        memory["top"].items(), key=lambda kv: -kv[1]["bytes"]
+    )[:8])
+
     summary = {
         "files": list(files),
         "manifest": manifest,
@@ -175,11 +276,17 @@ def summarize(files: list[str]) -> dict:
         "phases": {},
         "ops": {},
         "tuning": {name: tuning[name] for name in sorted(tuning)},
+        "memory": memory,
+        "compile": {},
+        "vmem": {name: vmem[name] for name in sorted(vmem)},
     }
     for name in sorted(phases):
+        ph = phases[name]
         summary["phases"][name] = {
-            "count": phases[name]["count"],
-            **_stats(phases[name]["per_rank"]),
+            "count": ph["count"],
+            "mean_call_s": (ph["call_seconds"] / ph["call_count"]
+                            if ph["call_count"] else 0.0),
+            **_stats(ph["per_rank"]),
         }
     for name in sorted(ops):
         o = ops[name]
@@ -192,7 +299,42 @@ def summarize(files: list[str]) -> dict:
             "gbps_p90": _percentile(gbps, 90),
             **_stats(o["per_rank"]),
         }
+    for label in sorted(compiles):
+        c = dict(compiles[label])
+        c["cost_models"] = len(c.pop("_ba_seen"))
+        summary["compile"][label] = dict(
+            c, **_roofline_join(c, label, summary["ops"],
+                                summary["phases"])
+        )
     return summary
+
+
+def _roofline_join(c: dict, label: str, ops: dict, phases: dict) -> dict:
+    """Join a compile record's cost model against the measured runtime
+    of the same fn: the mean per-call seconds come from the span table
+    (op named like the label) or, failing that, from the PhaseTimer
+    phase the record named. Yields the model-implied achieved GB/s and
+    the roofline fraction when the probing rank knew its peak.
+
+    A label probed at several shapes (``cost_models`` > 1 — e.g. a
+    collbench op swept over payload sizes) gets NO model join: mixing
+    one shape's bytes with every shape's mean seconds would fabricate
+    the number this table exists to make trustworthy."""
+    mean_call = None
+    op = ops.get(label)
+    if op and op["ops"]:
+        mean_call = sum(
+            float(v) for v in op["per_rank_s"].values()
+        ) / op["ops"]
+    elif c.get("phase") in phases:
+        mean_call = phases[c["phase"]].get("mean_call_s")
+    out: dict = {"mean_call_s": mean_call}
+    ba = c.get("bytes_accessed")
+    if mean_call and ba and c.get("cost_models", 1) <= 1:
+        out["model_gbps"] = ba / mean_call / 1e9
+        if c.get("peak_gbps"):
+            out["roofline_frac"] = out["model_gbps"] / c["peak_gbps"]
+    return out
 
 
 def _print_text(summary: dict, skew_threshold: float) -> None:
@@ -237,6 +379,21 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
             f"cache_hits={t['hits']}"
         )
 
+    _print_memory(summary.get("memory") or {})
+    _print_compile(summary.get("compile") or {})
+    for name, v in summary.get("vmem", {}).items():
+        if v.get("error") is not None:
+            print(f"VMEM {name}: ERROR {v['error']}")
+            continue
+        ratio = v.get("ratio")
+        unsafe = " UNSAFE" if (ratio is not None and ratio < 0.95) else ""
+        print(
+            f"VMEM {name}: model={v.get('model_bytes')} "
+            f"actual={v.get('actual_bytes')} "
+            f"model/actual={'-' if ratio is None else format(ratio, '.3g')}"
+            f"{unsafe}"
+        )
+
     stragglers = 0
     for label, table in (("PHASE", summary["phases"]),
                          ("OP", summary["ops"])):
@@ -250,6 +407,212 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
                 )
     if not stragglers:
         print(f"OK no stragglers above {skew_threshold:g}x")
+
+
+def _print_memory(memory: dict) -> None:
+    """MEMORY table: per-phase watermarks, run peak, top live buffers.
+    Silent when the run recorded no ``mem`` records (no --memwatch) —
+    old files keep their exact report shape."""
+    if not memory.get("records"):
+        return
+    for name, ph in memory.get("phases", {}).items():
+        parts = [f"MEM phase={name}:"]
+        for key in ("peak_bytes", "delta_bytes", "peak_delta"):
+            if ph.get(key) is not None:
+                parts.append(f"{key.replace('_bytes', '')}={ph[key]}")
+        parts.append(f"ranks={ph['ranks']} n={ph['records']}")
+        print(" ".join(parts))
+    peak = memory.get("peak", {})
+    parts = ["MEM peak:"]
+    for key in ("bytes_in_use", "peak_bytes_in_use", "live_bytes"):
+        if key in peak:
+            parts.append(
+                f"{key}={peak[key]['bytes']} (r{peak[key]['rank']})"
+            )
+    if len(parts) > 1:
+        print(" ".join(parts))
+    if not any(k in peak for k in ("bytes_in_use", "peak_bytes_in_use")):
+        # census-only run (CPU / fake devices report no allocator
+        # stats): say why there are no watermark numbers instead of the
+        # live-array totals silently reading as real HBM
+        print(f"MEM census-only: {memory['records']} records, no "
+              f"device memory_stats (CPU/fake devices)")
+    for key, e in memory.get("top", {}).items():
+        print(f"MEMTOP {key}: bytes={e['bytes']} count={e['count']} "
+              f"(r{e['rank']})")
+
+
+def _print_compile(compiles: dict) -> None:
+    for label, c in compiles.items():
+        parts = [
+            f"COMPILE {label}: n={c['compiles']} "
+            f"compile={c['seconds']:.6g}s"
+        ]
+        for key in ("flops", "bytes_accessed", "temp_bytes",
+                    "output_bytes"):
+            if c.get(key) is not None:
+                parts.append(f"{key}={c[key]:.6g}")
+        if c.get("mean_call_s"):
+            parts.append(f"mean_call={c['mean_call_s']:.6g}s")
+        if c.get("model_gbps"):
+            parts.append(f"model_gbps={c['model_gbps']:.4g}")
+        if c.get("roofline_frac") is not None:
+            parts.append(f"roofline={c['roofline_frac'] * 100:.1f}%")
+        if c.get("cost_models", 1) > 1:
+            # several shapes under one label: last-seen flops/bytes are
+            # shown but no model join (see _roofline_join)
+            parts.append(f"cost_models={c['cost_models']}")
+        print(" ".join(parts))
+
+
+# ---------------------------------------------------------------------------
+# --diff: compare two runs (JSONL sets or bench JSON files)
+# ---------------------------------------------------------------------------
+
+
+def _load_bench_doc(path: str) -> dict | None:
+    """The bench result object from a path holding either bench.py's one
+    JSON line or a driver-captured ``BENCH_r*.json`` wrapper (the result
+    line is the last JSON object inside its ``tail``). None when the
+    file is not a single JSON document (then it is treated as JSONL)."""
+    try:
+        doc = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if "metric" in doc:
+        return doc
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(d, dict) and "metric" in d:
+                return d
+    return None
+
+
+def _bench_metrics(doc: dict, prefix: str = "") -> dict[str, dict]:
+    """``{metric_name: {value, band, higher_better}}`` from a bench
+    result object, sub-dtype objects included (``bfloat16.iter/s``).
+    The noise band is the half-spread of the finite samples over their
+    median — the run's own cross-sample noise."""
+    out: dict[str, dict] = {}
+    if isinstance(doc.get("value"), (int, float)):
+        samples = [s for s in (doc.get("samples") or [])
+                   if isinstance(s, (int, float))]
+        band = 0.0
+        if len(samples) >= 2:
+            mid = sorted(samples)[len(samples) // 2]
+            if mid:
+                band = (max(samples) - min(samples)) / 2 / abs(mid)
+        out[prefix + (doc.get("unit") or "value")] = {
+            "value": float(doc["value"]), "band": band,
+            "higher_better": True,
+        }
+    if isinstance(doc.get("hbm_peak_bytes"), (int, float)):
+        out[prefix + "hbm_peak_bytes"] = {
+            "value": float(doc["hbm_peak_bytes"]), "band": 0.0,
+            "higher_better": False,
+        }
+    for sub in ("float32", "bfloat16"):
+        if isinstance(doc.get(sub), dict):
+            out.update(_bench_metrics(doc[sub], prefix=f"{sub}."))
+    return out
+
+
+def _jsonl_metrics(files: list[str]) -> dict[str, dict]:
+    """Per-phase / per-op / memory metrics of one JSONL run. The noise
+    band of a phase/op is its cross-rank spread (half the max−min over
+    the mean); bandwidth uses the p10–p90 spread over p50."""
+    s = summarize(files)
+    out: dict[str, dict] = {}
+
+    def rank_band(st) -> float:
+        return ((st["max_s"] - st["min_s"]) / (2 * st["mean_s"])
+                if st["mean_s"] else 0.0)
+
+    for name, st in s["phases"].items():
+        out[f"phase:{name}"] = {
+            "value": st["mean_s"], "band": rank_band(st),
+            "higher_better": False,
+        }
+    for name, st in s["ops"].items():
+        out[f"op:{name}"] = {
+            "value": st["mean_s"], "band": rank_band(st),
+            "higher_better": False,
+        }
+        p50 = st["gbps_p50"]
+        if p50 == p50 and p50:  # not NaN, non-zero
+            out[f"op:{name}:gbps"] = {
+                "value": p50,
+                "band": (st["gbps_p90"] - st["gbps_p10"]) / (2 * p50),
+                "higher_better": True,
+            }
+    peak = (s.get("memory") or {}).get("peak") or {}
+    if "peak_bytes_in_use" in peak:
+        out["mem:peak_bytes_in_use"] = {
+            "value": float(peak["peak_bytes_in_use"]["bytes"]),
+            "band": 0.0, "higher_better": False,
+        }
+    return out
+
+
+def _side_metrics(path: str) -> tuple[str, dict[str, dict]]:
+    bench = _load_bench_doc(path)
+    if bench is not None:
+        return "bench", _bench_metrics(bench)
+    files = [f for f in expand_rank_files([path]) if Path(f).exists()]
+    return "jsonl", _jsonl_metrics(files)
+
+
+def diff_main(path_a: str, path_b: str, threshold: float = 0.05) -> int:
+    """Compare two runs per metric. A change is flagged only beyond the
+    noise band — the larger of either side's cross-sample/cross-rank
+    band and the ``--diff-threshold`` floor. Returns 1 when any flagged
+    change is a *regression* (slower / less bandwidth / more memory),
+    0 otherwise."""
+    kind_a, a = _side_metrics(path_a)
+    kind_b, b = _side_metrics(path_b)
+    print(f"DIFF A={path_a} ({kind_a}) B={path_b} ({kind_b})")
+    if kind_a != kind_b:
+        print("DIFF NOTE comparing different input kinds; only shared "
+              "metric names are compared")
+    shared = sorted(set(a) & set(b))
+    if not shared:
+        print("DIFF no shared metrics", file=sys.stderr)
+        return 1
+    regressions = 0
+    for name in shared:
+        ma, mb = a[name], b[name]
+        if not ma["value"]:
+            continue
+        change = (mb["value"] - ma["value"]) / abs(ma["value"])
+        band = max(ma["band"], mb["band"], threshold)
+        worse = (-change if ma["higher_better"] else change) > band
+        better = (change if ma["higher_better"] else -change) > band
+        tag = ""
+        if worse:
+            regressions += 1
+            tag = " REGRESSION"
+        elif better:
+            tag = " improved"
+        print(
+            f"DIFF {name}: A={ma['value']:.6g} B={mb['value']:.6g} "
+            f"change={change * 100:+.2f}% band=±{band * 100:.2f}%{tag}"
+        )
+    skipped = (set(a) | set(b)) - set(shared)
+    if skipped:
+        print(f"DIFF NOTE {len(skipped)} metrics present on one side "
+              f"only: {' '.join(sorted(skipped))}")
+    if regressions:
+        print(f"DIFF REGRESSIONS {regressions} beyond the noise band")
+        return 1
+    print("DIFF OK within noise")
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -292,7 +655,39 @@ def main(argv: list[str] | None = None) -> int:
         metavar="COLS",
         help="swimlane width in columns for --timeline (default 64)",
     )
+    p.add_argument(
+        "--diff",
+        action="store_true",
+        help="compare exactly two runs instead of summarizing: each "
+        "path is a JSONL set (base path expands its rank files) or a "
+        "bench JSON file (bench.py output / BENCH_r*.json wrapper); "
+        "changes beyond the cross-sample noise band are flagged and a "
+        "regression exits 1",
+    )
+    p.add_argument(
+        "--diff-threshold",
+        type=float,
+        default=0.05,
+        metavar="FRAC",
+        help="minimum relative-change floor for --diff flags when the "
+        "runs' own noise bands are tighter (default 0.05)",
+    )
     args = p.parse_args(argv)
+
+    if args.diff:
+        if len(args.files) != 2:
+            print("tpumt-report: --diff needs exactly two paths",
+                  file=sys.stderr)
+            return 1
+        for f in args.files:
+            if not Path(f).exists() and not (
+                expand_rank_files([f]) and
+                any(Path(x).exists() for x in expand_rank_files([f]))
+            ):
+                print(f"tpumt-report: cannot open {f}", file=sys.stderr)
+                return 1
+        return diff_main(args.files[0], args.files[1],
+                         threshold=args.diff_threshold)
 
     files = [f for f in expand_rank_files(args.files) if Path(f).exists()]
     if not files:

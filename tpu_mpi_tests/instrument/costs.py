@@ -1,0 +1,258 @@
+"""Compile-cost capture: AOT compile wall-time + the compiler's own
+cost/memory model, keyed by the tune-layer fingerprint.
+
+The repo measures achieved seconds and GB/s everywhere, but until now no
+span knew what the *compiler* thinks the op costs — so "fast as the
+hardware allows" (ROADMAP) was unverifiable: achieved bandwidth had no
+denominator. This module supplies it:
+
+* :func:`compile_probe` — the AOT wrap point. Given a (jitted or plain)
+  function and example args, it times ``fn.lower(*args).compile()``
+  (``kind: "compile"`` JSONL span on the PR-2 wall clock, so
+  ``tpumt-trace`` draws a compile track) and captures
+  ``compiled.cost_analysis()`` (flops, bytes accessed) and
+  ``compiled.memory_analysis()`` (temp/output/argument allocation
+  sizes), tagging the record with the tune-layer fingerprint
+  (:mod:`tpu_mpi_tests.tune.fingerprint`) and the device's peak HBM
+  bandwidth where known. The probe compiles *in addition to* the plain
+  execution path (jax's jit dispatch cache is separate from AOT) — it
+  runs only under ``--telemetry``, dedupes per (label, arg-avals), and
+  the persistent compilation cache (``--compile-cache``) makes the
+  second compile nearly free. It never raises and never touches the
+  measured fn's buffers (``lower``/``compile`` do not execute).
+* a **cost registry + span provider**: the latest probe per label is
+  kept in-process and registered as the telemetry layer's cost
+  provider, so every later span whose ``op`` matches a probed label
+  gets ``cost_bytes``/``cost_flops``/``model_gbps`` and — where a peak
+  is known — ``roofline_frac`` (achieved cost-model bytes/s over peak
+  bytes/s) attached to its JSONL record. ``tpumt-report`` joins the
+  same records into the COMPILE table.
+
+Module import is stdlib-only (jax loads inside the probe), keeping the
+login-node CLI closure jax-free.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from tpu_mpi_tests.instrument import telemetry as _telemetry
+
+#: published peak HBM bandwidth per device kind, GB/s — the roofline
+#: denominator. Override/extend with TPU_MPI_PEAK_GBPS (a float) when
+#: the device kind is missing or the pod's effective peak differs.
+PEAK_HBM_GBPS = {
+    "TPU v2": 700.0,
+    "TPU v3": 900.0,
+    "TPU v4": 1228.0,
+    "TPU v5 lite": 819.0,
+    "TPU v5e": 819.0,
+    "TPU v5p": 2765.0,
+    "TPU v6 lite": 1640.0,
+    "TPU v6e": 1640.0,
+}
+
+_LOCK = threading.Lock()
+#: label -> latest probe info (flops/bytes/compile seconds/fingerprint).
+#: A label probed at MORE THAN ONE shape set (e.g. collbench sweeping an
+#: op over payload sizes) is marked ``"ambiguous"``: spans cannot know
+#: which shape a given call ran at, so attaching any single shape's cost
+#: model would fabricate numbers — ambiguous labels attach nothing.
+_REGISTRY: dict[str, dict[str, Any]] = {}
+#: (label, aval-key) pairs already probed — one compile per shape set
+_PROBED: set = set()
+
+
+def peak_gbps() -> float | None:
+    """Peak HBM GB/s for this process's devices: ``TPU_MPI_PEAK_GBPS``
+    env override first, else the :data:`PEAK_HBM_GBPS` table by device
+    kind (substring match). ``None`` when unknown (CPU, fake devices) —
+    consumers then omit roofline percentages rather than fabricating
+    them."""
+    env = os.environ.get("TPU_MPI_PEAK_GBPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None
+    for name, gbps in PEAK_HBM_GBPS.items():
+        if name in kind or kind in name:
+            return gbps
+    return None
+
+
+def _aval_key(a) -> tuple:
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is None and dtype is None:
+        return (type(a).__name__,)
+    return (tuple(shape or ()), str(dtype))
+
+
+def _num(v) -> float | None:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if f == f else None
+
+
+def _cost_analysis(compiled) -> dict[str, Any]:
+    """Normalized ``cost_analysis()``: some jax versions return a list
+    of per-computation dicts, newer ones a dict."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca if isinstance(ca, dict) else {}
+
+
+def _memory_analysis(compiled) -> dict[str, int]:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    out = {}
+    for rec_key, attr in (
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+        ("code_bytes", "generated_code_size_in_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if isinstance(v, (int, float)):
+            out[rec_key] = int(v)
+    return out
+
+
+def _fingerprint(**ctx) -> str | None:
+    try:
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+
+        return fingerprint(**ctx)
+    except Exception:
+        return None
+
+
+def compile_probe(
+    fn: Callable,
+    args: tuple,
+    label: str,
+    phase: str | None = None,
+    emit: Callable[[dict], None] | None = None,
+    **ctx,
+) -> dict[str, Any] | None:
+    """AOT-compile ``fn(*args)``, record the compile span + cost model.
+
+    No-op (returns the existing registry entry, or None) unless span
+    telemetry is enabled — the probe costs a real compile, which is
+    observability overhead a plain benchmark run must not pay. Dedupes
+    per (label, arg shapes/dtypes). ``phase`` names the PhaseTimer
+    phase / span op whose measured seconds this fn's runtime lands in,
+    so ``tpumt-report`` can join compile cost against achieved time;
+    it defaults to ``label``. ``ctx`` feeds the tune-layer fingerprint
+    (dtype/shape/world context). Never raises; any failure (un-AOT-able
+    fn, analysis unsupported) returns None with nothing emitted."""
+    if not _telemetry.registry().enabled:
+        return None
+    key = (label,) + tuple(_aval_key(a) for a in args)
+    with _LOCK:
+        if key in _PROBED:
+            return _REGISTRY.get(label)
+        second_shape = any(k[0] == label for k in _PROBED)
+        _PROBED.add(key)
+    try:
+        import jax
+
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        t0_wall = time.time()
+        t0 = time.perf_counter()
+        compiled = jitted.lower(*args).compile()
+        t1 = time.perf_counter()
+        dt = t1 - t0
+        ca = _cost_analysis(compiled)
+        info: dict[str, Any] = {
+            "label": label,
+            "compile_s": dt,
+            "flops": _num(ca.get("flops")),
+            "bytes_accessed": _num(ca.get("bytes accessed")),
+            "fingerprint": _fingerprint(**ctx),
+        }
+        info.update(_memory_analysis(compiled))
+        peak = peak_gbps()
+        if peak:
+            info["peak_gbps"] = peak
+        if second_shape:
+            # the label now covers several shapes with different cost
+            # models; no single model can be attributed to its spans
+            info["ambiguous"] = True
+        with _LOCK:
+            _REGISTRY[label] = info
+        _telemetry.set_cost_provider(cost_fields)
+        record = {
+            "kind": "compile",
+            "phase": phase or label,
+            "seconds": dt,
+            "t_start": t0_wall,
+            # wall end anchored to the monotonic duration (same
+            # NTP-step argument as comm_span)
+            "t_end": t0_wall + dt,
+            "mono_start": t0,
+            "mono_end": t1,
+            **info,
+        }
+        (emit or _telemetry.emit)(record)
+        return info
+    except Exception:
+        return None
+
+
+def cost_info(label: str) -> dict[str, Any] | None:
+    """Latest probe result for ``label`` (None when never probed)."""
+    with _LOCK:
+        return _REGISTRY.get(label)
+
+
+def cost_fields(op: str, seconds: float | None) -> dict[str, Any]:
+    """Span-attachable roofline fields for a measured execution of the
+    probed fn ``op``: the cost model's flops/bytes, the model-implied
+    achieved rates over the measured ``seconds``, and the roofline
+    utilization where a peak is known. ``{}`` for unknown ops/invalid
+    seconds — the telemetry layer merges this into span records.
+    Labels probed at several shapes attach nothing (see the registry
+    note): the span cannot say which shape it ran at."""
+    info = cost_info(op)
+    if not info or info.get("ambiguous") or not seconds or seconds <= 0:
+        return {}
+    out: dict[str, Any] = {}
+    cb = info.get("bytes_accessed")
+    cf = info.get("flops")
+    if cb:
+        out["cost_bytes"] = cb
+        out["model_gbps"] = cb / seconds / 1e9
+        peak = info.get("peak_gbps")
+        if peak:
+            out["roofline_frac"] = cb / seconds / 1e9 / peak
+    if cf:
+        out["cost_flops"] = cf
+        out["model_gflops"] = cf / seconds / 1e9
+    return out
+
+
+def reset() -> None:
+    """Drop all probe state (tests)."""
+    with _LOCK:
+        _REGISTRY.clear()
+        _PROBED.clear()

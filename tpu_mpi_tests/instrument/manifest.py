@@ -73,6 +73,18 @@ def run_manifest(argv: list[str] | None = None, **extra) -> dict:
         "env": env,
         "git_sha": _git_sha(),
     }
+    # memory identity: whether the backend reports allocator watermarks
+    # (CPU/fake devices do not — downstream consumers degrade to
+    # census-only) and the per-device HBM capacity when it does, so a
+    # result file says what memory the numbers were measured against
+    from tpu_mpi_tests.instrument.memwatch import device_memory_stats
+
+    stats = device_memory_stats()
+    record["memory_stats_available"] = bool(stats)
+    limits = [s["bytes_limit"] for s in stats.values()
+              if "bytes_limit" in s]
+    if limits:
+        record["hbm_bytes_limit"] = max(limits)
     record.update(extra)
     return record
 

@@ -1,0 +1,363 @@
+"""HBM watermarks + live-buffer census: the memory axis of observability.
+
+The reference's unified-vs-explicit memory comparison (``daxpy_nvtx.cu``,
+PAPER §DAXPY pillar) is memory-side observability the time-domain layers
+(PRs 1–2) never reproduced: spans know *when* an op ran but nothing knows
+*what the HBM was doing* while it ran. This module is the missing
+recorder, three pieces:
+
+* :func:`device_memory_stats` — per-device allocator stats from
+  ``device.memory_stats()`` (``bytes_in_use``, ``peak_bytes_in_use``,
+  ``bytes_limit``), normalized to plain ints. CPU and fake devices
+  return ``None``/``{}`` from the backend; callers get ``{}`` and every
+  consumer degrades gracefully (census-only records, absent — never
+  zero — result fields).
+* :func:`live_array_census` — ``jax.live_arrays()`` bucketed by
+  shape·dtype (count/bytes per bucket, top-K offenders by bytes): the
+  answer to "what is actually holding the HBM", available on every
+  backend including CPU.
+* :class:`MemWatch` — the run-long recorder ``--memwatch`` arms: a
+  low-rate sampler thread plus :mod:`~tpu_mpi_tests.instrument.timers`
+  phase hooks, emitting ``kind: "mem"`` JSONL records stamped with the
+  PR-2 wall clock (``t`` / ``t_start``/``t_end``) so they land on the
+  shared cross-rank timeline — ``tpumt-trace`` renders them as Perfetto
+  counter tracks, ``tpumt-report`` as the MEMORY table, and the
+  watchdog dumps the same census when it fires.
+
+Thread discipline: the sampler emits through the Reporter's
+``jsonl``-backed sink, which serializes one locked ``write()`` per
+record — this module itself never touches a file handle (the TPM601
+hazard class). Module import is stdlib-only; jax loads lazily inside
+the probe functions so the watchdog (and anything else stdlib-side) can
+import this module on jax-less hosts.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+#: memory_stats fields worth recording (allocator dicts carry many more;
+#: these are the watermark/capacity trio every consumer reads)
+STATS_FIELDS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+#: default census depth: top-K shape·dtype buckets by bytes (the
+#: watchdog fire-dump contract is 8)
+CENSUS_TOP_K = 8
+
+#: default sampler period — low-rate by design: the sampler exists to
+#: draw a counter track, not to profile allocation churn
+SAMPLE_INTERVAL_S = 0.5
+
+
+def device_memory_stats() -> dict[str, dict[str, int]]:
+    """``{device_id: {bytes_in_use, peak_bytes_in_use, bytes_limit}}``
+    for every local device whose backend reports allocator stats.
+
+    Returns ``{}`` when jax is unavailable, the backend exposes no
+    ``memory_stats()`` (CPU, fake devices return ``None``/``{}``), or
+    the probe raises — never raises itself, so it is safe from the
+    watchdog's timer thread and the sampler."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return {}
+    out: dict[str, dict[str, int]] = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        fields = {
+            k: int(stats[k])
+            for k in STATS_FIELDS
+            if isinstance(stats.get(k), (int, float))
+        }
+        if fields:
+            out[str(getattr(d, "id", len(out)))] = fields
+    return out
+
+
+def _live_totals() -> tuple[int, int]:
+    """(count, bytes) of live arrays — one walk, no bucketing: the cheap
+    growth signal the phase hooks poll on backends without allocator
+    stats. (0, 0) when jax is unavailable."""
+    count = 0
+    total = 0
+    try:
+        import jax
+
+        for a in jax.live_arrays():
+            try:
+                if a.is_deleted():
+                    continue
+                total += int(a.size) * int(a.dtype.itemsize)
+                count += 1
+            except Exception:
+                continue
+    except Exception:
+        pass
+    return count, total
+
+
+def live_array_census(top_k: int = CENSUS_TOP_K) -> dict[str, Any] | None:
+    """Census of ``jax.live_arrays()`` bucketed by shape·dtype.
+
+    Returns ``{"count": N, "bytes": B, "top": [{key, count, bytes}, …]}``
+    with ``top`` holding the ``top_k`` buckets by total bytes (key shape
+    ``"8192x8192·float32"``); ``bytes`` are logical global sizes
+    (``size · itemsize``). ``None`` when jax is unavailable — the only
+    case with nothing to report; an empty process reports 0 buffers."""
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:
+        return None
+    buckets: dict[str, list[int]] = {}
+    count = 0
+    total = 0
+    for a in arrays:
+        try:
+            if a.is_deleted():
+                continue
+            nbytes = int(a.size) * int(a.dtype.itemsize)
+            key = "x".join(str(s) for s in a.shape) or "scalar"
+            key = f"{key}·{a.dtype.name}"
+        except Exception:
+            continue
+        b = buckets.setdefault(key, [0, 0])
+        b[0] += 1
+        b[1] += nbytes
+        count += 1
+        total += nbytes
+    top = sorted(buckets.items(), key=lambda kv: -kv[1][1])[: max(top_k, 0)]
+    return {
+        "count": count,
+        "bytes": total,
+        "top": [
+            {"key": k, "count": c, "bytes": b} for k, (c, b) in top
+        ],
+    }
+
+
+def mem_record(
+    event: str = "sample",
+    phase: str | None = None,
+    top_k: int = 0,
+    t_start: float | None = None,
+    t_end: float | None = None,
+) -> dict[str, Any]:
+    """One ``kind: "mem"`` JSONL record: wall timestamp ``t`` (the PR-2
+    clock the timeline merger offset-corrects), per-device watermarks
+    when the backend reports them, and live-array totals (full top-K
+    census only when ``top_k`` > 0 — it walks every live buffer).
+
+    Degrades to census-only where ``memory_stats()`` is absent/empty
+    (CPU, fake devices): no ``devices``/``bytes_in_use`` keys, never
+    zeros that would read as a measured empty HBM."""
+    rec: dict[str, Any] = {"kind": "mem", "event": event, "t": time.time()}
+    if phase is not None:
+        rec["phase"] = phase
+    if t_start is not None:
+        rec["t_start"] = t_start
+    if t_end is not None:
+        rec["t_end"] = t_end
+    devices = device_memory_stats()
+    if devices:
+        rec["devices"] = devices
+        rec["bytes_in_use"] = sum(
+            d.get("bytes_in_use", 0) for d in devices.values()
+        )
+        rec["peak_bytes_in_use"] = max(
+            d.get("peak_bytes_in_use", 0) for d in devices.values()
+        )
+    census = live_array_census(top_k if top_k > 0 else CENSUS_TOP_K)
+    if census is not None:
+        rec["live_count"] = census["count"]
+        rec["live_bytes"] = census["bytes"]
+        if top_k > 0:
+            rec["census"] = census
+    return rec
+
+
+def watermark_lines(top_k: int = CENSUS_TOP_K) -> list[str]:
+    """Human dump for hang/fire diagnostics: per-device watermarks plus
+    the top-K live-array buckets. Best-effort and never raises — the
+    caller is the watchdog's timer thread mid-hang."""
+    lines: list[str] = []
+    try:
+        for dev, s in sorted(device_memory_stats().items()):
+            parts = [f"HBM dev{dev}:"]
+            for k in STATS_FIELDS:
+                if k in s:
+                    parts.append(f"{k}={s[k]}")
+            lines.append(" ".join(parts))
+    except Exception:
+        pass
+    try:
+        census = live_array_census(top_k)
+    except Exception:
+        census = None
+    if census is not None:
+        lines.append(
+            f"LIVE census: {census['count']} arrays, "
+            f"{census['bytes']} bytes"
+        )
+        for e in census["top"]:
+            lines.append(
+                f"LIVE {e['key']}: n={e['count']} bytes={e['bytes']}"
+            )
+    return lines
+
+
+class MemWatch:
+    """Run-long memory recorder: a daemon sampler thread plus PhaseTimer
+    hooks, both emitting ``kind: "mem"`` records through ``sink``.
+
+    Record stream: one ``event: "start"`` record (with census) on
+    :meth:`start`, ``event: "sample"`` records every ``interval_s``
+    (watermarks + live totals, no full census — the sampler stays
+    cheap), one ``event: "phase"`` record per phase *name* at its first
+    exit (census included) and again whenever that phase raises the
+    global peak watermark by >1% (hot-loop phases re-enter thousands of
+    times; emitting every exit would swamp the JSONL for zero new
+    information), and one ``event: "final"`` record (census) on
+    :meth:`stop`. Phase records carry ``t_start``/``t_end`` plus the
+    in-use delta and peak raise across the phase body. Non-emitting
+    exits stay cheap by design — one allocator query (or one
+    live-array walk where allocator stats are absent), never a full
+    census — because hot-loop phases pay the hook per iteration.
+
+    ``sink`` must serialize its own writes (the Reporter's ``jsonl``
+    does: one locked ``write()`` per record) — the sampler thread and
+    the main thread's phase hooks emit concurrently."""
+
+    def __init__(
+        self,
+        sink: Callable[[dict], None],
+        interval_s: float = SAMPLE_INTERVAL_S,
+        top_k: int = CENSUS_TOP_K,
+    ):
+        self._sink = sink
+        self._interval = max(float(interval_s), 0.02)
+        self._top_k = top_k
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        # probed once at start(): whether this backend reports allocator
+        # stats at all — phase begins skip the query where it never can
+        # return anything (CPU/fake devices)
+        self._has_device_stats = False
+        # phase name -> {t0, devices at entry, emitted, last peak}
+        self._phase_state: dict[str, dict[str, Any]] = {}
+
+    # -- lifecycle ---------------------------------------------------
+
+    def start(self) -> "MemWatch":
+        from tpu_mpi_tests.instrument import timers
+
+        self._has_device_stats = bool(device_memory_stats())
+        timers.add_phase_hook(self._on_phase)
+        self._emit(mem_record(event="start", top_k=self._top_k))
+        self._thread = threading.Thread(
+            target=self._run, name="tpumt-memwatch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent: stop the sampler, detach the phase hooks, emit
+        the final census record."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        try:
+            from tpu_mpi_tests.instrument import timers
+
+            timers.remove_phase_hook(self._on_phase)
+        except Exception:
+            pass
+        self._emit(mem_record(event="final", top_k=self._top_k))
+
+    # -- internals ---------------------------------------------------
+
+    def _emit(self, rec: dict) -> None:
+        try:
+            self._sink(rec)
+        except Exception:
+            pass  # observability must never fail the run
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._emit(mem_record(event="sample"))
+
+    def _on_phase(self, name: str, event: str) -> None:
+        if event == "begin":
+            with self._lock:
+                st = self._phase_state.setdefault(name, {})
+                st["t0"] = time.time()
+                if self._has_device_stats:
+                    st["devices"] = device_memory_stats()
+            return
+        if event != "end":
+            return
+        # cheap growth signal FIRST — a hot-loop phase pays this hook
+        # every iteration, and most exits emit nothing: one allocator
+        # query (or one no-bucketing live-array walk on backends with
+        # no allocator stats), never a full census
+        now = time.time()
+        devices = device_memory_stats() if self._has_device_stats else {}
+        if devices:
+            peak = max(
+                d.get("peak_bytes_in_use", 0) for d in devices.values()
+            )
+        else:
+            live_count, live_bytes = _live_totals()
+            peak = live_bytes
+        with self._lock:
+            st = self._phase_state.setdefault(name, {})
+            first = not st.get("emitted")
+            grew = peak > st.get("last_peak", 0) * 1.01
+            if not (first or grew):
+                return
+            st["emitted"] = True
+            st["last_peak"] = peak
+            t_start = st.get("t0", now)
+            begin = st.get("devices") or {}
+        rec: dict[str, Any] = {
+            "kind": "mem", "event": "phase", "phase": name,
+            "t": now, "t_start": t_start, "t_end": now,
+        }
+        if devices:
+            rec["devices"] = devices
+            rec["bytes_in_use"] = sum(
+                d.get("bytes_in_use", 0) for d in devices.values()
+            )
+            rec["peak_bytes_in_use"] = peak
+            if begin:
+                rec["delta_bytes"] = rec["bytes_in_use"] - sum(
+                    d.get("bytes_in_use", 0) for d in begin.values()
+                )
+                # peaks are monotonic (current jaxlibs expose no reset
+                # hook): the phase's raise is the watermark difference
+                # across its body
+                rec["peak_delta"] = peak - max(
+                    d.get("peak_bytes_in_use", 0) for d in begin.values()
+                )
+            live_count, live_bytes = _live_totals()
+        rec["live_count"] = live_count
+        rec["live_bytes"] = live_bytes
+        if first:
+            census = live_array_census(self._top_k)
+            if census is not None:
+                rec["census"] = census
+        self._emit(rec)

@@ -75,6 +75,7 @@ class Reporter:
         self._jsonl_file: IO[str] | None = None
         self._jsonl_lock = threading.Lock()
         self._telemetry = False
+        self._memwatch = None
         self._created_at = time.time()  # trace merge excludes older files
         # this run's clock_sync identity (set by make_reporter): the
         # trace merge uses it to recognize same-run sibling rank files
@@ -197,6 +198,12 @@ class Reporter:
         registry is disabled (its sink points at this reporter)."""
         self._telemetry = True
 
+    def attach_memwatch(self, memwatch):
+        """Own a running :class:`~tpu_mpi_tests.instrument.memwatch.
+        MemWatch`: closing the reporter stops its sampler (emitting the
+        final census record) before the JSONL file closes."""
+        self._memwatch = memwatch
+
     def jsonl(self, record: dict[str, Any]):
         # serialized under a lock and written as ONE write() call: the
         # watchdog emits its timeline record from a timer thread, and an
@@ -212,6 +219,12 @@ class Reporter:
             self._jsonl_file.flush()
 
     def close(self):
+        if self._memwatch is not None:
+            memwatch, self._memwatch = self._memwatch, None
+            try:
+                memwatch.stop()  # final mem record lands before close
+            except Exception:
+                pass
         if self._telemetry:
             self._telemetry = False
             from tpu_mpi_tests.instrument import telemetry as T

@@ -193,6 +193,28 @@ class Telemetry:
 
 _TELEMETRY = Telemetry()
 
+#: optional cost-model provider (instrument/costs.py registers itself on
+#: its first successful compile probe): ``provider(op, seconds)`` returns
+#: extra span fields ({} for unknown ops) — cost bytes/flops and roofline
+#: utilization, so a span carries achieved-vs-cost-model context
+_COST_PROVIDER: Callable[[str, float], dict] | None = None
+
+
+def set_cost_provider(provider: Callable[[str, float], dict] | None) -> None:
+    global _COST_PROVIDER
+    _COST_PROVIDER = provider
+
+
+def _cost_meta(op: str, seconds: float) -> dict:
+    """Best-effort cost fields for a closing span — a provider bug must
+    never fail the measured op."""
+    if _COST_PROVIDER is None:
+        return {}
+    try:
+        return _COST_PROVIDER(op, seconds) or {}
+    except Exception:
+        return {}
+
 
 def registry() -> Telemetry:
     """The process-wide telemetry registry."""
@@ -305,6 +327,9 @@ def comm_span(
         t1 = time.perf_counter()
         dt = t1 - t0
         gbps = (nbytes / dt / 1e9) if (nbytes and dt > 0) else None
+        cost = _cost_meta(op, dt)
+        if cost:
+            meta = {**cost, **meta}  # explicit caller meta wins
         # wall end is start + the monotonic duration, not a second
         # time.time() read: an NTP step mid-span would otherwise make
         # t_end - t_start disagree with `seconds` on the merged timeline
@@ -326,6 +351,22 @@ def comm_span(
         )
 
 
+def _maybe_compile_probe(op: str, fn: Callable, args: tuple) -> None:
+    """AOT compile-cost probe for jitted fns flowing through
+    :func:`span_call` — one probe per (op, arg shapes), only while
+    telemetry is enabled, so every instrumented comm wrapper records a
+    ``kind: "compile"`` span + cost model without per-wrapper wiring
+    (instrument/costs.py). Best-effort by contract."""
+    if not hasattr(fn, "lower"):
+        return
+    try:
+        from tpu_mpi_tests.instrument import costs
+
+        costs.compile_probe(fn, args, label=op)
+    except Exception:
+        pass
+
+
 def span_call(
     op: str,
     fn: Callable,
@@ -341,6 +382,7 @@ def span_call(
     :func:`_under_trace`)."""
     if not _TELEMETRY.enabled or _under_trace():
         return fn(*args)
+    _maybe_compile_probe(op, fn, args)
     with comm_span(
         op, nbytes=nbytes, axis_name=axis_name, world=world, **meta
     ) as span:

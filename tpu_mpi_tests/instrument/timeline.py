@@ -49,6 +49,7 @@ from tpu_mpi_tests.instrument.aggregate import (
 #: thread ids within each rank's trace process
 TID_COMM = 0
 TID_PHASE = 1
+TID_COMPILE = 2
 
 _US = 1e6  # trace-event ts/dur unit is microseconds
 
@@ -119,14 +120,19 @@ def rank_streams(
 
 
 def _collect(streams):
-    """Split aligned records into (spans, instants, n_unplaced).
+    """Split aligned records into (spans, instants, counters,
+    n_unplaced).
 
     spans:    (rank, tid, name, cat, t_start, dur_s, args)
     instants: (rank, tid, name, cat, t, scope, args)
+    counters: (rank, name, t, series_dict) — Perfetto counter samples
+              from ``kind: "mem"`` records: per-device ``bytes_in_use``
+              where the backend reports watermarks, live-array bytes
+              (the census-only CPU/fake-device degrade path) otherwise
     Timestamps are wall-clock seconds already shifted onto rank 0's
     clock (``t - offset``); records with no ``t_start``/``t`` cannot be
     placed and are only counted (pre-timeline JSONL compatibility)."""
-    spans, instants, unplaced = [], [], 0
+    spans, instants, counters, unplaced = [], [], [], 0
 
     def args_from(rec, keys):
         return {k: rec[k] for k in keys if rec.get(k) is not None}
@@ -144,7 +150,8 @@ def _collect(streams):
                     rank, TID_COMM, rec.get("op", "?"), "comm", start,
                     max(end - start, 0.0),
                     args_from(rec, ("nbytes", "gbps", "axis", "world",
-                                    "seconds")),
+                                    "seconds", "cost_bytes",
+                                    "model_gbps", "roofline_frac")),
                 ))
             elif kind == "time":
                 if rec.get("t_start") is None:
@@ -177,7 +184,40 @@ def _collect(streams):
                     float(rec["t"]) - offset, "p",
                     args_from(rec, ("deadline_s",)),
                 ))
-    return spans, instants, unplaced
+            elif kind == "compile":
+                if rec.get("t_start") is None:
+                    unplaced += 1
+                    continue
+                start = float(rec["t_start"]) - offset
+                end = float(rec.get("t_end") or rec["t_start"]) - offset
+                spans.append((
+                    rank, TID_COMPILE,
+                    f"compile {rec.get('label', '?')}", "compile",
+                    start, max(end - start, 0.0),
+                    args_from(rec, ("seconds", "flops", "bytes_accessed",
+                                    "temp_bytes", "output_bytes",
+                                    "fingerprint")),
+                ))
+            elif kind == "mem":
+                if rec.get("t") is None:
+                    unplaced += 1
+                    continue
+                t = float(rec["t"]) - offset
+                devices = rec.get("devices") or {}
+                if devices:
+                    counters.append((
+                        rank, "HBM bytes_in_use", t,
+                        {f"dev{d}": s.get("bytes_in_use", 0)
+                         for d, s in sorted(devices.items())},
+                    ))
+                elif rec.get("live_bytes") is not None:
+                    # census-only degrade path (no memory_stats): the
+                    # live-array total still draws a counter track
+                    counters.append((
+                        rank, "live bytes", t,
+                        {"bytes": rec["live_bytes"]},
+                    ))
+    return spans, instants, counters, unplaced
 
 
 def chrome_trace(
@@ -190,10 +230,12 @@ def chrome_trace(
     segment in files appended to across runs (see
     :func:`rank_streams`)."""
     streams = rank_streams(files, run_sync_us)
-    spans, instants, unplaced = _collect(streams)
-    starts = [s[4] for s in spans] + [i[4] for i in instants]
+    spans, instants, counters, unplaced = _collect(streams)
+    starts = ([s[4] for s in spans] + [i[4] for i in instants]
+              + [c[2] for c in counters])
     t0 = min(starts) if starts else 0.0
 
+    compile_ranks = {s[0] for s in spans if s[1] == TID_COMPILE}
     events = []
     for rank in sorted({r for r, _, _ in streams}):
         events.append({"ph": "M", "name": "process_name", "pid": rank,
@@ -202,6 +244,10 @@ def chrome_trace(
                        "tid": TID_COMM, "args": {"name": "comm"}})
         events.append({"ph": "M", "name": "thread_name", "pid": rank,
                        "tid": TID_PHASE, "args": {"name": "phases"}})
+        if rank in compile_ranks:
+            events.append({"ph": "M", "name": "thread_name", "pid": rank,
+                           "tid": TID_COMPILE,
+                           "args": {"name": "compile"}})
     for rank, tid, name, cat, start, dur, args in sorted(
         spans, key=lambda s: s[4]
     ):
@@ -214,6 +260,11 @@ def chrome_trace(
         events.append({"ph": "i", "name": name, "cat": cat, "pid": rank,
                        "tid": tid, "ts": (t - t0) * _US, "s": scope,
                        "args": args})
+    # memory counter tracks ("C" events): one track per (rank, name),
+    # one series per device (or the census-only live-bytes series)
+    for rank, name, t, series in sorted(counters, key=lambda c: c[2]):
+        events.append({"ph": "C", "name": name, "cat": "mem", "pid": rank,
+                       "tid": 0, "ts": (t - t0) * _US, "args": series})
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -270,7 +321,7 @@ def ascii_swimlane(files: list[str], width: int = 64,
     ``t_start``) — the barrier-skew series that shows *which step*
     desynchronized, not just that some step did."""
     streams = rank_streams(files)
-    spans, _, unplaced = _collect(streams)
+    spans, _, _, unplaced = _collect(streams)
     ranks = sorted({r for r, _, _ in streams})
     phase_spans = [s for s in spans if s[1] == TID_PHASE]
     comm_spans = [s for s in spans if s[1] == TID_COMM]

@@ -21,6 +21,34 @@ from contextlib import contextmanager
 import jax
 import numpy as np
 
+#: phase-boundary observers (``hook(name, "begin"|"end")``): the memory
+#: watcher (instrument/memwatch.py) snapshots HBM watermarks here so
+#: every PhaseTimer phase gets per-phase memory deltas without the
+#: drivers threading anything. Empty-list check only when unarmed; hooks
+#: fire OUTSIDE the timed window (before the start read, after the end
+#: read) so observer cost is never charged to the phase.
+_PHASE_HOOKS: list = []
+
+
+def add_phase_hook(hook) -> None:
+    if hook not in _PHASE_HOOKS:
+        _PHASE_HOOKS.append(hook)
+
+
+def remove_phase_hook(hook) -> None:
+    try:
+        _PHASE_HOOKS.remove(hook)
+    except ValueError:
+        pass
+
+
+def _fire_phase_hooks(name: str, event: str) -> None:
+    for hook in list(_PHASE_HOOKS):
+        try:
+            hook(name, event)
+        except Exception:
+            pass  # observers must never fail the measured phase
+
 
 @functools.lru_cache(maxsize=None)
 def _use_hard_sync() -> bool:
@@ -194,11 +222,15 @@ class PhaseTimer:
         them via :func:`block` inside the body before exit."""
         if sync is not None:
             block(sync)
+        if _PHASE_HOOKS:
+            _fire_phase_hooks(name, "begin")
         t0_wall = time.time()
         t0 = time.perf_counter()
         yield
         t1 = time.perf_counter()
         dt = t1 - t0
+        if _PHASE_HOOKS:
+            _fire_phase_hooks(name, "end")
         self.t_starts.setdefault(name, t0_wall)
         # wall end anchored to the monotonic duration (NTP-step-proof)
         self.t_ends[name] = t0_wall + dt

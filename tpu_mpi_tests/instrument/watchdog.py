@@ -30,6 +30,9 @@ from tpu_mpi_tests.instrument import telemetry as _telemetry
 #: how many flight-recorder events a watchdog fire dumps
 DUMP_EVENTS = 16
 
+#: how many live-array census buckets a watchdog fire dumps
+MEM_DUMP_TOP_K = 8
+
 
 def note_comm_op(desc: str) -> None:
     """Record a *dispatched* communication op in the flight recorder.
@@ -54,6 +57,24 @@ def last_comm_op() -> str | None:
 def comm_op_history(n: int = DUMP_EVENTS) -> list[str]:
     """The last ``n`` recorded comm events (oldest first), formatted."""
     return _telemetry.flight_lines(n)
+
+
+def memory_state_lines(top_k: int = MEM_DUMP_TOP_K) -> list[str]:
+    """Formatted memory state for a fire dump: per-device
+    ``memory_stats`` watermarks plus the top-``top_k`` live-array
+    shape·dtype buckets (instrument/memwatch.py). Also mirrors the
+    state into the JSONL sink as a ``kind: "mem"`` record
+    (``event: "watchdog"``) when telemetry is enabled. Never raises —
+    this runs on the watchdog's timer thread mid-hang, where a
+    diagnostic failure must not mask the hang itself."""
+    try:
+        from tpu_mpi_tests.instrument import memwatch
+
+        _telemetry.emit(memwatch.mem_record(event="watchdog",
+                                            top_k=top_k))
+        return memwatch.watermark_lines(top_k)
+    except Exception:
+        return []
 
 
 class Watchdog:
@@ -90,10 +111,22 @@ class Watchdog:
             )
         else:
             attribution = ""
+        # memory state at fire: per-device watermarks + top live-array
+        # census — a hang from an OOM-retrying allocator and a wedged
+        # collective look identical without this. Best-effort from this
+        # timer thread (allocator stats are local queries; the census
+        # reads a host-side registry — neither blocks on device queues),
+        # and also emitted as a ``kind: "mem"`` record so the timeline
+        # carries the memory state at the fire point.
+        mem_lines = memory_state_lines()
+        memory = (
+            f" memory at fire:\n    " + "\n    ".join(mem_lines) + "\n "
+            if mem_lines else ""
+        )
         msg = (
             f"WATCHDOG: phase '{self.phase}' exceeded {self.seconds}s — "
             f"likely a hung collective (dead peer / mismatched mesh / "
-            f"wedged RDMA semaphore);{attribution} "
+            f"wedged RDMA semaphore);{attribution}{memory} "
             f"aborting pid {os.getpid()}\n"
         )
         if self._on_timeout is not None:
