@@ -1,7 +1,7 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	lint ci clean
+	serve-smoke lint ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -104,6 +104,74 @@ mem-smoke:
 	grep -q '^COMPILE ' /tmp/_tpumt_mem_smoke.report.txt
 	@echo "mem-smoke report OK: MEMORY + COMPILE tables render"
 
+# serving-pipeline smoke: a 2-fake-device open-loop Poisson run (~5 s)
+# must (a) emit kind:"serve" JSONL with finite p50/p95/p99 per class,
+# (b) render a non-empty SLO table under tpumt-report, (c) place
+# serve:<class> request spans on the tpumt-trace timeline, and (d)
+# honor the --diff exit contract across two serve runs BOTH ways,
+# deterministically: the real run-vs-run diff must exit exactly as its
+# own output says (1 iff a REGRESSION line printed — a p99 from ~100
+# CPU requests is too tail-noisy to pin the direction in CI), and a
+# synthetically degraded copy of run 2 (10x latency, 1/10 throughput)
+# must always exit 1
+serve-smoke:
+	rm -f /tmp/_tpumt_serve_smoke*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 --duration 5 --arrival poisson --rate 30 \
+		--seed 7 --report-interval 1 --batch-deadline 120 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--telemetry --jsonl /tmp/_tpumt_serve_smoke.r1.jsonl \
+		--trace-out /tmp/_tpumt_serve_smoke.trace.json
+	python -c "import json, math; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_serve_smoke.r1.jsonl')]; \
+		sm = [r for r in recs if r.get('kind') == 'serve' \
+			and r.get('event') == 'summary']; \
+		assert len(sm) == 2, [r.get('class') for r in sm]; \
+		assert all(r['requests'] > 0 and \
+			math.isfinite(r['p50_ms']) and \
+			math.isfinite(r['p95_ms']) and \
+			math.isfinite(r['p99_ms']) for r in sm), sm; \
+		d = json.load(open('/tmp/_tpumt_serve_smoke.trace.json')); \
+		spans = [e for e in d['traceEvents'] if e['ph'] == 'X' \
+			and e['name'].startswith('serve:')]; \
+		assert spans, 'no serve request spans in trace'; \
+		print('serve-smoke records OK:', len(sm), 'classes,', \
+			len(spans), 'request spans')"
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_serve_smoke.r1.jsonl \
+		> /tmp/_tpumt_serve_smoke.report.txt
+	grep -q '^SLO daxpy:4096:float32: ' /tmp/_tpumt_serve_smoke.report.txt
+	grep -q '^SLO allreduce:1024:float32: ' \
+		/tmp/_tpumt_serve_smoke.report.txt
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 --duration 5 --arrival poisson --rate 30 \
+		--seed 7 --report-interval 1 --batch-deadline 120 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--jsonl /tmp/_tpumt_serve_smoke.r2.jsonl
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_serve_smoke.r1.jsonl \
+		/tmp/_tpumt_serve_smoke.r2.jsonl \
+		> /tmp/_tpumt_serve_smoke.diff.txt; rc=$$?; \
+	if grep -q ' REGRESSION' /tmp/_tpumt_serve_smoke.diff.txt; \
+		then test $$rc -eq 1; else test $$rc -eq 0; fi
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_serve_smoke.r2.jsonl')]; \
+		f = open('/tmp/_tpumt_serve_smoke.bad.jsonl', 'w'); \
+		[f.write(json.dumps({**r, **({k: r[k] * 10 for k in \
+			('p50_ms', 'p95_ms', 'p99_ms') if k in r}), \
+			**({'achieved_hz': r['achieved_hz'] / 10} \
+			if 'achieved_hz' in r else {})}) + chr(10)) \
+			for r in recs if r.get('kind') == 'serve']; \
+		f.close()"
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_serve_smoke.r1.jsonl \
+		/tmp/_tpumt_serve_smoke.bad.jsonl \
+		> /tmp/_tpumt_serve_smoke.baddiff.txt; test $$? -eq 1
+	grep -q ' REGRESSION' /tmp/_tpumt_serve_smoke.baddiff.txt
+	@echo "serve-smoke OK: SLO table + request spans + diff gate"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -115,8 +183,9 @@ lint:
 
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
-# observability smoke, and the lint self-clean gate
-ci: verify trace-smoke tune-smoke mem-smoke lint
+# observability smoke, the serving-pipeline smoke, and the lint
+# self-clean gate
+ci: verify trace-smoke tune-smoke mem-smoke serve-smoke lint
 
 clean:
 	$(MAKE) -C native clean

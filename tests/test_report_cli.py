@@ -476,6 +476,211 @@ def test_compile_table_skips_model_join_for_multi_shape_labels(
     assert "cost_models=2" in line and "model_gbps" not in line
 
 
+# ---------------------------------------------------------------------------
+# SLO table + serve percentile diff (kind:"serve" records, serving mode)
+# ---------------------------------------------------------------------------
+
+
+def _serve_records(p50, p95, p99, achieved=20.0, requests=100,
+                   errors=0, shed=0, qmax=3, rank=0, windows=3,
+                   jitter=0.02):
+    """One rank's serve stream for one class: `windows` window records
+    with a small percentile spread (the cross-window noise band) plus
+    the run summary."""
+    cls = "daxpy:4096:float32"
+    recs = []
+    for i in range(windows):
+        f = 1.0 + jitter * (i - windows // 2)
+        recs.append({
+            "kind": "serve", "event": "window", "class": cls,
+            "workload": "daxpy", "shape": [4096], "dtype": "float32",
+            "t_start": 10.0 + i, "t_end": 11.0 + i, "duration_s": 1.0,
+            "arrivals": requests // windows,
+            "requests": requests // windows, "errors": 0, "shed": 0,
+            "batches": requests // windows,
+            "offered_hz": achieved, "achieved_hz": achieved * f,
+            "p50_ms": p50 * f, "p95_ms": p95 * f, "p99_ms": p99 * f,
+            "queue_max": qmax - 1, "rank": rank,
+        })
+    recs.append({
+        "kind": "serve", "event": "summary", "class": cls,
+        "workload": "daxpy", "shape": [4096], "dtype": "float32",
+        "t_start": 10.0, "t_end": 10.0 + windows,
+        "duration_s": float(windows),
+        "arrivals": requests + errors + shed, "requests": requests,
+        "errors": errors, "shed": shed, "batches": requests,
+        "offered_hz": (requests + errors + shed) / windows,
+        "achieved_hz": achieved, "p50_ms": p50, "p95_ms": p95,
+        "p99_ms": p99, "mean_ms": p50, "queue_max": qmax, "rank": rank,
+    })
+    return recs
+
+
+def test_slo_table_summary_and_text(tmp_path, capsys):
+    """Golden SLO row from canned two-rank serve records: counts/rates
+    sum across ranks, percentiles take the worst rank's tail."""
+    _write_jsonl(tmp_path / "s.p0.jsonl", [
+        {"kind": "manifest", "process_index": 0, "process_count": 2},
+        *_serve_records(2.0, 4.0, 8.0, achieved=20.0, requests=100,
+                        errors=1, shed=2, qmax=3, rank=0),
+    ])
+    _write_jsonl(tmp_path / "s.p1.jsonl", [
+        {"kind": "manifest", "process_index": 1, "process_count": 2},
+        *_serve_records(2.5, 5.0, 10.0, achieved=18.0, requests=90,
+                        qmax=5, rank=1),
+    ])
+    files = aggregate.expand_rank_files([str(tmp_path / "s.jsonl")])
+    s = aggregate.summarize(files)
+    sv = s["serve"]["daxpy:4096:float32"]
+    assert sv["ranks"] == 2 and sv["windows"] == 6
+    assert sv["requests"] == 190 and sv["errors"] == 1
+    assert sv["shed"] == 2
+    assert sv["achieved_hz"] == pytest.approx(38.0)
+    # SLO = worst-rank tail, not the mean
+    assert sv["p50_ms"] == 2.5 and sv["p99_ms"] == 10.0
+    assert sv["queue_max"] == 5
+    # the band spans window AND rank spread — rank 1's slower tail
+    # widens it well past the per-rank ±2% jitter
+    assert sv["bands"]["p99_ms"] > 0.1
+
+    rc = aggregate.main([str(tmp_path / "s.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    (line,) = [ln for ln in out.splitlines() if ln.startswith("SLO ")]
+    assert line == (
+        "SLO daxpy:4096:float32: ranks=2 offered=64.33/s "
+        "achieved=38/s n=190 err=1 shed=2 p50=2.5ms p95=5ms "
+        "p99=10ms qmax=5 windows=6"
+    )
+
+
+def test_slo_table_synthesized_from_windows(tmp_path):
+    """A run that died before its summary still gets an SLO row from
+    the window records alone."""
+    recs = _serve_records(1.0, 2.0, 3.0, requests=90)[:-1]  # no summary
+    _write_jsonl(tmp_path / "w.jsonl", recs)
+    s = aggregate.summarize([str(tmp_path / "w.jsonl")])
+    sv = s["serve"]["daxpy:4096:float32"]
+    assert sv["requests"] == 90 and sv["ranks"] == 1
+    assert sv["achieved_hz"] == pytest.approx(30.0)
+    assert sv["p99_ms"] == pytest.approx(3.0 * 1.02)  # worst window
+    # single rank: the band is the pure cross-window jitter (±2%)
+    assert sv["bands"]["p99_ms"] == pytest.approx(0.02, rel=0.1)
+
+
+def test_slo_table_mixed_summary_and_crashed_rank(tmp_path):
+    """Per-rank synthesis: rank 0 finished (summary), rank 1 crashed
+    after windows only — rank 1's tail must still be in the row, not
+    silently dropped because a sibling finished cleanly."""
+    _write_jsonl(tmp_path / "m.p0.jsonl",
+                 _serve_records(2.0, 4.0, 8.0, requests=90, rank=0))
+    crashed = _serve_records(4.0, 8.0, 16.0, requests=90, rank=1)[:-1]
+    _write_jsonl(tmp_path / "m.p1.jsonl", crashed)
+    files = aggregate.expand_rank_files([str(tmp_path / "m.jsonl")])
+    sv = aggregate.summarize(files)["serve"]["daxpy:4096:float32"]
+    assert sv["ranks"] == 2
+    assert sv["requests"] == 180
+    # the crashed rank's worst window is the row's tail
+    assert sv["p99_ms"] == pytest.approx(16.0 * 1.02)
+
+
+def test_old_files_grow_no_slo_table(two_rank_run, capsys):
+    aggregate.main([str(two_rank_run / "run.jsonl")])
+    assert "SLO" not in capsys.readouterr().out
+
+
+def test_diff_serve_percentile_regression(tmp_path, capsys):
+    """A p99 regression beyond the cross-window band exits 1; the same
+    tail inside the band exits 0 — the serve SLO joins the bench diff's
+    exit contract."""
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    c = tmp_path / "c.jsonl"
+    _write_jsonl(a, _serve_records(2.0, 4.0, 8.0))
+    _write_jsonl(b, _serve_records(2.0, 4.0, 16.0))  # p99 2x: regression
+    _write_jsonl(c, _serve_records(2.0, 4.0, 8.2))  # inside 5% floor
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    (line,) = [ln for ln in out.splitlines()
+               if ln.startswith("DIFF serve:daxpy:4096:float32:p99_ms:")]
+    assert line.endswith("REGRESSION")
+    # achieved throughput compared too (higher-better, unchanged here)
+    assert any(
+        ln.startswith("DIFF serve:daxpy:4096:float32:achieved_hz:")
+        for ln in out.splitlines()
+    )
+    rc = aggregate.main(["--diff", str(a), str(c)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "DIFF OK within noise" in out
+
+
+def test_diff_serve_total_stall_flags(tmp_path, capsys):
+    """achieved_hz=0 (every batch errored) must still emit the metric:
+    a -100% throughput collapse is the regression the gate exists for,
+    not a present-on-one-side NOTE."""
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_jsonl(a, _serve_records(2.0, 4.0, 8.0, achieved=20.0))
+    dead = _serve_records(2.0, 4.0, 8.0, achieved=0.0, requests=0,
+                          errors=100, jitter=0.0)
+    for r in dead:  # a stalled run records no latencies
+        for k in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
+            r.pop(k, None)
+    _write_jsonl(b, dead)
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    (line,) = [
+        ln for ln in out.splitlines()
+        if ln.startswith("DIFF serve:daxpy:4096:float32:achieved_hz:")
+    ]
+    assert "-100.00%" in line and line.endswith("REGRESSION")
+
+
+def test_diff_serve_throughput_drop_flags(tmp_path, capsys):
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_jsonl(a, _serve_records(2.0, 4.0, 8.0, achieved=20.0))
+    _write_jsonl(b, _serve_records(2.0, 4.0, 8.0, achieved=10.0))
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    (line,) = [
+        ln for ln in out.splitlines()
+        if ln.startswith("DIFF serve:daxpy:4096:float32:achieved_hz:")
+    ]
+    assert line.endswith("REGRESSION")
+
+
+def test_slo_table_renders_without_jax(tmp_path):
+    """The SLO path must stay stdlib-only like every other table."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    _write_jsonl(tmp_path / "s.jsonl", _serve_records(2.0, 4.0, 8.0))
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.instrument import aggregate\n"
+        f"assert aggregate.main([{str(tmp_path / 's.jsonl')!r}]) == 0\n"
+        "print('NOJAX SLO OK')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=Path(__file__).resolve().parent.parent,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "NOJAX SLO OK" in r.stdout
+    assert "SLO daxpy:4096:float32:" in r.stdout
+
+
 def test_memory_census_only_note(tmp_path, capsys):
     """Census-only runs (CPU/fake devices) must say WHY there are no
     watermark numbers — live totals alone must not read as real HBM

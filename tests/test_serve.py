@@ -1,0 +1,546 @@
+"""Serving-mode harness (tpu_mpi_tests/serve/ + drivers/serve.py).
+
+The pure layers (arrival, histogram, batcher, loop orchestration) are
+tested jax-free with injected clocks/handlers — deterministic and fast;
+the end-to-end smoke drives the real tpumt-serve driver on the
+fake-device mesh.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+
+import pytest
+
+from tpu_mpi_tests.serve.arrival import ClosedLoop, OpenLoopPoisson
+from tpu_mpi_tests.serve.batcher import coalesce
+from tpu_mpi_tests.serve.histogram import LatencyHistogram
+from tpu_mpi_tests.serve.loop import Request, ServeLoop
+from tpu_mpi_tests.serve.workloads import (
+    WorkloadClass,
+    WorkloadMix,
+    parse_workload_table,
+)
+
+# ---------------------------------------------------------------------------
+# arrival processes
+# ---------------------------------------------------------------------------
+
+
+def _drain(proc, until, step=0.1):
+    out, t = [], 0.0
+    while t <= until:
+        out.extend(proc.take_due(t))
+        t += step
+    return out
+
+
+def test_poisson_deterministic_under_seed():
+    a = OpenLoopPoisson(100.0, seed=7)
+    b = OpenLoopPoisson(100.0, seed=7)
+    a.start(0.0)
+    b.start(0.0)
+    ta = _drain(a, 1.0)
+    tb = _drain(b, 1.0)
+    assert ta == tb and len(ta) > 50
+    # a different seed gives a different schedule
+    c = OpenLoopPoisson(100.0, seed=8)
+    c.start(0.0)
+    assert _drain(c, 1.0) != ta
+
+
+def test_poisson_rate_and_limit():
+    p = OpenLoopPoisson(1000.0, seed=3)
+    p.start(0.0)
+    due = p.take_due(10.0, limit=1.0)
+    # ~1000 arrivals in the 1 s window (Poisson: ±4 sigma is ±~130)
+    assert 800 < len(due) < 1200
+    assert all(t <= 1.0 for t in due)
+    # nothing past the limit ever materializes
+    assert p.take_due(10.0, limit=1.0) == []
+    assert p.next_event() is not None and p.next_event() > 1.0
+
+
+def test_poisson_rejects_bad_rate():
+    with pytest.raises(ValueError):
+        OpenLoopPoisson(0.0)
+
+
+def test_closed_loop_population():
+    c = ClosedLoop(4)
+    c.start(5.0)
+    assert c.take_due(5.0) == [5.0] * 4
+    assert c.take_due(6.0) == []
+    assert c.next_event() is None
+    c.on_complete(2, 7.0)
+    assert c.next_event() == 7.0
+    assert c.take_due(7.0) == [7.0, 7.0]
+    # refills scheduled past the limit stay pending (the drain stops)
+    c.on_complete(1, 9.0)
+    assert c.take_due(10.0, limit=8.0) == []
+
+
+# ---------------------------------------------------------------------------
+# workload table
+# ---------------------------------------------------------------------------
+
+
+def test_parse_workload_table_full_and_defaults():
+    classes = parse_workload_table(
+        "daxpy:4096:float32:2,attn:256x64:bfloat16:0.5,halo"
+    )
+    assert [c.key for c in classes] == [
+        "daxpy:4096:float32", "attn:256x64:bfloat16",
+        "halo:65536:float32",
+    ]
+    assert classes[0].weight == 2 and classes[1].shape == (256, 64)
+    assert classes[2].weight == 1.0  # defaults applied
+
+
+@pytest.mark.parametrize("bad", [
+    "nosuch:128", "daxpy:0", "daxpy:128:int8", "daxpy:128:float32:0",
+    "daxpy:128:float32:1:extra", "daxpy:12x", "",
+    "daxpy:128,daxpy:128",  # duplicate class
+])
+def test_parse_workload_table_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_workload_table(bad)
+
+
+def test_mix_draw_deterministic_and_weighted():
+    classes = parse_workload_table("daxpy:128:float32:9,halo:256:float32:1")
+    a = [WorkloadMix(classes, seed=5).draw().key for _ in range(1)]
+    b = [WorkloadMix(classes, seed=5).draw().key for _ in range(1)]
+    assert a == b
+    mix = WorkloadMix(classes, seed=5)
+    draws = [mix.draw().workload for _ in range(2000)]
+    frac = draws.count("daxpy") / len(draws)
+    assert 0.85 < frac < 0.95  # 9:1 weighting
+
+
+# ---------------------------------------------------------------------------
+# histogram: bounded memory + percentile correctness
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_percentiles_vs_sorted_reference():
+    rng = random.Random(11)
+    h = LatencyHistogram()
+    samples = [rng.lognormvariate(-6.0, 1.0) for _ in range(5000)]
+    for s in samples:
+        h.record(s)
+    ref = sorted(samples)
+    for q in (50.0, 95.0, 99.0):
+        want = ref[max(0, math.ceil(q / 100 * len(ref)) - 1)]
+        got = h.percentile(q)
+        # log-bucket resolution: within one bucket width (~10%)
+        assert abs(got - want) / want < 0.11, (q, got, want)
+    assert h.min_s == min(samples) and h.max_s == max(samples)
+    assert h.mean() == pytest.approx(sum(samples) / len(samples))
+
+
+def test_histogram_memory_independent_of_sample_count():
+    small, large = LatencyHistogram(), LatencyHistogram()
+    rng = random.Random(2)
+    for _ in range(10):
+        small.record(rng.random())
+    for _ in range(100000):
+        large.record(rng.random())
+    # the bounded-memory contract: identical footprint either way
+    assert len(small.counts) == len(large.counts)
+    assert large.count == 100000
+
+
+def test_histogram_edges():
+    h = LatencyHistogram()
+    assert h.percentile(50) is None and h.percentiles_ms() == {}
+    h.record(0.0)  # below MIN_LATENCY_S -> underflow, reads back as min
+    assert h.percentile(50) == 0.0
+    h2 = LatencyHistogram()
+    h2.record(float("nan"))
+    h2.record(-1.0)
+    assert h2.count == 0  # invalid latencies never land
+
+
+# ---------------------------------------------------------------------------
+# batcher: class-compatible coalescing only
+# ---------------------------------------------------------------------------
+
+
+def _req(key_cls, t=0.0):
+    return Request(key_cls, t)
+
+
+def test_coalesce_never_crosses_class():
+    a = WorkloadClass("daxpy", (128,), "float32")
+    a16 = WorkloadClass("daxpy", (128,), "bfloat16")
+    b = WorkloadClass("daxpy", (256,), "float32")
+    queue = [_req(a), _req(a16), _req(b), _req(a), _req(b)]
+    batch, rest = coalesce(queue, max_batch=8)
+    assert [r.cls.key for r in batch] == [a.key, a.key]
+    # dtype and shape siblings stay queued, order preserved
+    assert [r.cls.key for r in rest] == [a16.key, b.key, b.key]
+
+
+def test_coalesce_caps_and_fifo_head():
+    a = WorkloadClass("daxpy", (128,), "float32")
+    b = WorkloadClass("halo", (256,), "float32")
+    queue = [_req(b)] + [_req(a) for _ in range(10)]
+    batch, rest = coalesce(queue, max_batch=4)
+    # head of queue picks the class even if a bigger batch exists behind
+    assert [r.cls.key for r in batch] == [b.key]
+    batch2, rest2 = coalesce(rest, max_batch=4)
+    assert len(batch2) == 4 and all(r.cls.key == a.key for r in batch2)
+    assert len(rest2) == 6
+    assert coalesce([], 4) == ([], [])
+
+
+# ---------------------------------------------------------------------------
+# loop orchestration under a fake clock (jax-free)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def _run_loop(rate, duration, service_s=0.001, window_s=2.0, seed=0,
+              max_batch=8, classes=None, watchdog=None):
+    clk = FakeClock()
+    classes = classes or parse_workload_table(
+        "daxpy:128:float32:3,allreduce:64:float32:1"
+    )
+    records = []
+
+    def handler(n):
+        clk.t += service_s * n
+
+    loop = ServeLoop(
+        classes, {c.key: handler for c in classes},
+        OpenLoopPoisson(rate, seed=seed),
+        duration_s=duration, max_batch=max_batch, window_s=window_s,
+        seed=seed, sink=records.append, watchdog=watchdog,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    summaries = loop.run()
+    return records, summaries
+
+
+def test_loop_record_count_independent_of_request_count():
+    """Bounded-memory acceptance: 10x the traffic must NOT mean 10x the
+    records — emission is per (class, window), never per request."""
+    rec_lo, sum_lo = _run_loop(rate=20.0, duration=10.0)
+    rec_hi, sum_hi = _run_loop(rate=200.0, duration=10.0)
+    n_lo = sum(r["requests"] for r in sum_lo)
+    n_hi = sum(r["requests"] for r in sum_hi)
+    assert n_hi > 5 * n_lo  # the traffic really did scale
+    assert len(rec_hi) == len(rec_lo)  # the record stream did not
+
+
+def test_loop_summary_accounting_and_percentiles():
+    records, summaries = _run_loop(rate=50.0, duration=10.0)
+    assert {r["event"] for r in records} == {"window", "summary"}
+    for s in summaries:
+        assert s["kind"] == "serve" and s["event"] == "summary"
+        assert s["requests"] == s["arrivals"]  # everything served
+        assert s["errors"] == 0 and s["shed"] == 0
+        assert s["achieved_hz"] == pytest.approx(
+            s["requests"] / s["duration_s"])
+        if s["requests"]:
+            assert math.isfinite(s["p50_ms"])
+            assert s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"]
+    # windows carry the wall clock (PR-2 placement contract)
+    w = [r for r in records if r["event"] == "window"][0]
+    assert w["t_end"] > w["t_start"]
+
+
+def test_loop_deterministic_under_seed():
+    _, a = _run_loop(rate=50.0, duration=10.0, seed=9)
+    _, b = _run_loop(rate=50.0, duration=10.0, seed=9)
+    sa = {r["class"]: (r["requests"], r["batches"]) for r in a}
+    sb = {r["class"]: (r["requests"], r["batches"]) for r in b}
+    assert sa == sb
+
+
+def test_loop_handler_errors_counted_not_fatal():
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+    records = []
+
+    def bad(n):
+        clk.t += 0.001
+        raise RuntimeError("device fell over")
+
+    loop = ServeLoop(
+        classes, {classes[0].key: bad},
+        OpenLoopPoisson(50.0, seed=0),
+        duration_s=5.0, window_s=2.0, sink=records.append,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    assert summary["errors"] > 0 and summary["requests"] == 0
+    assert "p50_ms" not in summary  # absent fields, never fake zeros
+
+
+def test_loop_closed_persistent_failure_backs_off():
+    """A dead handler under closed-loop arrivals must not busy-spin:
+    the post-failure backoff bounds the error-batch rate (and, under
+    an injected clock, is what keeps time advancing at all)."""
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+
+    def dead(n):
+        raise RuntimeError("mesh lost")  # fails without consuming time
+
+    loop = ServeLoop(
+        classes, {classes[0].key: dead}, ClosedLoop(4),
+        duration_s=5.0, window_s=10.0,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    assert summary["errors"] > 0 and summary["requests"] == 0
+    # ~duration / FAIL_BACKOFF_S batches, not millions
+    assert summary["batches"] <= 5.0 / 0.05 + 5
+
+
+def test_loop_sheds_beyond_max_queue():
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+
+    def slow(n):
+        clk.t += 1.0  # 1 s per batch vs 100 req/s offered
+
+    loop = ServeLoop(
+        classes, {classes[0].key: slow},
+        OpenLoopPoisson(100.0, seed=0),
+        duration_s=5.0, window_s=10.0, max_queue=20, max_batch=1,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    assert summary["shed"] > 0
+    assert summary["queue_max"] <= 20
+
+
+def test_loop_saturation_visible_in_summary():
+    """A saturated-but-not-shedding run must still read as saturated:
+    offered is the rate over the TRAFFIC window, not diluted by the
+    post-deadline drain, so offered >> achieved and the drain length
+    is first-class in the record."""
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+
+    def slow(n):
+        clk.t += 0.05 * n  # sustains ~20/s vs 100/s offered
+
+    loop = ServeLoop(
+        classes, {classes[0].key: slow},
+        OpenLoopPoisson(100.0, seed=0),
+        duration_s=5.0, window_s=100.0, max_batch=1,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (s,) = loop.run()
+    assert s["requests"] == s["arrivals"]  # nothing shed or errored
+    assert s["offered_hz"] == pytest.approx(s["arrivals"] / 5.0)
+    assert s["achieved_hz"] < 0.3 * s["offered_hz"]
+    assert s["drain_s"] > 10.0  # the backlog took longer than the run
+
+
+def test_loop_closed_arrival_tracks_concurrency():
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+
+    def handler(n):
+        clk.t += 0.01 * n
+
+    loop = ServeLoop(
+        classes, {classes[0].key: handler}, ClosedLoop(3),
+        duration_s=10.0, window_s=5.0, max_batch=8,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    # 3 clients, 10 ms service, batched: ~100 batch rounds x 3
+    assert summary["requests"] > 100
+    assert summary["queue_max"] <= 3
+
+
+def test_loop_requires_handler_per_class():
+    classes = parse_workload_table("daxpy:128:float32,halo:256:float32")
+    with pytest.raises(ValueError):
+        ServeLoop(classes, {}, OpenLoopPoisson(1.0), duration_s=1.0)
+
+
+def test_loop_arms_watchdog_only_around_dispatch():
+    """The serve loop drives the idle-aware arm/disarm API: armed once
+    per batch, always disarmed afterwards (idle gaps uncovered)."""
+    events = []
+
+    class SpyWatchdog:
+        def arm(self, phase=None):
+            events.append(("arm", phase))
+
+        def disarm(self):
+            events.append(("disarm", None))
+
+    records, summaries = _run_loop(rate=20.0, duration=5.0,
+                                   watchdog=SpyWatchdog())
+    batches = sum(s["batches"] for s in summaries)
+    arms = [e for e in events if e[0] == "arm"]
+    assert len(arms) == batches > 0
+    assert len(events) == 2 * batches
+    # strict alternation: never armed across an idle wait
+    for i, (what, _) in enumerate(events):
+        assert what == ("arm" if i % 2 == 0 else "disarm")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end smoke on the fake-device mesh (2+ devices, real handlers)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def serve_env(tmp_path, monkeypatch):
+    # isolate the schedule cache; keep the run off any warmed state
+    monkeypatch.setenv("TPU_MPI_TUNE_CACHE",
+                       str(tmp_path / "tune.json"))
+    from tpu_mpi_tests.tune import registry as tr
+
+    yield tmp_path
+    tr.deconfigure()
+
+
+def test_serve_driver_end_to_end(serve_env, capsys):
+    """tpumt-serve on the fake-device mesh: rc 0, SERVE lines, serve
+    records with finite percentiles, SLO table renders from the JSONL."""
+    from tpu_mpi_tests.drivers import serve as drv
+    from tpu_mpi_tests.instrument import aggregate
+
+    jl = serve_env / "serve.jsonl"
+    rc = drv.main([
+        "--duration", "1.5", "--arrival", "poisson", "--rate", "40",
+        "--seed", "3", "--report-interval", "0.5",
+        "--workloads", "daxpy:4096:float32:3,allreduce:512:float32:1",
+        "--max-batch", "4", "--batch-deadline", "120",
+        "--jsonl", str(jl),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "SERVE daxpy:4096:float32:" in out
+    assert "SERVE allreduce:512:float32:" in out
+
+    recs = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    assert recs[0]["kind"] == "manifest"  # self-describing result file
+    serves = [r for r in recs if r.get("kind") == "serve"]
+    summaries = [r for r in serves if r["event"] == "summary"]
+    assert {r["class"] for r in summaries} == {
+        "daxpy:4096:float32", "allreduce:512:float32"
+    }
+    for r in summaries:
+        assert r["requests"] > 0 and math.isfinite(r["p50_ms"])
+        assert r["t_end"] > r["t_start"]
+
+    rc = aggregate.main([str(jl)])
+    rep = capsys.readouterr().out
+    assert rc == 0
+    assert any(ln.startswith("SLO daxpy:4096:float32:")
+               for ln in rep.splitlines())
+
+
+def test_serve_driver_rejects_bad_table(serve_env, capsys):
+    from tpu_mpi_tests.drivers import serve as drv
+
+    rc = drv.main(["--duration", "1", "--workloads", "nosuch:128"])
+    out = capsys.readouterr().out
+    assert rc == 2 and "ERROR" in out and "unknown workload" in out
+
+
+@pytest.mark.slow
+def test_serve_driver_closed_loop_all_handlers(serve_env, capsys):
+    """All four registered handler families under closed-loop load on
+    the 8-fake-device mesh (slow: attn/halo compile)."""
+    from tpu_mpi_tests.drivers import serve as drv
+
+    rc = drv.main([
+        "--duration", "2", "--arrival", "closed", "--concurrency", "3",
+        "--seed", "1", "--report-interval", "1",
+        "--workloads",
+        "daxpy:4096:float32,halo:65536:float32,attn:128x32:float32,"
+        "allreduce:512:float32",
+        "--jsonl", str(serve_env / "closed.jsonl"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert out.count("SERVE ") >= 4
+
+
+def test_serve_main_promotes_x64_for_float64_classes():
+    """A float64 workload class must arm the x64 software path (else
+    jnp silently truncates to f32 and every SLO row mislabels what
+    ran); malformed specs defer to run()'s ERROR reporting."""
+    from tpu_mpi_tests.drivers.serve import _table_wants_x64
+
+    assert _table_wants_x64("daxpy:256:float64")
+    assert _table_wants_x64("daxpy:256:float32,halo:512:float64:2")
+    assert not _table_wants_x64("daxpy:256:float32")
+    assert not _table_wants_x64("definitely::malformed::")
+
+
+def test_serve_main_rejects_closed_concurrency_over_queue(capsys):
+    """Shed closed-loop clients are never re-armed, so a population
+    larger than the queue bound would silently decay — rejected."""
+    from tpu_mpi_tests.drivers import serve as drv
+
+    with pytest.raises(SystemExit):
+        drv.main(["--arrival", "closed", "--concurrency", "50",
+                  "--max-queue", "10"])
+    assert "--max-queue" in capsys.readouterr().err
+
+
+@pytest.mark.parametrize("argv", [
+    ["--batch-deadline", "-1"],  # negative Timer fires instantly
+    ["--batch-deadline", "0"],
+    ["--max-queue", "0"],
+])
+def test_serve_main_rejects_degenerate_flags(argv, capsys):
+    from tpu_mpi_tests.drivers import serve as drv
+
+    with pytest.raises(SystemExit):
+        drv.main(["--duration", "1"] + argv)
+    assert "must be" in capsys.readouterr().err
+
+
+def test_halo_handler_recovers_after_failed_batch(mesh8, monkeypatch):
+    """Donated-state contract: a batch that fails mid-flight must not
+    poison the class — the handler rebuilds its (possibly consumed)
+    buffers and the next batch serves normally."""
+    from tpu_mpi_tests.comm import halo as H
+    from tpu_mpi_tests.drivers import _common
+
+    step = _common.workload_factory("halo")(mesh8, (4096,), "float32")
+    step(2)  # healthy baseline
+
+    def flaky(*a, **kw):
+        raise RuntimeError("transient device error")
+
+    monkeypatch.setattr(H, "halo_exchange", flaky)
+    with pytest.raises(RuntimeError):
+        step(2)
+    monkeypatch.undo()
+    step(2)  # must serve again, not fail buffer-deleted forever
+
+
+def test_workload_registry_names():
+    from tpu_mpi_tests.drivers import _common
+
+    names = _common.workload_names()
+    assert {"daxpy", "halo", "attn", "allreduce"} <= set(names)
+    with pytest.raises(KeyError):
+        _common.workload_factory("nosuch")

@@ -124,6 +124,63 @@ def test_watchdog_cancel_prevents_firing():
     assert not fired.is_set()
 
 
+def test_idle_watchdog_idle_gap_does_not_fire():
+    """Serve-mode contract: an idle gap LONGER than the deadline must
+    not dump — the deadline clock only runs while armed (open-loop
+    Poisson gaps between arrivals are legitimate idleness)."""
+    from tpu_mpi_tests.instrument.watchdog import IdleAwareWatchdog
+
+    fired = threading.Event()
+    wd = IdleAwareWatchdog(
+        0.05, "serve", _on_timeout=lambda m: fired.set()
+    )
+    # armed + disarmed around a fast batch, then idle 3x the deadline
+    wd.arm("serve:daxpy")
+    wd.disarm()
+    time.sleep(0.15)
+    assert not fired.is_set()
+    # re-arm/disarm cycles across idle gaps stay quiet too
+    for _ in range(3):
+        wd.arm()
+        wd.disarm()
+        time.sleep(0.06)
+    assert not fired.is_set()
+
+
+def test_idle_watchdog_wedged_batch_still_fires():
+    """Armed and never disarmed (a genuinely hung batch) fires with the
+    armed phase in the diagnosis."""
+    from tpu_mpi_tests.instrument.watchdog import IdleAwareWatchdog
+
+    fired = threading.Event()
+    msgs = []
+
+    def on_timeout(msg):
+        msgs.append(msg)
+        fired.set()
+
+    wd = IdleAwareWatchdog(0.05, "serve", _on_timeout=on_timeout)
+    wd.arm("serve:allreduce:512:float32")
+    assert fired.wait(timeout=5.0)
+    wd.disarm()
+    assert "serve:allreduce:512:float32" in msgs[0]
+
+
+def test_idle_watchdog_arm_resets_deadline():
+    """Each arm() restarts the clock: N short batches each under the
+    deadline never fire even though they sum past it."""
+    from tpu_mpi_tests.instrument.watchdog import IdleAwareWatchdog
+
+    fired = threading.Event()
+    wd = IdleAwareWatchdog(
+        0.08, "serve", _on_timeout=lambda m: fired.set()
+    )
+    for _ in range(4):
+        with wd.active("serve:daxpy"):
+            time.sleep(0.04)  # half the deadline, 2x total
+    assert not fired.is_set()
+
+
 def test_watchdog_dumps_memory_state(monkeypatch):
     """The fire dump carries the memory axis: live-array census buckets
     (census-only on CPU — memory_stats is absent there) alongside the

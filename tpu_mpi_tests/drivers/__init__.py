@@ -9,6 +9,7 @@
 | stencil2d           | mpi_stencil2d_gt.cc (flagship stencil) + *_sycl variants |
 | gather_inplace      | mpigatherinplace.f90    |
 | envprobe            | mpienv.f90              |
+| serve               | — (beyond parity: steady-state serving loop) |
 
 All drivers run unchanged on the fake-device CPU mesh (``--fake-devices N``)
 and on real TPU slices; the same shard_map code path executes in both.

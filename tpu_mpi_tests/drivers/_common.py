@@ -9,6 +9,60 @@ from __future__ import annotations
 
 import argparse
 import os
+from typing import Callable
+
+# ---------------------------------------------------------------------------
+# Workload-handler registry (serve mode; first slice of the ROADMAP-4
+# driver-boilerplate factor-out)
+# ---------------------------------------------------------------------------
+
+#: name -> factory ``(mesh, shape, dtype) -> step_fn``; ``step_fn(n)``
+#: executes ``n`` coalesced requests against persistent state and returns
+#: only after device completion (it blocks), so serve-mode latency reads
+#: are sync-honest by contract, not by caller discipline
+_WORKLOAD_FACTORIES: dict[str, Callable] = {}
+
+
+def register_workload(name: str, factory: Callable) -> Callable:
+    """Register a serve-mode workload handler under ``name``.
+
+    Drivers register the step their benchmark already exercises (daxpy
+    step, stencil1d halo step, attnbench ring block, collbench small
+    allreduce) at import time, so serve mode dispatches them
+    declaratively instead of copying driver bodies. Idempotent per name
+    (test runners re-import driver modules); returns the factory so it
+    can be used as a decorator."""
+    _WORKLOAD_FACTORIES.setdefault(name, factory)
+    return factory
+
+
+def workload_names() -> tuple[str, ...]:
+    _import_workload_owners()
+    return tuple(sorted(_WORKLOAD_FACTORIES))
+
+
+def workload_factory(name: str) -> Callable:
+    """The registered factory for ``name``. Imports the owning driver
+    modules on demand (like ``tune.registry._import_knob_owners``) so
+    lookups never depend on who imported what first."""
+    _import_workload_owners()
+    try:
+        return _WORKLOAD_FACTORIES[name]
+    except KeyError:
+        raise KeyError(
+            f"no workload handler {name!r}; registered: "
+            f"{','.join(sorted(_WORKLOAD_FACTORIES))}"
+        ) from None
+
+
+def _import_workload_owners() -> None:
+    """Import every driver module that registers a handler. Lazy so the
+    registry stays importable without jax (driver modules only import
+    jax inside their run/factory bodies)."""
+    import tpu_mpi_tests.drivers.attnbench  # noqa: F401
+    import tpu_mpi_tests.drivers.collbench  # noqa: F401
+    import tpu_mpi_tests.drivers.daxpy  # noqa: F401
+    import tpu_mpi_tests.drivers.stencil1d  # noqa: F401
 
 
 def base_parser(description: str) -> argparse.ArgumentParser:

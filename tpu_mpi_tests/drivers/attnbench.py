@@ -245,6 +245,51 @@ def run(args) -> int:
         return rc
 
 
+def _serve_step_factory(mesh, shape, dtype):
+    """Serve-mode handler: ``step_fn(n)`` runs ``n`` ring-attention
+    blocks (sequence sharded over the mesh axis — the driver's ``ring``
+    tier, XLA local blocks) with the output fed back as the next query.
+    Batched as ``n`` dispatches of the persistent jitted step with one
+    sync at the end — wrapping the shard_map ring in an *outer* jitted
+    ``fori_loop`` trips the jax-0.4.x PartitionId SPMD limitation the
+    attnbench ring tier already documents on CPU meshes. Shape is
+    ``(L, head_dim)`` with L divisible by the mesh world."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.comm.ring import ring_attention_fn
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.utils import check_divisible
+
+    if len(shape) != 2:
+        raise ValueError(f"attn wants an (L, head_dim) shape, got {shape}")
+    L, d = shape
+    world = mesh.devices.size
+    check_divisible(L, world, "sequence over mesh axis")
+    axis_name = mesh.axis_names[0]
+    dt = jnp.dtype(dtype)
+    attn = ring_attention_fn(mesh, axis_name, causal=False, flash=False)
+    key = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(kk, (L, d), dt)
+        for kk in jax.random.split(key, 3)
+    )
+    state = {"s": tuple(shard_1d(t, mesh) for t in (q, k, v))}
+
+    def step(n: int):
+        qq, kk, vv = state["s"]
+        for _ in range(n):
+            qq = attn(qq, kk, vv)
+        state["s"] = block((qq, kk, vv))
+
+    step(1)  # compile + warm before traffic opens
+    return step
+
+
+_common.register_workload("attn", _serve_step_factory)
+
+
 def main(argv=None) -> int:
     p = _common.base_parser(__doc__)
     p.add_argument("--seq-len", type=int, default=8192)

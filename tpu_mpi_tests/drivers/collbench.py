@@ -340,6 +340,49 @@ def run(args) -> int:
         return 0
 
 
+def _serve_step_factory(mesh, shape, dtype):
+    """Serve-mode handler: ``step_fn(n)`` runs ``n`` device-chained
+    small-payload allreduces (the decode-step collective class: fixed
+    per-op cost dominates, which is exactly what tail latency under
+    mixed traffic stresses). ``shape`` is elements *per shard*; reuses
+    the benchmark's own chained loop (:func:`_loop_fn`) so serve mode
+    measures the same program ``COLL allreduce`` rows do."""
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.comm.collectives import shard_1d
+    from tpu_mpi_tests.instrument.timers import block
+
+    if len(shape) != 1:
+        raise ValueError(f"allreduce wants a 1-d shape, got {shape}")
+    (n,) = shape
+    world = mesh.devices.size
+    axis_name = mesh.axis_names[0]
+    dt = jnp.dtype(dtype)
+    run_fn = _loop_fn(mesh, axis_name, "allreduce", world)
+
+    def init():
+        return shard_1d(jnp.ones((n * world,), dt), mesh, axis_name)
+
+    state = {"x": init()}
+
+    def step(k: int):
+        try:
+            state["x"] = block(run_fn(state["x"], k))
+        except Exception:
+            # run_fn donates its input: a failed batch may have
+            # consumed the held buffer — rebuild so the NEXT batch of
+            # this class serves instead of failing buffer-deleted
+            # forever (the loop counts this batch's error either way)
+            state["x"] = init()
+            raise
+
+    step(1)  # compile + warm before traffic opens
+    return step
+
+
+_common.register_workload("allreduce", _serve_step_factory)
+
+
 def main(argv=None) -> int:
     p = _common.base_parser(__doc__)
     p.add_argument(

@@ -94,6 +94,43 @@ def run(args) -> int:
         return 0
 
 
+def _serve_step_factory(mesh, shape, dtype):
+    """Serve-mode handler (``drivers/_common.py`` workload registry):
+    ``step_fn(n)`` runs ``n`` device-chained DAXPY steps against
+    persistent buffers. The recurrence ``y ← a·x + y/2`` keeps the
+    iterate bounded (fixed point 2·a·x) so an hours-long serve run can
+    never overflow the state the way the raw accumulating kernel would.
+    ``mesh`` is unused — DAXPY is the single-device workload class."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_mpi_tests.instrument.timers import block
+
+    if len(shape) != 1:
+        raise ValueError(f"daxpy wants a 1-d shape, got {shape}")
+    (n,) = shape
+    dt = jnp.dtype(dtype)
+    x = jnp.arange(1, n + 1, dtype=dt)
+    a = jnp.asarray(2.0, dt)
+    half = jnp.asarray(0.5, dt)
+
+    @jax.jit
+    def run(y, k):
+        return lax.fori_loop(0, k, lambda _, yy: a * x + yy * half, y)
+
+    state = {"y": jnp.zeros((n,), dt)}
+
+    def step(k: int):
+        state["y"] = block(run(state["y"], k))
+
+    step(1)  # compile + warm before traffic opens
+    return step
+
+
+_common.register_workload("daxpy", _serve_step_factory)
+
+
 def main(argv=None) -> int:
     p = _common.base_parser(__doc__)
     p.add_argument("--n", type=int, default=1024, help="vector length")

@@ -1,0 +1,252 @@
+"""Serving-mode harness: steady-state traffic against a persistent mesh.
+
+Every other driver is a one-shot benchmark; this one is the ROADMAP
+north star's missing regime — a long-running service loop (``serve/``)
+that keeps one mesh and the warmed compile/tune caches alive, generates
+requests via a configurable arrival process (open-loop Poisson or
+closed-loop at a target concurrency), draws each request from a mixed
+workload table (``--workloads``: daxpy step, stencil1d halo step,
+ring-attention block, small-payload allreduce — the registered handlers
+of ``drivers/_common.py``), coalesces compatible requests into batches,
+and records per-request latency into bounded-memory histograms.
+
+Output per workload class (stable line + ``kind: "serve"`` JSONL)::
+
+    SERVE <class>: offered=<hz>/s achieved=<hz>/s n=<done> err=<e> \
+shed=<s> p50=<ms>ms p95=<ms>ms p99=<ms>ms qmax=<depth>
+
+``tpumt-report`` renders the merged records as the SLO table and
+``tpumt-report --diff`` gates the percentiles against the cross-window
+noise band; with ``--telemetry --trace-out`` every batch appears as a
+``serve:<class>`` request span on the Perfetto timeline. Pair long runs
+with ``--memwatch`` to watch HBM over hours (README "Serving mode").
+
+Single-process only (fake-device meshes included): mixed-traffic batch
+composition depends on real-time arrival/service interleaving, which
+would diverge across ranks and deadlock collectives — the rank-
+coordinated variant is ROADMAP work, like the tune sweeps before it.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_mpi_tests.drivers import _common
+
+
+def run(args) -> int:
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.instrument.watchdog import IdleAwareWatchdog
+    from tpu_mpi_tests.serve.arrival import ClosedLoop, OpenLoopPoisson
+    from tpu_mpi_tests.serve.loop import ServeLoop
+    from tpu_mpi_tests.serve.workloads import parse_workload_table
+    from tpu_mpi_tests.tune import registry as tr
+    from tpu_mpi_tests.utils import TpuMtError
+
+    bootstrap()
+    topo = topology()
+    if topo.process_count > 1:
+        print("ERROR serve mode is single-process only: batch "
+              "composition depends on arrival/service timing and would "
+              "diverge across ranks mid-collective (run one process, "
+              "fake or real devices)")
+        return 2
+    mesh = make_mesh()
+    world = topo.global_device_count
+
+    try:
+        classes = parse_workload_table(args.workloads)
+    except ValueError as e:
+        print(f"ERROR {e}")
+        return 2
+
+    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    with rep:
+        if args.arrival == "poisson":
+            load = f"rate={args.rate:g}/s"
+        else:
+            load = f"concurrency={args.concurrency}"
+        rep.banner(
+            f"serve: arrival={args.arrival} {load} "
+            f"duration={args.duration:g}s world={world} "
+            f"max_batch={args.max_batch} seed={args.seed} "
+            f"classes={','.join(c.key for c in classes)}"
+        )
+
+        # warm-cache preload: knob owners imported, schedule cache
+        # fingerprints resolved BEFORE traffic opens — no first request
+        # pays a cold resolution inside its measured latency
+        warm = tr.preload()
+        if tr.configured_cache() is not None:
+            rep.banner(f"serve: tune preload resolved {len(warm)} "
+                       f"schedule knobs")
+
+        # build + warm one persistent handler per workload class (the
+        # factories compile and run one step — serve latency then
+        # measures the steady state, not compilation)
+        handlers = {}
+        for cls in classes:
+            try:
+                factory = _common.workload_factory(cls.workload)
+                handlers[cls.key] = factory(mesh, cls.shape, cls.dtype)
+            except (TpuMtError, ValueError, KeyError) as e:
+                rep.line(f"ERROR workload {cls.key}: {e}")
+                return 2
+        rep.banner(f"serve: {len(handlers)} handlers warmed, "
+                   f"opening traffic")
+
+        if args.arrival == "poisson":
+            arrival = OpenLoopPoisson(args.rate, seed=args.seed)
+        else:
+            arrival = ClosedLoop(args.concurrency)
+        wd = (IdleAwareWatchdog(args.batch_deadline, "serve")
+              if args.batch_deadline else None)
+        loop = ServeLoop(
+            classes, handlers, arrival,
+            duration_s=args.duration,
+            max_batch=args.max_batch,
+            window_s=args.report_interval,
+            max_queue=args.max_queue,
+            seed=args.seed,
+            sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}),
+            watchdog=wd,
+        )
+        summaries = loop.run()
+
+        rc = 0
+        for rec in summaries:
+            def ms(field, rec=rec):
+                v = rec.get(field)
+                return "-" if v is None else format(v, ".4g")
+
+            rep.line(
+                f"SERVE {rec['class']}: "
+                f"offered={rec['offered_hz']:.4g}/s "
+                f"achieved={rec['achieved_hz']:.4g}/s "
+                f"n={rec['requests']} err={rec['errors']} "
+                f"shed={rec['shed']} p50={ms('p50_ms')}ms "
+                f"p95={ms('p95_ms')}ms p99={ms('p99_ms')}ms "
+                f"qmax={rec['queue_max']}"
+            )
+            if rec["errors"] or rec["shed"]:
+                rc = 1
+            if rec["arrivals"] and not rec["requests"]:
+                rep.line(f"SERVE FAIL {rec['class']}: {rec['arrivals']} "
+                         f"arrivals, zero completed")
+                rc = 1
+        if not sum(r["requests"] for r in summaries):
+            rep.line("SERVE FAIL: no requests completed (duration too "
+                     "short for the configured rate?)")
+            rc = 1
+        return rc
+
+
+def main(argv=None) -> int:
+    from tpu_mpi_tests.serve.workloads import DEFAULT_TABLE
+
+    p = _common.base_parser(__doc__)
+    p.add_argument(
+        "--duration", type=float, default=10.0, metavar="S",
+        help="traffic window in seconds (the queue drains afterwards); "
+        "serving runs are open-ended by design — pair long runs with "
+        "--memwatch to watch HBM over hours",
+    )
+    p.add_argument(
+        "--arrival", default="poisson", choices=["poisson", "closed"],
+        help="arrival process: 'poisson' = open loop at --rate (latency "
+        "includes queue wait from the scheduled arrival — coordinated "
+        "omission impossible); 'closed' = fixed population of "
+        "--concurrency clients, each re-issuing on completion",
+    )
+    p.add_argument(
+        "--rate", type=float, default=20.0, metavar="HZ",
+        help="open-loop offered rate, requests/second (default 20)",
+    )
+    p.add_argument(
+        "--concurrency", type=int, default=4, metavar="N",
+        help="closed-loop client population (default 4)",
+    )
+    p.add_argument(
+        "--workloads", default=DEFAULT_TABLE, metavar="TABLE",
+        help="comma list of name[:shape[:dtype[:weight]]] entries "
+        "(shape dims 'x'-separated, e.g. attn:256x64:bfloat16:2); "
+        "handlers: daxpy (vector step), halo (stencil1d exchange), "
+        "attn (ring-attention block), allreduce (small-payload "
+        f"collective). Default: {DEFAULT_TABLE}",
+    )
+    p.add_argument(
+        "--seed", type=int, default=0,
+        help="RNG seed for the arrival schedule and workload mix "
+        "(deterministic request sequences across runs)",
+    )
+    p.add_argument(
+        "--max-batch", type=int, default=8, metavar="N",
+        help="coalescing cap: at most N compatible (same shape x dtype "
+        "x op) queued requests execute as one batch (default 8)",
+    )
+    p.add_argument(
+        "--max-queue", type=int, default=10000, metavar="N",
+        help="queue bound: arrivals beyond N waiting requests are shed "
+        "and counted in the SLO table (default 10000)",
+    )
+    p.add_argument(
+        "--report-interval", type=float, default=5.0, metavar="S",
+        help="SLO window length: per-class kind:'serve' records emit "
+        "every S seconds plus one run summary (default 5); the "
+        "cross-window spread is the --diff noise band",
+    )
+    p.add_argument(
+        "--batch-deadline", type=float, default=None, metavar="S",
+        help="idle-aware watchdog: hard-exit if one BATCH exceeds S "
+        "seconds (armed only around active dispatch — idle gaps "
+        "between arrivals never fire it); distinct from --deadline, "
+        "which bounds the whole run",
+    )
+    args = p.parse_args(argv)
+    if args.duration <= 0:
+        p.error("--duration must be positive")
+    if args.rate <= 0:
+        p.error("--rate must be positive")
+    if args.concurrency < 1:
+        p.error("--concurrency must be >= 1")
+    if args.max_batch < 1:
+        p.error("--max-batch must be >= 1")
+    if args.report_interval <= 0:
+        p.error("--report-interval must be positive")
+    if args.max_queue < 1:
+        p.error("--max-queue must be >= 1")
+    if args.batch_deadline is not None and args.batch_deadline <= 0:
+        # a negative Timer fires immediately: the first batch would die
+        # with a bogus "hung collective" diagnosis
+        p.error("--batch-deadline must be positive (omit to disable)")
+    if args.arrival == "closed" and args.concurrency > args.max_queue:
+        # a shed closed-loop client is never re-armed (re-arming a
+        # request the full queue just rejected would spin) — the
+        # population would silently decay below what the flag promised
+        p.error("--concurrency must be <= --max-queue for closed-loop "
+                "arrivals (shed clients leave the population for good)")
+    if _table_wants_x64(args.workloads) and args.dtype != "float64":
+        # float64 workload classes need the x64 software path armed
+        # BEFORE the backend materializes arrays — otherwise jnp
+        # silently truncates to float32 and every SLO row mislabels
+        # what actually ran (the TPM3xx hazard class, serve-shaped)
+        args.dtype = "float64"
+    _common.setup_platform(args)
+    return _common.run_guarded(run, args)
+
+
+def _table_wants_x64(spec: str) -> bool:
+    """Whether any workload class in ``spec`` asks for float64 (a
+    malformed spec answers False — ``run`` reports it properly)."""
+    from tpu_mpi_tests.serve.workloads import parse_workload_table
+
+    try:
+        return any(
+            c.dtype == "float64" for c in parse_workload_table(spec)
+        )
+    except ValueError:
+        return False
+
+
+if __name__ == "__main__":
+    sys.exit(main())

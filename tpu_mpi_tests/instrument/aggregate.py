@@ -30,7 +30,12 @@ into:
   roofline utilization where the device's peak bandwidth is known;
 * a VMEM table (``kind: "vmem"`` records from ``tpu/vmemprobe.py``):
   model-vs-actual scoped-VMEM per kernel config, under-estimates
-  flagged UNSAFE.
+  flagged UNSAFE;
+* an SLO table (``kind: "serve"`` records from the serving loop —
+  ``drivers/serve.py`` / ``tpu_mpi_tests/serve/``): per workload class,
+  offered vs achieved request rate, p50/p95/p99 latency, queue depth,
+  and error/shed counts; the cross-window spread of the per-window
+  records doubles as the ``--diff`` noise band for the percentiles.
 
 ``--diff A B`` compares two runs instead: two JSONL sets (per-phase /
 per-op / memory metrics) or two bench JSON files (``bench.py`` output or
@@ -162,6 +167,7 @@ def summarize(files: list[str]) -> dict:
     memory: dict = {"phases": {}, "peak": {}, "top": {}, "records": 0}
     compiles: dict[str, dict] = {}
     vmem: dict[str, dict] = {}
+    serve: dict[str, dict] = {}
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -249,6 +255,19 @@ def summarize(files: list[str]) -> dict:
                           "error"):
                     if rec.get(k) is not None:
                         v[k] = rec[k]
+            elif kind == "serve":
+                sv = serve.setdefault(
+                    rec.get("class", "?"),
+                    {"workload": rec.get("workload"),
+                     "dtype": rec.get("dtype"),
+                     "summaries": {}, "windows": []},
+                )
+                rank = rec.get("rank", file_rank)
+                if rec.get("event") == "summary":
+                    # last summary per rank wins (append-mode reruns)
+                    sv["summaries"][rank] = rec
+                else:
+                    sv["windows"].append(dict(rec, rank=rank))
 
     def _stats(per_rank: dict) -> dict:
         vals = list(per_rank.values())
@@ -279,6 +298,7 @@ def summarize(files: list[str]) -> dict:
         "memory": memory,
         "compile": {},
         "vmem": {name: vmem[name] for name in sorted(vmem)},
+        "serve": {cls: _serve_row(serve[cls]) for cls in sorted(serve)},
     }
     for name in sorted(phases):
         ph = phases[name]
@@ -307,6 +327,94 @@ def summarize(files: list[str]) -> dict:
                                 summary["phases"])
         )
     return summary
+
+
+def _noise_band(vals: list) -> float:
+    """Half-spread of the finite samples over their median — the same
+    cross-sample band the bench diff uses."""
+    vals = [float(v) for v in vals
+            if isinstance(v, (int, float)) and v == v]
+    if len(vals) < 2:
+        return 0.0
+    mid = sorted(vals)[len(vals) // 2]
+    return (max(vals) - min(vals)) / 2 / abs(mid) if mid else 0.0
+
+
+#: the serve metrics whose cross-window spread becomes a --diff band
+_SERVE_METRICS = ("p50_ms", "p95_ms", "p99_ms", "mean_ms", "achieved_hz")
+
+
+def _serve_row(sv: dict) -> dict:
+    """One SLO-table row from a class's serve records: rank summaries
+    combined as sums for counts/rates and worst-rank maxima for the
+    latency percentiles (an SLO is a tail guarantee — the slowest
+    rank's tail is the honest number). A rank that died before its
+    summary is synthesized from its window records — per rank, so one
+    crashed rank cannot vanish from the row just because its siblings
+    finished cleanly. Like every other ``summarize`` table (phases,
+    ops, memory), append-mode files merge ALL runs they hold — point
+    the CLI at one run's files (or fresh ``--jsonl`` paths, as the
+    smoke does) when diffing; per-run segmentation is the trace
+    merger's job, not this table's."""
+    per_rank: dict = dict(sv["summaries"])
+    synth: dict = {}
+    for w in sv["windows"]:
+        rank = w.get("rank", 0)
+        if rank in per_rank:
+            continue  # that rank's summary is authoritative
+        agg = synth.setdefault(rank, {
+            "arrivals": 0, "requests": 0, "errors": 0, "shed": 0,
+            "batches": 0, "queue_max": 0,
+            "_t_lo": None, "_t_hi": None,
+        })
+        for k in ("arrivals", "requests", "errors", "shed",
+                  "batches"):
+            agg[k] = agg.get(k, 0) + int(w.get(k) or 0)
+        # wall span, not summed active durations: idle windows are
+        # never emitted, and dividing by active time alone would
+        # overstate a sparse class's rates by the idle fraction
+        for key, fn in (("_t_lo", min), ("_t_hi", max)):
+            bound = w.get("t_start" if key == "_t_lo" else "t_end")
+            if isinstance(bound, (int, float)):
+                cur = agg[key]
+                agg[key] = bound if cur is None else fn(cur, bound)
+        agg["queue_max"] = max(agg["queue_max"],
+                               int(w.get("queue_max") or 0))
+        for k in _SERVE_METRICS[:-1]:
+            if isinstance(w.get(k), (int, float)):
+                agg[k] = max(agg.get(k) or 0.0, float(w[k]))
+    for agg in synth.values():
+        lo, hi = agg.pop("_t_lo"), agg.pop("_t_hi")
+        dur = (hi - lo) if (lo is not None and hi is not None
+                           and hi > lo) else 1e-9
+        agg["duration_s"] = dur
+        agg["offered_hz"] = agg["arrivals"] / dur
+        agg["achieved_hz"] = agg["requests"] / dur
+    per_rank.update(synth)
+    rows = list(per_rank.values())
+    row = {
+        "workload": sv.get("workload"),
+        "dtype": sv.get("dtype"),
+        "ranks": len(rows),
+        "windows": len(sv["windows"]),
+    }
+    for k in ("arrivals", "requests", "errors", "shed", "batches"):
+        row[k] = sum(int(r.get(k) or 0) for r in rows)
+    for k in ("offered_hz", "achieved_hz"):
+        row[k] = sum(float(r.get(k) or 0.0) for r in rows)
+    for k in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
+        vals = [float(r[k]) for r in rows
+                if isinstance(r.get(k), (int, float))]
+        if vals:
+            row[k] = max(vals)
+    row["queue_max"] = max(
+        (int(r.get("queue_max") or 0) for r in rows), default=0
+    )
+    row["bands"] = {
+        k: _noise_band([w.get(k) for w in sv["windows"]])
+        for k in _SERVE_METRICS
+    }
+    return row
 
 
 def _roofline_join(c: dict, label: str, ops: dict, phases: dict) -> dict:
@@ -367,6 +475,21 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
             f"bytes={op['bytes']} mean={op['mean_s']:.6g} "
             f"min={op['min_s']:.6g} max={op['max_s']:.6g} "
             f"skew={op['skew']:.3g}{gb}"
+        )
+
+    for cls, sv in summary.get("serve", {}).items():
+        def ms(key, sv=sv):
+            v = sv.get(key)
+            return "-" if v is None else format(v, ".4g")
+
+        print(
+            f"SLO {cls}: ranks={sv['ranks']} "
+            f"offered={sv['offered_hz']:.4g}/s "
+            f"achieved={sv['achieved_hz']:.4g}/s "
+            f"n={sv['requests']} err={sv['errors']} shed={sv['shed']} "
+            f"p50={ms('p50_ms')}ms p95={ms('p95_ms')}ms "
+            f"p99={ms('p99_ms')}ms qmax={sv['queue_max']} "
+            f"windows={sv['windows']}"
         )
 
     for name, t in summary.get("tuning", {}).items():
@@ -502,15 +625,9 @@ def _bench_metrics(doc: dict, prefix: str = "") -> dict[str, dict]:
     median — the run's own cross-sample noise."""
     out: dict[str, dict] = {}
     if isinstance(doc.get("value"), (int, float)):
-        samples = [s for s in (doc.get("samples") or [])
-                   if isinstance(s, (int, float))]
-        band = 0.0
-        if len(samples) >= 2:
-            mid = sorted(samples)[len(samples) // 2]
-            if mid:
-                band = (max(samples) - min(samples)) / 2 / abs(mid)
         out[prefix + (doc.get("unit") or "value")] = {
-            "value": float(doc["value"]), "band": band,
+            "value": float(doc["value"]),
+            "band": _noise_band(doc.get("samples") or []),
             "higher_better": True,
         }
     if isinstance(doc.get("hbm_peak_bytes"), (int, float)):
@@ -558,6 +675,29 @@ def _jsonl_metrics(files: list[str]) -> dict[str, dict]:
             "value": float(peak["peak_bytes_in_use"]["bytes"]),
             "band": 0.0, "higher_better": False,
         }
+    # serve SLO metrics: latency percentiles (lower better) + achieved
+    # throughput-under-load (higher better); the band is each class's
+    # own cross-window spread, so a noisy run demands a bigger change
+    # before its tail flags (same contract as the bench samples)
+    for cls, sv in s.get("serve", {}).items():
+        bands = sv.get("bands") or {}
+        for met in ("p50_ms", "p95_ms", "p99_ms"):
+            v = sv.get(met)
+            if isinstance(v, (int, float)):
+                out[f"serve:{cls}:{met}"] = {
+                    "value": float(v),
+                    "band": bands.get(met, 0.0),
+                    "higher_better": False,
+                }
+        # isinstance, not truthiness: a run whose throughput collapsed
+        # to 0 must emit the metric, or the -100% regression would
+        # degrade to a present-on-one-side NOTE and the gate exits 0
+        if isinstance(sv.get("achieved_hz"), (int, float)):
+            out[f"serve:{cls}:achieved_hz"] = {
+                "value": float(sv["achieved_hz"]),
+                "band": bands.get("achieved_hz", 0.0),
+                "higher_better": True,
+            }
     return out
 
 

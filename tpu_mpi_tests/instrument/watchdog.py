@@ -148,6 +148,50 @@ class Watchdog:
             self._timer = None
 
 
+class IdleAwareWatchdog(Watchdog):
+    """Watchdog for workloads with idle gaps: the deadline clock runs
+    only while ARMED.
+
+    :class:`Watchdog` assumes continuous dispatch — one timer covering a
+    whole phase — which is wrong for a serving loop, where open-loop
+    Poisson arrivals legitimately leave the process idle for longer than
+    any sane batch deadline. This variant makes the active window
+    explicit: ``arm()`` (re)starts the timer just before a dispatch,
+    ``disarm()`` stops it once the batch completed; while disarmed, no
+    amount of idle time can fire. A genuinely wedged batch — armed,
+    never disarmed — still dumps and hard-exits exactly like the base
+    class. Arm/disarm are called from the single serve-loop thread.
+
+    Each ``arm()`` starts a fresh ``threading.Timer`` — ~100 us next to
+    the device round-trip every batch already pays, and the whole
+    feature is opt-in (``--batch-deadline``). If a future workload arms
+    at kHz rates, the upgrade path is one persistent checker thread
+    polling an armed-deadline timestamp; not worth the extra shared
+    state at today's batch rates.
+    """
+
+    def arm(self, phase: str | None = None) -> "IdleAwareWatchdog":
+        """(Re)start the deadline for one active dispatch window."""
+        if phase is not None:
+            self.phase = phase
+        self.cancel()
+        return self.start()
+
+    def disarm(self) -> None:
+        """Back to idle: the deadline clock stops."""
+        self.cancel()
+
+    @contextmanager
+    def active(self, phase: str | None = None):
+        """``with wd.active("serve:daxpy"): dispatch()`` — armed only
+        inside the block."""
+        self.arm(phase)
+        try:
+            yield self
+        finally:
+            self.disarm()
+
+
 @contextmanager
 def deadline(seconds: float | None, phase: str = "phase"):
     """``with deadline(120, "allgather"): ...`` — no-op when ``seconds`` is

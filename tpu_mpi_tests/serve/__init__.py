@@ -1,0 +1,44 @@
+"""Serving-mode harness: steady-state traffic against a persistent mesh.
+
+The ROADMAP's north star is a production system serving heavy traffic;
+every other driver is a one-shot benchmark. This package models the
+missing regime — sustained load, tail latency, throughput-under-load —
+as four small pure-Python pieces (arrival processes, a weighted workload
+table, a class-compatible batcher, bounded-memory latency histograms)
+around one single-threaded loop, with the actual device work supplied by
+the workload-handler registry in ``drivers/_common.py`` and all
+observability riding the existing telemetry/JSONL spine
+(``kind: "serve"`` records → ``tpumt-report`` SLO table, batch spans →
+``tpumt-trace`` timelines). Entry point: ``tpumt-serve``
+(``drivers/serve.py``).
+"""
+
+# lazy re-exports (PEP 562), matching the instrument package: the table/
+# histogram/arrival layers are stdlib-only and must stay importable in
+# jax-free test and login-node contexts
+_EXPORTS = {
+    "OpenLoopPoisson": "arrival",
+    "ClosedLoop": "arrival",
+    "coalesce": "batcher",
+    "LatencyHistogram": "histogram",
+    "ServeLoop": "loop",
+    "Request": "loop",
+    "WorkloadClass": "workloads",
+    "WorkloadMix": "workloads",
+    "parse_workload_table": "workloads",
+    "DEFAULT_TABLE": "workloads",
+}
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"tpu_mpi_tests.serve.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

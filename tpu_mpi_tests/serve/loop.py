@@ -1,0 +1,317 @@
+"""The steady-state serving loop: unbounded time, bounded memory.
+
+Every other driver in this repo is a one-shot benchmark — initialize a
+mesh, run N iterations, report, exit. This loop is the repo's first
+subsystem where *time is unbounded and the steady state is the
+measurement*: one persistent mesh and one set of warmed compiled
+handlers stay alive while an arrival process (``serve/arrival.py``)
+generates requests drawn from a mixed workload table
+(``serve/workloads.py``), the batcher (``serve/batcher.py``) coalesces
+compatible requests, and per-request latency lands in fixed-size
+histograms (``serve/histogram.py``).
+
+Observability rides the existing spine, not a new one:
+
+* per-class SLO records (``kind: "serve"``, ``event: "window"`` every
+  ``window_s`` plus one ``event: "summary"``) flow through the caller's
+  sink onto the same JSONL stream every other record uses, wall-clock
+  stamped on the PR-2 clock so ``tpumt-trace`` places them and
+  ``tpumt-report`` renders the SLO table / ``--diff`` gates it;
+* each executed batch is bracketed in a telemetry ``comm_span``
+  (``op: "serve:<class>"``), so with ``--telemetry`` the request stream
+  appears on the cross-rank timeline as first-class request spans;
+* the watchdog integration is idle-aware (``IdleAwareWatchdog``): armed
+  only around active dispatch, so an arbitrarily long Poisson gap can
+  never fire it while a genuinely wedged batch still does.
+
+The loop itself is single-threaded pure Python with injectable clocks —
+deterministic under test (fake clock, fake handlers, no jax import) and
+honest in production (handlers block on device completion before
+returning, so a latency reading is a completed request, not a dispatch).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+from tpu_mpi_tests.serve.batcher import coalesce
+from tpu_mpi_tests.serve.histogram import LatencyHistogram
+from tpu_mpi_tests.serve.workloads import WorkloadClass, WorkloadMix
+
+#: longest single sleep while idle — keeps the loop responsive to the
+#: run deadline and window boundaries without busy-waiting
+MAX_IDLE_SLEEP_S = 0.25
+
+#: pause after a failed batch: closed-loop clients re-arm the instant
+#: their batch completes, so a persistently failing handler would
+#: otherwise busy-spin the loop at CPU speed for the whole run — this
+#: bounds it to ~20 error batches/s while leaving transient errors
+#: nearly free
+FAIL_BACKOFF_S = 0.05
+
+
+class Request:
+    """One in-queue request: its workload class and scheduled arrival
+    time (the open-loop latency origin — queue wait counts)."""
+
+    __slots__ = ("cls", "arrival")
+
+    def __init__(self, cls: WorkloadClass, arrival: float):
+        self.cls = cls
+        self.arrival = arrival
+
+
+class _ClassStats:
+    """Per-class accumulators, total + current-window. Fixed size: two
+    histograms and a handful of counters, regardless of request count."""
+
+    __slots__ = ("hist", "win_hist", "requests", "errors", "shed",
+                 "batches", "arrivals", "queue_max", "win_requests",
+                 "win_errors", "win_shed", "win_batches", "win_arrivals",
+                 "win_queue_max")
+
+    def __init__(self):
+        self.hist = LatencyHistogram()
+        self.win_hist = LatencyHistogram()
+        self.requests = self.errors = self.shed = 0
+        self.batches = self.arrivals = self.queue_max = 0
+        self.win_requests = self.win_errors = self.win_shed = 0
+        self.win_batches = self.win_arrivals = self.win_queue_max = 0
+
+    def window_active(self) -> bool:
+        return bool(self.win_arrivals or self.win_requests
+                    or self.win_errors or self.win_shed)
+
+    def reset_window(self) -> None:
+        self.win_hist.reset()
+        self.win_requests = self.win_errors = self.win_shed = 0
+        self.win_batches = self.win_arrivals = self.win_queue_max = 0
+
+
+class ServeLoop:
+    """Drive ``handlers`` under ``arrival`` for ``duration_s`` seconds.
+
+    ``handlers`` maps each class key to a ``step_fn(n)`` executing ``n``
+    coalesced requests and returning only after device completion (the
+    driver-registry contract, ``drivers/_common.py``). ``sink`` receives
+    every ``kind: "serve"`` record; ``watchdog`` (optional) must expose
+    the idle-aware ``arm(phase)``/``disarm()`` API. ``clock``/``wall``/
+    ``sleep`` are injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        classes: list[WorkloadClass],
+        handlers: dict[str, Callable[[int], Any]],
+        arrival,
+        *,
+        duration_s: float,
+        max_batch: int = 8,
+        window_s: float = 5.0,
+        max_queue: int = 10000,
+        seed: int = 0,
+        sink: Callable[[dict], None] | None = None,
+        watchdog=None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        missing = [c.key for c in classes if c.key not in handlers]
+        if missing:
+            raise ValueError(f"no handler for classes: {missing}")
+        self.classes = list(classes)
+        self.handlers = dict(handlers)
+        self.arrival = arrival
+        self.duration_s = float(duration_s)
+        self.max_batch = max(1, int(max_batch))
+        self.window_s = float(window_s)
+        self.max_queue = int(max_queue)
+        self.mix = WorkloadMix(classes, seed=seed)
+        self.sink = sink
+        self.watchdog = watchdog
+        self._clock = clock
+        self._wall = wall
+        self._sleep = sleep
+        self.stats: dict[str, _ClassStats] = {
+            c.key: _ClassStats() for c in classes
+        }
+        self._by_key = {c.key: c for c in classes}
+
+    # -- record emission ---------------------------------------------------
+
+    def _emit(self, event: str, cls: WorkloadClass, st: _ClassStats,
+              t_start: float, t_end: float, window: bool,
+              offered_dur: float | None = None) -> dict:
+        """``offered_dur`` divides the offered rate when the record's
+        span is longer than the window arrivals were generated in: a
+        summary covers traffic + drain, and dividing arrivals by the
+        drain-inclusive span would make a saturated run (offered ≫
+        sustained, everything eventually served) read as offered ==
+        achieved — the exact signal the pair exists to expose."""
+        dur = max(t_end - t_start, 1e-9)
+        if window:
+            arrivals, requests = st.win_arrivals, st.win_requests
+            errors, shed = st.win_errors, st.win_shed
+            batches, qmax = st.win_batches, st.win_queue_max
+            hist = st.win_hist
+        else:
+            arrivals, requests = st.arrivals, st.requests
+            errors, shed = st.errors, st.shed
+            batches, qmax = st.batches, st.queue_max
+            hist = st.hist
+        rec = {
+            "kind": "serve",
+            "event": event,
+            "class": cls.key,
+            "workload": cls.workload,
+            "shape": list(cls.shape),
+            "dtype": cls.dtype,
+            "t_start": t_start,
+            "t_end": t_end,
+            "duration_s": dur,
+            "arrivals": arrivals,
+            "requests": requests,
+            "errors": errors,
+            "shed": shed,
+            "batches": batches,
+            "offered_hz": arrivals / (offered_dur or dur),
+            "achieved_hz": requests / dur,
+            "queue_max": qmax,
+            **hist.percentiles_ms(),
+        }
+        if offered_dur is not None and dur > offered_dur:
+            # how long past the deadline the queue took to drain — a
+            # saturated run's backlog, first-class in the record
+            rec["drain_s"] = dur - offered_dur
+        if self.sink is not None:
+            self.sink(rec)
+        return rec
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self) -> list[dict]:
+        """Serve until the deadline, drain the queue, return the per-class
+        ``event: "summary"`` records (also pushed through the sink)."""
+        from tpu_mpi_tests.instrument.telemetry import comm_span
+
+        clock, wall = self._clock, self._wall
+        t0 = clock()
+        wall0 = wall()
+        t_end = t0 + self.duration_s
+        self.arrival.start(t0)
+        queue: list[Request] = []
+        # per-class waiting counts, maintained incrementally (+1 on
+        # enqueue, -batch on coalesce): the SLO queue-depth column must
+        # not cost an O(queue) scan inside the latency-measuring loop
+        waiting: dict[str, int] = {}
+        window_start = t0
+        window_wall = wall0
+
+        def wall_at(t_mono: float) -> float:
+            return wall0 + (t_mono - t0)
+
+        while True:
+            now = clock()
+            # ingest arrivals scheduled up to now (never past the
+            # deadline — the post-deadline drain must terminate)
+            for t_arr in self.arrival.take_due(now, limit=t_end):
+                cls = self.mix.draw()
+                st = self.stats[cls.key]
+                st.arrivals += 1
+                st.win_arrivals += 1
+                if len(queue) >= self.max_queue:
+                    # shed and gone: a shed request is never fed back
+                    # through on_complete (re-arming what the full
+                    # queue just rejected would spin) — closed-loop
+                    # callers must keep concurrency <= max_queue or
+                    # the population decays (the driver enforces it)
+                    st.shed += 1
+                    st.win_shed += 1
+                    continue
+                queue.append(Request(cls, t_arr))
+                d = waiting.get(cls.key, 0) + 1
+                waiting[cls.key] = d
+                st.queue_max = max(st.queue_max, d)
+                st.win_queue_max = max(st.win_queue_max, d)
+            # window boundary: emit + reset (drain windows included)
+            if now - window_start >= self.window_s:
+                w_end = wall_at(now)
+                for cls in self.classes:
+                    st = self.stats[cls.key]
+                    if st.window_active():
+                        self._emit("window", cls, st, window_wall,
+                                   w_end, window=True)
+                    st.reset_window()
+                    # requests already waiting carry into the new
+                    # window's depth — a backlog is not depth zero
+                    st.win_queue_max = waiting.get(cls.key, 0)
+                window_start = now
+                window_wall = w_end
+
+            if queue:
+                batch, queue = coalesce(queue, self.max_batch)
+                cls = batch[0].cls
+                waiting[cls.key] -= len(batch)
+                st = self.stats[cls.key]
+                if self.watchdog is not None:
+                    self.watchdog.arm(f"serve:{cls.key}")
+                failed = False
+                try:
+                    with comm_span(
+                        f"serve:{cls.key}",
+                        nbytes=cls.nbytes * len(batch),
+                        requests=len(batch),
+                    ):
+                        # handler blocks on device completion before
+                        # returning (registry contract) — the span and
+                        # the latency reads below are sync-honest
+                        self.handlers[cls.key](len(batch))
+                except Exception:
+                    failed = True
+                finally:
+                    if self.watchdog is not None:
+                        self.watchdog.disarm()
+                done = clock()
+                st.batches += 1
+                st.win_batches += 1
+                if failed:
+                    st.errors += len(batch)
+                    st.win_errors += len(batch)
+                else:
+                    for req in batch:
+                        lat = done - req.arrival
+                        st.requests += 1
+                        st.win_requests += 1
+                        st.hist.record(lat)
+                        st.win_hist.record(lat)
+                self.arrival.on_complete(len(batch), done)
+                if failed:
+                    self._sleep(FAIL_BACKOFF_S)
+                continue
+
+            if now >= t_end:
+                break  # deadline passed, queue drained
+            nxt = self.arrival.next_event()
+            targets = [t_end, window_start + self.window_s]
+            if nxt is not None:
+                targets.append(nxt)
+            gap = min(targets) - now
+            if gap > 0:
+                self._sleep(min(gap, MAX_IDLE_SLEEP_S))
+
+        end_wall = wall_at(clock())
+        # final partial window, then the run summaries
+        for cls in self.classes:
+            st = self.stats[cls.key]
+            if st.window_active():
+                self._emit("window", cls, st, window_wall, end_wall,
+                           window=True)
+            st.reset_window()
+        return [
+            self._emit("summary", self._by_key[key], st, wall0,
+                       end_wall, window=False,
+                       offered_dur=min(self.duration_s,
+                                       max(end_wall - wall0, 1e-9)))
+            for key, st in self.stats.items()
+        ]

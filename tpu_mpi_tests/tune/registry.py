@@ -192,3 +192,20 @@ def resolve(knob: str, explicit=None, prior=None,
     if prior is not None:
         return prior
     return space(knob).prior
+
+
+def preload() -> dict[str, Any]:
+    """Warm the resolution path before steady-state traffic opens.
+
+    Serve mode (``drivers/serve.py``) calls this once at startup: it
+    imports every knob-owning module (their ``declare_space`` calls run
+    now, not on the first request), touches the loaded cache so the
+    device-fingerprint computation happens up front, and returns the
+    device-level resolution of every declared space — cached winner
+    where the warmed cache has one, shipped prior otherwise. Context-
+    sensitive sites still re-resolve with their full context at use
+    time (precedence unchanged); this pass exists so no first request
+    pays a cold import, cache read, or fingerprint build inside its
+    measured latency."""
+    _import_knob_owners()
+    return {knob: resolve(knob) for knob in sorted(_SPACES)}
