@@ -1,7 +1,7 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke lint ci clean
+	serve-smoke overlap-smoke lint ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -172,6 +172,53 @@ serve-smoke:
 	grep -q ' REGRESSION' /tmp/_tpumt_serve_smoke.baddiff.txt
 	@echo "serve-smoke OK: SLO table + request spans + diff gate"
 
+# overlap-engine smoke (README "Overlap engine"): a 2-fake-device
+# stencil1d pipeline run at depth 2 must (a) record kind:"overlap" with
+# overlap_frac > 0, pass the bitwise seam gate (driver rc 0), and place
+# depth-2 async exchange spans on the merged trace; (b) a depth-1 run
+# must report overlap_frac exactly 0; (c) tpumt-report must render the
+# OVERLAP table for BOTH; and (d) diffing the serialized run against
+# the pipelined one must flag the re-serialization
+# (overlap:halo:frac REGRESSION, exit 1) — the gate that catches a
+# future PR silently de-pipelining the hot path
+overlap-smoke:
+	rm -f /tmp/_tpumt_ov_smoke*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.stencil1d \
+		--fake-devices 2 --n-global 65536 --overlap 2 \
+		--overlap-iters 8 --telemetry \
+		--jsonl /tmp/_tpumt_ov_smoke.d2.jsonl \
+		--trace-out /tmp/_tpumt_ov_smoke.trace.json
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.stencil1d \
+		--fake-devices 2 --n-global 65536 --overlap 1 \
+		--overlap-iters 8 --telemetry \
+		--jsonl /tmp/_tpumt_ov_smoke.d1.jsonl
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_ov_smoke.d2.jsonl')]; \
+		ov = [r for r in recs if r.get('kind') == 'overlap']; \
+		assert ov and ov[0]['depth'] == 2 \
+			and ov[0]['overlap_frac'] > 0, ov; \
+		recs1 = [json.loads(l) for l in \
+			open('/tmp/_tpumt_ov_smoke.d1.jsonl')]; \
+		ov1 = [r for r in recs1 if r.get('kind') == 'overlap']; \
+		assert ov1 and ov1[0]['overlap_frac'] == 0.0, ov1; \
+		d = json.load(open('/tmp/_tpumt_ov_smoke.trace.json')); \
+		spans = [e for e in d['traceEvents'] if e['ph'] == 'X' \
+			and e.get('args', {}).get('overlap_depth') == 2]; \
+		assert spans, 'no pipelined exchange spans in trace'; \
+		print('overlap-smoke records OK:', len(spans), \
+			'async spans')"
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_ov_smoke.d2.jsonl | grep -q '^OVERLAP halo: depth=2'
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_ov_smoke.d1.jsonl \
+		| grep -q '^OVERLAP halo: depth=1 frac=0.000'
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_ov_smoke.d2.jsonl /tmp/_tpumt_ov_smoke.d1.jsonl \
+		> /tmp/_tpumt_ov_smoke.diff.txt; test $$? -eq 1
+	grep -q 'overlap:halo:frac.*REGRESSION' /tmp/_tpumt_ov_smoke.diff.txt
+	@echo "overlap-smoke OK: frac gate + trace spans + diff gate"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -183,9 +230,9 @@ lint:
 
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
-# observability smoke, the serving-pipeline smoke, and the lint
-# self-clean gate
-ci: verify trace-smoke tune-smoke mem-smoke serve-smoke lint
+# observability smoke, the serving-pipeline smoke, the overlap-engine
+# smoke, and the lint self-clean gate
+ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke lint
 
 clean:
 	$(MAKE) -C native clean

@@ -91,9 +91,31 @@ def _resolve_blocks(blocks_env: "str | None", dtype_name: str, *, n: int,
     ))
 
 
+def _resolve_overlap(env_val: "str | None", dtype_name: str, *, n: int,
+                     world: int) -> int:
+    """Halo pipeline depth for the bench schedule: explicit
+    TPU_MPI_BENCH_OVERLAP > cached winner > shipped prior (1 — the
+    serialized schedule, byte-identical to the pre-overlap era)."""
+    if env_val is not None:
+        return max(1, min(int(env_val), 2))
+    from tpu_mpi_tests.comm.halo import resolve_overlap_depth
+
+    return resolve_overlap_depth(None, dtype=dtype_name, n=n, world=world)
+
+
 def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
-                    topo, n_blocks: int, report_declined: bool = False):
-    """Build one per-iteration schedule: ``(run, state, use_blocks)``.
+                    topo, n_blocks: int, ov_depth: int = 1,
+                    report_declined: bool = False):
+    """Build one per-iteration schedule:
+    ``(run, state, use_blocks, ov_eff)``.
+
+    ``ov_depth >= 2`` selects the comm/compute-overlap step
+    (``halo.iterate_overlap_fn`` — edge ppermutes in flight while the
+    core kernel runs on old data) where it applies: TPU, the dim-1
+    single-buffer path, ``steps == 1`` (the overlap step carries
+    per-step-radius ghosts). Everywhere else the depth is declined to
+    1 with a stderr NOTE — the schedule string must never claim an
+    overlap that did not run.
 
     The resident-block schedule (TPU, k>1): S separate buffers per shard
     run the fast full-height dim-0 (sublane-tap) kernel; the inter-block
@@ -146,6 +168,23 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
         lambda r: d.init_shard(f, r, dtype),
         axis=bench_dim,
     )
+    ov_eff = 1
+    if (
+        ov_depth >= 2 and topo.platform == "tpu" and steps == 1
+        and not use_blocks
+    ):
+        ov_eff = 2
+    elif ov_depth >= 2:
+        import sys
+
+        print(
+            f"NOTE overlap depth {ov_depth} not applicable "
+            f"(platform={topo.platform} steps={steps} "
+            f"blocks={n_blocks}); running the serialized schedule "
+            f"(_ov1)",
+            file=sys.stderr,
+            flush=True,
+        )
     if use_blocks:
         from tpu_mpi_tests.comm.halo import (
             iterate_pallas_blocks_fn,
@@ -158,17 +197,23 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
             mesh=bench_mesh, axis_name=axis_name,
         )
         zg = split_blocks(zg, n_blocks, d.n_bnd, mesh=bench_mesh)
+    elif ov_eff >= 2:
+        from tpu_mpi_tests.comm.halo import iterate_overlap_fn
+
+        run = iterate_overlap_fn(
+            mesh, axis_name, d.n_bnd, eps * d.scale, axis=bench_dim
+        )
     elif topo.platform == "tpu":
         run = iterate_pallas_fn(
             mesh, axis_name, d.n_bnd, eps * d.scale, steps=steps
         )
     else:  # CPU smoke path: interpret-mode pallas is far too slow
         run = iterate_fused_fn(mesh, axis_name, 1, 2, d.n_bnd, d.scale, eps)
-    return run, zg, use_blocks
+    return run, zg, use_blocks, ov_eff
 
 
 def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
-             blocks_env: str | None):
+             blocks_env: str | None, overlap_env: str | None = None):
     """One dtype's full measurement: resolve the schedule (explicit env >
     cached winner > prior; TPU_MPI_BENCH_TUNE=1 sweeps block-count
     candidates on a cache miss first), chain-time it, median-of-samples.
@@ -204,7 +249,7 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         cands = [prior] + [c for c in sp.candidates if c != prior]
 
         def measure_blocks(cand):
-            run_c, zg_c, ub = _build_schedule(
+            run_c, zg_c, ub, _ = _build_schedule(
                 dtype_name, n=n, steps=steps, world=world, mesh=mesh,
                 axis_name=axis_name, topo=topo, n_blocks=int(cand),
             )
@@ -223,9 +268,11 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
             emit=_tune_emit, dtype=dtype_name, n=n, world=world,
         ))
 
-    run, zg, use_blocks = _build_schedule(
+    ov_depth = _resolve_overlap(overlap_env, dtype_name, n=n, world=world)
+    run, zg, use_blocks, ov_eff = _build_schedule(
         dtype_name, n=n, steps=steps, world=world, mesh=mesh,
         axis_name=axis_name, topo=topo, n_blocks=n_blocks,
+        ov_depth=ov_depth,
         report_declined=blocks_env is not None,
     )
 
@@ -288,12 +335,15 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         "samples": [
             round(s, 2) if np.isfinite(s) else None for s in samples
         ],
-        # which per-iteration schedule actually ran (the blocks
-        # gate can decline a requested TPU_MPI_BENCH_BLOCKS)
+        # which per-iteration schedule actually ran (the blocks gate
+        # can decline a requested TPU_MPI_BENCH_BLOCKS, the overlap
+        # gate a requested depth) — the _ov<d> suffix attributes the
+        # row to a pipeline depth, not just a shape (ISSUE 7)
         "schedule": (
             f"blocks{n_blocks}_dim0_world{world}_{dtype_name}"
+            f"_ov{ov_eff}"
             if use_blocks
-            else f"dim1_world{world}_{dtype_name}"
+            else f"dim1_world{world}_{dtype_name}_ov{ov_eff}"
         ),
         "steps": steps,
     }
@@ -358,6 +408,7 @@ def main() -> None:
         dtype_name, n=n, steps=steps, world=world, mesh=mesh,
         axis_name=axis_name, topo=topo,
         blocks_env=os.environ.get("TPU_MPI_BENCH_BLOCKS"),
+        overlap_env=os.environ.get("TPU_MPI_BENCH_OVERLAP"),
     ))
 
     second = os.environ.get("TPU_MPI_BENCH_SECOND_DTYPE", "")

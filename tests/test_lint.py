@@ -32,6 +32,7 @@ FILE_FAMILIES = [
     ("TPM5", "tpm5"),
     ("TPM6", "tpm6"),
     ("TPM7", "tpm7"),
+    ("TPM8", "tpm8"),
 ]
 
 
@@ -236,6 +237,48 @@ def test_schedule_constants_mutation_outside_tune(tmp_path):
     assert "TPM701" not in codes_of(lint_paths([str(p)]))
     p.write_text("FLIGHT_CAPACITY = 64\n")  # no schedule keyword
     assert "TPM701" not in codes_of(lint_paths([str(p)]))
+    # the ISSUE-7 pipeline knobs are schedule words too: a re-pinned
+    # depth constant outside tune/ fires, the declared space does not
+    p.write_text("RING_PIPELINE_DEPTH = 2\n")
+    assert "TPM701" in codes_of(lint_paths([str(p)]))
+
+
+def test_overlap_region_scoping(tmp_path):
+    """TPM801 behavior beyond the goldens: the region closes at the
+    handle's consume point (a sync after `.done()` is clean), an
+    UNCONSUMED handle keeps the region open to the end of the function,
+    and a nested function's syncs do not leak into the outer region."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.instrument.telemetry import async_span\n"
+        "from tpu_mpi_tests.instrument.timers import block\n"
+        "def good(fn, z):\n"
+        "    h = async_span('op')\n"
+        "    ex = fn(z)\n"
+        "    h.done(ex)\n"
+        "    return block(ex)\n"
+    )
+    assert "TPM801" not in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from tpu_mpi_tests.instrument.telemetry import async_span\n"
+        "from tpu_mpi_tests.instrument.timers import block\n"
+        "def dangling(fn, z):\n"
+        "    h = async_span('op')\n"
+        "    ex = fn(z)\n"
+        "    return block(ex)\n"  # handle never consumed: still a region
+    )
+    assert "TPM801" in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from tpu_mpi_tests.instrument.telemetry import async_span\n"
+        "from tpu_mpi_tests.instrument.timers import block\n"
+        "def outer(fn, z):\n"
+        "    h = async_span('op')\n"
+        "    ex = fn(z)\n"
+        "    h.done(ex)\n"
+        "def unrelated(y):\n"
+        "    return block(y)\n"  # no region in unrelated's scope
+    )
+    assert "TPM801" not in codes_of(lint_paths([str(p)]))
 
 
 def test_cli_human_output_and_exit_codes(capsys):
@@ -268,10 +311,10 @@ def test_cli_list_rules_covers_every_family(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     for code in ("TPM101", "TPM201", "TPM301", "TPM302", "TPM401",
-                 "TPM501", "TPM601", "TPM701", "TPM900"):
+                 "TPM501", "TPM601", "TPM701", "TPM801", "TPM900"):
         assert code in out
     # table rows match the registry (README is hand-synced to this)
-    assert len(rule_table()) >= 8
+    assert len(rule_table()) >= 9
 
 
 def test_self_clean_gate():

@@ -1,0 +1,619 @@
+"""Overlap-engine gates (ISSUE 7): the interior/boundary seam is
+bit-identical across pipeline depths (the same compiled programs run in
+both schedules, so equality is structural), the ring prefetch pipeline
+is result-invariant at every depth, the collective dispatch window
+bounds in-flight chains without changing results, the depth knobs sweep
+and persist under the full fingerprint, and the measured
+``overlap_frac`` discriminates a pipelined run (> 0) from a serialized
+one (exactly 0) all the way through the JSONL → tpumt-report OVERLAP
+table → ``--diff`` gate pipeline."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_mpi_tests.arrays.domain import Domain1D
+from tpu_mpi_tests.comm import collectives as C
+from tpu_mpi_tests.comm import halo as H
+from tpu_mpi_tests.instrument import telemetry as T
+from tpu_mpi_tests.instrument.aggregate import summarize, _jsonl_metrics
+from tpu_mpi_tests.instrument.timers import PhaseTimer, block
+from tpu_mpi_tests.kernels.stencil import N_BND, analytic_pairs
+from tpu_mpi_tests.tune import registry as tr
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    """Unconfigured tune registry + disabled telemetry around each test
+    (the engine records spans; a leaked sink corrupts other tests)."""
+    monkeypatch.delenv("TPU_MPI_TUNE_CACHE", raising=False)
+    tr.deconfigure()
+    T.disable()
+    T.registry().reset()
+    yield
+    tr.deconfigure()
+    T.disable()
+    T.registry().reset()
+
+
+EPS = 1e-6
+
+
+def _jacobi_setup(mesh8, dtype=jnp.float32, n=4096):
+    d = Domain1D(n_global=n, n_shards=8, n_bnd=2)
+    f, _ = analytic_pairs()["1d"]
+    z0 = jnp.asarray(d.init_global(f), dtype)
+    fns = H.overlap_jacobi_fns(
+        mesh8, "shard", 0, 1, 2, float(d.scale), EPS
+    )
+    return d, z0, fns
+
+
+def _run_pipeline(mesh8, z0, fns, depth, n_steps, timer=None):
+    ex_fn, core_fn, seam_fn = fns
+    runner = H.OverlapRunner(
+        "halo_exchange", depth=depth, timer=timer,
+        phase="overlap_interior",
+    )
+    z = C.shard_1d(z0, mesh8)
+    for _ in range(n_steps):
+        ex, zc = runner.step(ex_fn, core_fn, z)
+        z = block(seam_fn(ex, zc))
+    return np.asarray(z), runner
+
+
+# ------------------------------------------------------- seam identity
+
+
+class TestJacobiSeam:
+    def test_depth1_equals_depth2_bitwise(self, mesh8):
+        """The acceptance gate: the pipelined schedule is byte-identical
+        to the serialized one (same programs, reordered)."""
+        _, z0, fns = _jacobi_setup(mesh8)
+        d1, _ = _run_pipeline(mesh8, z0, fns, 1, 6)
+        d2, _ = _run_pipeline(mesh8, z0, fns, 2, 6)
+        np.testing.assert_array_equal(d1, d2)
+
+    def test_depth1_matches_iterate_fused(self, mesh8):
+        """The split formulation computes the fused device-chained
+        loop's recurrence (exact to roundoff — XLA fuses the
+        one-program formulation with different FMA boundaries, so
+        bitwise equality is only guaranteed WITHIN the engine)."""
+        d, z0, fns = _jacobi_setup(mesh8)
+        run = H.iterate_fused_fn(
+            mesh8, "shard", 0, 1, 2, float(d.scale), EPS
+        )
+        ref = np.asarray(block(run(C.shard_1d(z0, mesh8), 6)))
+        d1, _ = _run_pipeline(mesh8, z0, fns, 1, 6)
+        np.testing.assert_allclose(d1, ref, rtol=1e-6, atol=1e-12)
+
+    def test_overlap_frac_discriminates(self, mesh8):
+        """Serialized run: exactly 0 (the exchange drains before the
+        phase opens). Pipelined run: > 0 measured wall overlap."""
+        _, z0, fns = _jacobi_setup(mesh8)
+        _, r1 = _run_pipeline(mesh8, z0, fns, 1, 4)
+        _, r2 = _run_pipeline(mesh8, z0, fns, 2, 4)
+        assert r1.overlap_frac == 0.0
+        assert r1.comm_s == 0.0
+        assert r2.overlap_frac > 0.0
+        assert r2.comm_s > 0.0
+
+    def test_annotate_attaches_to_phase_record(self, mesh8):
+        _, z0, fns = _jacobi_setup(mesh8)
+        timer = PhaseTimer()
+        _, runner = _run_pipeline(mesh8, z0, fns, 2, 3, timer=timer)
+        runner.annotate(timer)
+        extras = timer.extras["overlap_interior"]
+        assert extras["overlap_frac"] == runner.overlap_frac
+        assert extras["overlap_depth"] == 2
+
+
+class TestHeatSeam:
+    @staticmethod
+    def _setup(mesh2d):
+        import math
+
+        px, py, nxl, nyl = 4, 2, 12, 12
+        nx, ny = px * nxl, py * nyl
+        dx, dy = 2 * math.pi / nx, 2 * math.pi / ny
+        nu = 0.1
+        dt = 0.4 / (nu * (1 / dx**2 + 1 / dy**2))
+        cx, cy = nu * dt / dx**2, nu * dt / dy**2
+        gxs, gys = nxl + 2, nyl + 2
+        zg = np.zeros((px * gxs, py * gys), np.float32)
+        xs = np.arange(nx) * dx
+        ys = np.arange(ny) * dy
+        z0 = np.sin(xs)[:, None] * np.sin(ys)[None, :]
+        for rx in range(px):
+            for ry in range(py):
+                zg[rx * gxs + 1:rx * gxs + 1 + nxl,
+                   ry * gys + 1:ry * gys + 1 + nyl] = z0[
+                    rx * nxl:(rx + 1) * nxl, ry * nyl:(ry + 1) * nyl]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        place = NamedSharding(mesh2d, P("x", "y"))
+        return zg, place, float(cx), float(cy)
+
+    def test_depths_bitwise_and_fused_close(self, mesh2d):
+        zg, place, cx, cy = self._setup(mesh2d)
+        ex_fn, core_fn, seam_fn = H.heat_overlap_fns(
+            mesh2d, "x", "y", cx, cy
+        )
+
+        def run(depth, n):
+            runner = H.OverlapRunner("halo_exchange2d", depth=depth)
+            z = jax.device_put(zg, place)
+            for _ in range(n):
+                ex, zc = runner.step(ex_fn, core_fn, z)
+                z = block(seam_fn(ex, zc))
+            return np.asarray(z)
+
+        d1, d2 = run(1, 5), run(2, 5)
+        np.testing.assert_array_equal(d1, d2)
+        fused = H.heat_step2d_fn(mesh2d, "x", "y", 1, cx, cy)
+        ref = np.asarray(block(fused(jax.device_put(zg, place), 5)))
+        np.testing.assert_allclose(d1, ref, rtol=1e-6, atol=1e-7)
+
+
+class TestGridSeam:
+    def test_depths_bitwise_and_step2d_close(self, mesh2d):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_mpi_tests.drivers.stencil2d_grid import _init_block
+
+        dx = Domain1D(n_global=4 * 12, n_shards=4)
+        dy = Domain1D(n_global=2 * 12, n_shards=2)
+        zf, _ = analytic_pairs()["2d_dim0"]
+        zg = np.zeros((4 * dx.n_ghosted, 2 * dy.n_ghosted), np.float32)
+        for rx in range(4):
+            for ry in range(2):
+                zg[rx * dx.n_ghosted:(rx + 1) * dx.n_ghosted,
+                   ry * dy.n_ghosted:(ry + 1) * dy.n_ghosted] = \
+                    _init_block(dx, dy, rx, ry, 4, 2, zf, np.float32)
+        zs = jax.device_put(zg, NamedSharding(mesh2d, P("x", "y")))
+        ex_fn, core_fn, seam_fn = H.grid_overlap_fns(
+            mesh2d, "x", "y", N_BND, float(dx.scale), float(dy.scale)
+        )
+
+        def run(depth):
+            runner = H.OverlapRunner("halo_exchange2d", depth=depth)
+            ex, cores = runner.step(ex_fn, core_fn, zs)
+            return block(seam_fn(ex, *cores))
+
+        ax, ay, ares = run(1)
+        bx, by, bres = run(2)
+        np.testing.assert_array_equal(np.asarray(ax), np.asarray(bx))
+        np.testing.assert_array_equal(np.asarray(ay), np.asarray(by))
+        assert float(ares) == float(bres)
+        step = H.step2d_fn(
+            mesh2d, "x", "y", N_BND, float(dx.scale), float(dy.scale)
+        )
+        rx_, ry_, res_ = block(step(zs))
+        # exact-to-roundoff vs the fused program: the frame strips'
+        # cancellation amplifies reformulation roundoff by ~scale
+        np.testing.assert_allclose(
+            np.asarray(ax), np.asarray(rx_), rtol=1e-4, atol=1e-3
+        )
+        np.testing.assert_allclose(
+            np.asarray(ay), np.asarray(ry_), rtol=1e-4, atol=1e-3
+        )
+        np.testing.assert_allclose(float(ares), float(res_), rtol=1e-5)
+
+
+# ------------------------------------------------------ ring pipelining
+
+
+class TestRingPipeline:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("depth", [2, 4])
+    def test_depth_invariant_bitwise(self, mesh8, causal, depth):
+        """The prefetched ring consumes the same block values at every
+        step — results must be bit-identical to the depth-1 ring."""
+        from tpu_mpi_tests.comm.ring import ring_attention_fn
+
+        key = jax.random.PRNGKey(3)
+        q, k, v = (
+            jax.random.normal(kk, (64, 16), jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        qs, ks, vs = (C.shard_1d(t, mesh8) for t in (q, k, v))
+        base = ring_attention_fn(mesh8, "shard", causal=causal, depth=1)
+        piped = ring_attention_fn(
+            mesh8, "shard", causal=causal, depth=depth
+        )
+        np.testing.assert_array_equal(
+            np.asarray(base(qs, ks, vs)), np.asarray(piped(qs, ks, vs))
+        )
+
+    def test_depth_clamps_to_ring_size(self, mesh8):
+        from tpu_mpi_tests.comm.ring import ring_attention_fn
+
+        key = jax.random.PRNGKey(4)
+        q, k, v = (
+            jax.random.normal(kk, (32, 8), jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+        qs, ks, vs = (C.shard_1d(t, mesh8) for t in (q, k, v))
+        base = ring_attention_fn(mesh8, "shard", depth=1)
+        deep = ring_attention_fn(mesh8, "shard", depth=64)
+        np.testing.assert_array_equal(
+            np.asarray(base(qs, ks, vs)), np.asarray(deep(qs, ks, vs))
+        )
+
+
+# --------------------------------------------------- dispatch window
+
+
+class TestDispatchWindow:
+    def test_depth1_is_plain_span_call(self, mesh8):
+        """Depth 1 must take the per-call sync-honest path: sync spans,
+        never async ones."""
+        records = []
+        T.enable(sink=records.append)
+        x = C.shard_1d(jnp.ones((64,), jnp.float32), mesh8)
+        win = C.DispatchWindow(1)
+        y = win.call("allreduce", lambda a: a, x, nbytes=64, world=8)
+        win.drain()
+        T.disable()
+        spans = [r for r in records if r.get("kind") == "span"]
+        assert len(spans) == 1
+        assert "async" not in spans[0]
+        assert y is x
+
+    def test_bounded_inflight_and_async_spans(self, mesh8):
+        records = []
+        T.enable(sink=records.append)
+        fn = C._allreduce_fn(mesh8, "shard", 1)
+        x = C.shard_1d(jnp.ones((8,), jnp.float32), mesh8)
+        win = C.DispatchWindow(3)
+        for _ in range(7):
+            x = win.call("allreduce", fn, x, nbytes=64, world=8)
+            # the window may hold at most depth−1 after serving a call
+            assert len(win._inflight) <= 2
+        win.drain()
+        assert not win._inflight
+        T.disable()
+        spans = [r for r in records if r.get("kind") == "span"]
+        assert len(spans) == 7
+        assert all(s.get("async") is True for s in spans)
+        assert all(s.get("dispatch_depth") == 3 for s in spans)
+        # results flowed through the real collective chain
+        assert float(np.asarray(x)[0]) == 8.0**7
+
+    def test_window_results_match_direct_chain(self, mesh8):
+        fn = C._allreduce_fn(mesh8, "shard", 1)
+        x0 = jnp.arange(8, dtype=jnp.float32)
+        direct = C.shard_1d(x0, mesh8)
+        for _ in range(4):
+            direct = fn(direct)
+        windowed = C.shard_1d(x0, mesh8)
+        with C.DispatchWindow(4) as win:
+            for _ in range(4):
+                windowed = win.call("allreduce", fn, windowed)
+        np.testing.assert_array_equal(
+            np.asarray(direct), np.asarray(windowed)
+        )
+
+    def test_halo_exchange_window_routing(self, mesh8):
+        """halo_exchange(window=...) rides the window (async span);
+        window=None stays the per-call sync span — byte-identical
+        results either way."""
+        d = Domain1D(n_global=256, n_shards=8, n_bnd=2)
+        f, _ = analytic_pairs()["1d"]
+        z0 = jnp.asarray(d.init_global(f))
+        records = []
+        T.enable(sink=records.append)
+        plain = H.halo_exchange(C.shard_1d(z0, mesh8), mesh8)
+        with C.DispatchWindow(2) as win:
+            wind = H.halo_exchange(
+                C.shard_1d(z0, mesh8), mesh8, window=win
+            )
+        T.disable()
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(wind))
+        spans = [r for r in records if r.get("kind") == "span"
+                 and r.get("op") == "halo_exchange"]
+        assert len(spans) == 2
+        assert "async" not in spans[0]
+        assert spans[1].get("async") is True
+
+
+# ------------------------------------------------- async span telemetry
+
+
+def test_async_span_record_shape(mesh8):
+    records = []
+    T.enable(sink=records.append)
+    h = T.async_span("demo_op", nbytes=1000, axis_name="shard", world=8,
+                     overlap_depth=2)
+    x = C.shard_1d(jnp.ones((8,), jnp.float32), mesh8)
+    h.done(x)
+    h.done(x)  # idempotent: one record
+    T.disable()
+    spans = [r for r in records if r.get("kind") == "span"]
+    assert len(spans) == 1
+    s = spans[0]
+    assert s["op"] == "demo_op"
+    assert s["async"] is True
+    assert s["overlap_depth"] == 2
+    assert s["t_end"] >= s["t_start"]
+    assert s["mono_end"] >= s["mono_start"]
+    # counters accumulate like any other span
+    assert T.counters()["demo_op"]["ops"] == 1
+
+
+def test_async_span_inert_when_disabled():
+    h = T.async_span("demo_op")
+    h.done(None)
+    assert h.mono_end >= h.mono_start
+    assert T.counters().get("demo_op") is None
+
+
+# -------------------------------------------------- depth knob tuning
+
+
+class TestDepthTuning:
+    def test_sweep_records_winner_under_full_fingerprint(self, tmp_path):
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+        from tpu_mpi_tests.tune.sweep import sweep
+
+        tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+        records = []
+        secs = {1: 0.5, 2: 0.2}
+
+        def measure(cand):
+            return secs[int(cand)]
+
+        win = sweep(
+            "halo/overlap", measure, emit=records.append,
+            dtype="float32", n=65536, world=8,
+        )
+        assert int(win) == 2
+        fp = fingerprint(dtype="float32", n=65536, world=8)
+        cache = tr.configured_cache()
+        assert cache.lookup("halo/overlap", fp) == 2
+        kinds = [r["kind"] for r in records]
+        assert kinds.count("tune") == 2 and "tune_result" in kinds
+        assert all(r["fingerprint"] == fp for r in records
+                   if r["kind"] == "tune")
+        # resolution now serves the tuned depth
+        assert H.resolve_overlap_depth(
+            None, dtype="float32", n=65536, world=8
+        ) == 2
+
+    def test_resolution_precedence_and_prior(self, tmp_path):
+        # unconfigured: prior (1) — byte-identical to the pre-overlap era
+        assert H.resolve_overlap_depth(None, dtype="x", n=1, world=8) == 1
+        assert C.resolve_dispatch_depth(None, dtype="x", n=1) == 1
+        from tpu_mpi_tests.comm.ring import _resolve_pipeline_depth
+
+        assert _resolve_pipeline_depth(None, dtype="x", lq=8) == 1
+        # explicit always wins
+        assert H.resolve_overlap_depth(2) == 2
+        assert C.resolve_dispatch_depth(4) == 4
+        assert _resolve_pipeline_depth(4) == 4
+
+    def test_malformed_cache_degrades_to_prior(self, tmp_path):
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+
+        tr.configure(cache_path=str(tmp_path / "t.json"))
+        cache = tr.configured_cache()
+        fp = fingerprint(dtype="float32", n=64, world=8)
+        cache.store("halo/overlap", fp, "garbage")
+        cache.store("coll/dispatch_depth", fp, {"not": "an int"})
+        assert H.resolve_overlap_depth(
+            None, dtype="float32", n=64, world=8
+        ) == 1
+        assert C.resolve_dispatch_depth(
+            None, dtype="float32", n=64, world=8
+        ) == 1
+
+    def test_spaces_declared_with_unoverlapped_priors(self):
+        spaces = tr.spaces()
+        for knob in ("halo/overlap", "ring/pipeline_depth",
+                     "coll/dispatch_depth"):
+            assert knob in spaces, knob
+            assert spaces[knob].prior == 1, knob
+
+
+def test_serve_halo_handler_uses_tuned_window(tmp_path, mesh8):
+    """Satellite 2: the serve-mode halo factory resolves the tuned
+    dispatch depth like any other knob — a warmed cache entry makes
+    steady-state traffic dispatch through the window (async spans),
+    while the unconfigured prior keeps today's per-call sync path."""
+    from tpu_mpi_tests.drivers import _common
+    from tpu_mpi_tests.tune.fingerprint import device_fingerprint
+
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    tr.configured_cache().store(
+        "coll/dispatch_depth", device_fingerprint(), 3
+    )
+    records = []
+    T.enable(sink=records.append)
+    step = _common.workload_factory("halo")(mesh8, (256,), "float32")
+    records.clear()  # drop the warmup batch's spans
+    step(4)
+    T.disable()
+    spans = [r for r in records if r.get("kind") == "span"
+             and r.get("op") == "halo_exchange"]
+    assert len(spans) == 4
+    assert all(s.get("async") is True for s in spans)
+    assert all(s.get("dispatch_depth") == 3 for s in spans)
+
+
+# ------------------------------------- report / diff / trace pipeline
+
+
+class TestOverlapReporting:
+    @staticmethod
+    def _run_driver(tmp_path, name, depth):
+        from tpu_mpi_tests.drivers import stencil1d
+
+        out = tmp_path / f"{name}.jsonl"
+        rc = stencil1d.main([
+            "--n-global", "4096", "--dtype", "float64",
+            "--overlap", str(depth), "--overlap-iters", "4",
+            "--telemetry", "--jsonl", str(out),
+        ])
+        assert rc == 0
+        return out
+
+    def test_driver_records_and_report_table(self, tmp_path, capsys):
+        """The acceptance pipeline: a depth-2 fake-device run produces
+        a merged timeline whose overlap_frac > 0 while the depth-1 run
+        reports exactly 0 — and the OVERLAP table renders both."""
+        d1 = self._run_driver(tmp_path, "d1", 1)
+        d2 = self._run_driver(tmp_path, "d2", 2)
+        capsys.readouterr()
+
+        s1 = summarize([str(d1)])
+        s2 = summarize([str(d2)])
+        assert s1["overlap"]["halo"]["overlap_frac"] == 0.0
+        assert s1["overlap"]["halo"]["depth"] == 1
+        assert s2["overlap"]["halo"]["overlap_frac"] > 0.0
+        assert s2["overlap"]["halo"]["depth"] == 2
+        # the annotated phase record carries the frac too
+        assert s2["phases"]["overlap_interior"]["overlap_frac"] > 0.0
+        assert s1["phases"]["overlap_interior"]["overlap_frac"] == 0.0
+
+        from tpu_mpi_tests.instrument import aggregate
+
+        for f in (d1, d2):
+            assert aggregate.main([str(f)]) == 0
+        out = capsys.readouterr().out
+        assert "OVERLAP halo: depth=1 frac=0.000" in out
+        assert "OVERLAP halo: depth=2 frac=" in out
+
+    def test_diff_gates_reserialization(self, tmp_path, capsys):
+        """A pipeline that silently re-serializes (frac → 0) must fail
+        the --diff noise-band gate."""
+        d1 = self._run_driver(tmp_path, "d1", 1)
+        d2 = self._run_driver(tmp_path, "d2", 2)
+        capsys.readouterr()
+        from tpu_mpi_tests.instrument.aggregate import diff_main
+
+        rc = diff_main(str(d2), str(d1))
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "overlap:halo:frac" in out
+        assert "REGRESSION" in out
+
+    def test_trace_carries_async_span(self, tmp_path):
+        d2 = self._run_driver(tmp_path, "d2", 2)
+        from tpu_mpi_tests.instrument.timeline import chrome_trace
+
+        doc = chrome_trace([str(d2)])
+        spans = [e for e in doc["traceEvents"]
+                 if e["ph"] == "X" and e["name"] == "halo_exchange"
+                 and e["args"].get("overlap_depth") == 2]
+        assert spans, "pipelined exchange spans must reach the timeline"
+        assert all(e["args"].get("async") is True for e in spans)
+
+    def test_bench_rows_become_gated_series(self):
+        recs = [
+            {"kind": "attn", "tier": "ring", "stripe": False,
+             "tflops": 1.5},
+            {"kind": "attn", "tier": "ring", "stripe": False,
+             "tflops": 1.7},
+            {"kind": "heat", "steps_per_s": 650.0},
+            {"kind": "overlap", "op": "heat2d", "depth": 2,
+             "overlap_frac": 0.8, "comm_s": 0.1, "compute_s": 0.2,
+             "steps": 10, "steps_per_s": 650.0},
+        ]
+        import os
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".jsonl", delete=False
+        ) as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+            path = fh.name
+        try:
+            s = summarize([path])
+            assert s["bench"]["attn:ring:tflops"]["value"] == \
+                pytest.approx(1.6)
+            assert s["bench"]["heat:steps_per_s"]["value"] == 650.0
+            assert s["overlap"]["heat2d"]["rate"] == 650.0
+            m = _jsonl_metrics([path])
+            assert m["bench:attn:ring:tflops"]["higher_better"] is True
+            assert m["overlap:heat2d:frac"]["value"] == \
+                pytest.approx(0.8)
+            assert m["overlap:heat2d:rate"]["value"] == 650.0
+        finally:
+            os.unlink(path)
+
+
+# -------------------------------------------------- driver overlap modes
+
+
+class TestDriverOverlapModes:
+    def test_heat2d_overlap_eigen_gate(self, capsys):
+        from tpu_mpi_tests.drivers import heat2d
+
+        rc = heat2d.main([
+            "--nx-local", "12", "--ny-local", "12", "--n-steps", "30",
+            "--overlap", "2",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "OVERLAP heat2d depth=2" in out
+        assert "overlap_frac=" in out
+
+    def test_heat2d_overlap_requires_xla_per_step(self, capsys):
+        from tpu_mpi_tests.drivers import heat2d
+
+        with pytest.raises(SystemExit):
+            heat2d.main([
+                "--overlap", "2", "--kernel", "pallas",
+            ])
+
+    def test_grid_overlap_err_gate(self, capsys):
+        from tpu_mpi_tests.drivers import stencil2d_grid
+
+        rc = stencil2d_grid.main([
+            "--nx-local", "12", "--ny-local", "12", "--n-iter", "4",
+            "--n-warmup", "1", "--overlap", "2",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "OVERLAP stencil2d_grid depth=2" in out
+
+    def test_stencil1d_overlap_seam_gate(self, capsys):
+        from tpu_mpi_tests.drivers import stencil1d
+
+        rc = stencil1d.main([
+            "--n-global", "4096", "--dtype", "float64",
+            "--overlap", "2", "--overlap-iters", "4",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "OVERLAP halo depth=2" in out
+        assert "OVERLAP FAIL" not in out
+
+    def test_stencil1d_overlap_auto_tune_sweeps(self, tmp_path, capsys):
+        """--overlap auto --tune: a cache miss sweeps the depth
+        candidates, persists the winner, and a rerun is a pure hit."""
+        from tpu_mpi_tests.drivers import stencil1d
+
+        cache = tmp_path / "cache.json"
+        argv = [
+            "--n-global", "4096", "--dtype", "float64",
+            "--overlap", "auto", "--overlap-iters", "4",
+            "--tune", "--tune-cache", str(cache),
+            "--jsonl", str(tmp_path / "r1.jsonl"),
+        ]
+        assert stencil1d.main(argv) == 0
+        doc = json.loads(cache.read_text())
+        assert any(k.startswith("halo/overlap|") for k in doc["entries"])
+        argv2 = argv[:-1] + [str(tmp_path / "r2.jsonl")]
+        assert stencil1d.main(argv2) == 0
+        recs = [json.loads(line) for line in
+                (tmp_path / "r2.jsonl").read_text().splitlines()]
+        kinds = [r.get("kind") for r in recs]
+        assert "tune_hit" in kinds
+        hit_knobs = {r["knob"] for r in recs
+                     if r.get("kind") == "tune_hit"}
+        assert "halo/overlap" in hit_knobs

@@ -1,0 +1,24 @@
+"""TPM8 bad fixture: a sync between prefetch issue and consume point
+re-serializes the pipeline — the in-flight exchange drains against the
+block instead of hiding under the compute."""
+import jax
+
+from tpu_mpi_tests.instrument.telemetry import async_span
+from tpu_mpi_tests.instrument.timers import block
+
+
+def pipelined_step(exchange_fn, core_fn, z, other):
+    h = async_span("halo_exchange", nbytes=1024)
+    ex = exchange_fn(z)
+    jax.block_until_ready(other)  # BAD: drains the queue mid-region
+    out = core_fn(z)
+    h.done(ex)
+    return ex, out
+
+
+def pipelined_step_block(exchange_fn, core_fn, z):
+    h = async_span("halo_exchange")
+    ex = exchange_fn(z)
+    out = block(core_fn(z))  # BAD (unsuppressed): lexically in-region
+    h.done(ex)
+    return ex, out

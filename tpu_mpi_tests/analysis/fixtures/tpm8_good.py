@@ -1,0 +1,15 @@
+"""TPM8 good fixture: syncs happen OUTSIDE the overlap region — before
+the prefetch issues or after the handle is consumed."""
+import jax
+
+from tpu_mpi_tests.instrument.telemetry import async_span
+from tpu_mpi_tests.instrument.timers import block
+
+
+def pipelined_step(exchange_fn, core_fn, z, other):
+    jax.block_until_ready(other)  # before the region opens: fine
+    h = async_span("halo_exchange", nbytes=1024)
+    ex = exchange_fn(z)
+    out = core_fn(z)
+    h.done(ex)
+    return ex, block(out)  # after the consume point: fine

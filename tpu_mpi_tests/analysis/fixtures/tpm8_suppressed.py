@@ -1,0 +1,15 @@
+"""TPM8 suppressed fixture: the ONE sanctioned in-region sync — the
+overlapped compute itself must block under its phase bracket (that is
+the window the exchange hides beneath), and says so."""
+from tpu_mpi_tests.instrument.telemetry import async_span
+from tpu_mpi_tests.instrument.timers import block
+
+
+def pipelined_step(exchange_fn, core_fn, z):
+    h = async_span("halo_exchange", nbytes=1024)
+    ex = exchange_fn(z)
+    # the overlapped interior compute IS the measured phase — blocking
+    # on it is the design, not a re-serialization
+    out = block(core_fn(z))  # tpumt: ignore[TPM801]
+    h.done(ex)
+    return ex, out

@@ -8,6 +8,9 @@ comments stay meaningful across releases.
 from tpu_mpi_tests.analysis.rules.axis_consistency import AxisConsistency
 from tpu_mpi_tests.analysis.rules.concurrency import UnlockedSharedWrite
 from tpu_mpi_tests.analysis.rules.import_hygiene import ImportHygiene
+from tpu_mpi_tests.analysis.rules.overlap_regions import (
+    OverlapRegionSync,
+)
 from tpu_mpi_tests.analysis.rules.schedule_constants import (
     ScheduleConstants,
 )
@@ -23,4 +26,5 @@ ALL_RULES = [
     AxisConsistency(),
     UnlockedSharedWrite(),
     ScheduleConstants(),
+    OverlapRegionSync(),
 ]

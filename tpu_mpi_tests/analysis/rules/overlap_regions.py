@@ -1,0 +1,136 @@
+"""TPM8xx — overlap-region sync discipline.
+
+The bug class THIS repo's overlap engine creates (ISSUE 7, README
+"Overlap engine"), encoded the day it ships: a ``block_until_ready``-
+class sync lexically inside a declared overlap region — between a
+prefetch issue (``h = async_span(...)`` opening a dispatch-window span)
+and its consume point (``h.done(...)`` / ``h.wait(...)``) — silently
+re-serializes the pipeline. Nothing errors: results stay identical
+(the whole point of the engine), it/s regresses, and ``overlap_frac``
+quietly drops toward 0. The ``--diff`` gate catches the symptom in
+benchmarks that run; this rule catches the cause at lint time,
+everywhere.
+
+One sync inside the region is DELIBERATE by design: the overlapped
+interior compute must block under its phase bracket — that is the
+window the exchange hides beneath. The engine
+(``comm/halo.py`` ``OverlapRunner.overlap step``) carries the
+sanctioned inline suppression with its why-comment; new overlap code
+should either route through the engine (no region in driver code at
+all) or suppress its one deliberate compute-sync the same way.
+
+Detection (lexical, per function scope): an assignment whose value
+calls ``async_span`` opens a region for that handle name; the first
+``<handle>.done(...)`` or ``<handle>.wait(...)`` closes it; any call to
+``block`` (``instrument.timers.block``), ``jax.block_until_ready``, or
+a ``.block_until_ready()`` method at a line strictly inside an open
+region is a TPM801 finding. An unconsumed handle leaves its region
+open to the end of the function — a dangling dispatch-window span is
+exactly when an accidental sync hides longest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import FileContext, attr_parts
+
+#: call targets that open an overlap region when bound to a name
+PREFETCH_NAMES = {"async_span"}
+#: handle methods that consume (close) the region
+CONSUME_ATTRS = {"done", "wait"}
+#: sync call heuristics: the repo's block() helper, jax's module-level
+#: sync, and the method spelling
+SYNC_LAST_ATTRS = {"block_until_ready"}
+SYNC_RESOLVED = {
+    "tpu_mpi_tests.instrument.timers.block",
+    "jax.block_until_ready",
+}
+
+
+def _is_prefetch(call: ast.Call, ctx: FileContext) -> bool:
+    resolved = ctx.imports.resolve(call.func) or ""
+    return resolved.rsplit(".", 1)[-1] in PREFETCH_NAMES
+
+
+def _is_sync(call: ast.Call, ctx: FileContext) -> bool:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in SYNC_LAST_ATTRS:
+        return True
+    resolved = ctx.imports.resolve(func) or ""
+    if resolved in SYNC_RESOLVED:
+        return True
+    # bare `block(...)` bound from the timers module resolves above;
+    # a same-file helper named block still counts (same hazard)
+    return resolved.rsplit(".", 1)[-1] == "block"
+
+
+class OverlapRegionSync:
+    name = "overlap-regions"
+    scope = "file"
+    codes = {
+        "TPM801": "sync call inside a declared overlap region (between "
+                  "a prefetch issue and its consume point) — "
+                  "re-serializes the pipeline",
+    }
+
+    def check(self, ctx: FileContext) -> Iterator[tuple]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_scope(ctx, node)
+
+    def _check_scope(self, ctx: FileContext, fn) -> Iterator[tuple]:
+        """Line-ordered event scan of ONE function body (nested defs get
+        their own scan — their lines must not leak region state)."""
+        events: list[tuple[int, str, object]] = []
+        nested: set[int] = set()
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                for inner in ast.walk(sub):
+                    nested.add(id(inner))
+        for sub in ast.walk(fn):
+            if id(sub) in nested:
+                continue
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ) and _is_prefetch(sub.value, ctx):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        events.append((sub.lineno, "open", t.id))
+            elif isinstance(sub, ast.Call):
+                func = sub.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in CONSUME_ATTRS
+                    and isinstance(func.value, ast.Name)
+                ):
+                    events.append((sub.lineno, "close", func.value.id))
+                elif _is_sync(sub, ctx):
+                    parts = attr_parts(func)
+                    events.append(
+                        (sub.lineno, "sync",
+                         (sub, ".".join(parts) if parts else "sync"))
+                    )
+        events.sort(key=lambda e: e[0])
+        open_regions: dict[str, int] = {}
+        for line, kind, payload in events:
+            if kind == "open":
+                open_regions[payload] = line
+            elif kind == "close":
+                open_regions.pop(payload, None)
+            elif open_regions:
+                call, name = payload
+                handle, at = next(iter(open_regions.items()))
+                yield (
+                    call.lineno, call.col_offset, "TPM801",
+                    f"'{name}(...)' syncs inside the overlap region "
+                    f"opened by '{handle} = async_span(...)' at line "
+                    f"{at} — the in-flight comm serializes against it "
+                    f"and overlap_frac silently drops to 0; move the "
+                    f"sync after '{handle}.done()', or suppress with a "
+                    f"why-comment if this sync IS the overlapped "
+                    f"compute phase",
+                )

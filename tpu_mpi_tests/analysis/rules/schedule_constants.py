@@ -21,9 +21,9 @@ Sanctioned homes, exempt by construction:
   lives).
 
 Heuristic scope: ALL-CAPS module-level names containing a schedule
-keyword (TILE/BLOCK/STEP/STAGING/SCHEDULE/CREDIT/MEASURED/K_GROUP)
-whose value carries a numeric literal. String-valued config names and
-function-local values are out of scope.
+keyword (TILE/BLOCK/STEP/STAGING/SCHEDULE/CREDIT/MEASURED/K_GROUP/
+DEPTH/OVERLAP) whose value carries a numeric literal. String-valued
+config names and function-local values are out of scope.
 """
 
 from __future__ import annotations
@@ -39,7 +39,8 @@ TUNE_PREFIX = "tpu_mpi_tests.tune"
 
 _CONST_NAME = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
 _SCHEDULE_WORD = re.compile(
-    r"(TILE|BLOCK|STEP|STAGING|SCHEDULE|CREDIT|MEASURED|K_GROUP|KGROUP)"
+    r"(TILE|BLOCK|STEP|STAGING|SCHEDULE|CREDIT|MEASURED|K_GROUP|KGROUP"
+    r"|DEPTH|OVERLAP)"  # the ISSUE-7 pipeline knobs are schedules too
 )
 
 

@@ -24,6 +24,7 @@ fake CPU devices and TPU slices.
 from __future__ import annotations
 
 import functools
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -32,8 +33,142 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_mpi_tests.compat import shard_map
-from tpu_mpi_tests.instrument.telemetry import comm_span, span_call
+from tpu_mpi_tests.instrument.telemetry import (
+    async_span,
+    comm_span,
+    span_call,
+)
+from tpu_mpi_tests.tune import priors as _priors
+from tpu_mpi_tests.tune.registry import (
+    declare_space,
+    resolve as _tune_resolve,
+)
 from tpu_mpi_tests.utils import TpuMtError, check_divisible
+
+#: the collective dispatch-depth knob (ISSUE 7 tentpole c) — declared
+#: here because the chained-collective dispatch pattern lives here;
+#: prior 1 = today's per-call sync, byte-identical untuned
+COLL_DISPATCH_SPACE = declare_space(
+    "coll/dispatch_depth",
+    (_priors.COLL_DISPATCH_DEPTH, 2, 4, 8),
+    describe="chained collective dispatches allowed in flight before "
+             "the window blocks on the oldest",
+)
+
+
+def resolve_dispatch_depth(explicit=None, **ctx) -> int:
+    """Dispatch-window depth: explicit > cached winner > prior (1).
+    The device-only fallback stays ON (unlike the shape-keyed knobs):
+    dispatch depth prices host dispatch/drain latency, which is a
+    device/controller property far more than a payload one, so one
+    collbench sweep's winner serves every chained site on the machine.
+    Malformed cache values degrade to the prior."""
+    val = _tune_resolve(
+        "coll/dispatch_depth", explicit=explicit,
+        prior=_priors.COLL_DISPATCH_DEPTH, **ctx,
+    )
+    try:
+        depth = int(val)
+    except (TypeError, ValueError):
+        depth = _priors.COLL_DISPATCH_DEPTH
+    return max(1, depth)
+
+
+def _any_deleted(tree) -> bool:
+    """True when any jax.Array leaf was deleted (donated to a later
+    dispatch) — such a result cannot be blocked on directly."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and leaf.is_deleted():
+            return True
+    return False
+
+
+class DispatchWindow:
+    """Bound the sync-honesty window of chained collective dispatches.
+
+    Per-call sync (``span_call``) charges every collective its full
+    dispatch + drain round-trip; a chained sequence (serve-mode batches,
+    halo-exchange chains) can instead keep up to ``depth`` dispatches in
+    flight before blocking on the oldest — bounding how stale the
+    "measured" window can get instead of syncing per call. ``depth=1``
+    degenerates to ``span_call`` per call, byte-identical to the
+    pre-window behavior; ``depth=None`` resolves through the schedule
+    cache (``coll/dispatch_depth``, prior 1).
+
+    Spans recorded through an open window are dispatch-window spans
+    (``async: true`` — :class:`~tpu_mpi_tests.instrument.telemetry.
+    AsyncSpan`): their window runs dispatch → drain, NOT the op's
+    sync-honest duration. Use as a context manager; exit drains every
+    in-flight op so no span is left dangling.
+    """
+
+    def __init__(self, depth: int | None = None, **ctx):
+        self.depth = resolve_dispatch_depth(depth, **ctx)
+        self._inflight: deque = deque()
+
+    def call(self, op: str, fn, *args, nbytes: int = 0,
+             axis_name: str | None = None, world: int = 1, **meta):
+        """Dispatch ``fn(*args)`` under this window. Depth 1: the
+        per-call sync-honest path (``span_call``), unchanged. Depth ≥ 2:
+        the op rides an open dispatch-window span; once ``depth`` ops
+        are in flight the oldest is drained first."""
+        if self.depth <= 1:
+            return span_call(
+                op, fn, *args, nbytes=nbytes, axis_name=axis_name,
+                world=world, **meta,
+            )
+        handle = async_span(
+            op, nbytes=nbytes, axis_name=axis_name, world=world,
+            dispatch_depth=self.depth, **meta,
+        )
+        out = fn(*args)
+        self._inflight.append((handle, out))
+        while len(self._inflight) >= self.depth:
+            self._drain_oldest()
+        return out
+
+    def _drain_oldest(self) -> None:
+        """Retire the oldest in-flight op. A donating chained fn (the
+        normal case: ``x = allreduce(x)``) consumes older outputs as
+        later inputs, so the oldest buffer may already be deleted and
+        cannot be blocked on directly; in-order dispatch means the
+        first STILL-LIVE result's completion proves everything before
+        it completed, so the window blocks once there and closes every
+        span it vouches for. Non-donating chains degrade to the classic
+        block-the-oldest; donating chains sync once per ``depth`` calls
+        — the bounded-window cadence this knob exists to buy."""
+        live = next(
+            (i for i, (_, res) in enumerate(self._inflight)
+             if not _any_deleted(res)),
+            None,
+        )
+        if live is None:
+            # every in-flight result was donated by work dispatched
+            # OUTSIDE the window: nothing left to block on — close the
+            # spans at the drain point without a sync (the external
+            # consumer's own sync is the only remaining observation
+            # point; crashing the drain would be worse than the
+            # slightly-early close)
+            while self._inflight:
+                h, _ = self._inflight.popleft()
+                h.done(None)
+            return
+        target = self._inflight[live][1]
+        for _ in range(live + 1):
+            h, res = self._inflight.popleft()
+            h.done(res if not _any_deleted(res) else target)
+
+    def drain(self) -> None:
+        """Block on every in-flight op (closing its span) — the window's
+        consume point; idempotent."""
+        while self._inflight:
+            self._drain_oldest()
+
+    def __enter__(self) -> "DispatchWindow":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.drain()
 
 
 def shard_1d(arr, mesh: Mesh, axis_name: str | None = None, axis: int = 0):

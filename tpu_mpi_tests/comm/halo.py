@@ -99,6 +99,37 @@ STENCIL_STEPS_SPACE = declare_space(
     describe="temporal-blocking depth (timesteps fused per HBM pass)",
 )
 
+#: the halo pipeline depth (ISSUE 7 tentpole a): 1 = today's serialized
+#: exchange-then-update schedule (the prior, so untuned resolution is
+#: byte-identical to the pre-overlap era); 2 = double-buffered — the
+#: ghost exchange rides in flight while the interior/boundary-split
+#: update computes its core (the reference's Irecv/compute/Waitall
+#: pattern, host-scheduled; README "Overlap engine"). Deeper than 2
+#: would need temporally-blocked ghosts — not a candidate here.
+HALO_OVERLAP_SPACE = declare_space(
+    "halo/overlap",
+    (_priors.HALO_OVERLAP_DEPTH, 2),
+    describe="halo pipeline depth: 1 = serialized, 2 = exchange in "
+             "flight under the interior compute",
+)
+
+
+def resolve_overlap_depth(explicit=None, **ctx) -> int:
+    """The halo pipeline depth to run: explicit > cached winner >
+    shipped prior (1 — the serialized schedule). Context-sensitive
+    (``device_fallback=False``): an overlap win measured at one
+    shape/dtype must not leak to another through the device-only
+    slot. Malformed cache values degrade to the prior."""
+    val = _tune_resolve(
+        "halo/overlap", explicit=explicit,
+        prior=_priors.HALO_OVERLAP_DEPTH, device_fallback=False, **ctx,
+    )
+    try:
+        depth = int(val)
+    except (TypeError, ValueError):
+        depth = _priors.HALO_OVERLAP_DEPTH
+    return max(1, min(depth, 2))
+
 
 def _staging_context(zg, axis: int, world: int) -> dict:
     """Cache context for the halo/staging knob: what moves the optimum
@@ -139,6 +170,18 @@ def resolve_staging(staging: "Staging | str", zg, axis: int,
         # mode a cache must never silently select
         resolved = Staging.DIRECT
     return resolved
+
+
+def halo_payload_bytes(zg, axis: int, world: int, n_bnd: int,
+                       periodic: bool) -> int:
+    """Telemetry payload convention for one halo exchange: 2 directions ×
+    one ghost band per neighbor pair (``world`` pairs on a periodic ring,
+    ``world−1`` otherwise); band = ``n_bnd`` slabs of the non-decomposed
+    extent. Shared by the per-call spans and the overlap engine's
+    dispatch-window spans so both account the same bytes."""
+    pairs = world if periodic else world - 1
+    band_bytes = n_bnd * (zg.size // zg.shape[axis]) * zg.dtype.itemsize
+    return 2 * pairs * band_bytes
 
 
 def _ring_rotate(lo_edge, hi_edge, cur_lo, cur_hi, *, axis_name: str,
@@ -302,6 +345,7 @@ def halo_exchange(
     periodic: bool = False,
     staging: Staging | str = Staging.DIRECT,
     interpret: bool | None = None,
+    window=None,
 ):
     """Exchange halos of a ghosted-global sharded array (see arrays/domain.py
     for the layout: each shard holds its ghosted block along ``axis``).
@@ -314,6 +358,15 @@ def halo_exchange(
     ``pltpu.InterpretParams`` for the simulated multi-device interpreter —
     the mode ``tests/test_ring_sync.py`` uses to execute the ring's
     barrier under race detection).
+
+    ``window`` (a :class:`~tpu_mpi_tests.comm.collectives.DispatchWindow`)
+    routes the DIRECT/DEVICE_STAGED dispatch through a bounded in-flight
+    window instead of the per-call sync-honest span — the serve-mode
+    chained-exchange path (README "Overlap engine"). ``window=None``
+    (the default) is byte-identical to the pre-window behavior; the
+    HOST_STAGED and PALLAS_RDMA tiers ignore the window (host staging is
+    synchronous by construction, and a wedged RDMA ring must keep its
+    per-call dispatch note adjacency).
     """
     axis_name = axis_name or mesh.axis_names[0]
     from tpu_mpi_tests.arrays.spaces import ensure_device
@@ -325,9 +378,7 @@ def halo_exchange(
     # (world pairs on a periodic ring, world−1 otherwise); band = n_bnd
     # slabs of the non-decomposed extent. Computed before the call — the
     # input is donated and its metadata may be gone afterwards.
-    pairs = world if periodic else world - 1
-    band_bytes = n_bnd * (zg.size // zg.shape[axis]) * zg.dtype.itemsize
-    nbytes = 2 * pairs * band_bytes
+    nbytes = halo_payload_bytes(zg, axis, world, n_bnd, periodic)
     if staging is Staging.HOST_STAGED:
         return span_call(
             "halo_exchange_host",
@@ -354,17 +405,24 @@ def halo_exchange(
             zg,
             nbytes=nbytes, axis_name=axis_name, world=world,
         )
+    fn = _exchange_fn(
+        mesh,
+        axis_name,
+        axis,
+        zg.ndim,
+        n_bnd,
+        periodic,
+        staging is Staging.DEVICE_STAGED,
+    )
+    if window is not None:
+        return window.call(
+            "halo_exchange", fn, zg,
+            nbytes=nbytes, axis_name=axis_name, world=world,
+            staging=staging.value,
+        )
     return span_call(
         "halo_exchange",
-        _exchange_fn(
-            mesh,
-            axis_name,
-            axis,
-            zg.ndim,
-            n_bnd,
-            periodic,
-            staging is Staging.DEVICE_STAGED,
-        ),
+        fn,
         zg,
         nbytes=nbytes, axis_name=axis_name, world=world,
         staging=staging.value,
@@ -1229,3 +1287,437 @@ def exchange_stencil_fused_fn(
         return stencil1d_5(z, scale=scale, axis=axis)
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# Overlap engine (ISSUE 7 tentpole a): host-scheduled double-buffered halos
+# with an explicit interior/boundary seam — README "Overlap engine"
+# ---------------------------------------------------------------------------
+
+
+class OverlapRunner:
+    """Host-level comm/compute overlap engine for one pipelined phase.
+
+    Depth 1 (:meth:`serial_step`) is today's schedule: a sync-honest
+    blocking exchange, then the timed compute phase — byte-identical to
+    the unpipelined driver loop. Depth ≥ 2 (:meth:`overlap_step`)
+    dispatches the exchange, computes the update's CORE (every cell
+    whose stencil touches no fresh ghost — it depends only on old data)
+    while the ghost bands fly, then drains the exchange and lets the
+    caller patch the boundary seam. The reference's Irecv / compute
+    interior / Waitall / fill boundary pattern
+    (``mpi_stencil2d_gt.cc:136-255``), scheduled from the host so the
+    span timeline can *prove* the overlap.
+
+    Accounting: per step, the measured wall overlap between the
+    exchange's dispatch-window span (its own recorded mono clock —
+    :class:`~tpu_mpi_tests.instrument.telemetry.AsyncSpan`, the PR-2
+    span-timeline data) and the interior-compute window.
+    ``overlap_frac`` = overlapped seconds / compute seconds. Be precise
+    about what this measures: it is SCHEDULE overlap — the comm was in
+    flight across the compute window — so a healthy depth-2 pipeline
+    reads ≈ 1.0 *by construction* (the span opens before and drains
+    after the phase), while any reversion to serialized scheduling
+    (depth resolving to 1, a restructured loop) reads exactly 0; that
+    reversion is what the ``--diff`` frac gate catches. A sync smuggled
+    INSIDE the region would not move this number — that hazard is rule
+    TPM801's (static) job. The genuinely *measured* hiding signal is
+    ``drain_s`` (accumulated from ``AsyncSpan.done``): ~0 means the
+    exchange completed under the compute; large means the compute
+    finished first and the pipeline waited — comm was NOT hidden.
+    ``comm_s`` is the dispatch-window width (dispatch → drain), not
+    device DMA time; ``roofline_frac`` (PR 5) stays the arbiter of
+    whether overlap bought real bandwidth.
+    """
+
+    def __init__(self, op: str, *, depth: int, nbytes: int = 0,
+                 axis_name: str | None = None, world: int = 1,
+                 timer=None, phase: str = "overlap_interior", **meta):
+        self.op = op
+        self.depth = max(1, int(depth))
+        self.nbytes = int(nbytes)
+        self.axis_name = axis_name
+        self.world = world
+        self.timer = timer
+        self.phase = phase
+        self.meta = meta
+        self.comm_s = 0.0
+        self.compute_s = 0.0
+        self.overlap_s = 0.0
+        self.drain_s = 0.0
+        self.steps = 0
+
+    def _phase_ctx(self):
+        if self.timer is not None:
+            return self.timer.phase(self.phase)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def step(self, exchange_fn, core_fn, z):
+        """One pipeline step: returns ``(ex, core_out)``; the caller
+        applies the boundary seam from both.
+
+        Depth 1 — the serialized schedule: the exchange is dispatched
+        and drained under a sync-honest span, THEN the core computes
+        (from ``ex``; bit-identical to computing from ``z`` since the
+        core taps no ghost and the exchange writes only ghosts). Depth
+        ≥ 2: the exchange rides an open dispatch-window span while the
+        core computes from the pre-exchange buffer. Both depths run
+        the SAME compiled programs on bit-identical inputs, which is
+        what makes the depth-independence claim structural rather than
+        hopeful (XLA fuses different program shapes differently — even
+        per-cell-identical arithmetic can differ in final bits across
+        programs, so equality is engineered by sharing programs, not
+        asserted across formulations)."""
+        import time as _time
+
+        from tpu_mpi_tests.instrument import telemetry as _T
+        from tpu_mpi_tests.instrument.timers import block
+
+        if self.depth <= 1:
+            ex = _T.span_call(
+                self.op, exchange_fn, z, nbytes=self.nbytes,
+                axis_name=self.axis_name, world=self.world, **self.meta,
+            )
+            ex = block(ex)
+            t0 = _time.perf_counter()
+            with self._phase_ctx():
+                out = block(core_fn(ex))
+            self.compute_s += _time.perf_counter() - t0
+            self.steps += 1
+            return ex, out
+
+        h = _T.async_span(
+            self.op, nbytes=self.nbytes, axis_name=self.axis_name,
+            world=self.world, overlap_depth=self.depth, **self.meta,
+        )
+        ex = exchange_fn(z)
+        t0 = _time.perf_counter()
+        with self._phase_ctx():
+            # deliberate sync INSIDE the overlap region: the overlapped
+            # interior compute must block here — that IS the measured
+            # phase the exchange hides under; only syncs on the
+            # in-flight exchange itself would re-serialize
+            out = block(core_fn(z))  # tpumt: ignore[TPM801]
+        t1 = _time.perf_counter()
+        h.done(ex)
+        self.compute_s += t1 - t0
+        self.comm_s += h.mono_end - h.mono_start
+        self.drain_s += h.drain_s
+        self.overlap_s += max(
+            0.0, min(h.mono_end, t1) - max(h.mono_start, t0)
+        )
+        self.steps += 1
+        return ex, out
+
+    @property
+    def overlap_frac(self) -> float:
+        return self.overlap_s / self.compute_s if self.compute_s else 0.0
+
+    def annotate(self, timer=None) -> None:
+        """Attach the measured overlap to the compute phase's record
+        (``PhaseTimer.annotate`` → the JSONL ``time`` record), so the
+        OVERLAP table and ``--diff`` can gate it."""
+        t = timer if timer is not None else self.timer
+        if t is not None and hasattr(t, "annotate"):
+            t.annotate(
+                self.phase,
+                overlap_frac=self.overlap_frac,
+                comm_overlap_s=self.overlap_s,
+                overlap_depth=self.depth,
+            )
+
+    def record(self, op: str | None = None, **extra) -> dict:
+        """The ``kind: "overlap"`` JSONL record for this run — one per
+        pipelined phase, rendered by tpumt-report's OVERLAP table and
+        gated by ``--diff`` (``overlap:<op>:frac``)."""
+        return {
+            "kind": "overlap",
+            "op": op or self.op,
+            "depth": self.depth,
+            "steps": self.steps,
+            "overlap_frac": self.overlap_frac,
+            "comm_s": self.comm_s,
+            "compute_s": self.compute_s,
+            "drain_s": self.drain_s,
+            "world": self.world,
+            **extra,
+        }
+
+
+@functools.lru_cache(maxsize=None)
+def overlap_jacobi_fns(
+    mesh: Mesh,
+    axis_name: str,
+    axis: int,
+    ndim: int,
+    n_bnd: int,
+    scale: float,
+    eps: float,
+    periodic: bool = False,
+    staged: bool = False,
+):
+    """Split-step programs for the 1-D Jacobi pipeline (the
+    ``iterate_fused_fn`` body, exchange-then-update, as three compiled
+    pieces): ``(exchange_nod, core, seam)``.
+
+    * ``exchange_nod(z)``: the ppermute ghost exchange WITHOUT input
+      donation — in the pipelined schedule the core still reads the
+      pre-exchange buffer while the bands fly, so the buffer must
+      survive the dispatch.
+    * ``core(z)``: the per-step update (``interior += eps·dz``)
+      restricted to cells whose stencil touches NO ghost
+      (``[2·n_bnd, N−2·n_bnd)`` along ``axis``) — depends only on old
+      data, so it runs while the exchange flies. Depth 1 feeds it the
+      exchanged array instead; the core's taps are ghost-free, so the
+      two inputs are bit-identical where it reads.
+    * ``seam(ex, zc)``: the boundary patch — recompute the two
+      ``n_bnd``-wide strips from the arrived ghosts (windows of ``ex``)
+      and write strips + ghost bands into the core-updated array.
+
+    Per-cell the split computes the serial taps with the serial
+    arithmetic; the depth-1 and depth≥2 schedules run these SAME
+    programs, so their results are bit-identical by construction
+    (gated by ``tests/test_overlap.py``; vs the device-chained
+    ``iterate_fused_fn`` the agreement is exact-to-roundoff — XLA may
+    fuse the one-program formulation with different FMA boundaries)."""
+    from tpu_mpi_tests.kernels.stencil import stencil1d_5
+    from tpu_mpi_tests.utils import TpuMtError
+
+    spec = [None] * ndim
+    spec[axis] = axis_name
+    smap = functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(*spec),
+        check_vma=False,
+    )
+
+    @jax.jit
+    @smap
+    def exchange_nod(z):
+        return exchange_shard(
+            z, axis_name=axis_name, axis=axis, n_bnd=n_bnd,
+            periodic=periodic, staged=staged,
+        )
+
+    @jax.jit
+    @smap
+    def core(z):
+        N = z.shape[axis]
+        if N < 4 * n_bnd + 1:
+            raise TpuMtError(
+                f"overlap_jacobi_fns: local ghosted extent {N} too small "
+                f"for the interior/boundary split (need > {4 * n_bnd})"
+            )
+        # core cells [2nb, N-2nb) tap [nb, N-nb) — no ghosts
+        window = lax.slice_in_dim(z, n_bnd, N - n_bnd, axis=axis)
+        dz = stencil1d_5(window, scale=scale, axis=axis)
+        new_core = (
+            lax.slice_in_dim(z, 2 * n_bnd, N - 2 * n_bnd, axis=axis)
+            + eps * dz
+        )
+        return lax.dynamic_update_slice_in_dim(
+            z, new_core, 2 * n_bnd, axis=axis
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(*spec), P(*spec)),
+        out_specs=P(*spec), check_vma=False,
+    )
+    def seam(ex, zc):
+        N = ex.shape[axis]
+        # lo strip [nb, 2nb) taps ex[0, 3nb); hi strip mirrors
+        lo_win = lax.slice_in_dim(ex, 0, 3 * n_bnd, axis=axis)
+        new_lo = (
+            lax.slice_in_dim(ex, n_bnd, 2 * n_bnd, axis=axis)
+            + eps * stencil1d_5(lo_win, scale=scale, axis=axis)
+        )
+        hi_win = lax.slice_in_dim(ex, N - 3 * n_bnd, N, axis=axis)
+        new_hi = (
+            lax.slice_in_dim(ex, N - 2 * n_bnd, N - n_bnd, axis=axis)
+            + eps * stencil1d_5(hi_win, scale=scale, axis=axis)
+        )
+        out = lax.dynamic_update_slice_in_dim(zc, new_lo, n_bnd, axis=axis)
+        out = lax.dynamic_update_slice_in_dim(
+            out, new_hi, N - 2 * n_bnd, axis=axis
+        )
+        # ghost bands: exactly the exchange's arrivals (serial keeps them)
+        out = lax.dynamic_update_slice_in_dim(
+            out, lax.slice_in_dim(ex, 0, n_bnd, axis=axis), 0, axis=axis
+        )
+        return lax.dynamic_update_slice_in_dim(
+            out, lax.slice_in_dim(ex, N - n_bnd, N, axis=axis),
+            N - n_bnd, axis=axis,
+        )
+
+    return exchange_nod, core, seam
+
+
+@functools.lru_cache(maxsize=None)
+def heat_overlap_fns(
+    mesh: Mesh,
+    axis_x: str,
+    axis_y: str,
+    cx: float,
+    cy: float,
+):
+    """Split-step programs for the heat2d pipeline (periodic dual-axis,
+    ``n_bnd=1``, one Euler step per exchange — the ``heat_step2d_fn``
+    XLA body): ``(exchange_nod, core, seam)``.
+
+    ``exchange_nod(z)`` chains both axes' periodic exchanges without
+    donation; ``core(z)`` updates the cells at distance ≥ 2 from every
+    shard edge (no ghost taps); ``seam(ex, zc)`` recomputes the 1-wide
+    boundary frame from the arrived ghosts and copies the ghost
+    rows/columns. The driver's ``--overlap 1`` resolution keeps
+    today's fused device-side loop untouched (byte-identical
+    schedules); the engine's own depth-1/depth-2 runs share these
+    programs and are bit-identical to each other, exact-to-roundoff
+    vs the fused body (gated by ``tests/test_overlap.py`` and
+    end-to-end by the driver's eigen check)."""
+
+    def _exchange_body(z):
+        z = exchange_shard(z, axis_name=axis_x, axis=0, n_bnd=1,
+                           periodic=True)
+        return exchange_shard(z, axis_name=axis_y, axis=1, n_bnd=1,
+                              periodic=True)
+
+    spec = P(axis_x, axis_y)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+        check_vma=False,
+    )
+    def exchange_nod(z):
+        return _exchange_body(z)
+
+    def _lap(zz, ix, iy, jx, jy):
+        """One Euler update of the window ``[ix:jx) × [iy:jy)`` from its
+        ±1 neighbors — the exact ``heat_step2d_fn`` arithmetic on a
+        sub-slab (per-cell identical taps and casts)."""
+        mid = zz[ix:jx, iy:jy]
+        d2x = zz[ix + 1:jx + 1, iy:jy] + zz[ix - 1:jx - 1, iy:jy] \
+            - 2.0 * mid
+        d2y = zz[ix:jx, iy + 1:jy + 1] + zz[ix:jx, iy - 1:jy - 1] \
+            - 2.0 * mid
+        return mid + zz.dtype.type(cx) * d2x + zz.dtype.type(cy) * d2y
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+        check_vma=False,
+    )
+    def core(z):
+        nx, ny = z.shape
+        new = _lap(z, 2, 2, nx - 2, ny - 2)
+        return lax.dynamic_update_slice(z, new, (2, 2))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    def seam(ex, zc):
+        nx, ny = ex.shape
+        out = zc
+        # boundary frame from the arrived ghosts: two full-width rows,
+        # two columns excluding the rows already written
+        out = lax.dynamic_update_slice(
+            out, _lap(ex, 1, 1, 2, ny - 1), (1, 1)
+        )
+        out = lax.dynamic_update_slice(
+            out, _lap(ex, nx - 2, 1, nx - 1, ny - 1), (nx - 2, 1)
+        )
+        out = lax.dynamic_update_slice(
+            out, _lap(ex, 2, 1, nx - 2, 2), (2, 1)
+        )
+        out = lax.dynamic_update_slice(
+            out, _lap(ex, 2, ny - 2, nx - 2, ny - 1), (2, ny - 2)
+        )
+        # ghost rows/columns exactly as the exchange left them (the
+        # serial update never touches ghosts)
+        out = lax.dynamic_update_slice(out, ex[0:1, :], (0, 0))
+        out = lax.dynamic_update_slice(out, ex[nx - 1:nx, :], (nx - 1, 0))
+        out = lax.dynamic_update_slice(out, ex[:, 0:1], (0, 0))
+        return lax.dynamic_update_slice(out, ex[:, ny - 1:ny], (0, ny - 1))
+
+    return exchange_nod, core, seam
+
+
+@functools.lru_cache(maxsize=None)
+def grid_overlap_fns(
+    mesh: Mesh,
+    axis_x: str,
+    axis_y: str,
+    n_bnd: int,
+    scale_x: float,
+    scale_y: float,
+):
+    """Split-step programs for the 2-D-grid derivative pipeline (the
+    ``step2d_fn`` XLA pipeline): ``(exchange_nod, core, seam)``.
+
+    ``core(z)`` computes both derivatives' interiors from old data only
+    — ``dz_dx`` rows ``[nb, nxi−nb)`` never tap a row ghost (and never
+    tap column ghosts at all; the dual slab is pre-sliced to interior
+    columns), symmetrically for ``dz_dy``. ``seam(ex, cores)``
+    completes the ``nb``-wide frame rows/columns from the exchanged
+    array, reassembles the full derivative fields, and reduces the
+    global residual (``psum`` over both mesh axes) — per-cell identical
+    to the fused serial program; the residual's reduction order may
+    differ in the last bits (tolerance-gated like every residual)."""
+    from tpu_mpi_tests.kernels.stencil import stencil1d_5
+
+    spec = P(axis_x, axis_y)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=spec, out_specs=spec,
+        check_vma=False,
+    )
+    def exchange_nod(z):
+        z = exchange_shard(z, axis_name=axis_x, axis=0, n_bnd=n_bnd)
+        return exchange_shard(z, axis_name=axis_y, axis=1, n_bnd=n_bnd)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=spec, out_specs=(spec, spec),
+        check_vma=False,
+    )
+    def core(z):
+        nb = n_bnd
+        nxg, nyg = z.shape
+        slab = z[nb:nxg - nb, nb:nyg - nb]  # interior both dims
+        dx_core = stencil1d_5(slab, scale=scale_x, axis=0)
+        dy_core = stencil1d_5(slab, scale=scale_y, axis=1)
+        return dx_core, dy_core
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, P()),
+        check_vma=False,
+    )
+    def seam(ex, dx_core, dy_core):
+        nb = n_bnd
+        nxg, nyg = ex.shape
+        dx_top = stencil1d_5(
+            ex[0:3 * nb, nb:nyg - nb], scale=scale_x, axis=0
+        )
+        dx_bot = stencil1d_5(
+            ex[nxg - 3 * nb:nxg, nb:nyg - nb], scale=scale_x, axis=0
+        )
+        dz_dx = jnp.concatenate([dx_top, dx_core, dx_bot], axis=0)
+        dy_lo = stencil1d_5(
+            ex[nb:nxg - nb, 0:3 * nb], scale=scale_y, axis=1
+        )
+        dy_hi = stencil1d_5(
+            ex[nb:nxg - nb, nyg - 3 * nb:nyg], scale=scale_y, axis=1
+        )
+        dz_dy = jnp.concatenate([dy_lo, dy_core, dy_hi], axis=1)
+        residual = jnp.sum(jnp.square(dz_dx)) + jnp.sum(jnp.square(dz_dy))
+        return dz_dx, dz_dy, lax.psum(residual, (axis_x, axis_y))
+
+    return exchange_nod, core, seam

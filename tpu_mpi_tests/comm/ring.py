@@ -108,12 +108,25 @@ def ring_pass(x, axis_name: str, shift: int = 1):
     return lax.ppermute(x, axis_name, perm)
 
 
-def ring_scan(f, init, block, axis_name: str):
+def ring_scan(f, init, block, axis_name: str, depth: int = 1):
     """Fold ``f(carry, block_j, j)`` over every rank's block ``j`` as blocks
     rotate around the ring; after ``n`` steps each rank has seen all blocks.
 
     ``f`` must keep carry shapes static. Step ``s`` on rank ``r`` sees the
     block originally owned by rank ``(r - s) % n``.
+
+    ``depth`` is the K/V prefetch pipeline depth (ISSUE 7 tentpole b,
+    knob ``ring/pipeline_depth``): 1 — the exact historical schedule —
+    rotates the block AFTER consuming it; ``depth = d ≥ 2`` keeps
+    ``d − 1`` rotations in flight, so the ``ppermute`` producing the
+    next block was issued a full step earlier and its
+    collective-permute-start precedes the current block's compute in
+    program order — XLA's latency-hiding scheduler can run them
+    together. The consumed values are identical at every depth (step
+    ``s`` always sees ``rot^s(block)``), so results are bit-identical
+    (gated by ``tests/test_overlap.py``); ``depth`` is clamped to the
+    ring size. Cost: ``d − 1`` live extra block buffers and as many
+    tail rotations whose results are dropped.
     """
     n = axis_size(axis_name)
     r = lax.axis_index(axis_name)
@@ -122,16 +135,34 @@ def ring_scan(f, init, block, axis_name: str):
     init = jax.tree.map(
         lambda x: pcast_varying(jnp.asarray(x), axis_name), init
     )
+    d = max(1, min(int(depth), n))
+
+    if d == 1:
+        def body(s, state):
+            carry, blk = state
+            src = lax.rem(r - s + n, jnp.int32(n))
+            carry = f(carry, blk, src)
+            # rotate for the next step (sent even on the last step; XLA
+            # drops nothing observable and the loop stays uniform)
+            return carry, ring_pass(blk, axis_name)
+
+        carry, _ = lax.fori_loop(0, n, body, (init, block))
+        return carry
+
+    # pipelined: the in-flight queue holds rot^s(block) .. rot^{s+d-1};
+    # the prologue issues the first d−1 rotations before any compute
+    q = (block,)
+    for _ in range(d - 1):
+        q = q + (ring_pass(q[-1], axis_name),)
 
     def body(s, state):
-        carry, blk = state
+        carry, q = state
         src = lax.rem(r - s + n, jnp.int32(n))
-        carry = f(carry, blk, src)
-        # rotate for the next step (sent even on the last step; XLA drops
-        # nothing observable and the loop stays uniform)
-        return carry, ring_pass(blk, axis_name)
+        carry = f(carry, q[0], src)
+        # consume the arrived head, issue the rotation d−1 steps ahead
+        return carry, q[1:] + (ring_pass(q[-1], axis_name),)
 
-    carry, _ = lax.fori_loop(0, n, body, (init, block))
+    carry, _ = lax.fori_loop(0, n, body, (init, q))
     return carry
 
 
@@ -181,6 +212,37 @@ FLASH_TILE_SPACES = {
     for layout in ("contig", "striped")
 }
 
+from tpu_mpi_tests.tune.priors import (  # noqa: E402
+    RING_PIPELINE_DEPTH,
+)
+
+#: the ring K/V prefetch pipeline depth (ISSUE 7 tentpole b) — declared
+#: here because the ring schedule lives here; prior 1 keeps untuned
+#: resolution byte-identical to the historical rotate-after-compute loop
+RING_DEPTH_SPACE = declare_space(
+    "ring/pipeline_depth",
+    (RING_PIPELINE_DEPTH, 2, 4),
+    describe="K/V rotations kept in flight ahead of the consuming "
+             "matmul (1 = rotate after compute)",
+)
+
+
+def _resolve_pipeline_depth(depth, dtype=None, lq=None) -> int:
+    """Ring pipeline depth: explicit > cached winner > prior (1).
+    Context like the tile knobs (dtype + local block length); malformed
+    cache values degrade to the prior — the cache is an accelerant,
+    never a way to crash a run."""
+    if depth is not None:
+        return max(1, int(depth))
+    tuned = _tune_resolve(
+        "ring/pipeline_depth", prior=RING_PIPELINE_DEPTH,
+        dtype=dtype, lq=lq,
+    )
+    try:
+        return max(1, int(tuned))
+    except (TypeError, ValueError):
+        return RING_PIPELINE_DEPTH
+
 
 def _resolve_tile_field(field: str, stripe: bool, dtype, lq) -> int:
     layout = "striped" if stripe else "contig"
@@ -223,6 +285,7 @@ def ring_attention(
     k_tile: int | None = None,
     skip_tile: int | None = None,
     stripe: bool = False,
+    depth: int | None = None,
 ):
     """Blockwise ring attention for one shard (call inside ``shard_map``).
 
@@ -271,6 +334,9 @@ def ring_attention(
     skip_tile = _resolve_skip_tile(
         skip_tile, stripe, dtype=_dt, lq=q.shape[0]
     )
+    # pipeline depth (ISSUE 7): explicit > cached > prior (1 = the
+    # historical rotate-after-compute ring; README "Overlap engine")
+    depth = _resolve_pipeline_depth(depth, dtype=_dt, lq=q.shape[0])
 
     lq = q.shape[0]
     n = axis_size(axis_name)
@@ -300,7 +366,9 @@ def ring_attention(
             )
             return m, l, acc
 
-        m, l, acc = ring_scan(step, (m0, l0, acc0), (k, v), axis_name)
+        m, l, acc = ring_scan(
+            step, (m0, l0, acc0), (k, v), axis_name, depth=depth
+        )
         return (acc / l).astype(q.dtype)
 
     m0 = jnp.full(q.shape[:-1], -jnp.inf, q.dtype)
@@ -329,7 +397,9 @@ def ring_attention(
         acc = acc * corr[:, None] + jnp.matmul(p, v_blk, precision=precision)
         return m_new, l, acc
 
-    m, l, acc = ring_scan(step, (m0, l0, acc0), (k, v), axis_name)
+    m, l, acc = ring_scan(
+        step, (m0, l0, acc0), (k, v), axis_name, depth=depth
+    )
     return acc / l[:, None]
 
 
@@ -345,6 +415,7 @@ def ring_attention_fn(
     skip_tile: int | None = None,
     precision=lax.Precision.HIGHEST,
     stripe: bool = False,
+    depth: int | None = None,
 ):
     """Jitted ring attention over a sequence sharded along ``axis_name``
     (inputs (L_global, d) sharded on axis 0). ``flash=True`` uses the
@@ -356,6 +427,9 @@ def ring_attention_fn(
     VERDICT r4 #2; README "Autotuning"). ``stripe=True``
     expects/returns the striped causal layout
     (:func:`to_striped`/:func:`from_striped` convert globally).
+    ``depth=None`` resolves the K/V prefetch pipeline depth through the
+    schedule cache (``ring/pipeline_depth``, prior 1 — README "Overlap
+    engine"); results are depth-independent bit for bit.
 
     Choosing ``stripe`` is DTYPE-dependent (BASELINE round-5
     stripebalance dtype note, single-chip paced proxy at lq=4096):
@@ -378,6 +452,7 @@ def ring_attention_fn(
             q, k, v, axis_name, causal=causal, flash=flash,
             interpret=interpret, q_tile=q_tile, k_tile=k_tile,
             skip_tile=skip_tile, precision=precision, stripe=stripe,
+            depth=depth,
         )
 
     world = mesh.shape[axis_name]

@@ -48,7 +48,10 @@ def run(args) -> int:
     mesh = make_mesh()
     axis_name = mesh.axis_names[0]
 
-    from tpu_mpi_tests.comm.ring import _resolve_k_tile
+    from tpu_mpi_tests.comm.ring import (
+        _resolve_k_tile,
+        _resolve_pipeline_depth,
+    )
 
     # stripe only affects the RING tier's layout; flash/ulysses always
     # run the contig defaults — the banner shows the REQUEST (None =
@@ -125,12 +128,14 @@ def run(args) -> int:
                     for kk in jax.random.split(key, 3)
                 )
 
-            def make_attn(kt, st, tier=tier):
+            def make_attn(kt, st, tier=tier, depth=None):
                 if tier == "ring":
                     return ring_attention_fn(
                         mesh, axis_name, causal=args.causal, flash=True,
                         precision=prec, stripe=args.stripe,
                         k_tile=kt, skip_tile=st,
+                        depth=depth if depth is not None
+                        else args.ring_depth,
                     )
                 if tier == "ulysses":
                     return ulysses_attention_fn(
@@ -192,6 +197,38 @@ def run(args) -> int:
                         dtype=args.dtype, lq=lq_local,
                     )
 
+            if (
+                args.tune and tier == "ring"
+                and args.ring_depth is None
+                and ("depth", lq_local) not in tuned_layouts
+            ):
+                # ring pipeline-depth sweep (ISSUE 7): each candidate
+                # runs the REAL ring tier at a shortened chain, so the
+                # winner prices the prefetch pipeline against the
+                # matmul it hides under — results are depth-invariant
+                # bit for bit, only the schedule changes
+                from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+                tuned_layouts.add(("depth", lq_local))
+                n_long = max(11, args.n_iter // 10)
+
+                def measure_depth(cand):
+                    loop = make_loop(
+                        make_attn(args.k_tile, args.skip_tile,
+                                  depth=int(cand))
+                    )
+                    sec, st = chain_rate(
+                        loop, make_qkv(),
+                        n_short=n_long // 10 or 1, n_long=n_long,
+                    )
+                    del st
+                    return sec
+
+                ensure_tuned(
+                    "ring/pipeline_depth", measure_depth,
+                    dtype=args.dtype, lq=lq_local,
+                )
+
             attn = make_attn(args.k_tile, args.skip_tile)
             loop = make_loop(attn)
             state0 = make_qkv()
@@ -220,6 +257,12 @@ def run(args) -> int:
                    "stripe": striped,
                    "tflops": tflops * heads, "us_per_iter": sec * 1e6,
                    "world": world}
+            if tier == "ring":
+                # schedule attribution (ISSUE 7 satellite): the
+                # resolved prefetch pipeline depth this row ran with
+                row["ring_depth"] = _resolve_pipeline_depth(
+                    args.ring_depth, dtype=args.dtype, lq=lq_local
+                )
             if tier != "xla":  # flash-kernel tiers only
                 row["k_tile_ceiling"] = _resolve_k_tile(
                     args.k_tile, striped, dtype=args.dtype, lq=lq_local
@@ -253,7 +296,13 @@ def _serve_step_factory(mesh, shape, dtype):
     sync at the end — wrapping the shard_map ring in an *outer* jitted
     ``fori_loop`` trips the jax-0.4.x PartitionId SPMD limitation the
     attnbench ring tier already documents on CPU meshes. Shape is
-    ``(L, head_dim)`` with L divisible by the mesh world."""
+    ``(L, head_dim)`` with L divisible by the mesh world.
+
+    The ring's K/V prefetch pipeline depth resolves inside
+    ``ring_attention`` like any other knob (``ring/pipeline_depth``,
+    cached winner > prior 1 — README "Overlap engine"), so
+    ``tpumt-serve`` steady-state traffic exercises the tuned pipelined
+    ring without serve-side wiring."""
     import jax
     import jax.numpy as jnp
 
@@ -323,6 +372,16 @@ def main(argv=None) -> int:
         "an explicit value always wins over the cache",
     )
     p.add_argument(
+        "--ring-depth", type=int, default=None,
+        help="ring K/V prefetch pipeline depth (ISSUE 7; README "
+        "'Overlap engine'): 1 = rotate after compute (the historical "
+        "schedule), d>=2 keeps d-1 rotations in flight ahead of the "
+        "consuming matmul. Default: the schedule cache's tuned winner "
+        "for this topology, else the prior (1); results are "
+        "depth-invariant bit for bit. With --tune, a cache miss "
+        "sweeps the candidates on the real ring tier first",
+    )
+    p.add_argument(
         "--fast", action="store_true",
         help="MXU-native (DEFAULT) matmul precision instead of HIGHEST "
         "(the throughput configuration BASELINE.md quotes)",
@@ -334,6 +393,8 @@ def main(argv=None) -> int:
         p.error("--seq-len must be >= 8 and --head-dim >= 1")
     if args.n_iter < 10:
         p.error("--n-iter must be >= 10")
+    if args.ring_depth is not None and args.ring_depth < 1:
+        p.error("--ring-depth must be >= 1")
     if args.k_tile is not None and args.k_tile < 8:
         p.error("--k-tile must be >= 8")
     if args.skip_tile is not None and args.skip_tile != 0 \

@@ -195,6 +195,53 @@ def _resolve_variant(base, args, mesh, axis_name, world, n, dtype,
     return eff_of(variant)
 
 
+def _tune_dispatch_depth(args, mesh, axis_name: str, world: int) -> None:
+    """Sweep the ``coll/dispatch_depth`` knob (ISSUE 7 tentpole c) on a
+    cache miss: a host-chained run of small allreduces dispatched
+    through a :class:`~tpu_mpi_tests.comm.collectives.DispatchWindow`
+    at each candidate depth — the latency-bound chaining pattern the
+    window exists for. The winner persists under the full AND
+    device-only fingerprints, so every chained site on this machine
+    (e.g. the serve-mode halo handler) resolves it."""
+    import time
+
+    import jax.numpy as jnp
+
+    from tpu_mpi_tests.comm import collectives as C
+    from tpu_mpi_tests.instrument.timers import block
+    from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+    dtype = _common.jnp_dtype(args)
+    shard_bytes = min(
+        int(s) for s in args.sizes_kib.split(",")
+    ) * 1024  # smallest ladder size: fixed dispatch cost dominates there
+    n = shard_bytes // jnp.dtype(dtype).itemsize
+    run_fn = _loop_fn(mesh, axis_name, "allreduce", world)
+    chain = max(16, args.n_iter // 10)
+    nbytes = int(2 * (world - 1) / world * shard_bytes)
+
+    def measure(cand):
+        x = C.shard_1d(jnp.ones((n * world,), dtype), mesh, axis_name)
+        block(run_fn(x + 0, 1))  # compile + warm (run_fn donates)
+        win = C.DispatchWindow(int(cand))
+        t0 = time.perf_counter()
+        for _ in range(chain):
+            x = win.call(
+                "allreduce", run_fn, x, 1,
+                nbytes=nbytes, axis_name=axis_name, world=world,
+            )
+        win.drain()
+        block(x)
+        sec = time.perf_counter() - t0
+        del x
+        return sec
+
+    ensure_tuned(
+        "coll/dispatch_depth", measure,
+        dtype=args.dtype, bytes=shard_bytes, world=world,
+    )
+
+
 def _busbw_bytes(name: str, shard_bytes: int, world: int) -> float:
     name = name.removesuffix("_rdma")  # ring twins move the same bytes
     if world < 2:
@@ -250,6 +297,12 @@ def run(args) -> int:
                 if n == "auto" else [n]
             )
         ]
+
+        if args.tune:
+            # dispatch-depth sweep (on-miss inside ensure_tuned): the
+            # window knob is priced here, where the chained-collective
+            # pattern lives, and consumed wherever chains dispatch
+            _tune_dispatch_depth(args, mesh, axis_name, world)
 
         dtype = _common.jnp_dtype(args)
         itemsize = jnp.dtype(dtype).itemsize

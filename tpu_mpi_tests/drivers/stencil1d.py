@@ -148,7 +148,107 @@ def run(args) -> int:
                 f"ERR_NORM FAIL: max {per_rank_err.max():.8g} > tol {tol:.8g}"
             )
             return 1
+        if args.overlap != "0":
+            return _run_overlap(args, rep, mesh, topo, zg, d)
         return 0
+
+
+def _run_overlap(args, rep, mesh, topo, zg, d) -> int:
+    """The ``--overlap`` mode: run the double-buffered halo pipeline
+    (README "Overlap engine") for ``--overlap-iters`` steps of the
+    fused exchange+update recurrence on a copy of the verified field.
+
+    Depth resolves explicit > cached > prior (1); with ``--tune`` and
+    ``--overlap auto`` a cache miss sweeps the depth candidates first
+    (each priced on a short pipeline run). Depth ≥ 2 runs are verified
+    bit-identical against a depth-1 rerun — the interior/boundary seam
+    correctness gate — and the measured ``overlap_frac`` (wall overlap
+    of the in-flight exchange span with the interior-compute phase) is
+    attached to the phase record and the ``kind:"overlap"`` row."""
+    import time as _time
+
+    from tpu_mpi_tests.comm import halo as H
+    from tpu_mpi_tests.instrument.timers import PhaseTimer, block
+    import numpy as np
+
+    world = topo.global_device_count
+    axis_name = mesh.axis_names[0]
+    eps = 1e-6
+    n_iters = args.overlap_iters
+    explicit = None if args.overlap == "auto" else int(args.overlap)
+    ctx = dict(dtype=args.dtype, n=args.n_global, world=world)
+    fns = H.overlap_jacobi_fns(
+        mesh, axis_name, 0, 1, 2, float(d.scale), eps
+    )
+    exchange_nod, core, seam = fns
+    nbytes = H.halo_payload_bytes(zg, 0, world, 2, False)
+
+    def pipeline(depth: int, n: int, timer=None):
+        runner = H.OverlapRunner(
+            "halo_exchange", depth=depth, nbytes=nbytes,
+            axis_name=axis_name, world=world, timer=timer,
+            phase="overlap_interior",
+        )
+        z = block(zg + 0)
+        for _ in range(n):
+            ex, zc = runner.step(exchange_nod, core, z)
+            z = block(seam(ex, zc))
+        return z, runner
+
+    if explicit is None and args.tune:
+        from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+        def measure(cand):
+            # compile + warm OUTSIDE the timed window: the split
+            # programs are shared across depths (lru_cache), so the
+            # first candidate — the prior, depth 1 — would otherwise
+            # pay the one-time jit cost and bias the winner to depth 2
+            z, _ = pipeline(int(cand), 1)
+            del z
+            t0 = _time.perf_counter()
+            z, _ = pipeline(int(cand), max(4, n_iters // 4))
+            del z
+            return _time.perf_counter() - t0
+
+        ensure_tuned(
+            "halo/overlap", measure, device_fallback=False, **ctx
+        )
+    depth = H.resolve_overlap_depth(explicit, **ctx)
+    rep.banner(f"OVERLAP halo depth resolved -> {depth}")
+
+    zw, _ = pipeline(depth, 1)  # compile + warm (programs are shared
+    del zw                      # across depths via the lru cache)
+    timer = PhaseTimer()
+    t0 = _time.perf_counter()
+    z, runner = pipeline(depth, n_iters, timer=timer)
+    seconds = _time.perf_counter() - t0
+    it_per_s = n_iters / seconds if seconds > 0 else float("inf")
+
+    rc = 0
+    if depth > 1:
+        # seam gate: the pipelined schedule must be bit-identical to
+        # the serialized one (same compiled programs, reordered)
+        z_ref, _ = pipeline(1, n_iters)
+        if not np.array_equal(np.asarray(z), np.asarray(z_ref)):
+            rep.line(
+                f"OVERLAP FAIL depth={depth}: pipelined result diverges "
+                f"from the depth-1 schedule (seam defect)"
+            )
+            rc = 1
+        del z_ref
+    del z
+
+    runner.annotate(timer)
+    rep.time_lines(timer, stats=True)
+    rep.line(
+        f"OVERLAP halo depth={depth} iters={n_iters} "
+        f"{it_per_s:0.1f} it/s overlap_frac={runner.overlap_frac:0.3f}",
+        runner.record(
+            "halo", iters=n_iters, it_per_s=it_per_s, dtype=args.dtype,
+            n=args.n_global,
+        ),
+    )
+    return rc
 
 
 def _serve_step_factory(mesh, shape, dtype):
@@ -158,7 +258,15 @@ def _serve_step_factory(mesh, shape, dtype):
     exactly the driver's timed step). Each exchange goes through
     :func:`~tpu_mpi_tests.comm.halo.halo_exchange`, so with telemetry on
     every request also lands its own comm span, and the staging schedule
-    resolves through the tune cache like any other run."""
+    resolves through the tune cache like any other run.
+
+    The chained exchanges dispatch through a
+    :class:`~tpu_mpi_tests.comm.collectives.DispatchWindow` whose depth
+    resolves from the schedule cache (``coll/dispatch_depth``, prior 1)
+    — so steady-state traffic exercises the tuned pipelined path: at
+    depth 1 every exchange syncs per call (today's behavior,
+    byte-identical), at depth ≥ 2 up to that many dispatches ride in
+    flight before the window blocks on the oldest."""
     import jax.numpy as jnp
 
     from tpu_mpi_tests.arrays.domain import Domain1D
@@ -174,6 +282,10 @@ def _serve_step_factory(mesh, shape, dtype):
     d = Domain1D(n_global=n, n_shards=world, n_bnd=2)
     f, _ = analytic_pairs()["1d"]
     dt = jnp.dtype(dtype)
+    # tuned overlap depth, resolved like any other knob (cached > prior)
+    depth = C.resolve_dispatch_depth(
+        dtype=str(dt), n=n, world=world
+    )
 
     def init():
         return block(C.device_init(
@@ -185,12 +297,16 @@ def _serve_step_factory(mesh, shape, dtype):
     def step(k: int):
         try:
             z = state["z"]
-            for _ in range(k):
-                # AUTO staging: the tune cache's winner for this
-                # topology when one is warmed, the shipped prior
-                # (direct) otherwise — the schedule preload at serve
-                # start is consumed here
-                z = H.halo_exchange(z, mesh, staging=H.Staging.AUTO)
+            with C.DispatchWindow(depth) as win:
+                for _ in range(k):
+                    # AUTO staging: the tune cache's winner for this
+                    # topology when one is warmed, the shipped prior
+                    # (direct) otherwise — the schedule preload at
+                    # serve start is consumed here
+                    z = H.halo_exchange(
+                        z, mesh, staging=H.Staging.AUTO,
+                        window=win if depth > 1 else None,
+                    )
             state["z"] = block(z)
         except Exception:
             # the exchange donates its input: after a mid-batch failure
@@ -237,7 +353,26 @@ def main(argv=None) -> int:
         default=None,
         help="err_norm gate (default: dtype-dependent)",
     )
+    p.add_argument(
+        "--overlap",
+        default="0",
+        choices=["0", "1", "2", "auto"],
+        help="run the double-buffered halo pipeline after the gate "
+        "(README 'Overlap engine'): 0 = off (default), 1 = the "
+        "serialized schedule, 2 = exchange in flight under the "
+        "interior compute, auto = the schedule cache's tuned depth "
+        "(with --tune a cache miss sweeps the candidates first); "
+        "depth>=2 is verified bit-identical to depth 1",
+    )
+    p.add_argument(
+        "--overlap-iters",
+        type=int,
+        default=32,
+        help="pipeline steps for --overlap (default 32)",
+    )
     args = p.parse_args(argv)
+    if args.overlap_iters < 1:
+        p.error("--overlap-iters must be positive")
     if args.n_global_mi is not None:
         args.n_global = args.n_global_mi * 1024 * 1024
     if args.n_global < 1:

@@ -31,6 +31,14 @@ into:
 * a VMEM table (``kind: "vmem"`` records from ``tpu/vmemprobe.py``):
   model-vs-actual scoped-VMEM per kernel config, under-estimates
   flagged UNSAFE;
+* an OVERLAP table (``kind: "overlap"`` records + annotated phase
+  records from the overlap engine — ``comm/halo.py`` OverlapRunner,
+  README "Overlap engine"): per pipelined op, the resolved depth and
+  the measured wall overlap between in-flight comm spans and the
+  interior-compute phase (``overlap_frac`` — 0.000 on a depth-1 run,
+  rendered either way); the driver bench rows (``kind: "attn"``/
+  ``"heat"``) aggregate alongside as BENCH lines so ``--diff`` can
+  gate them;
 * an SLO table (``kind: "serve"`` records from the serving loop —
   ``drivers/serve.py`` / ``tpu_mpi_tests/serve/``): per workload class,
   offered vs achieved request rate, p50/p95/p99 latency, queue depth,
@@ -168,6 +176,8 @@ def summarize(files: list[str]) -> dict:
     compiles: dict[str, dict] = {}
     vmem: dict[str, dict] = {}
     serve: dict[str, dict] = {}
+    overlap: dict[str, dict] = {}
+    bench_rows: dict[str, list] = {}
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -192,11 +202,27 @@ def summarize(files: list[str]) -> dict:
                 # PhaseTimer record's `count` is its iteration count
                 ph["call_count"] += int(rec.get("count") or 1)
                 ph["call_seconds"] += secs
+                # overlap-engine annotations (PhaseTimer.annotate):
+                # carried per rank so the phase summary can report the
+                # measured comm/compute overlap of the pipelined phase
+                if isinstance(rec.get("overlap_frac"), (int, float)):
+                    ph.setdefault("ov_frac", {})[rank] = float(
+                        rec["overlap_frac"]
+                    )
+                if rec.get("overlap_depth") is not None:
+                    ph["ov_depth"] = rec["overlap_depth"]
             elif kind == "span":
                 rank = rec.get("rank", file_rank)
                 secs = float(rec.get("seconds") or 0.0)
+                # dispatch-window spans (AsyncSpan: dispatch → drain,
+                # NOT a sync-honest op duration) aggregate under their
+                # own [async] row — merging them with sync spans would
+                # corrupt the op's seconds and GB/s percentiles
+                op_name = rec.get("op", "?") + (
+                    "[async]" if rec.get("async") else ""
+                )
                 op = ops.setdefault(
-                    rec.get("op", "?"),
+                    op_name,
                     {"per_rank": {}, "ops": 0, "bytes": 0, "gbps": []},
                 )
                 op["per_rank"][rank] = op["per_rank"].get(rank, 0.0) + secs
@@ -255,6 +281,45 @@ def summarize(files: list[str]) -> dict:
                           "error"):
                     if rec.get(k) is not None:
                         v[k] = rec[k]
+            elif kind == "overlap":
+                rank = rec.get("rank", file_rank)
+                ov = overlap.setdefault(
+                    rec.get("op", "?"),
+                    {"depth": None, "frac": {}, "rate": {},
+                     "rate_unit": None, "comm_s": 0.0, "compute_s": 0.0,
+                     "drain_s": 0.0, "steps": 0},
+                )
+                if rec.get("depth") is not None:
+                    ov["depth"] = rec["depth"]
+                if isinstance(rec.get("overlap_frac"), (int, float)):
+                    ov["frac"][rank] = float(rec["overlap_frac"])
+                for key, unit in (("it_per_s", "it/s"),
+                                  ("steps_per_s", "steps/s")):
+                    if isinstance(rec.get(key), (int, float)):
+                        ov["rate"][rank] = float(rec[key])
+                        ov["rate_unit"] = unit
+                for key in ("comm_s", "compute_s", "drain_s"):
+                    if isinstance(rec.get(key), (int, float)):
+                        ov[key] += float(rec[key])
+                ov["steps"] += int(rec.get("steps") or 0)
+            elif kind == "attn":
+                # driver bench rows become gated --diff series: a
+                # schedule change that silently slows a tier must trip
+                # the noise-band gate, not pass unobserved
+                if isinstance(rec.get("tflops"), (int, float)):
+                    key = (
+                        f"attn:{rec.get('tier', '?')}"
+                        + ("[striped]" if rec.get("stripe") else "")
+                        + ":tflops"
+                    )
+                    bench_rows.setdefault(key, []).append(
+                        float(rec["tflops"])
+                    )
+            elif kind == "heat":
+                if isinstance(rec.get("steps_per_s"), (int, float)):
+                    bench_rows.setdefault("heat:steps_per_s", []).append(
+                        float(rec["steps_per_s"])
+                    )
             elif kind == "serve":
                 sv = serve.setdefault(
                     rec.get("class", "?"),
@@ -299,6 +364,13 @@ def summarize(files: list[str]) -> dict:
         "compile": {},
         "vmem": {name: vmem[name] for name in sorted(vmem)},
         "serve": {cls: _serve_row(serve[cls]) for cls in sorted(serve)},
+        "overlap": {op: _overlap_row(overlap[op])
+                    for op in sorted(overlap)},
+        "bench": {
+            key: {"value": sum(vals) / len(vals),
+                  "band": _noise_band(vals), "n": len(vals)}
+            for key, vals in sorted(bench_rows.items())
+        },
     }
     for name in sorted(phases):
         ph = phases[name]
@@ -308,6 +380,13 @@ def summarize(files: list[str]) -> dict:
                             if ph["call_count"] else 0.0),
             **_stats(ph["per_rank"]),
         }
+        if "ov_frac" in ph:
+            fracs = list(ph["ov_frac"].values())
+            summary["phases"][name]["overlap_frac"] = (
+                sum(fracs) / len(fracs)
+            )
+            if ph.get("ov_depth") is not None:
+                summary["phases"][name]["overlap_depth"] = ph["ov_depth"]
     for name in sorted(ops):
         o = ops[name]
         gbps = sorted(o["gbps"])
@@ -338,6 +417,29 @@ def _noise_band(vals: list) -> float:
         return 0.0
     mid = sorted(vals)[len(vals) // 2]
     return (max(vals) - min(vals)) / 2 / abs(mid) if mid else 0.0
+
+
+def _overlap_row(ov: dict) -> dict:
+    """One OVERLAP-table row from a run's ``kind:"overlap"`` records:
+    per-rank fracs/rates averaged, their cross-rank spread kept as the
+    ``--diff`` noise band. ``overlap_frac`` is reported even at 0.0 —
+    a depth-1 (serialized) run must RENDER its zero, that is half of
+    the acceptance contract."""
+    fracs = list(ov["frac"].values())
+    rates = list(ov["rate"].values())
+    return {
+        "depth": ov["depth"],
+        "ranks": max(len(fracs), len(rates), 1),
+        "steps": ov["steps"],
+        "overlap_frac": sum(fracs) / len(fracs) if fracs else 0.0,
+        "frac_band": _noise_band(fracs),
+        "comm_s": ov["comm_s"],
+        "compute_s": ov["compute_s"],
+        "drain_s": ov["drain_s"],
+        "rate": sum(rates) / len(rates) if rates else None,
+        "rate_unit": ov["rate_unit"],
+        "rate_band": _noise_band(rates),
+    }
 
 
 #: the serve metrics whose cross-window spread becomes a --diff band
@@ -490,6 +592,29 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
             f"p50={ms('p50_ms')}ms p95={ms('p95_ms')}ms "
             f"p99={ms('p99_ms')}ms qmax={sv['queue_max']} "
             f"windows={sv['windows']}"
+        )
+
+    for op, ov in summary.get("overlap", {}).items():
+        rate = ""
+        if ov.get("rate") is not None:
+            rate = f" {ov['rate']:.4g} {ov['rate_unit'] or 'it/s'}"
+        print(
+            f"OVERLAP {op}: depth={ov['depth']} "
+            f"frac={ov['overlap_frac']:.3f} "
+            f"comm={ov['comm_s']:.6g}s compute={ov['compute_s']:.6g}s "
+            f"drain={ov['drain_s']:.6g}s "
+            f"steps={ov['steps']} ranks={ov['ranks']}{rate}"
+        )
+    for name, ph in summary["phases"].items():
+        if "overlap_frac" in ph:
+            print(
+                f"OVERLAP phase={name}: frac={ph['overlap_frac']:.3f}"
+                f" depth={ph.get('overlap_depth', '-')}"
+            )
+    for key, b in summary.get("bench", {}).items():
+        print(
+            f"BENCH {key}: value={b['value']:.6g} n={b['n']} "
+            f"band=±{b['band'] * 100:.2f}%"
         )
 
     for name, t in summary.get("tuning", {}).items():
@@ -698,6 +823,29 @@ def _jsonl_metrics(files: list[str]) -> dict[str, dict]:
                 "band": bands.get("achieved_hz", 0.0),
                 "higher_better": True,
             }
+    # overlap-engine series (ISSUE 7 satellite): a future change that
+    # silently re-serializes the pipeline drops overlap_frac from ~1
+    # to 0 — a -100% regression beyond any noise band, so the gate
+    # exits 1 instead of the de-pipelining passing unobserved. The
+    # rate (it/s / steps/s) and the driver bench rows gate alongside.
+    for op, ov in s.get("overlap", {}).items():
+        if isinstance(ov.get("overlap_frac"), (int, float)):
+            out[f"overlap:{op}:frac"] = {
+                "value": float(ov["overlap_frac"]),
+                "band": ov.get("frac_band", 0.0),
+                "higher_better": True,
+            }
+        if isinstance(ov.get("rate"), (int, float)):
+            out[f"overlap:{op}:rate"] = {
+                "value": float(ov["rate"]),
+                "band": ov.get("rate_band", 0.0),
+                "higher_better": True,
+            }
+    for key, b in s.get("bench", {}).items():
+        out[f"bench:{key}"] = {
+            "value": b["value"], "band": b["band"],
+            "higher_better": True,
+        }
     return out
 
 

@@ -189,7 +189,10 @@ class Reporter:
                  "t_end": getattr(timer, "t_ends", {}).get(name),
                  "mono_start": getattr(timer, "mono_starts", {}).get(name),
                  "mono_end": getattr(timer, "mono_ends", {}).get(name),
-                 "rank": self.rank}
+                 "rank": self.rank,
+                 # annotated extras (PhaseTimer.annotate): overlap_frac
+                 # and friends ride the phase record they describe
+                 **getattr(timer, "extras", {}).get(name, {})}
             )
 
     def attach_telemetry(self):

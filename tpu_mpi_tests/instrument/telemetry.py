@@ -351,6 +351,93 @@ def comm_span(
         )
 
 
+class AsyncSpan:
+    """Dispatch-window span handle for the overlap engine: opened at
+    dispatch time, closed by :meth:`done`, which blocks on the op's
+    result (the drain point) and records the event.
+
+    The recorded window spans dispatch → observed completion, which is
+    WIDER than the op's device time — it includes whatever host/compute
+    work rode alongside while the op was in flight. That is the point
+    (the window is what overlap_frac measures against the compute
+    phase), but it means the ``seconds`` field is NOT a sync-honest op
+    duration; records therefore carry ``async: true`` so downstream
+    consumers (tpumt-report OP stats, GB/s percentiles) can tell the
+    two apart. Inert (no event recorded) when telemetry is disabled or
+    under a jax trace, but the mono clock bounds are always tracked —
+    the overlap engine derives its measured overlap from them either
+    way."""
+
+    __slots__ = ("op", "nbytes", "axis_name", "world", "meta",
+                 "t0_wall", "mono_start", "mono_end", "drain_s",
+                 "closed", "_armed")
+
+    def __init__(self, op: str, nbytes: int = 0,
+                 axis_name: str | None = None, world: int = 1, **meta):
+        self.op = op
+        self.nbytes = int(nbytes)
+        self.axis_name = axis_name
+        self.world = world
+        self.meta = meta
+        self.closed = False
+        self._armed = _TELEMETRY.enabled and not _under_trace()
+        self.t0_wall = time.time()
+        self.mono_start = time.perf_counter()
+        self.mono_end = self.mono_start
+        #: seconds :meth:`done` spent actually waiting on the result —
+        #: the one genuinely *measured* hiding signal: ~0 means the op
+        #: completed under whatever ran alongside; large means the
+        #: caller's compute finished first and the op was NOT hidden
+        self.drain_s = 0.0
+
+    def done(self, result=None) -> None:
+        """Block on ``result`` (the op's output pytree) and close the
+        span. Idempotent — a drained window may be drained again."""
+        if self.closed:
+            return
+        self.closed = True
+        if result is not None:
+            from tpu_mpi_tests.instrument.timers import block
+
+            t_wait = time.perf_counter()
+            block(result)
+            self.drain_s = time.perf_counter() - t_wait
+        self.mono_end = time.perf_counter()
+        dt = self.mono_end - self.mono_start
+        if not self._armed:
+            return
+        gbps = (self.nbytes / dt / 1e9) if (self.nbytes and dt > 0) else None
+        _TELEMETRY.record(
+            CommEvent(
+                op=self.op,
+                nbytes=self.nbytes,
+                axis_name=self.axis_name,
+                world=self.world,
+                seconds=dt,
+                gbps=gbps,
+                wall_time=self.t0_wall + dt,
+                t_start=self.t0_wall,
+                t_end=self.t0_wall + dt,
+                mono_start=self.mono_start,
+                mono_end=self.mono_end,
+                meta={"async": True, "drain_s": self.drain_s,
+                      **self.meta},
+            )
+        )
+
+
+def async_span(op: str, nbytes: int = 0, axis_name: str | None = None,
+               world: int = 1, **meta) -> AsyncSpan:
+    """Open a dispatch-window span (see :class:`AsyncSpan`): the comm op
+    is dispatched now, the caller computes alongside it, and
+    ``handle.done(result)`` is the drain point that closes the window.
+    This is the overlap engine's span primitive — the sync-honest
+    :func:`comm_span`/:func:`span_call` stay the default for everything
+    that syncs per call."""
+    return AsyncSpan(op, nbytes=nbytes, axis_name=axis_name, world=world,
+                     **meta)
+
+
 def _maybe_compile_probe(op: str, fn: Callable, args: tuple) -> None:
     """AOT compile-cost probe for jitted fns flowing through
     :func:`span_call` — one probe per (op, arg shapes), only while

@@ -151,7 +151,9 @@ def _collect(streams):
                     max(end - start, 0.0),
                     args_from(rec, ("nbytes", "gbps", "axis", "world",
                                     "seconds", "cost_bytes",
-                                    "model_gbps", "roofline_frac")),
+                                    "model_gbps", "roofline_frac",
+                                    "async", "overlap_depth",
+                                    "dispatch_depth")),
                 ))
             elif kind == "time":
                 if rec.get("t_start") is None:

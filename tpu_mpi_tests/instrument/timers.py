@@ -212,6 +212,10 @@ class PhaseTimer:
         self.mono_starts: dict[str, float] = {}
         self.mono_ends: dict[str, float] = {}
         self._entries: dict[str, int] = defaultdict(int)
+        # extra per-phase record fields (``annotate``): the overlap
+        # engine attaches its measured overlap_frac here so the phase's
+        # JSONL ``time`` record carries it without new record kinds
+        self.extras: dict[str, dict] = {}
         self.skip_first = skip_first
 
     @contextmanager
@@ -252,6 +256,13 @@ class PhaseTimer:
     def mean(self, name: str) -> float:
         c = self.counts[name]
         return self.seconds[name] / c if c else 0.0
+
+    def annotate(self, name: str, **fields) -> None:
+        """Attach extra fields to a phase's JSONL ``time`` record (e.g.
+        the overlap engine's ``overlap_frac``). Merged by
+        ``Reporter.time_lines``; unknown to the stdout ``TIME`` line,
+        whose reference shape stays fixed."""
+        self.extras.setdefault(name, {}).update(fields)
 
     def wall_span(self, name: str) -> tuple[float | None, float | None]:
         """Wall-clock ``(t_start, t_end)`` of the phase's full lifetime

@@ -66,3 +66,24 @@ HALO_STAGING = "direct"
 # hand-written RDMA ring twin ("rdma") as the sweep alternative where
 # one exists (allgather/allreduce).
 COLL_VARIANT = "xla"
+
+# Overlap-engine depth priors (ISSUE 7). All three ship at 1 — today's
+# strictly-serialized schedules — so an untuned run stays byte-identical
+# to the pre-overlap era; a ``--tune`` sweep (or an explicit flag) is
+# what opens a pipeline. Depth semantics per knob:
+#
+# * ``halo/overlap`` (comm/halo.py): 1 = blocking exchange then update;
+#   2 = the ghost exchange rides in flight while the interior computes
+#   (the reference's Irecv/compute/Waitall split, host-scheduled).
+# * ``ring/pipeline_depth`` (comm/ring.py): 1 = rotate the K/V block
+#   after consuming it; d = the next d−1 rotations are issued before
+#   the current block's matmul, so the permute-start precedes the
+#   compute in program order and XLA's latency-hiding scheduler can
+#   run them together.
+# * ``coll/dispatch_depth`` (comm/collectives.py): up to d chained
+#   collective dispatches in flight before the window blocks on the
+#   oldest — bounds the sync-honesty window instead of syncing per
+#   call.
+HALO_OVERLAP_DEPTH = 1
+RING_PIPELINE_DEPTH = 1
+COLL_DISPATCH_DEPTH = 1
