@@ -1,7 +1,7 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke overlap-smoke lint ci clean
+	serve-smoke overlap-smoke moe-smoke lint ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -119,7 +119,7 @@ serve-smoke:
 	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
 		--fake-devices 2 --duration 5 --arrival poisson --rate 30 \
 		--seed 7 --report-interval 1 --batch-deadline 120 \
-		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1,moe:256x32:float32:2 \
 		--telemetry --jsonl /tmp/_tpumt_serve_smoke.r1.jsonl \
 		--trace-out /tmp/_tpumt_serve_smoke.trace.json
 	python -c "import json, math; \
@@ -127,7 +127,9 @@ serve-smoke:
 			open('/tmp/_tpumt_serve_smoke.r1.jsonl')]; \
 		sm = [r for r in recs if r.get('kind') == 'serve' \
 			and r.get('event') == 'summary']; \
-		assert len(sm) == 2, [r.get('class') for r in sm]; \
+		assert len(sm) == 3, [r.get('class') for r in sm]; \
+		rts = [r for r in recs if r.get('kind') == 'route']; \
+		assert rts, 'moe serve traffic must land route records'; \
 		assert all(r['requests'] > 0 and \
 			math.isfinite(r['p50_ms']) and \
 			math.isfinite(r['p95_ms']) and \
@@ -144,10 +146,12 @@ serve-smoke:
 	grep -q '^SLO daxpy:4096:float32: ' /tmp/_tpumt_serve_smoke.report.txt
 	grep -q '^SLO allreduce:1024:float32: ' \
 		/tmp/_tpumt_serve_smoke.report.txt
+	grep -q '^SLO moe:256x32:float32: ' /tmp/_tpumt_serve_smoke.report.txt
+	grep -q '^ROUTE moe: ' /tmp/_tpumt_serve_smoke.report.txt
 	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
 		--fake-devices 2 --duration 5 --arrival poisson --rate 30 \
 		--seed 7 --report-interval 1 --batch-deadline 120 \
-		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1,moe:256x32:float32:2 \
 		--jsonl /tmp/_tpumt_serve_smoke.r2.jsonl
 	python -m tpu_mpi_tests.instrument.aggregate --diff \
 		/tmp/_tpumt_serve_smoke.r1.jsonl \
@@ -219,6 +223,69 @@ overlap-smoke:
 	grep -q 'overlap:halo:frac.*REGRESSION' /tmp/_tpumt_ov_smoke.diff.txt
 	@echo "overlap-smoke OK: frac gate + trace spans + diff gate"
 
+# workload-spec pillar smoke (ISSUE 8): on 2 fake devices the MoE spec
+# must route → combine → verify (rc 0) with kind:"route" records whose
+# overflow accounting is deterministic, the decode spec must emit
+# µs/op latency rows, tpumt-report must render the ROUTE + DECODE +
+# WORKLOAD tables, and --diff must gate a synthetically degraded copy
+# (overflow % up, decode latency 10x) with exit 1 while the run against
+# itself passes clean
+moe-smoke:
+	rm -f /tmp/_tpumt_moe_smoke*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.workloads.moe \
+		--fake-devices 2 --tokens 512 --d-model 32 --iters 8 \
+		--capacity-factor 1.0 --telemetry \
+		--jsonl /tmp/_tpumt_moe_smoke.moe.jsonl
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.workloads.decode \
+		--fake-devices 2 --batches 1,8 --heads 16 --n-iter 100 \
+		--jsonl /tmp/_tpumt_moe_smoke.dec.jsonl
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_moe_smoke.moe.jsonl')]; \
+		rts = [r for r in recs if r.get('kind') == 'route']; \
+		assert rts and all(r['overflow_pct'] > 0 for r in rts), rts; \
+		assert len({(r['routed'], r['dropped']) for r in rts}) == 1, \
+			'drop accounting must be deterministic across calls'; \
+		dec = [json.loads(l) for l in \
+			open('/tmp/_tpumt_moe_smoke.dec.jsonl')]; \
+		rows = [r for r in dec if r.get('kind') == 'decode']; \
+		assert len(rows) == 4 and all(r['us_per_op'] > 0 \
+			for r in rows), rows; \
+		print('moe-smoke records OK:', len(rts), 'route,', \
+			len(rows), 'decode rows')"
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_moe_smoke.moe.jsonl /tmp/_tpumt_moe_smoke.dec.jsonl \
+		> /tmp/_tpumt_moe_smoke.report.txt
+	grep -q '^ROUTE moe: ' /tmp/_tpumt_moe_smoke.report.txt
+	grep -q '^DECODE allreduce:1x16: ' /tmp/_tpumt_moe_smoke.report.txt
+	grep -q '^WORKLOAD moe:us_per_step: ' /tmp/_tpumt_moe_smoke.report.txt
+	cat /tmp/_tpumt_moe_smoke.moe.jsonl /tmp/_tpumt_moe_smoke.dec.jsonl \
+		> /tmp/_tpumt_moe_smoke.all.jsonl
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_moe_smoke.all.jsonl /tmp/_tpumt_moe_smoke.all.jsonl \
+		> /dev/null
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_moe_smoke.all.jsonl')]; \
+		f = open('/tmp/_tpumt_moe_smoke.bad.jsonl', 'w'); \
+		[f.write(json.dumps({**r, \
+			**({'overflow_pct': r['overflow_pct'] * 2 + 10} \
+				if r.get('kind') == 'route' else {}), \
+			**({'us_per_op': r['us_per_op'] * 10} \
+				if r.get('kind') == 'decode' else {}), \
+			**({'value': r['value'] * 10} \
+				if r.get('kind') == 'workload' else {})}) \
+			+ chr(10)) for r in recs]; \
+		f.close()"
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_moe_smoke.all.jsonl /tmp/_tpumt_moe_smoke.bad.jsonl \
+		> /tmp/_tpumt_moe_smoke.diff.txt; test $$? -eq 1
+	grep -q 'route:moe:overflow_pct.*REGRESSION' \
+		/tmp/_tpumt_moe_smoke.diff.txt
+	grep -q 'decode:allreduce:1x16:us_per_op.*REGRESSION' \
+		/tmp/_tpumt_moe_smoke.diff.txt
+	@echo "moe-smoke OK: route + decode rows + ROUTE table + diff gate"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -231,8 +298,9 @@ lint:
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
 # observability smoke, the serving-pipeline smoke, the overlap-engine
-# smoke, and the lint self-clean gate
-ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke lint
+# smoke, the workload-spec pillar smoke, and the lint self-clean gate
+ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke \
+	moe-smoke lint
 
 clean:
 	$(MAKE) -C native clean

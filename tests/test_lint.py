@@ -243,6 +243,33 @@ def test_schedule_constants_mutation_outside_tune(tmp_path):
     assert "TPM701" in codes_of(lint_paths([str(p)]))
 
 
+def test_schedule_constants_workloads_extended_keywords(tmp_path):
+    """ISSUE-8 extension: inside tpu_mpi_tests.workloads the keyword
+    set grows the serving-era knob vocabulary (CAPACITY/LOOKUP/COMBINE/
+    ROUTE/EXPERT/FANOUT) — a spec's pinned capacity constant fires and
+    is exempt ONLY via declare_space; the same name outside workloads/
+    stays out of scope (FLIGHT_CAPACITY is a ring-buffer bound there)."""
+    pkg = tmp_path / "tpu_mpi_tests" / "workloads"
+    pkg.mkdir(parents=True)
+    (tmp_path / "tpu_mpi_tests" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    spec = pkg / "myspec.py"
+    spec.write_text("MOE_CAPACITY_FACTOR = 1.25\n")
+    assert "TPM701" in codes_of(lint_paths([str(spec)]))
+    spec.write_text("EMBED_LOOKUP_WIDTH = 128\n")
+    assert "TPM701" in codes_of(lint_paths([str(spec)]))
+    # declare_space is the sanctioned route, inside workloads/ too
+    spec.write_text(
+        "from tpu_mpi_tests.tune.registry import declare_space\n"
+        'CAPACITY_SPACE = declare_space("moe/cap", (1.25, 2.0))\n'
+    )
+    assert "TPM701" not in codes_of(lint_paths([str(spec)]))
+    # outside workloads/, the extended words stay out of scope
+    other = tmp_path / "other.py"
+    other.write_text("MOE_CAPACITY_FACTOR = 1.25\n")
+    assert "TPM701" not in codes_of(lint_paths([str(other)]))
+
+
 def test_overlap_region_scoping(tmp_path):
     """TPM801 behavior beyond the goldens: the region closes at the
     handle's consume point (a sync after `.done()` is clean), an

@@ -1,0 +1,202 @@
+"""MoE token-routing tests: exact parity with the dense reference and
+the ragged/non-divisible occupancy cases the capacity buckets exist for
+(ISSUE 8): overflowing occupancy tables, an expert receiving zero
+tokens, and deterministic drop accounting under a fixed seed."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_mpi_tests.comm import moe as M
+from tpu_mpi_tests.utils import TpuMtError
+
+W = 8  # the suite's fake-device world
+
+
+def _place(mesh, x, dest):
+    xs = jax.device_put(
+        jnp.asarray(x, jnp.float32), NamedSharding(mesh, P("shard", None))
+    )
+    ds = jax.device_put(
+        jnp.asarray(dest), NamedSharding(mesh, P("shard"))
+    )
+    return xs, ds
+
+
+def _tokens(seed, t, d=4):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(1, 8, size=(t, d)).astype(np.float32)
+    dest = rng.integers(0, W, size=(t,)).astype(np.int32)
+    return x, dest
+
+
+class TestRouting:
+    @pytest.mark.parametrize("combine", ["alltoall", "allgather"])
+    @pytest.mark.parametrize("capacity", [1, 3, 64])
+    def test_matches_dense_reference_exactly(self, mesh8, capacity,
+                                             combine):
+        x, dest = _tokens(0, 64)
+        xs, ds = _place(mesh8, x, dest)
+        y, stats = M.route_tokens(xs, ds, mesh8, capacity,
+                                  combine=combine)
+        ref = M.route_reference(x, dest, W, capacity)
+        np.testing.assert_array_equal(np.asarray(y), ref)
+        assert stats.tokens == 64
+        assert stats.routed + stats.dropped == 64
+
+    def test_overflowing_occupancy_table(self, mesh8):
+        """Every token on every rank names expert 0: each (source, 0)
+        pair offers T_local tokens against `capacity` slots — the
+        accounting must show exactly the overflow the table implies."""
+        t = 64
+        t_local = t // W
+        x = np.arange(t * 4, dtype=np.float32).reshape(t, 4) + 1
+        dest = np.zeros(t, np.int32)
+        xs, ds = _place(mesh8, x, dest)
+        cap = 2
+        y, stats = M.route_tokens(xs, ds, mesh8, cap)
+        assert stats.dropped == (t_local - cap) * W
+        assert stats.overflow_pct == pytest.approx(
+            100.0 * (t_local - cap) / t_local
+        )
+        # expert 0 holds every routed token; the load vector says so
+        assert stats.expert_load[0] == cap * W
+        assert all(v == 0 for v in stats.expert_load[1:])
+        np.testing.assert_array_equal(
+            np.asarray(y), M.route_reference(x, dest, W, cap)
+        )
+
+    def test_expert_receiving_zero_tokens(self, mesh8):
+        """A rank nobody routes to must read load 0 (its capacity slots
+        fly empty) while the rest of the routing stays exact."""
+        x, dest = _tokens(1, 64)
+        dest = np.where(dest == 3, 4, dest).astype(np.int32)  # starve 3
+        xs, ds = _place(mesh8, x, dest)
+        y, stats = M.route_tokens(xs, ds, mesh8, 8)
+        assert stats.expert_load[3] == 0
+        assert stats.counts[:, 3].sum() == 0
+        np.testing.assert_array_equal(
+            np.asarray(y), M.route_reference(x, dest, W, 8)
+        )
+
+    def test_imbalance_of_uniform_load_is_one(self, mesh8):
+        """A perfectly balanced table (each shard's tokens round-robin
+        the experts) reads imbalance exactly 1.0."""
+        t = 64
+        x = np.ones((t, 4), np.float32)
+        dest = (np.arange(t) % W).astype(np.int32)
+        xs, ds = _place(mesh8, x, dest)
+        _, stats = M.route_tokens(xs, ds, mesh8, 4)
+        assert stats.imbalance == 1.0
+        assert stats.dropped == 0
+
+    def test_drop_accounting_deterministic_under_fixed_seed(self, mesh8):
+        """Same seed → byte-identical route records across runs (the
+        serve-mode class identity depends on it): counts matrix, drop
+        totals, overflow %, imbalance, and the record dict itself."""
+        recs = []
+        for _ in range(2):
+            x, dest = _tokens(7, 64)
+            xs, ds = _place(mesh8, x, dest)
+            _, stats = M.route_tokens(xs, ds, mesh8, 2)
+            recs.append(stats.record(op="moe"))
+        assert recs[0] == recs[1]
+        a, b = (M.route_tokens(*_place(mesh8, *_tokens(7, 64)),
+                               mesh8, 2)[1] for _ in range(2))
+        np.testing.assert_array_equal(a.counts, b.counts)
+        assert a.dropped == b.dropped
+
+    def test_non_divisible_tokens_fail_fast(self, mesh8):
+        x, dest = _tokens(2, 60)  # 60 % 8 != 0
+        xs = jnp.asarray(x)
+        ds = jnp.asarray(dest)
+        with pytest.raises(TpuMtError):
+            M.route_tokens(xs, ds, mesh8, 4)
+
+    def test_bad_capacity_rejected(self, mesh8):
+        x, dest = _tokens(3, 64)
+        xs, ds = _place(mesh8, x, dest)
+        with pytest.raises(ValueError):
+            M.route_tokens(xs, ds, mesh8, 0)
+
+    def test_route_record_reaches_telemetry_sink(self, mesh8):
+        """With telemetry on, every routed call mirrors its accounting
+        as a kind:"route" record — the ROUTE table's input."""
+        from tpu_mpi_tests.instrument import telemetry as T
+
+        x, dest = _tokens(4, 64)
+        xs, ds = _place(mesh8, x, dest)
+        records = []
+        T.enable(sink=records.append)
+        try:
+            M.route_tokens(xs, ds, mesh8, 3)
+        finally:
+            T.disable()
+            T.registry().reset()
+        routes = [r for r in records if r.get("kind") == "route"]
+        assert len(routes) == 1
+        assert routes[0]["tokens"] == 64
+        assert routes[0]["capacity"] == 3
+        spans = [r for r in records if r.get("kind") == "span"
+                 and r.get("op") == "moe"]
+        assert len(spans) == 1
+        assert spans[0]["nbytes"] == M.route_payload_bytes(
+            xs, W, 3, "alltoall"
+        )
+
+    def test_combine_variants_agree(self, mesh8):
+        """Both combine schedules are the same function: byte-identical
+        outputs and accounting."""
+        x, dest = _tokens(5, 64)
+        xs, ds = _place(mesh8, x, dest)
+        y_a, st_a = M.route_tokens(xs, ds, mesh8, 3, combine="alltoall")
+        y_g, st_g = M.route_tokens(xs, ds, mesh8, 3, combine="allgather")
+        np.testing.assert_array_equal(np.asarray(y_a), np.asarray(y_g))
+        np.testing.assert_array_equal(st_a.counts, st_g.counts)
+
+    def test_malformed_cached_combine_degrades_to_prior(self, mesh8,
+                                                        tmp_path):
+        """A corrupted cache value for moe/combine must resolve to the
+        shipped prior, not crash or run an unknown schedule."""
+        from tpu_mpi_tests.tune import registry as tr
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+
+        cache = tr.configure(cache_path=str(tmp_path / "c.json"))
+        try:
+            cache.store(
+                "moe/combine",
+                fingerprint(dtype="float32", n=64, world=W),
+                "bogus",
+            )
+            assert M.resolve_combine(
+                None, dtype="float32", n=64, world=W
+            ) == "alltoall"
+        finally:
+            tr.deconfigure()
+
+
+class TestRouteStats:
+    def test_stats_properties_from_counts(self):
+        counts = np.zeros((2, 2), np.int64)
+        counts[0, 0] = 5  # over a capacity of 3
+        counts[1, 1] = 1
+        st = M.RouteStats(world=2, capacity=3, counts=counts)
+        assert st.tokens == 6
+        assert st.routed == 4  # min(5,3) + 1
+        assert st.dropped == 2
+        assert st.overflow_pct == pytest.approx(100 * 2 / 6)
+        assert list(st.expert_load) == [3, 1]
+        assert st.imbalance == pytest.approx(3 / 2)
+        rec = st.record(op="x")
+        assert rec["kind"] == "route" and rec["dropped"] == 2
+
+    def test_empty_table_degenerates_cleanly(self):
+        st = M.RouteStats(
+            world=2, capacity=3, counts=np.zeros((2, 2), np.int64)
+        )
+        assert st.overflow_pct == 0.0
+        assert st.imbalance == 1.0
+        assert st.occupancy_pct == 0.0

@@ -693,3 +693,125 @@ def test_memory_census_only_note(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "MEM census-only: 1 records, no device memory_stats" in out
     assert "live_bytes=4096" in out
+
+
+# --------------------------------------------------------------------------
+# ISSUE 8: ROUTE / DECODE / WORKLOAD tables + their --diff gating
+# --------------------------------------------------------------------------
+
+
+def _route_rec(overflow=5.0, imbalance=1.3, **over):
+    rec = {
+        "kind": "route", "op": "moe", "world": 4, "capacity": 8,
+        "tokens": 128, "routed": 120, "dropped": 8,
+        "overflow_pct": overflow, "occupancy_pct": 93.75,
+        "imbalance": imbalance, "combine": "alltoall",
+    }
+    rec.update(over)
+    return rec
+
+
+def test_route_table_summary_and_text(tmp_path, capsys):
+    _write_jsonl(tmp_path / "r.jsonl", [
+        _route_rec(overflow=4.0),
+        _route_rec(overflow=6.0),
+    ])
+    files = [str(tmp_path / "r.jsonl")]
+    s = aggregate.summarize(files)
+    rt = s["route"]["moe"]
+    assert rt["calls"] == 2
+    assert rt["tokens"] == 256 and rt["dropped"] == 16
+    assert rt["overflow_pct"] == pytest.approx(5.0)
+    assert rt["overflow_band"] > 0  # cross-call spread is the band
+    aggregate.main(files)
+    out = capsys.readouterr().out
+    assert (
+        "ROUTE moe: calls=2 world=4 capacity=8 tokens=256 routed=240 "
+        "dropped=16 overflow=5.00% occupancy=93.8% imbalance=1.300 "
+        "combine=alltoall"
+    ) in out
+
+
+def test_decode_and_workload_rows_render(tmp_path, capsys):
+    _write_jsonl(tmp_path / "d.jsonl", [
+        {"kind": "decode", "collective": "allreduce", "batch": 1,
+         "heads": 16, "shard_bytes": 64, "us_per_op": 50.0, "world": 4,
+         "n_iter": 100},
+        {"kind": "workload", "workload": "moe", "metric": "us_per_step",
+         "value": 900.0, "unit": "us", "higher_better": False},
+    ])
+    aggregate.main([str(tmp_path / "d.jsonl")])
+    out = capsys.readouterr().out
+    assert "DECODE allreduce:1x16: us_per_op=50 bytes=64 n=1" in out
+    assert "WORKLOAD moe:us_per_step: value=900 us n=1" in out
+
+
+def test_diff_route_overflow_regression(tmp_path, capsys):
+    """The moe-smoke contract: overflow % beyond the noise band exits 1
+    as a lower-is-better regression; an equal run passes clean."""
+    _write_jsonl(tmp_path / "a.jsonl", [_route_rec(overflow=5.0)])
+    _write_jsonl(tmp_path / "b.jsonl", [_route_rec(overflow=20.0)])
+    rc = aggregate.main(
+        ["--diff", str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "route:moe:overflow_pct" in out and "REGRESSION" in out
+    rc = aggregate.main(
+        ["--diff", str(tmp_path / "a.jsonl"), str(tmp_path / "a.jsonl")]
+    )
+    assert rc == 0
+
+
+def test_diff_decode_latency_lower_better(tmp_path, capsys):
+    base = {"kind": "decode", "collective": "allreduce", "batch": 8,
+            "heads": 16, "shard_bytes": 512, "world": 4, "n_iter": 100}
+    _write_jsonl(tmp_path / "a.jsonl", [dict(base, us_per_op=50.0)])
+    _write_jsonl(tmp_path / "b.jsonl", [dict(base, us_per_op=500.0)])
+    rc = aggregate.main(
+        ["--diff", str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "decode:allreduce:8x16:us_per_op" in out
+    # the reverse direction is an improvement, not a regression
+    rc = aggregate.main(
+        ["--diff", str(tmp_path / "b.jsonl"), str(tmp_path / "a.jsonl")]
+    )
+    assert rc == 0
+    assert "improved" in capsys.readouterr().out
+
+
+def test_diff_workload_row_direction_from_record(tmp_path, capsys):
+    """kind:"workload" rows carry their own regression direction: a
+    lower-better metric growing flags; a higher-better one growing is
+    an improvement."""
+    def row(metric, value, higher):
+        return {"kind": "workload", "workload": "w", "metric": metric,
+                "value": value, "unit": "u", "higher_better": higher}
+
+    _write_jsonl(tmp_path / "a.jsonl",
+                 [row("lat", 10.0, False), row("rate", 10.0, True)])
+    _write_jsonl(tmp_path / "b.jsonl",
+                 [row("lat", 100.0, False), row("rate", 100.0, True)])
+    rc = aggregate.main(
+        ["--diff", str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "workload:w:lat" in out
+    lat = [l for l in out.splitlines() if "workload:w:lat" in l][0]
+    rate = [l for l in out.splitlines() if "workload:w:rate" in l][0]
+    assert "REGRESSION" in lat
+    assert "improved" in rate
+
+
+def test_old_files_grow_no_route_tables(two_rank_run, capsys):
+    """Pre-ISSUE-8 record streams keep their exact report shape: no
+    ROUTE/DECODE/WORKLOAD lines appear for runs that recorded none."""
+    files = aggregate.expand_rank_files([str(two_rank_run / "run.jsonl")])
+    aggregate.main(files)
+    out = capsys.readouterr().out
+    assert "ROUTE" not in out
+    assert "DECODE" not in out
+    assert "WORKLOAD" not in out

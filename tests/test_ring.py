@@ -171,6 +171,65 @@ def test_ulysses_long_sequence_blockwise(mesh8):
     assert np.allclose(a, b, atol=2e-5)
 
 
+def _ulysses_span_nbytes(mesh8, block_keys, records):
+    """Run the ulysses fn (a fresh lru-cache key via ``block_keys``)
+    under a capturing telemetry sink; return (span_nbytes, args)."""
+    from tpu_mpi_tests.comm.alltoall import ulysses_attention_fn
+    from tpu_mpi_tests.instrument import telemetry as T
+
+    L, H, Dh = 8 * 4, 8, 8
+    args = tuple(
+        shard_1d(jnp.ones((L, H, Dh), jnp.float32), mesh8)
+        for _ in range(3)
+    )
+    T.enable(sink=records.append)
+    try:
+        ulysses_attention_fn(mesh8, "shard", block_keys=block_keys)(*args)
+    finally:
+        T.disable()
+        T.registry().reset()
+    spans = [r for r in records
+             if r.get("kind") == "span" and r.get("op") == (
+                 "ulysses_attention")]
+    assert len(spans) == 1, records
+    return spans[0]["nbytes"], args
+
+
+def test_ulysses_telemetry_bytes_default_path(mesh8):
+    """Regression (ISSUE 8 satellite): the recorded ulysses payload
+    used ``2*q.nbytes`` for the output all-to-all. On the default path
+    the output IS q-shaped, so the fix must record exactly the same
+    number — (w−1)/w of q+k+v plus the output operand."""
+    records = []
+    nbytes, (q, k, v) = _ulysses_span_nbytes(mesh8, 4093, records)
+    moved = q.nbytes + k.nbytes + v.nbytes + q.nbytes  # out == q shape
+    assert nbytes == 7 * moved // 8
+
+
+def test_ulysses_telemetry_bytes_track_padded_output(mesh8,
+                                                     monkeypatch):
+    """When the local attention returns a PADDED output (the
+    flash/blockwise-padding case the old q-shaped accounting silently
+    mis-counted), the recorded bytes must follow the actual output
+    operand of the head→seq all-to-all."""
+    from tpu_mpi_tests.comm import alltoall as A
+
+    real = A._local_attention
+
+    def padded(q, k, v, causal, precision, block_keys=512):
+        out = real(q, k, v, causal, precision, block_keys=block_keys)
+        return jnp.concatenate([out, jnp.zeros_like(out)], axis=0)
+
+    monkeypatch.setattr(A, "_local_attention", padded)
+    records = []
+    nbytes, (q, k, v) = _ulysses_span_nbytes(mesh8, 4091, records)
+    # the out operand is 2x q-sized now; q-shaped accounting would
+    # still claim 4*q.nbytes worth of operands
+    moved = q.nbytes + k.nbytes + v.nbytes + 2 * q.nbytes
+    assert nbytes == 7 * moved // 8
+    assert nbytes != 7 * (4 * q.nbytes) // 8
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_full(mesh8, causal):
     rng = np.random.default_rng(0)

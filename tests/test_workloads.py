@@ -1,0 +1,331 @@
+"""Workload-spec subsystem tests (ISSUE 8): the registry + generic
+runner, the byte-identical daxpy/stencil1d ports, the three serving-era
+pillars as one-shot drivers and serve handlers, and the embedding
+primitives' exact parity with their dense references."""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_mpi_tests import workloads
+from tpu_mpi_tests.drivers import _common
+from tpu_mpi_tests.workloads import runner as wrunner
+from tpu_mpi_tests.workloads.decode import DECODE_LINE_RE
+
+
+# ------------------------------------------------------ registry / CLI
+
+
+class TestRegistry:
+    def test_all_specs_registered(self):
+        names = workloads.spec_names()
+        for name in ("daxpy", "decode", "embedding", "moe", "stencil1d"):
+            assert name in names, names
+
+    def test_specs_register_serve_handlers(self):
+        """Registering a spec wires its serve workload class — the
+        three new pillars serve without any serve-layer edits."""
+        names = _common.workload_names()
+        for name in ("daxpy", "halo", "moe", "decode", "embedding"):
+            assert name in names, names
+
+    def test_get_spec_unknown_name(self):
+        with pytest.raises(KeyError):
+            workloads.get_spec("nope")
+
+    def test_umbrella_cli_lists_specs(self, capsys):
+        assert wrunner.main(["--list"]) == 0
+        out = capsys.readouterr().out.split()
+        assert "moe" in out and "stencil1d" in out
+
+    def test_umbrella_cli_unknown_spec(self, capsys):
+        assert wrunner.main(["nope"]) == 2
+
+
+# ------------------------------------------------- byte-identical ports
+
+
+class TestPortedDrivers:
+    """The daxpy/stencil1d driver bodies live on specs now; their
+    stdout must stay byte-identical to the pre-port drivers — every
+    line accounted for, static text exact, numeric fields in the
+    historical formats."""
+
+    def test_daxpy_output_shape_is_exact(self, capsys):
+        from tpu_mpi_tests.drivers import daxpy
+
+        rc = daxpy.main(["--n", "512", "--dtype", "float64"])
+        assert rc == 0
+        lines = capsys.readouterr().out.splitlines()
+        assert lines[0] == "0/1 SUM = 131328.000000"  # 512*513/2, %f
+        for i, phase in enumerate(
+            ("copyInput", "kernel", "copyOutput"), start=1
+        ):
+            assert re.fullmatch(
+                rf"TIME {phase} : \d+\.\d{{6}}", lines[i]
+            ), lines[i]
+        assert len(lines) == 4  # nothing extra crept in
+
+    def test_daxpy_print_elements_precede_sum(self, capsys):
+        from tpu_mpi_tests.drivers import daxpy
+
+        rc = daxpy.main(
+            ["--n", "4", "--dtype", "float64", "--print-elements"]
+        )
+        assert rc == 0
+        lines = capsys.readouterr().out.splitlines()
+        assert lines[:4] == [f"{v:f}" for v in (1.0, 2.0, 3.0, 4.0)]
+        assert lines[4] == "0/1 SUM = 10.000000"
+
+    def test_stencil1d_output_shape_is_exact(self, capsys):
+        from tpu_mpi_tests.drivers import stencil1d
+
+        rc = stencil1d.main(["--n-global", "4096", "--dtype", "float64"])
+        assert rc == 0
+        lines = capsys.readouterr().out.splitlines()
+        assert lines[0] == (
+            "stencil1d: n_global=4096 world=8 n_local=512 "
+            "dtype=float64 staging=direct"
+        )
+        for r in range(8):
+            assert re.fullmatch(
+                rf"{r}/8 exchange time \d+\.\d{{8}}", lines[1 + r]
+            ), lines[1 + r]
+            assert re.fullmatch(
+                rf"{r}/8 \[cpu\] err_norm = \d+\.\d{{8}}", lines[9 + r]
+            ), lines[9 + r]
+        assert len(lines) == 17
+
+    def test_ported_drivers_keep_module_api(self):
+        """The compat surface embedders/tests rely on survives the
+        port: run/main/_serve_step_factory on both driver modules."""
+        from tpu_mpi_tests.drivers import daxpy, stencil1d
+
+        for mod in (daxpy, stencil1d):
+            assert callable(mod.run)
+            assert callable(mod.main)
+            assert callable(mod._serve_step_factory)
+
+    def test_daxpy_run_via_spec_runner(self):
+        """daxpy.run(args) — the embedder entry — still works."""
+        from tpu_mpi_tests.drivers import daxpy
+
+        p = _common.base_parser("t")
+        daxpy.SPEC.add_args(p)
+        args = p.parse_args(["--n", "64", "--dtype", "float64"])
+        assert daxpy.run(args) == 0
+
+
+# ---------------------------------------------------- the new pillars
+
+
+class TestMoESpec:
+    def test_one_shot_driver_end_to_end(self, capsys, tmp_path):
+        from tpu_mpi_tests.workloads import moe
+
+        out = tmp_path / "moe.jsonl"
+        rc = moe.main([
+            "--tokens", "256", "--d-model", "16", "--iters", "2",
+            "--capacity-factor", "1.0", "--jsonl", str(out),
+        ])
+        text = capsys.readouterr().out
+        assert rc == 0
+        m = re.search(
+            r"ROUTE moe: world=8 capacity=(\d+) tokens=256 "
+            r"routed=(\d+) dropped=(\d+) overflow=([\d.]+)% "
+            r"occupancy=([\d.]+)% imbalance=([\d.]+)",
+            text,
+        )
+        assert m, text
+        assert int(m.group(2)) + int(m.group(3)) == 256
+        assert "WORKLOAD moe: us_per_step=" in text
+        recs = [json.loads(line) for line in out.read_text().splitlines()]
+        rows = [r for r in recs if r.get("kind") == "workload"]
+        assert rows and rows[0]["workload"] == "moe"
+        assert rows[0]["higher_better"] is False
+        assert any(r.get("kind") == "route" for r in recs)
+
+    def test_bad_args_exit_2(self):
+        from tpu_mpi_tests.workloads import moe
+
+        with pytest.raises(SystemExit) as e:
+            moe.main(["--tokens", "0"])
+        assert e.value.code == 2
+
+    def test_serve_handler_runs_batches(self, mesh8):
+        step = _common.workload_factory("moe")(mesh8, (256, 16),
+                                               "float32")
+        step(3)  # chained routed steps; raises on any defect
+
+    def test_serve_handler_rejects_bad_shape(self, mesh8):
+        with pytest.raises(ValueError):
+            _common.workload_factory("moe")(mesh8, (256,), "float32")
+
+
+class TestDecodeSpec:
+    def test_one_shot_driver_rows_parse(self, capsys, tmp_path):
+        from tpu_mpi_tests.workloads import decode
+
+        out = tmp_path / "dec.jsonl"
+        rc = decode.main([
+            "--batches", "1,4", "--heads", "8", "--n-iter", "20",
+            "--jsonl", str(out),
+        ])
+        text = capsys.readouterr().out
+        assert rc == 0
+        rows = re.findall(DECODE_LINE_RE, text)
+        assert len(rows) == 4  # 2 colls x 2 batches
+        # µs/op latency rows, not GB/s: no bandwidth field on the line
+        assert "GB/s" not in text
+        recs = [json.loads(line) for line in out.read_text().splitlines()]
+        dec = [r for r in recs if r.get("kind") == "decode"]
+        assert len(dec) == 4
+        assert all(r["us_per_op"] > 0 for r in dec)
+
+    def test_unknown_collective_exits_2(self, capsys):
+        from tpu_mpi_tests.workloads import decode
+
+        assert decode.main(["--colls", "nope", "--n-iter", "20"]) == 2
+        assert "ERROR unknown decode collective" in (
+            capsys.readouterr().out
+        )
+
+    def test_serve_handler_runs_batches(self, mesh8):
+        step = _common.workload_factory("decode")(mesh8, (4, 8),
+                                                  "float32")
+        step(2)
+
+
+class TestEmbeddingSpec:
+    def test_one_shot_driver_end_to_end(self, capsys, tmp_path):
+        from tpu_mpi_tests.workloads import embedding
+
+        out = tmp_path / "emb.jsonl"
+        rc = embedding.main([
+            "--vocab", "1024", "--d-model", "16", "--batch", "64",
+            "--iters", "2", "--jsonl", str(out),
+        ])
+        text = capsys.readouterr().out
+        assert rc == 0
+        assert re.search(
+            r"EMBED lookup: variant=take us_per_op=[\d.]+", text
+        )
+        assert re.search(r"EMBED scatter: us_per_op=[\d.]+", text)
+        assert "WORKLOAD embedding: lookup_us_per_op=" in text
+
+    def test_onehot_variant_verifies_too(self, capsys):
+        from tpu_mpi_tests.workloads import embedding
+
+        rc = embedding.main([
+            "--vocab", "256", "--d-model", "8", "--batch", "32",
+            "--iters", "1", "--lookup", "onehot",
+        ])
+        assert rc == 0
+        assert "variant=onehot" in capsys.readouterr().out
+
+    def test_serve_handler_runs_batches(self, mesh8):
+        step = _common.workload_factory("embedding")(
+            mesh8, (1024, 32, 16), "float32"
+        )
+        step(2)
+
+
+# ------------------------------------------- embedding comm primitives
+
+
+class TestEmbeddingComm:
+    @pytest.mark.parametrize("variant", ["take", "onehot"])
+    def test_lookup_matches_dense(self, mesh8, variant):
+        from tpu_mpi_tests.comm import embedding as E
+
+        rng = np.random.default_rng(0)
+        tab = rng.integers(-4, 5, size=(64, 8)).astype(np.float32)
+        ids = rng.integers(0, 64, size=(24,)).astype(np.int32)
+        tabs = jax.device_put(
+            jnp.asarray(tab), NamedSharding(mesh8, P("shard", None))
+        )
+        idr = jax.device_put(jnp.asarray(ids), NamedSharding(mesh8, P()))
+        out = E.embedding_lookup(tabs, idr, mesh8, variant=variant)
+        np.testing.assert_array_equal(np.asarray(out), tab[ids])
+
+    def test_scatter_add_accumulates_duplicates(self, mesh8):
+        from tpu_mpi_tests.comm import embedding as E
+
+        tab = np.zeros((64, 4), np.float32)
+        # every rank's ids hit row 5 → 8 independent adds must all land
+        ids = np.full((8,), 5, np.int32)
+        upd = np.ones((8, 4), np.float32)
+        tabs = jax.device_put(
+            jnp.asarray(tab), NamedSharding(mesh8, P("shard", None))
+        )
+        ids_s = jax.device_put(
+            jnp.asarray(ids), NamedSharding(mesh8, P("shard"))
+        )
+        upd_s = jax.device_put(
+            jnp.asarray(upd), NamedSharding(mesh8, P("shard", None))
+        )
+        new = E.embedding_scatter_add(tabs, ids_s, upd_s, mesh8)
+        ref = tab.copy()
+        np.add.at(ref, ids, upd)
+        np.testing.assert_array_equal(np.asarray(new), ref)
+        assert ref[5, 0] == 8.0  # the duplicates genuinely accumulated
+
+    def test_lookup_variant_precedence_cached_over_prior(self, mesh8,
+                                                         tmp_path):
+        from tpu_mpi_tests.comm.embedding import resolve_lookup
+        from tpu_mpi_tests.tune import registry as tr
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+
+        ctx = dict(dtype="float32", n=64, bytes=24, world=8)
+        assert resolve_lookup(None, **ctx) == "take"  # prior
+        cache = tr.configure(cache_path=str(tmp_path / "c.json"))
+        try:
+            cache.store("embedding/lookup", fingerprint(**ctx), "onehot")
+            assert resolve_lookup(None, **ctx) == "onehot"  # cached
+            assert resolve_lookup("take", **ctx) == "take"  # explicit
+            cache.store("embedding/lookup", fingerprint(**ctx), "bogus")
+            assert resolve_lookup(None, **ctx) == "take"  # degrade
+        finally:
+            tr.deconfigure()
+
+
+# --------------------------------------------------- runner behaviors
+
+
+class TestRunner:
+    def test_workload_row_record_shape(self, capsys, tmp_path):
+        """The runner's stable bench row: WORKLOAD line + kind:"workload"
+        record carrying the regression direction."""
+        from tpu_mpi_tests.workloads import decode
+
+        out = tmp_path / "d.jsonl"
+        rc = decode.main([
+            "--batches", "1", "--heads", "8", "--n-iter", "20",
+            "--colls", "allreduce", "--jsonl", str(out),
+        ])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert re.search(
+            r"WORKLOAD decode: allreduce_us_per_op=[\d.]+ us", text
+        )
+        recs = [json.loads(line) for line in out.read_text().splitlines()]
+        (row,) = [r for r in recs if r.get("kind") == "workload"]
+        assert row["metric"] == "allreduce_us_per_op"
+        assert row["higher_better"] is False
+        assert row["unit"] == "us"
+        assert row["world"] == 8
+
+    def test_spec_spaces_resolve_through_registry(self):
+        """The new pillars' knobs are declared spaces — visible to the
+        registry (and so to serve-mode preload) like every PR-4 knob."""
+        from tpu_mpi_tests.tune import registry as tr
+
+        spaces = tr.spaces()
+        assert spaces["moe/combine"].prior == "alltoall"
+        assert spaces["embedding/lookup"].prior == "take"

@@ -24,6 +24,16 @@ Heuristic scope: ALL-CAPS module-level names containing a schedule
 keyword (TILE/BLOCK/STEP/STAGING/SCHEDULE/CREDIT/MEASURED/K_GROUP/
 DEPTH/OVERLAP) whose value carries a numeric literal. String-valued
 config names and function-local values are out of scope.
+
+Inside the workload-spec subsystem (``tpu_mpi_tests.workloads``) the
+keyword set is EXTENDED with the serving-era knob vocabulary
+(CAPACITY/LOOKUP/COMBINE/ROUTE/EXPERT/FANOUT): specs are exactly where
+the next generation of schedule constants would accrete, so a spec's
+schedule constant is exempt only by routing through ``declare_space``
+— the same door the rest of the repo already has shut. The extension
+is scoped to ``workloads/`` because those words are overloaded
+elsewhere (``FLIGHT_CAPACITY`` is a ring-buffer bound, not a
+schedule).
 """
 
 from __future__ import annotations
@@ -37,10 +47,21 @@ from tpu_mpi_tests.analysis.core import FileContext, last_attr
 #: module-name prefix of the sanctioned schedule-constant home
 TUNE_PREFIX = "tpu_mpi_tests.tune"
 
+#: module-name prefix that opts into the EXTENDED keyword set: workload
+#: specs carry the serving-era knob vocabulary, and their schedule
+#: constants are exempt only via declare_space
+WORKLOADS_PREFIX = "tpu_mpi_tests.workloads"
+
 _CONST_NAME = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
 _SCHEDULE_WORD = re.compile(
     r"(TILE|BLOCK|STEP|STAGING|SCHEDULE|CREDIT|MEASURED|K_GROUP|KGROUP"
     r"|DEPTH|OVERLAP)"  # the ISSUE-7 pipeline knobs are schedules too
+)
+_SPEC_SCHEDULE_WORD = re.compile(
+    # the ISSUE-8 serving-era knob vocabulary, in scope only inside
+    # tpu_mpi_tests.workloads (overloaded meanings elsewhere)
+    r"(TILE|BLOCK|STEP|STAGING|SCHEDULE|CREDIT|MEASURED|K_GROUP|KGROUP"
+    r"|DEPTH|OVERLAP|CAPACITY|LOOKUP|COMBINE|ROUTE|EXPERT|FANOUT)"
 )
 
 
@@ -77,6 +98,9 @@ class ScheduleConstants:
     def check(self, ctx: FileContext) -> Iterator[tuple]:
         if ctx.module.startswith(TUNE_PREFIX):
             return
+        word = (_SPEC_SCHEDULE_WORD
+                if ctx.module.startswith(WORKLOADS_PREFIX)
+                else _SCHEDULE_WORD)
         for stmt in ctx.tree.body:
             if isinstance(stmt, ast.Assign):
                 targets, value = stmt.targets, stmt.value
@@ -88,7 +112,7 @@ class ScheduleConstants:
                 t.id for t in targets
                 if isinstance(t, ast.Name)
                 and _CONST_NAME.match(t.id)
-                and _SCHEDULE_WORD.search(t.id)
+                and word.search(t.id)
             ]
             if not names:
                 continue

@@ -16,6 +16,7 @@ n−1 K/V block rotations) — the classic DeepSpeed-Ulysses trade.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_mpi_tests.compat import axis_size, shard_map
 from tpu_mpi_tests.comm.ring import online_softmax_update
+from tpu_mpi_tests.instrument import telemetry as _telemetry
 from tpu_mpi_tests.instrument.telemetry import span_call
 from tpu_mpi_tests.utils import check_divisible
 
@@ -183,18 +185,44 @@ def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
                                  precision=precision)
 
     world = mesh.shape[axis_name]
+    out_nbytes_cache: dict = {}
+
+    def _out_nbytes(q, k, v) -> int:
+        """Bytes of the head→seq all-to-all's operand — the ACTUAL
+        output of the local attention, probed at trace time (no
+        execution) and cached per input signature. Counting it as
+        q-shaped (the pre-fix ``2*q.nbytes``) silently under/over-counts
+        whenever flash/blockwise padding or an accumulation dtype makes
+        the out operand differ from q."""
+        key = tuple(
+            (tuple(t.shape), str(getattr(t, "dtype", "?")))
+            for t in (q, k, v)
+        )
+        nb = out_nbytes_cache.get(key)
+        if nb is None:
+            out = jax.eval_shape(attn, q, k, v)
+            nb = out_nbytes_cache[key] = int(
+                math.prod(out.shape) * out.dtype.itemsize
+            )
+        return nb
 
     def attn_recorded(q, k, v):
         # telemetry payload: two all-to-alls — q/k/v seq→head, then the
-        # output (q-shaped) head→seq; each moves (w−1)/w of its operand
-        moved = (
-            2 * int(getattr(q, "nbytes", 0))
-            + int(getattr(k, "nbytes", 0))
-            + int(getattr(v, "nbytes", 0))
-        )
+        # output (NOT necessarily q-shaped) head→seq; each moves
+        # (w−1)/w of its operand. The output probe runs only on the
+        # enabled path — a disabled call must stay one attribute check
+        nbytes = 0
+        if _telemetry.registry().enabled:
+            moved = (
+                int(getattr(q, "nbytes", 0))
+                + int(getattr(k, "nbytes", 0))
+                + int(getattr(v, "nbytes", 0))
+                + _out_nbytes(q, k, v)
+            )
+            nbytes = (world - 1) * moved // world
         return span_call(
             "ulysses_attention", attn, q, k, v,
-            nbytes=(world - 1) * moved // world,
+            nbytes=nbytes,
             axis_name=axis_name, world=world,
             flash=flash, causal=causal,
         )

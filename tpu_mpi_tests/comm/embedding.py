@@ -1,0 +1,166 @@
+"""Sharded embedding gather/scatter: the table-lookup comm pillar.
+
+The ``gather_inplace`` pillar generalized to what production inference
+actually runs (ROADMAP item 4): a ``(vocab, d_model)`` table too large
+to replicate lives row-sharded across the mesh, a batch of token ids
+must come back as dense rows, and the training-side dual pushes
+gradient rows back into the owning shards. Communication shapes:
+
+* **lookup** — each rank resolves the ids that land in its row range
+  locally (foreign ids contribute zeros) and one ``psum`` assembles the
+  replicated ``(B, d_model)`` result: the allreduce-of-partials
+  formulation XLA lowers sharded ``take`` to;
+* **scatter-add** — ids/updates arrive batch-sharded, one
+  ``all_gather`` replicates them, and each rank scatter-adds only the
+  rows it owns (duplicate ids accumulate, ``.at[].add`` semantics).
+
+The *local* gather is a tunable schedule (``embedding/lookup``):
+``take`` (dynamic gather rows) vs ``onehot`` (a one-hot matmul — the
+classic TPU alternative that trades FLOPs for the MXU's streaming
+access pattern; measured-better for small vocab shards). The knob is
+fingerprint-keyed (dtype × vocab bucket × batch bucket × world) and
+resolves explicit > cached > prior like every schedule since PR 4; a
+``--tune`` run prices both on this table before persisting the winner.
+
+Verified against the dense host reference in ``tests/test_moe.py`` /
+the embedding workload spec — lookups are copies and the scatter sums
+integer-valued rows, so equality is exact in every dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_mpi_tests.compat import shard_map
+from tpu_mpi_tests.instrument.telemetry import span_call
+from tpu_mpi_tests.tune import priors as _priors
+from tpu_mpi_tests.tune.registry import (
+    declare_space,
+    resolve as _tune_resolve,
+)
+from tpu_mpi_tests.utils import check_divisible
+
+#: local-gather schedule knob — declared here because the lookup lives
+#: here; prior "take" (the dynamic-gather lowering)
+EMBED_LOOKUP_SPACE = declare_space(
+    "embedding/lookup",
+    (_priors.EMBED_LOOKUP, "onehot"),
+    describe="sharded embedding local gather: dynamic take vs one-hot "
+             "matmul",
+)
+
+
+def resolve_lookup(explicit=None, **ctx) -> str:
+    """Lookup variant: explicit > cached winner > prior ("take").
+    ``device_fallback=False``: the optimum is shape-keyed (the one-hot
+    matmul is O(B·V_local) — a small-vocab winner is measured-wrong at
+    a large shard). Malformed cache values degrade to the prior."""
+    val = _tune_resolve(
+        "embedding/lookup", explicit=explicit, prior=_priors.EMBED_LOOKUP,
+        device_fallback=False, **ctx,
+    )
+    return val if val in ("take", "onehot") else _priors.EMBED_LOOKUP
+
+
+@functools.lru_cache(maxsize=None)
+def _lookup_fn(mesh: Mesh, axis_name: str, variant: str):
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis_name, None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def lookup(table, ids):
+        v_local = table.shape[0]
+        base = lax.axis_index(axis_name) * v_local
+        local = ids.astype(jnp.int32) - base
+        ok = (local >= 0) & (local < v_local)
+        if variant == "onehot":
+            oh = (local[:, None] == jnp.arange(v_local,
+                                               dtype=jnp.int32)[None, :])
+            oh = (oh & ok[:, None]).astype(table.dtype)
+            rows = oh @ table
+        else:  # take
+            rows = table[jnp.clip(local, 0, v_local - 1)]
+            rows = rows * ok[:, None].astype(table.dtype)
+        return lax.psum(rows, axis_name)
+
+    return lookup
+
+
+def embedding_lookup(table, ids, mesh: Mesh, axis_name: str | None = None,
+                     variant: str | None = None):
+    """Gather ``table[ids]`` from a row-sharded table: ``table`` is
+    ``(V, D)`` sharded on axis 0, ``ids`` a replicated int vector;
+    returns the replicated ``(B, D)`` rows. Payload model: the psum of
+    partial rows, allreduce accounting (``2(w−1)·B·D`` bytes aggregate)."""
+    axis_name = axis_name or mesh.axis_names[0]
+    world = mesh.shape[axis_name]
+    check_divisible(table.shape[0], world, "embedding rows over mesh axis")
+    variant = resolve_lookup(
+        variant, dtype=str(table.dtype), n=table.shape[0],
+        bytes=int(ids.shape[0]), world=world,
+    )
+    row_bytes = int(ids.shape[0]) * int(table.shape[1]) * table.dtype.itemsize
+    return span_call(
+        "embedding_lookup",
+        _lookup_fn(mesh, axis_name, variant),
+        table, ids,
+        nbytes=2 * (world - 1) * row_bytes,
+        axis_name=axis_name, world=world, variant=variant,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _scatter_add_fn(mesh: Mesh, axis_name: str):
+    @functools.partial(jax.jit, donate_argnums=0)
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name), P(axis_name, None)),
+        out_specs=P(axis_name, None),
+        check_vma=False,
+    )
+    def scatter(table, ids, updates):
+        v_local = table.shape[0]
+        ids_all = lax.all_gather(ids.astype(jnp.int32), axis_name,
+                                 axis=0, tiled=True)
+        upd_all = lax.all_gather(updates, axis_name, axis=0, tiled=True)
+        base = lax.axis_index(axis_name) * v_local
+        local = ids_all - base
+        ok = (local >= 0) & (local < v_local)
+        # foreign rows scatter to the out-of-range index and drop —
+        # never a masked write into row 0
+        return table.at[jnp.where(ok, local, v_local)].add(
+            upd_all, mode="drop"
+        )
+
+    return scatter
+
+
+def embedding_scatter_add(table, ids, updates, mesh: Mesh,
+                          axis_name: str | None = None):
+    """Push batch-sharded update rows into the owning table shards:
+    ``ids`` ``(B,)`` and ``updates`` ``(B, D)`` sharded on axis 0,
+    ``table`` ``(V, D)`` row-sharded (donated — the in-place analog).
+    Duplicate ids accumulate. Payload model: the id+update allgather
+    (``(w−1)·(B·D + B·4)`` bytes aggregate)."""
+    axis_name = axis_name or mesh.axis_names[0]
+    world = mesh.shape[axis_name]
+    check_divisible(table.shape[0], world, "embedding rows over mesh axis")
+    check_divisible(ids.shape[0], world, "embedding batch over mesh axis")
+    nbytes = (world - 1) * (
+        int(getattr(updates, "nbytes", 0)) + int(ids.shape[0]) * 4
+    )
+    return span_call(
+        "embedding_scatter_add",
+        _scatter_add_fn(mesh, axis_name),
+        table, ids, updates,
+        nbytes=nbytes,
+        axis_name=axis_name, world=world,
+    )

@@ -1,0 +1,282 @@
+"""MoE token routing: capacity-bucketed all-to-all dispatch/combine.
+
+The serving-era shape of the all-to-all pillar (ROADMAP item 4): in a
+mixture-of-experts layer every rank holds a shard of the token stream,
+each token names a destination expert (one expert per mesh rank here),
+and the layer is two variable-occupancy ``lax.all_to_all`` hops —
+dispatch tokens to their experts, combine the processed tokens back to
+their source positions. Occupancy varies per (source, expert) pair, but
+the collective's buffers cannot: every pair gets a fixed ``capacity``
+slot bucket, tokens beyond it are DROPPED (the standard MoE overflow
+rule), and the drop accounting — occupancy, overflow %, per-expert
+imbalance — is a first-class measurement (``kind: "route"`` records,
+the ``tpumt-report`` ROUTE table), because in production it is the
+routing distribution, not the link bandwidth, that decides whether an
+MoE layer keeps its SLO.
+
+Semantics (verified against :func:`route_reference` in
+``tests/test_moe.py``):
+
+* token ``t`` on source rank ``r`` with destination ``e`` is routed iff
+  fewer than ``capacity`` earlier tokens of shard ``r`` (local order)
+  named ``e``; routed tokens return as ``f_e(x_t)`` (the analytic
+  per-expert function ``(e+1)·x`` when ``scale=True``), dropped tokens
+  return zeros — exact in every dtype for integer-valued inputs;
+* the dispatch buffer is ``(world, capacity, D)`` per rank; empty slots
+  carry zeros and survive the expert function (``f_e(0) = 0``).
+
+The combine hop is a tunable schedule (``moe/combine``): the inverse
+``all_to_all`` (prior — moves the same bytes as the dispatch) vs an
+``all_gather`` of the processed buffers with a local slot select (moves
+``world``× the bytes but collapses the second variable-occupancy hop
+into the gather pattern some topologies prefer for tiny payloads).
+Resolution is explicit > cached > prior like every knob since PR 4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_mpi_tests.comm.collectives import host_value
+from tpu_mpi_tests.compat import shard_map
+from tpu_mpi_tests.instrument import telemetry
+from tpu_mpi_tests.instrument.telemetry import span_call
+from tpu_mpi_tests.tune import priors as _priors
+from tpu_mpi_tests.tune.registry import (
+    declare_space,
+    resolve as _tune_resolve,
+)
+from tpu_mpi_tests.utils import check_divisible
+
+#: the combine-hop schedule knob — declared here because the routing
+#: collective lives here; prior "alltoall" keeps untuned runs on the
+#: symmetric dispatch/combine pair
+MOE_COMBINE_SPACE = declare_space(
+    "moe/combine",
+    (_priors.MOE_COMBINE, "allgather"),
+    describe="MoE combine hop: inverse all_to_all vs all_gather + "
+             "local slot select",
+)
+
+
+def resolve_combine(explicit=None, **ctx) -> str:
+    """Combine-hop variant: explicit > cached winner > prior.
+    ``device_fallback=False`` — the optimum is payload-sensitive (the
+    allgather variant moves world× the bytes), so a sibling shape's
+    winner must not leak in. Malformed cache values degrade to the
+    prior."""
+    val = _tune_resolve(
+        "moe/combine", explicit=explicit, prior=_priors.MOE_COMBINE,
+        device_fallback=False, **ctx,
+    )
+    return val if val in ("alltoall", "allgather") else _priors.MOE_COMBINE
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteStats:
+    """Host-side accounting of one routed step.
+
+    ``counts[r, e]`` is source rank ``r``'s demand for expert ``e``
+    (pre-drop); ``expert_load[e]`` the tokens expert ``e`` actually
+    received (post-capacity). ``occupancy_pct`` is routed tokens over
+    total slot capacity (``world² · capacity``), ``imbalance`` the
+    max/mean ratio of per-expert load (1.0 = perfectly balanced; the
+    number capacity factors are provisioned against)."""
+
+    world: int
+    capacity: int
+    counts: np.ndarray  # (world, world) int64
+
+    @property
+    def tokens(self) -> int:
+        return int(self.counts.sum())
+
+    @property
+    def routed(self) -> int:
+        return int(np.minimum(self.counts, self.capacity).sum())
+
+    @property
+    def dropped(self) -> int:
+        return self.tokens - self.routed
+
+    @property
+    def overflow_pct(self) -> float:
+        return 100.0 * self.dropped / self.tokens if self.tokens else 0.0
+
+    @property
+    def expert_load(self) -> np.ndarray:
+        return np.minimum(self.counts, self.capacity).sum(axis=0)
+
+    @property
+    def occupancy_pct(self) -> float:
+        cap_total = self.world * self.world * self.capacity
+        return 100.0 * self.routed / cap_total if cap_total else 0.0
+
+    @property
+    def imbalance(self) -> float:
+        load = self.expert_load.astype(np.float64)
+        mean = load.mean() if load.size else 0.0
+        return float(load.max() / mean) if mean > 0 else 1.0
+
+    def record(self, op: str = "moe", **extra) -> dict:
+        """The ``kind: "route"`` JSONL shape (ROUTE table input)."""
+        return {
+            "kind": "route",
+            "op": op,
+            "world": self.world,
+            "capacity": self.capacity,
+            "tokens": self.tokens,
+            "routed": self.routed,
+            "dropped": self.dropped,
+            "overflow_pct": self.overflow_pct,
+            "occupancy_pct": self.occupancy_pct,
+            "imbalance": self.imbalance,
+            "expert_load": [int(v) for v in self.expert_load],
+            **extra,
+        }
+
+
+@functools.lru_cache(maxsize=None)
+def moe_route_fn(mesh: Mesh, axis_name: str, capacity: int,
+                 combine: str = "alltoall", scale: bool = True):
+    """Jitted routed step over a token-sharded ``(T_global, D)`` array
+    plus an int32 destination vector sharded alike. Returns
+    ``(y, counts)``: the routed-and-processed tokens (dropped positions
+    zero) and the per-(source, dest) demand matrix (``(world, world)``,
+    replicated so every process can read the accounting host-side —
+    multi-host runs cannot ``np.asarray`` a sharded output)."""
+    w = mesh.shape[axis_name]
+
+    def route(x, dest):
+        # (T_local, D) tokens, (T_local,) int32 destinations
+        d_model = x.shape[1]
+        dest = dest.astype(jnp.int32)
+        oh = (dest[:, None] == jnp.arange(w, dtype=jnp.int32)[None, :])
+        oh = oh.astype(jnp.int32)  # (T, w)
+        # position of each token within its destination group (exclusive
+        # running count) — the capacity cutoff is per (source, dest)
+        cum = jnp.cumsum(oh, axis=0) - oh
+        pos = jnp.take_along_axis(cum, dest[:, None], axis=1)[:, 0]
+        counts = oh.sum(axis=0)  # (w,) this source's per-dest demand
+        keep = pos < capacity
+        # slot layout: dest-major buckets of `capacity` slots; overflow
+        # tokens scatter to the out-of-range index and are dropped by
+        # the scatter mode (never silently wrapped)
+        slot = jnp.where(keep, dest * capacity + pos, w * capacity)
+        send = jnp.zeros((w * capacity, d_model), x.dtype)
+        send = send.at[slot].set(x, mode="drop").reshape(w, capacity,
+                                                        d_model)
+        recv = lax.all_to_all(send, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)
+        # expert compute on this rank (= expert axis_index): analytic
+        # (e+1)·x so verification is exact and f_e(0) = 0 keeps empty
+        # slots inert
+        proc = recv
+        if scale:
+            e = lax.axis_index(axis_name)
+            proc = recv * (e + 1).astype(x.dtype)
+        if combine == "allgather":
+            # gather every expert's processed buffer, select my source
+            # slot locally: g[e, r] = expert e's tokens from source r
+            g = lax.all_gather(proc, axis_name, axis=0, tiled=False)
+            back = g[:, lax.axis_index(axis_name)]
+        else:
+            back = lax.all_to_all(proc, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        flat = back.reshape(w * capacity, d_model)
+        y = flat[jnp.where(keep, slot, 0)] * keep[:, None].astype(x.dtype)
+        # replicate the (w, w) demand matrix (row = source rank) — a
+        # w² int32 all_gather, negligible next to the token hops
+        counts_all = lax.all_gather(counts, axis_name, axis=0,
+                                    tiled=False)
+        return y, counts_all
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name)),
+        out_specs=(P(axis_name, None), P()),
+        check_vma=False,
+    )
+    def routed(x, dest):
+        return route(x, dest)
+
+    return routed
+
+
+def route_payload_bytes(x, world: int, capacity: int,
+                        combine: str = "alltoall") -> int:
+    """Telemetry payload model (aggregate across ranks, busbw
+    convention): each a2a hop moves ``(w−1)/w`` of every rank's
+    ``(w, capacity, D)`` buffer; the allgather combine receives the
+    ``w−1`` foreign buffers whole."""
+    d_model = int(x.shape[-1])
+    item = int(x.dtype.itemsize) if hasattr(x, "dtype") else 4
+    buf = world * capacity * d_model * item  # per-rank dispatch buffer
+    dispatch = (world - 1) * buf  # w ranks × (w−1)/w × buf
+    if combine == "allgather":
+        return dispatch + world * (world - 1) * buf
+    return 2 * dispatch
+
+
+def route_tokens(x, dest, mesh: Mesh, capacity: int,
+                 axis_name: str | None = None, combine: str | None = None,
+                 scale: bool = True, op: str = "moe"):
+    """One routed MoE step with accounting: dispatch → expert → combine.
+
+    ``x`` is ``(T_global, D)`` sharded on axis 0 over the mesh axis,
+    ``dest`` the matching int32 destination vector (values in
+    ``[0, world)``), ``capacity`` the per-(source, expert) slot count.
+    Returns ``(y, RouteStats)`` — ``y`` sharded like ``x`` with dropped
+    positions zeroed. The call is bracketed in a sync-honest span
+    (``op``) with the dispatch+combine payload model, and the
+    accounting is mirrored to the telemetry sink as a ``kind: "route"``
+    record when telemetry is on (the ROUTE table's input)."""
+    axis_name = axis_name or mesh.axis_names[0]
+    world = mesh.shape[axis_name]
+    check_divisible(x.shape[0], world, "moe tokens over mesh axis")
+    if capacity < 1:
+        raise ValueError(f"capacity must be positive, got {capacity}")
+    combine = resolve_combine(
+        combine, dtype=str(x.dtype), n=x.shape[0], world=world,
+    )
+    fn = moe_route_fn(mesh, axis_name, int(capacity), combine, scale)
+    y, counts = span_call(
+        op, fn, x, dest,
+        nbytes=route_payload_bytes(x, world, capacity, combine),
+        axis_name=axis_name, world=world, combine=combine,
+        capacity=int(capacity),
+    )
+    stats = RouteStats(
+        world=world, capacity=int(capacity),
+        counts=np.asarray(host_value(counts), np.int64),
+    )
+    telemetry.emit(stats.record(op=op, combine=combine))
+    return y, stats
+
+
+def route_reference(x, dest, world: int, capacity: int,
+                    scale: bool = True) -> np.ndarray:
+    """Dense host-side reference of the routed step (numpy, no jax):
+    the same first-``capacity``-per-(source, dest) drop rule applied in
+    local shard order, dropped tokens zero, routed tokens ``(e+1)·x``.
+    The analytic gate the device path is verified against."""
+    x = np.asarray(x)
+    dest = np.asarray(dest)
+    t_local = x.shape[0] // world
+    y = np.zeros_like(x)
+    for r in range(world):
+        taken = np.zeros(world, np.int64)
+        for t in range(r * t_local, (r + 1) * t_local):
+            e = int(dest[t])
+            if taken[e] < capacity:
+                taken[e] += 1
+                y[t] = x[t] * (e + 1) if scale else x[t]
+    return y

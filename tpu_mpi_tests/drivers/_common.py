@@ -56,13 +56,17 @@ def workload_factory(name: str) -> Callable:
 
 
 def _import_workload_owners() -> None:
-    """Import every driver module that registers a handler. Lazy so the
-    registry stays importable without jax (driver modules only import
-    jax inside their run/factory bodies)."""
+    """Import every module that registers a handler. Lazy so the
+    registry stays importable without jax (driver/spec modules only
+    import jax inside their run/factory bodies). The workload-spec
+    subsystem registers its pillars (daxpy, halo, moe, decode,
+    embedding) through ``register_spec``; attnbench/collbench still
+    register directly."""
     import tpu_mpi_tests.drivers.attnbench  # noqa: F401
     import tpu_mpi_tests.drivers.collbench  # noqa: F401
-    import tpu_mpi_tests.drivers.daxpy  # noqa: F401
-    import tpu_mpi_tests.drivers.stencil1d  # noqa: F401
+    from tpu_mpi_tests import workloads
+
+    workloads.load_specs()
 
 
 def base_parser(description: str) -> argparse.ArgumentParser:

@@ -172,7 +172,9 @@ def main(argv=None) -> int:
         "(shape dims 'x'-separated, e.g. attn:256x64:bfloat16:2); "
         "handlers: daxpy (vector step), halo (stencil1d exchange), "
         "attn (ring-attention block), allreduce (small-payload "
-        f"collective). Default: {DEFAULT_TABLE}",
+        "collective), moe (tokensxd_model capacity-bucketed routing), "
+        "decode (batchxheads latency-bound allreduce), embedding "
+        f"(vocabxbatchxd_model sharded lookup). Default: {DEFAULT_TABLE}",
     )
     p.add_argument(
         "--seed", type=int, default=0,

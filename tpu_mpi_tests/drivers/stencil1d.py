@@ -1,384 +1,34 @@
 """Distributed 1-D 5-point stencil with halo exchange and error-norm gate.
 
-≅ ``mpi_stencil_gt.cc`` (call stack SURVEY.md §3.3): y = x³ over n_global
-points (default 32Mi, ``--n-global-mi`` in Mi units like the reference argv),
-decomposed across ranks with ghost width 2; one timed halo exchange; stencil
-derivative; per-rank ``err_norm`` vs the analytic 3x², exact to rounding for
-a cubic. Output lines preserved::
-
-    <rank>/<size> exchange time <s>
-    <rank>/<size> [<device>] err_norm = <v>
+≅ ``mpi_stencil_gt.cc``. The driver body lives in the declarative
+workload spec (:mod:`tpu_mpi_tests.workloads.stencil1d` — ported onto
+the spec subsystem, stdout byte-identical); this module stays the
+compatible entry point: ``python -m tpu_mpi_tests.drivers.stencil1d``
+and the ``halo`` serve-mode workload class behave exactly as before the
+port.
 """
 
 from __future__ import annotations
 
 import sys
-import time
 
-import numpy as np
+from tpu_mpi_tests.workloads.stencil1d import (  # noqa: F401
+    SPEC,
+    _run_overlap,
+    main,
+)
 
-from tpu_mpi_tests.drivers import _common
+#: the serve-mode handler, re-exported for compatibility (registration
+#: happens in the spec module via register_spec)
+_serve_step_factory = SPEC.serve_factory
 
 
 def run(args) -> int:
-    import jax
-    import jax.numpy as jnp
+    """The driver body (spec runner flow) — kept so embedders that
+    called ``stencil1d.run`` keep working."""
+    from tpu_mpi_tests.workloads.runner import run_body
 
-    from tpu_mpi_tests.arrays.domain import Domain1D
-    from tpu_mpi_tests.comm import collectives as C
-    from tpu_mpi_tests.comm import halo as H
-    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
-    from tpu_mpi_tests.instrument import ProfilerGate
-    from tpu_mpi_tests.instrument.timers import block
-    from tpu_mpi_tests.kernels.stencil import analytic_pairs
-    from tpu_mpi_tests.utils import TpuMtError
-
-    dtype = _common.jnp_dtype(args)
-    bootstrap()
-    topo = topology()
-    mesh = make_mesh()
-    world = topo.global_device_count
-    axis_name = mesh.axis_names[0]
-
-    n_global = args.n_global
-    d = Domain1D(n_global=n_global, n_shards=world, n_bnd=2)
-    f, df = analytic_pairs()["1d"]
-
-    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
-    with rep:
-        rep.banner(
-            f"stencil1d: n_global={n_global} world={world} "
-            f"n_local={d.n_local} dtype={args.dtype} staging={args.staging}"
-        )
-
-        # shards materialize on their own devices (multi-GB host→device init
-        # transfer is the wrong tool at 32Mi+ scale — see collectives.device_init)
-        zg = block(
-            C.device_init(
-                mesh, lambda r: d.init_shard_jax(f, r, dtype), ndim=1
-            )
-        )
-
-        staging = H.Staging.parse(args.staging)
-        if staging is H.Staging.AUTO:
-            if args.tune:
-                # measured sweep over the halo schedule space (staging
-                # strategy + ppermute-vs-RDMA flavor) on this exact
-                # buffer: each candidate prices a donated feedback chain
-                # (state = exchange(state)), sync-honest via block();
-                # the winner persists to the schedule cache and a rerun
-                # is a pure cache hit (make tune-smoke gates this)
-                from tpu_mpi_tests.tune.sweep import (
-                    ensure_tuned,
-                    feedback_rate,
-                )
-
-                def measure(cand):
-                    sec, _ = feedback_rate(
-                        lambda z: H.halo_exchange(z, mesh, staging=cand),
-                        zg + 0,  # fresh copy: the exchange donates
-                    )
-                    return sec
-
-                ensure_tuned(
-                    "halo/staging", measure, device_fallback=False,
-                    **H._staging_context(zg, 0, world),
-                )
-            staging = H.resolve_staging("auto", zg, 0, world)
-            rep.banner(f"TUNE halo/staging resolved -> {staging.value}")
-        with ProfilerGate(args.profile_dir):
-            # untimed warmup so the timed exchange measures communication, not
-            # trace+compile (exchange is idempotent: ghosts are rewritten with
-            # identical values) — async-dispatch discipline, SURVEY §7 part 2
-            zg = block(H.halo_exchange(zg, mesh, staging=staging))
-            # one timed exchange (mpi_stencil_gt.cc:200-205)
-            t0 = time.perf_counter()
-            zg = block(H.halo_exchange(zg, mesh, staging=staging))
-            seconds = time.perf_counter() - t0
-            if topo.process_index == 0:
-                for r in range(world):
-                    rep.line(
-                        f"{r}/{world} exchange time {seconds:0.8f}",
-                        {"kind": "exchange1d", "rank": r, "seconds": seconds},
-                    )
-
-            # compile-cost probe on the derivative kernel (the halo
-            # exchange is probed automatically through span_call); the
-            # fingerprint context keys the record to this layout
-            from tpu_mpi_tests.instrument import costs
-
-            deriv_fn = H.stencil_fn(mesh, axis_name, 0, 1, d.scale)
-            costs.compile_probe(
-                deriv_fn, (zg,), label="stencil1d_deriv",
-                dtype=args.dtype, n=n_global, world=world,
-            )
-            deriv = block(deriv_fn(zg))
-
-        # per-rank err norms vs analytic derivative, computed shard-local on
-        # device (the full global field never moves to host)
-        actual = C.device_init(
-            mesh, lambda r: d.interior_shard_jax(df, r, dtype), ndim=1
-        )
-        per_rank_err = C.per_rank_err_norms(deriv, actual, mesh)
-        kind = jax.devices()[0].device_kind
-        if topo.process_index == 0:
-            for r in range(world):
-                rep.line(
-                    f"{r}/{world} [{kind}] err_norm = {per_rank_err[r]:.8f}",
-                    {"kind": "err_norm", "rank": r, "err": float(per_rank_err[r])},
-                )
-
-        if args.tol is not None:
-            tol = args.tol
-        elif args.dtype == "float64":
-            # rounding error grows with scale·√n like the f32 case (coordinate
-            # ulps amplified by 1/delta); a broken halo exceeds this by >10⁴
-            eps64 = 2.2e-16
-            tol = max(
-                128 * eps64 * d.length**3 * d.scale * np.sqrt(n_global), 1e-6
-            )
-        else:
-            # f32/bf16: cancellation error ≈ eps·max|y|·scale per point
-            # (SURVEY §7 hard part 1); a broken halo exceeds this by >10³
-            eps = float(np.finfo(np.dtype(args.dtype).newbyteorder("=")).eps) if args.dtype != "bfloat16" else 7.8e-3
-            ymax = d.length**3
-            tol = 8 * eps * ymax * d.scale * np.sqrt(n_global)
-        if per_rank_err.max() > tol:
-            rep.line(
-                f"ERR_NORM FAIL: max {per_rank_err.max():.8g} > tol {tol:.8g}"
-            )
-            return 1
-        if args.overlap != "0":
-            return _run_overlap(args, rep, mesh, topo, zg, d)
-        return 0
-
-
-def _run_overlap(args, rep, mesh, topo, zg, d) -> int:
-    """The ``--overlap`` mode: run the double-buffered halo pipeline
-    (README "Overlap engine") for ``--overlap-iters`` steps of the
-    fused exchange+update recurrence on a copy of the verified field.
-
-    Depth resolves explicit > cached > prior (1); with ``--tune`` and
-    ``--overlap auto`` a cache miss sweeps the depth candidates first
-    (each priced on a short pipeline run). Depth ≥ 2 runs are verified
-    bit-identical against a depth-1 rerun — the interior/boundary seam
-    correctness gate — and the measured ``overlap_frac`` (wall overlap
-    of the in-flight exchange span with the interior-compute phase) is
-    attached to the phase record and the ``kind:"overlap"`` row."""
-    import time as _time
-
-    from tpu_mpi_tests.comm import halo as H
-    from tpu_mpi_tests.instrument.timers import PhaseTimer, block
-    import numpy as np
-
-    world = topo.global_device_count
-    axis_name = mesh.axis_names[0]
-    eps = 1e-6
-    n_iters = args.overlap_iters
-    explicit = None if args.overlap == "auto" else int(args.overlap)
-    ctx = dict(dtype=args.dtype, n=args.n_global, world=world)
-    fns = H.overlap_jacobi_fns(
-        mesh, axis_name, 0, 1, 2, float(d.scale), eps
-    )
-    exchange_nod, core, seam = fns
-    nbytes = H.halo_payload_bytes(zg, 0, world, 2, False)
-
-    def pipeline(depth: int, n: int, timer=None):
-        runner = H.OverlapRunner(
-            "halo_exchange", depth=depth, nbytes=nbytes,
-            axis_name=axis_name, world=world, timer=timer,
-            phase="overlap_interior",
-        )
-        z = block(zg + 0)
-        for _ in range(n):
-            ex, zc = runner.step(exchange_nod, core, z)
-            z = block(seam(ex, zc))
-        return z, runner
-
-    if explicit is None and args.tune:
-        from tpu_mpi_tests.tune.sweep import ensure_tuned
-
-        def measure(cand):
-            # compile + warm OUTSIDE the timed window: the split
-            # programs are shared across depths (lru_cache), so the
-            # first candidate — the prior, depth 1 — would otherwise
-            # pay the one-time jit cost and bias the winner to depth 2
-            z, _ = pipeline(int(cand), 1)
-            del z
-            t0 = _time.perf_counter()
-            z, _ = pipeline(int(cand), max(4, n_iters // 4))
-            del z
-            return _time.perf_counter() - t0
-
-        ensure_tuned(
-            "halo/overlap", measure, device_fallback=False, **ctx
-        )
-    depth = H.resolve_overlap_depth(explicit, **ctx)
-    rep.banner(f"OVERLAP halo depth resolved -> {depth}")
-
-    zw, _ = pipeline(depth, 1)  # compile + warm (programs are shared
-    del zw                      # across depths via the lru cache)
-    timer = PhaseTimer()
-    t0 = _time.perf_counter()
-    z, runner = pipeline(depth, n_iters, timer=timer)
-    seconds = _time.perf_counter() - t0
-    it_per_s = n_iters / seconds if seconds > 0 else float("inf")
-
-    rc = 0
-    if depth > 1:
-        # seam gate: the pipelined schedule must be bit-identical to
-        # the serialized one (same compiled programs, reordered)
-        z_ref, _ = pipeline(1, n_iters)
-        if not np.array_equal(np.asarray(z), np.asarray(z_ref)):
-            rep.line(
-                f"OVERLAP FAIL depth={depth}: pipelined result diverges "
-                f"from the depth-1 schedule (seam defect)"
-            )
-            rc = 1
-        del z_ref
-    del z
-
-    runner.annotate(timer)
-    rep.time_lines(timer, stats=True)
-    rep.line(
-        f"OVERLAP halo depth={depth} iters={n_iters} "
-        f"{it_per_s:0.1f} it/s overlap_frac={runner.overlap_frac:0.3f}",
-        runner.record(
-            "halo", iters=n_iters, it_per_s=it_per_s, dtype=args.dtype,
-            n=args.n_global,
-        ),
-    )
-    return rc
-
-
-def _serve_step_factory(mesh, shape, dtype):
-    """Serve-mode handler: ``step_fn(n)`` performs ``n`` halo exchanges
-    on a persistent ghosted shard set (the exchange is idempotent —
-    ghosts are rewritten with identical values — so chained requests are
-    exactly the driver's timed step). Each exchange goes through
-    :func:`~tpu_mpi_tests.comm.halo.halo_exchange`, so with telemetry on
-    every request also lands its own comm span, and the staging schedule
-    resolves through the tune cache like any other run.
-
-    The chained exchanges dispatch through a
-    :class:`~tpu_mpi_tests.comm.collectives.DispatchWindow` whose depth
-    resolves from the schedule cache (``coll/dispatch_depth``, prior 1)
-    — so steady-state traffic exercises the tuned pipelined path: at
-    depth 1 every exchange syncs per call (today's behavior,
-    byte-identical), at depth ≥ 2 up to that many dispatches ride in
-    flight before the window blocks on the oldest."""
-    import jax.numpy as jnp
-
-    from tpu_mpi_tests.arrays.domain import Domain1D
-    from tpu_mpi_tests.comm import collectives as C
-    from tpu_mpi_tests.comm import halo as H
-    from tpu_mpi_tests.instrument.timers import block
-    from tpu_mpi_tests.kernels.stencil import analytic_pairs
-
-    if len(shape) != 1:
-        raise ValueError(f"halo wants a 1-d shape, got {shape}")
-    (n,) = shape
-    world = mesh.devices.size
-    d = Domain1D(n_global=n, n_shards=world, n_bnd=2)
-    f, _ = analytic_pairs()["1d"]
-    dt = jnp.dtype(dtype)
-    # tuned overlap depth, resolved like any other knob (cached > prior)
-    depth = C.resolve_dispatch_depth(
-        dtype=str(dt), n=n, world=world
-    )
-
-    def init():
-        return block(C.device_init(
-            mesh, lambda r: d.init_shard_jax(f, r, dt), ndim=1
-        ))
-
-    state = {"z": init()}
-
-    def step(k: int):
-        try:
-            z = state["z"]
-            with C.DispatchWindow(depth) as win:
-                for _ in range(k):
-                    # AUTO staging: the tune cache's winner for this
-                    # topology when one is warmed, the shipped prior
-                    # (direct) otherwise — the schedule preload at
-                    # serve start is consumed here
-                    z = H.halo_exchange(
-                        z, mesh, staging=H.Staging.AUTO,
-                        window=win if depth > 1 else None,
-                    )
-            state["z"] = block(z)
-        except Exception:
-            # the exchange donates its input: after a mid-batch failure
-            # the held buffer may already be consumed, and keeping it
-            # would poison every later batch of this class with
-            # buffer-deleted errors for the rest of a long run —
-            # rebuild, then let the loop count the error
-            state["z"] = init()
-            raise
-
-    step(1)  # compile + warm before traffic opens
-    return step
-
-
-_common.register_workload("halo", _serve_step_factory)
-
-
-def main(argv=None) -> int:
-    p = _common.base_parser(__doc__)
-    p.add_argument(
-        "--n-global-mi",
-        type=int,
-        default=None,
-        help="global size in Mi elements (reference argv unit; default 32)",
-    )
-    p.add_argument(
-        "--n-global",
-        type=int,
-        default=32 * 1024 * 1024,
-        help="global size in elements (exact; overridden by --n-global-mi)",
-    )
-    p.add_argument(
-        "--staging",
-        default="direct",
-        choices=["direct", "device", "host", "pallas", "auto"],
-        help="halo staging mode (≅ reference stage_host/device variants; "
-        "'pallas' = hand-written inter-chip RDMA ring kernel; 'auto' = "
-        "the schedule cache's tuned winner for this topology — with "
-        "--tune a cache miss runs the measured sweep first)",
-    )
-    p.add_argument(
-        "--tol",
-        type=float,
-        default=None,
-        help="err_norm gate (default: dtype-dependent)",
-    )
-    p.add_argument(
-        "--overlap",
-        default="0",
-        choices=["0", "1", "2", "auto"],
-        help="run the double-buffered halo pipeline after the gate "
-        "(README 'Overlap engine'): 0 = off (default), 1 = the "
-        "serialized schedule, 2 = exchange in flight under the "
-        "interior compute, auto = the schedule cache's tuned depth "
-        "(with --tune a cache miss sweeps the candidates first); "
-        "depth>=2 is verified bit-identical to depth 1",
-    )
-    p.add_argument(
-        "--overlap-iters",
-        type=int,
-        default=32,
-        help="pipeline steps for --overlap (default 32)",
-    )
-    args = p.parse_args(argv)
-    if args.overlap_iters < 1:
-        p.error("--overlap-iters must be positive")
-    if args.n_global_mi is not None:
-        args.n_global = args.n_global_mi * 1024 * 1024
-    if args.n_global < 1:
-        p.error(f"global size must be positive, got {args.n_global}")
-    _common.setup_platform(args)
-    return _common.run_guarded(run, args)
+    return run_body(SPEC, args)
 
 
 if __name__ == "__main__":

@@ -43,7 +43,19 @@ into:
   ``drivers/serve.py`` / ``tpu_mpi_tests/serve/``): per workload class,
   offered vs achieved request rate, p50/p95/p99 latency, queue depth,
   and error/shed counts; the cross-window spread of the per-window
-  records doubles as the ``--diff`` noise band for the percentiles.
+  records doubles as the ``--diff`` noise band for the percentiles;
+* a ROUTE table (``kind: "route"`` records from the MoE routing
+  collective — ``comm/moe.py``): per routed op, token/capacity
+  accounting — occupancy %, overflow (dropped) %, per-expert imbalance
+  — with ``--diff`` gating overflow and imbalance lower-is-better
+  (README "Reading the ROUTE table");
+* DECODE rows (``kind: "decode"`` records from the decode-collective
+  pillar — ``workloads/decode.py``): µs/op latency per (collective,
+  batch×heads), gated lower-is-better by ``--diff`` — the
+  latency-bound regime where GB/s tables are blind;
+* WORKLOAD rows (``kind: "workload"`` records — the spec runner's
+  stable bench row, ``workloads/runner.py``): one headline metric per
+  workload spec, regression direction carried by the record itself.
 
 ``--diff A B`` compares two runs instead: two JSONL sets (per-phase /
 per-op / memory metrics) or two bench JSON files (``bench.py`` output or
@@ -178,6 +190,9 @@ def summarize(files: list[str]) -> dict:
     serve: dict[str, dict] = {}
     overlap: dict[str, dict] = {}
     bench_rows: dict[str, list] = {}
+    route: dict[str, dict] = {}
+    decode: dict[str, dict] = {}
+    workload: dict[str, dict] = {}
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -320,6 +335,47 @@ def summarize(files: list[str]) -> dict:
                     bench_rows.setdefault("heat:steps_per_s", []).append(
                         float(rec["steps_per_s"])
                     )
+            elif kind == "route":
+                rt = route.setdefault(
+                    rec.get("op", "?"),
+                    {"calls": 0, "tokens": 0, "routed": 0, "dropped": 0,
+                     "overflow": [], "occupancy": [], "imbalance": [],
+                     "capacity": None, "world": None, "combine": None},
+                )
+                rt["calls"] += 1
+                for k in ("tokens", "routed", "dropped"):
+                    rt[k] += int(rec.get(k) or 0)
+                for k, dst in (("overflow_pct", "overflow"),
+                               ("occupancy_pct", "occupancy"),
+                               ("imbalance", "imbalance")):
+                    if isinstance(rec.get(k), (int, float)):
+                        rt[dst].append(float(rec[k]))
+                for k in ("capacity", "world", "combine"):
+                    if rec.get(k) is not None:
+                        rt[k] = rec[k]
+            elif kind == "decode":
+                key = (f"{rec.get('collective', '?')}:"
+                       f"{rec.get('batch', '?')}x{rec.get('heads', '?')}")
+                d = decode.setdefault(
+                    key, {"us": [], "shard_bytes": None, "world": None},
+                )
+                if isinstance(rec.get("us_per_op"), (int, float)):
+                    d["us"].append(float(rec["us_per_op"]))
+                for k in ("shard_bytes", "world"):
+                    if rec.get(k) is not None:
+                        d[k] = rec[k]
+            elif kind == "workload":
+                key = (f"{rec.get('workload', '?')}:"
+                       f"{rec.get('metric', '?')}")
+                wl = workload.setdefault(
+                    key, {"vals": [], "unit": "", "higher_better": True},
+                )
+                if isinstance(rec.get("value"), (int, float)):
+                    wl["vals"].append(float(rec["value"]))
+                if rec.get("unit"):
+                    wl["unit"] = rec["unit"]
+                if rec.get("higher_better") is not None:
+                    wl["higher_better"] = bool(rec["higher_better"])
             elif kind == "serve":
                 sv = serve.setdefault(
                     rec.get("class", "?"),
@@ -364,6 +420,19 @@ def summarize(files: list[str]) -> dict:
         "compile": {},
         "vmem": {name: vmem[name] for name in sorted(vmem)},
         "serve": {cls: _serve_row(serve[cls]) for cls in sorted(serve)},
+        "route": {op: _route_row(route[op]) for op in sorted(route)},
+        "decode": {
+            key: {"us_per_op": sum(d["us"]) / len(d["us"]),
+                  "band": _noise_band(d["us"]), "n": len(d["us"]),
+                  "shard_bytes": d["shard_bytes"], "world": d["world"]}
+            for key, d in sorted(decode.items()) if d["us"]
+        },
+        "workload": {
+            key: {"value": sum(w["vals"]) / len(w["vals"]),
+                  "band": _noise_band(w["vals"]), "n": len(w["vals"]),
+                  "unit": w["unit"], "higher_better": w["higher_better"]}
+            for key, w in sorted(workload.items()) if w["vals"]
+        },
         "overlap": {op: _overlap_row(overlap[op])
                     for op in sorted(overlap)},
         "bench": {
@@ -439,6 +508,34 @@ def _overlap_row(ov: dict) -> dict:
         "rate": sum(rates) / len(rates) if rates else None,
         "rate_unit": ov["rate_unit"],
         "rate_band": _noise_band(rates),
+    }
+
+
+def _route_row(rt: dict) -> dict:
+    """One ROUTE-table row from a run's ``kind: "route"`` records:
+    token/drop counts summed across calls, the distribution metrics
+    (overflow %, occupancy %, imbalance) averaged with their
+    cross-record spread kept as the ``--diff`` noise band. A routing
+    change that raises overflow or imbalance beyond the run's own
+    variation is a regression — dropped tokens are lost quality, a hot
+    expert is the tail."""
+
+    def mean(vals):
+        return sum(vals) / len(vals) if vals else 0.0
+
+    return {
+        "calls": rt["calls"],
+        "world": rt["world"],
+        "capacity": rt["capacity"],
+        "combine": rt["combine"],
+        "tokens": rt["tokens"],
+        "routed": rt["routed"],
+        "dropped": rt["dropped"],
+        "overflow_pct": mean(rt["overflow"]),
+        "overflow_band": _noise_band(rt["overflow"]),
+        "occupancy_pct": mean(rt["occupancy"]),
+        "imbalance": mean(rt["imbalance"]),
+        "imbalance_band": _noise_band(rt["imbalance"]),
     }
 
 
@@ -594,6 +691,28 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
             f"windows={sv['windows']}"
         )
 
+    for op, rt in summary.get("route", {}).items():
+        print(
+            f"ROUTE {op}: calls={rt['calls']} world={rt['world']} "
+            f"capacity={rt['capacity']} tokens={rt['tokens']} "
+            f"routed={rt['routed']} dropped={rt['dropped']} "
+            f"overflow={rt['overflow_pct']:.2f}% "
+            f"occupancy={rt['occupancy_pct']:.1f}% "
+            f"imbalance={rt['imbalance']:.3f}"
+            + (f" combine={rt['combine']}" if rt.get("combine") else "")
+        )
+    for key, d in summary.get("decode", {}).items():
+        print(
+            f"DECODE {key}: us_per_op={d['us_per_op']:.4g} "
+            f"bytes={d['shard_bytes']} n={d['n']} "
+            f"band=±{d['band'] * 100:.2f}%"
+        )
+    for key, w in summary.get("workload", {}).items():
+        unit = f" {w['unit']}" if w["unit"] else ""
+        print(
+            f"WORKLOAD {key}: value={w['value']:.6g}{unit} n={w['n']} "
+            f"band=±{w['band'] * 100:.2f}%"
+        )
     for op, ov in summary.get("overlap", {}).items():
         rate = ""
         if ov.get("rate") is not None:
@@ -841,6 +960,35 @@ def _jsonl_metrics(files: list[str]) -> dict[str, dict]:
                 "band": ov.get("rate_band", 0.0),
                 "higher_better": True,
             }
+    # routing-quality series (ISSUE 8): overflow % is dropped tokens
+    # (lost quality under load) and imbalance is the hot-expert tail —
+    # both gate lower-is-better against the run's own cross-call spread
+    for op, rt in s.get("route", {}).items():
+        if isinstance(rt.get("overflow_pct"), (int, float)):
+            out[f"route:{op}:overflow_pct"] = {
+                "value": float(rt["overflow_pct"]),
+                "band": rt.get("overflow_band", 0.0),
+                "higher_better": False,
+            }
+        if isinstance(rt.get("imbalance"), (int, float)):
+            out[f"route:{op}:imbalance"] = {
+                "value": float(rt["imbalance"]),
+                "band": rt.get("imbalance_band", 0.0),
+                "higher_better": False,
+            }
+    # decode-latency rows: µs/op per (collective, batch×heads), lower
+    # better — the per-op fixed cost the GB/s tables are blind to
+    for key, d in s.get("decode", {}).items():
+        out[f"decode:{key}:us_per_op"] = {
+            "value": d["us_per_op"], "band": d["band"],
+            "higher_better": False,
+        }
+    # spec bench rows: regression direction recorded by the runner
+    for key, w in s.get("workload", {}).items():
+        out[f"workload:{key}"] = {
+            "value": w["value"], "band": w["band"],
+            "higher_better": w["higher_better"],
+        }
     for key, b in s.get("bench", {}).items():
         out[f"bench:{key}"] = {
             "value": b["value"], "band": b["band"],

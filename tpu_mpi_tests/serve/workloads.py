@@ -14,9 +14,13 @@ Spec grammar (CLI ``--workloads``, comma-separated entries)::
 ``shape`` is ``x``-separated dims (``256x64``); ``dtype``/``weight``
 default to float32 / 1. Omitted fields fall back to the per-workload
 defaults in :data:`DEFAULT_SHAPES`. The default table
-(:data:`DEFAULT_TABLE`) exercises all four registered handler families —
-daxpy step, stencil1d halo step, ring-attention block, small-payload
-allreduce — so the fingerprint space is genuinely mixed out of the box.
+(:data:`DEFAULT_TABLE`) exercises four handler families — daxpy step,
+stencil1d halo step, ring-attention block, small-payload allreduce —
+so the fingerprint space is genuinely mixed out of the box; the
+serving-era pillars (``moe`` token routing, ``decode`` collectives,
+``embedding`` lookup — registered automatically by their workload
+specs, ``tpu_mpi_tests/workloads/``) join a mix by naming them in the
+table (``moe:2048x64:float32:2``).
 
 The handlers themselves live with their drivers (the
 ``drivers/_common.py`` workload registry); this module is the pure
@@ -31,12 +35,17 @@ from dataclasses import dataclass
 #: the dtypes the driver layer accepts (mirrors ``base_parser --dtype``)
 VALID_DTYPES = ("float32", "float64", "bfloat16")
 
-#: per-workload default shapes (elements; attn is (L, head_dim))
+#: per-workload default shapes (elements; attn is (L, head_dim), moe is
+#: (tokens, d_model), decode is (batch, heads), embedding is
+#: (vocab, batch, d_model))
 DEFAULT_SHAPES = {
     "daxpy": (65536,),
     "halo": (65536,),
     "attn": (256, 64),
     "allreduce": (4096,),
+    "moe": (2048, 64),
+    "decode": (8, 16),
+    "embedding": (65536, 256, 64),
 }
 
 #: the out-of-the-box mix: all four handler families, small shapes, with

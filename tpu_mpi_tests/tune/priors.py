@@ -87,3 +87,14 @@ COLL_VARIANT = "xla"
 HALO_OVERLAP_DEPTH = 1
 RING_PIPELINE_DEPTH = 1
 COLL_DISPATCH_DEPTH = 1
+
+# Serving-era pillar priors (ISSUE 8). ``moe/combine``: the inverse
+# all_to_all mirrors the dispatch hop byte-for-byte — the symmetric
+# default; the allgather+select candidate moves world× the bytes but
+# collapses the second variable-occupancy hop. ``embedding/lookup``:
+# dynamic ``take`` is the general-case local gather; the one-hot matmul
+# candidate trades O(B·V_local) FLOPs for the MXU's streaming access
+# pattern and wins only on small vocab shards — which is exactly why
+# both knobs resolve with device_fallback=False (payload/shape keyed).
+MOE_COMBINE = "alltoall"
+EMBED_LOOKUP = "take"

@@ -85,7 +85,9 @@ def _import_knob_owners() -> None:
     module's import) so the registry itself stays importable without
     jax; the owners all import jax."""
     import tpu_mpi_tests.comm.collectives  # noqa: F401
+    import tpu_mpi_tests.comm.embedding  # noqa: F401
     import tpu_mpi_tests.comm.halo  # noqa: F401
+    import tpu_mpi_tests.comm.moe  # noqa: F401
     import tpu_mpi_tests.comm.ring  # noqa: F401
     import tpu_mpi_tests.drivers.collbench  # noqa: F401
 

@@ -1,0 +1,80 @@
+"""Declarative workload specs: a pillar in ~100 lines, not a driver copy.
+
+Every pillar added before this subsystem cost a 400–600-line driver that
+hand-rolled the same plumbing (arg parsing, platform setup, reporter,
+phase loop, tune wiring, serve registration, bench rows — attnbench is
+413 lines, ``drivers/_common.py`` 469). A workload spec is the part that
+is actually *about* the pillar:
+
+* name + CLI surface (``add_args``/``check_args`` on the shared
+  ``base_parser``);
+* ``build → step → verify`` hooks (mesh/sharding setup, the measured
+  body, the analytic gate);
+* a bytes model for the comm payload its spans claim;
+* the tune spaces it consumes (declared where the knob lives, PR-4
+  registry rules unchanged);
+* a stable bench metric (``kind: "workload"`` JSONL row).
+
+The generic runner (:mod:`~tpu_mpi_tests.workloads.runner`) supplies
+everything else — one flow shared by every spec, so a fix to the
+plumbing cannot miss a pillar. Registering a spec also registers its
+serve-mode handler (``drivers/_common.py`` workload registry), so a new
+pillar is a serving workload class, a tuned schedule consumer, and a
+``tpumt-report``/``--diff``-gated bench series the moment it exists.
+
+This module is stdlib-only at import (spec hooks import jax inside
+their bodies), like the tune registry it mirrors.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from tpu_mpi_tests.workloads.spec import WorkloadSpec
+
+_SPECS: dict[str, "WorkloadSpec"] = {}
+
+
+def register_spec(spec: "WorkloadSpec") -> "WorkloadSpec":
+    """Register a workload spec (idempotent per name — spec modules are
+    re-imported under test runners). Registration is what wires the
+    pillar into serve mode: a spec with a ``serve_factory`` lands in the
+    driver workload registry under ``spec.serve_name`` automatically."""
+    existing = _SPECS.get(spec.name)
+    if existing is not None:
+        return existing
+    _SPECS[spec.name] = spec
+    factory = spec.serve_factory
+    if factory is not None:
+        from tpu_mpi_tests.drivers import _common
+
+        _common.register_workload(spec.serve_name, factory)
+    return spec
+
+
+def load_specs() -> None:
+    """Import every spec module (their ``register_spec`` calls run now).
+    Lazy — like ``tune.registry._import_knob_owners`` — so the registry
+    stays importable without jax."""
+    import tpu_mpi_tests.workloads.daxpy  # noqa: F401
+    import tpu_mpi_tests.workloads.decode  # noqa: F401
+    import tpu_mpi_tests.workloads.embedding  # noqa: F401
+    import tpu_mpi_tests.workloads.moe  # noqa: F401
+    import tpu_mpi_tests.workloads.stencil1d  # noqa: F401
+
+
+def spec_names() -> tuple[str, ...]:
+    load_specs()
+    return tuple(sorted(_SPECS))
+
+
+def get_spec(name: str) -> "WorkloadSpec":
+    load_specs()
+    try:
+        return _SPECS[name]
+    except KeyError:
+        raise KeyError(
+            f"no workload spec {name!r}; registered: "
+            f"{','.join(sorted(_SPECS))}"
+        ) from None

@@ -1,0 +1,9 @@
+"""``python -m tpu_mpi_tests.workloads <spec> [args...]`` — the
+umbrella workload CLI (also installed as ``tpumt-workload``)."""
+
+import sys
+
+from tpu_mpi_tests.workloads.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,260 @@
+"""Decode-step collectives: tiny latency-bound allreduce/allgather.
+
+The second serving-era pillar (ROADMAP item 4): autoregressive decode
+runs one collective per layer per *token*, at batch×head payloads of a
+few KB — the regime where the per-op fixed cost the bf16-stripe verdict
+exposed dominates and GB/s is the wrong axis entirely. This spec sweeps
+the decode collectives over batch sizes at a fixed head count and
+reports **µs/op latency rows** (device-chained ``fori_loop`` timing via
+``chain_rate``, the same compiled programs collbench's COLL rows
+measure), each a ``kind: "decode"`` record that ``tpumt-report``
+renders and ``--diff`` gates lower-is-better — a schedule change that
+adds microseconds to the decode path trips the gate even though the
+bandwidth tables would never notice.
+
+Output per (collective, batch)::
+
+    DECODE <coll> batch=<b> heads=<h> bytes=<per-shard> <us> us/op  n=<iters>
+    WORKLOAD decode: allreduce_us_per_op=<v> us
+
+Verification: the same ``lax`` collectives the rows time are checked
+exactly against host references (sum for allreduce, concatenation for
+allgather) on integer-valued data.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_mpi_tests.workloads import register_spec
+from tpu_mpi_tests.workloads.spec import RunContext, WorkloadSpec
+
+#: the decode sweep's collectives: the tensor-parallel pair a decode
+#: step actually issues (row-parallel matmul → allreduce; KV/head
+#: assembly → allgather)
+DECODE_COLLS = ("allreduce", "allgather")
+
+# the DECODE line's parse pattern lives NEXT TO its format string so a
+# format change is a one-site edit (the collbench COLL_LINE_RE idiom)
+DECODE_LINE_RE = (
+    r"DECODE (\w+) batch=(\d+) heads=(\d+) bytes=(\d+) "
+    r"([\d.e+-]+|nan) us/op  n=(\d+)"
+)
+
+
+class DecodeSpec(WorkloadSpec):
+    name = "decode"
+    title = __doc__
+
+    def add_args(self, p) -> None:
+        p.add_argument(
+            "--batches", default="1,8,32",
+            help="comma list of decode batch sizes to sweep (default "
+            "1,8,32 — the single-stream / small-batch / saturated "
+            "decode regimes)",
+        )
+        p.add_argument(
+            "--heads", type=int, default=16,
+            help="attention heads per token step (payload elements per "
+            "shard = batch x heads; default 16)",
+        )
+        p.add_argument(
+            "--colls", default=",".join(DECODE_COLLS),
+            help=f"comma list of collectives ({'/'.join(DECODE_COLLS)})",
+        )
+        p.add_argument(
+            "--n-iter", type=int, default=2000,
+            help="chained device-side iterations per measurement "
+            "(default 2000; tiny ops need a long chain to clear "
+            "host-timer noise)",
+        )
+
+    def check_args(self, p, args) -> None:
+        if args.heads < 1:
+            p.error(f"--heads must be positive, got {args.heads}")
+        if args.n_iter < 10:
+            p.error("--n-iter must be >= 10")
+        try:
+            batches = [int(b) for b in args.batches.split(",") if b]
+        except ValueError:
+            p.error(f"--batches must be a comma list of ints, got "
+                    f"{args.batches!r}")
+        if not batches or any(b < 1 for b in batches):
+            p.error(f"--batches entries must be positive, got "
+                    f"{args.batches!r}")
+
+    def build(self, ctx: RunContext):
+        from tpu_mpi_tests.drivers import _common
+        from tpu_mpi_tests.workloads.spec import SpecError
+
+        names = _common.parse_choice_list(
+            ctx.args.colls, DECODE_COLLS, "decode collective"
+        )
+        if names is None:
+            raise SpecError(2)  # parse_choice_list printed the ERROR
+        batches = [int(b) for b in ctx.args.batches.split(",") if b]
+        ctx.rep.banner(
+            f"decode: world={ctx.world} batches={ctx.args.batches} "
+            f"heads={ctx.args.heads} colls={','.join(names)} "
+            f"n_iter={ctx.args.n_iter} dtype={ctx.args.dtype}"
+        )
+        return {"colls": names, "batches": batches, "rows": []}
+
+    def step(self, ctx: RunContext, state):
+        import jax.numpy as jnp
+
+        from tpu_mpi_tests.comm.collectives import shard_1d
+        from tpu_mpi_tests.drivers.collbench import _loop_fn
+        from tpu_mpi_tests.instrument import costs
+        from tpu_mpi_tests.instrument.timers import chain_rate
+
+        args, mesh, world = ctx.args, ctx.mesh, ctx.world
+        axis_name = ctx.axis_name
+        dtype = ctx.dtype()
+        itemsize = jnp.dtype(dtype).itemsize
+        with ctx.phase("decode_sweep"):
+            for coll in state["colls"]:
+                run_fn = _loop_fn(mesh, axis_name, coll, world)
+                for batch in state["batches"]:
+                    n = batch * args.heads  # elements per shard
+                    shard_bytes = n * itemsize
+                    x = shard_1d(jnp.ones((n * world,), dtype), mesh,
+                                 axis_name)
+                    costs.compile_probe(
+                        run_fn, (x, 1), label=f"decode_{coll}",
+                        dtype=args.dtype, bytes=shard_bytes, world=world,
+                    )
+                    sec, x = chain_rate(
+                        run_fn, x,
+                        n_short=args.n_iter // 10 or 1,
+                        n_long=args.n_iter,
+                    )
+                    us = sec * 1e6
+                    row = {
+                        "kind": "decode", "collective": coll,
+                        "batch": batch, "heads": args.heads,
+                        "shard_bytes": shard_bytes, "us_per_op": us,
+                        "world": world, "dtype": args.dtype,
+                        "n_iter": args.n_iter,
+                    }
+                    state["rows"].append(row)
+                    ctx.rep.line(
+                        f"DECODE {coll} batch={batch} "
+                        f"heads={args.heads} bytes={shard_bytes} "
+                        f"{us:0.3f} us/op  n={args.n_iter}",
+                        row,
+                    )
+                    del x
+        return state
+
+    def verify(self, ctx: RunContext, state) -> int:
+        """Exact host-reference check of the collectives the rows time:
+        per-rank rows of small integers — allreduce must return the
+        elementwise sum on every rank, allgather the concatenation."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from tpu_mpi_tests.comm import collectives as C
+
+        world, mesh = ctx.world, ctx.mesh
+        dtype = ctx.dtype()
+        L = max(int(ctx.args.heads), 4)
+        rows = np.arange(world * L, dtype=np.float64).reshape(world, L) % 7
+        per_rank = C.shard_1d(jnp.asarray(rows, dtype), mesh)
+        # allreduce output stays sharded: gather before the host read
+        # so a multi-process run can verify too
+        got_ar = np.asarray(
+            C.host_value(
+                C.all_gather(C.allreduce_sum(per_rank + 0, mesh), mesh)
+            ),
+            np.float64,
+        )
+        want = np.broadcast_to(rows.sum(axis=0), (world, L))
+        if not np.array_equal(got_ar, want):
+            ctx.rep.line("DECODE FAIL: allreduce mismatch vs host sum")
+            return 1
+        flat = C.shard_1d(jnp.asarray(rows.reshape(-1), dtype), mesh)
+        got_ag = np.asarray(
+            C.host_value(C.all_gather(flat, mesh)), np.float64
+        )
+        if not np.array_equal(got_ag, rows.reshape(-1)):
+            ctx.rep.line("DECODE FAIL: allgather mismatch vs host "
+                         "concatenation")
+            return 1
+        return 0
+
+    def bytes_model(self, ctx: RunContext, state) -> int:
+        # the smallest-row payload (the headline latency row's bytes)
+        import jax.numpy as jnp
+
+        item = jnp.dtype(ctx.dtype()).itemsize
+        return min(state["batches"]) * ctx.args.heads * item
+
+    def bench(self, ctx: RunContext, state) -> dict | None:
+        """Headline row: the smallest-batch allreduce latency — the
+        single-stream decode step cost (the per-size rows each gate
+        individually through their ``kind: "decode"`` records)."""
+        ar = [r for r in state["rows"] if r["collective"] == "allreduce"]
+        rows = ar or state["rows"]
+        if not rows:
+            return None
+        head = min(rows, key=lambda r: r["batch"])
+        return {
+            "metric": f"{head['collective']}_us_per_op",
+            "value": head["us_per_op"],
+            "unit": "us",
+            "higher_better": False,
+            "batch": head["batch"],
+            "heads": head["heads"],
+            "nbytes": head["shard_bytes"],
+        }
+
+    def serve_factory(self, mesh, shape, dtype):
+        """Serve-mode handler: ``step_fn(n)`` runs ``n`` device-chained
+        decode-step allreduces at the class's (batch, heads) shape —
+        the latency-bound class mixed traffic stresses. Reuses the
+        benchmark's own chained program (collbench ``_loop_fn``), which
+        donates: a failed batch rebuilds the buffer so one transient
+        error cannot poison the class (the collbench handler's rule)."""
+        import jax.numpy as jnp
+
+        from tpu_mpi_tests.comm.collectives import shard_1d
+        from tpu_mpi_tests.drivers.collbench import _loop_fn
+        from tpu_mpi_tests.instrument.timers import block
+
+        if len(shape) != 2:
+            raise ValueError(f"decode wants (batch, heads), got {shape}")
+        batch, heads = shape
+        n = batch * heads
+        world = mesh.devices.size
+        axis_name = mesh.axis_names[0]
+        dt = jnp.dtype(dtype)
+        run_fn = _loop_fn(mesh, axis_name, "allreduce", world)
+
+        def init():
+            return shard_1d(jnp.ones((n * world,), dt), mesh, axis_name)
+
+        state = {"x": init()}
+
+        def step(k: int):
+            try:
+                state["x"] = block(run_fn(state["x"], k))
+            except Exception:
+                state["x"] = init()
+                raise
+
+        step(1)  # compile + warm before traffic opens
+        return step
+
+
+SPEC = register_spec(DecodeSpec())
+
+
+def main(argv=None) -> int:
+    from tpu_mpi_tests.workloads.runner import make_main
+
+    return make_main(SPEC)(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,325 @@
+"""Embedding gather/scatter pillar: sharded table lookup under load.
+
+The ``gather_inplace`` pillar generalized (ROADMAP item 4): a
+``(vocab, d_model)`` table row-sharded across the mesh, batches of ids
+resolved to dense rows through the psum-of-partials lookup and pushed
+back through the allgather scatter-add (``comm/embedding.py``). The
+local-gather schedule (``embedding/lookup``: dynamic ``take`` vs
+one-hot matmul) is fingerprint-tuned — ``--lookup auto`` resolves the
+cached winner, ``--tune`` prices both on this exact table first — and
+both directions are verified exactly against the dense host reference
+(lookups are copies; scatter sums integer-valued rows).
+
+Output lines::
+
+    EMBED lookup: variant=<v> us_per_op=<t>
+    EMBED scatter: us_per_op=<t>
+    WORKLOAD embedding: lookup_us_per_op=<t> us
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_mpi_tests.workloads import register_spec
+from tpu_mpi_tests.workloads.spec import RunContext, WorkloadSpec
+
+
+def _build_table(seed: int, vocab: int, d_model: int, batch: int):
+    """Deterministic integer-valued table/ids/updates on host — exact
+    verification in every dtype (lookups copy, scatter sums small
+    ints)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    table = rng.integers(-4, 5, size=(vocab, d_model)).astype(np.float64)
+    ids = rng.integers(0, vocab, size=(batch,)).astype(np.int32)
+    updates = rng.integers(-3, 4, size=(batch, d_model)).astype(np.float64)
+    return table, ids, updates
+
+
+class EmbeddingSpec(WorkloadSpec):
+    name = "embedding"
+    title = __doc__
+
+    def add_args(self, p) -> None:
+        p.add_argument(
+            "--vocab", type=int, default=65536,
+            help="table rows (sharded over the mesh axis; must divide "
+            "by the device count)",
+        )
+        p.add_argument(
+            "--d-model", type=int, default=64,
+            help="row width (default 64)",
+        )
+        p.add_argument(
+            "--batch", type=int, default=256,
+            help="ids per lookup/scatter (must divide by the device "
+            "count for the scatter direction)",
+        )
+        p.add_argument(
+            "--iters", type=int, default=32,
+            help="timed lookups and scatters (default 32)",
+        )
+        p.add_argument(
+            "--lookup", default="auto",
+            choices=["auto", "take", "onehot"],
+            help="local-gather schedule: 'auto' resolves the "
+            "embedding/lookup knob (cached winner > prior 'take'; with "
+            "--tune a miss prices both on this table first)",
+        )
+        p.add_argument(
+            "--seed", type=int, default=0,
+            help="table/id RNG seed (default 0)",
+        )
+
+    def check_args(self, p, args) -> None:
+        for flag, val in (("--vocab", args.vocab),
+                          ("--d-model", args.d_model),
+                          ("--batch", args.batch),
+                          ("--iters", args.iters)):
+            if val < 1:
+                p.error(f"{flag} must be positive, got {val}")
+
+    def build(self, ctx: RunContext):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_mpi_tests.utils import check_divisible
+
+        args, mesh, world = ctx.args, ctx.mesh, ctx.world
+        check_divisible(args.vocab, world, "embedding rows over mesh axis")
+        check_divisible(args.batch, world, "embedding batch over mesh axis")
+        dtype = ctx.dtype()
+        t_host, ids_host, upd_host = _build_table(
+            args.seed, args.vocab, args.d_model, args.batch
+        )
+        axis = ctx.axis_name
+        table = jax.device_put(
+            jnp.asarray(t_host, dtype), NamedSharding(mesh, P(axis, None))
+        )
+        ids_rep = jax.device_put(
+            jnp.asarray(ids_host), NamedSharding(mesh, P())
+        )
+        ids_sh = jax.device_put(
+            jnp.asarray(ids_host), NamedSharding(mesh, P(axis))
+        )
+        upd_sh = jax.device_put(
+            jnp.asarray(upd_host, dtype),
+            NamedSharding(mesh, P(axis, None)),
+        )
+        variant = None if args.lookup == "auto" else args.lookup
+        if variant is None and args.tune:
+            variant = self._tune_lookup(ctx, table, ids_rep)
+        ctx.rep.banner(
+            f"embedding: vocab={args.vocab} d_model={args.d_model} "
+            f"batch={args.batch} world={world} dtype={args.dtype} "
+            f"lookup={variant or 'auto'}"
+        )
+        return {
+            "table": table, "ids_rep": ids_rep, "ids_sh": ids_sh,
+            "upd_sh": upd_sh, "t_host": t_host, "ids_host": ids_host,
+            "upd_host": upd_host, "variant": variant,
+        }
+
+    def _tune_lookup(self, ctx: RunContext, table, ids_rep):
+        """--tune + --lookup auto: price both local-gather schedules on
+        this table (sync-honest short chains), persist the winner."""
+        import time
+
+        from tpu_mpi_tests.comm import embedding as E
+        from tpu_mpi_tests.instrument.timers import block
+        from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+        def measure(cand):
+            block(E.embedding_lookup(table, ids_rep, ctx.mesh,
+                                     variant=cand))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(4):
+                out = E.embedding_lookup(table, ids_rep, ctx.mesh,
+                                         variant=cand)
+            block(out)
+            return time.perf_counter() - t0
+
+        return ensure_tuned(
+            "embedding/lookup", measure, device_fallback=False,
+            dtype=ctx.args.dtype, n=ctx.args.vocab,
+            bytes=ctx.args.batch, world=ctx.world,
+        )
+
+    def step(self, ctx: RunContext, state):
+        import time
+
+        from tpu_mpi_tests.comm import embedding as E
+        from tpu_mpi_tests.comm.embedding import resolve_lookup
+        from tpu_mpi_tests.instrument.timers import block
+
+        args = ctx.args
+        table, ids_rep = state["table"], state["ids_rep"]
+        variant = resolve_lookup(
+            state["variant"], dtype=args.dtype, n=args.vocab,
+            bytes=args.batch, world=ctx.world,
+        )
+        state["variant"] = variant
+        # lookup: warmup, then the timed chain
+        out = E.embedding_lookup(table, ids_rep, ctx.mesh,
+                                 variant=variant)
+        block(out)
+        with ctx.phase("lookup"):
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                out = E.embedding_lookup(table, ids_rep, ctx.mesh,
+                                         variant=variant)
+            block(out)
+            lookup_s = time.perf_counter() - t0
+        state["lookup_out"] = out
+        state["lookup_us"] = lookup_s / args.iters * 1e6
+        if ctx.topo.process_index == 0:
+            ctx.rep.line(
+                f"EMBED lookup: variant={variant} "
+                f"us_per_op={state['lookup_us']:0.3f}",
+                {"kind": "embed", "dir": "lookup", "variant": variant,
+                 "us_per_op": state["lookup_us"], "vocab": args.vocab,
+                 "d_model": args.d_model, "batch": args.batch,
+                 "world": ctx.world, "dtype": args.dtype},
+            )
+        # scatter-add: donates the table — chain through the donated
+        # result; warmup scatters into a throwaway copy so the timed
+        # chain starts from the reference state
+        warm = E.embedding_scatter_add(
+            table + 0, state["ids_sh"], state["upd_sh"], ctx.mesh
+        )
+        block(warm)
+        del warm
+        tab = table  # the build-time buffer is consumed by the chain
+        with ctx.phase("scatter"):
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                tab = E.embedding_scatter_add(
+                    tab, state["ids_sh"], state["upd_sh"], ctx.mesh
+                )
+            block(tab)
+            scatter_s = time.perf_counter() - t0
+        state["table_out"] = tab
+        state["scatter_us"] = scatter_s / args.iters * 1e6
+        if ctx.topo.process_index == 0:
+            ctx.rep.line(
+                f"EMBED scatter: us_per_op={state['scatter_us']:0.3f}",
+                {"kind": "embed", "dir": "scatter",
+                 "us_per_op": state["scatter_us"], "vocab": args.vocab,
+                 "d_model": args.d_model, "batch": args.batch,
+                 "world": ctx.world, "dtype": args.dtype},
+            )
+        return state
+
+    def verify(self, ctx: RunContext, state) -> int:
+        import numpy as np
+
+        from tpu_mpi_tests.comm.collectives import all_gather, host_value
+
+        t_host, ids, upd = (state["t_host"], state["ids_host"],
+                            state["upd_host"])
+        # lookup_out is replicated (psum), table_out row-sharded: fetch
+        # through host_value (gathering first where sharded) so a
+        # multi-process run can read them
+        got = np.asarray(host_value(state["lookup_out"]), np.float64)
+        want = t_host[ids]
+        if not np.array_equal(got, want):
+            bad = np.flatnonzero((got != want).any(axis=1))
+            ctx.rep.line(
+                f"EMBED FAIL lookup: {bad.size}/{len(ids)} rows "
+                f"mismatch the dense reference, first at [{int(bad[0])}]"
+            )
+            return 1
+        # iters scatter-adds of the same (ids, updates) accumulate
+        # linearly — duplicates included (np.add.at semantics)
+        ref = t_host.copy()
+        np.add.at(ref, ids, upd * ctx.args.iters)
+        got_t = np.asarray(
+            host_value(all_gather(state["table_out"], ctx.mesh,
+                                  ctx.axis_name)),
+            np.float64,
+        )
+        if not np.array_equal(got_t, ref):
+            bad = np.flatnonzero((got_t != ref).any(axis=1))
+            ctx.rep.line(
+                f"EMBED FAIL scatter: {bad.size}/{ref.shape[0]} table "
+                f"rows mismatch the dense reference, first at "
+                f"[{int(bad[0])}]"
+            )
+            return 1
+        return 0
+
+    def bytes_model(self, ctx: RunContext, state) -> int:
+        import jax.numpy as jnp
+
+        item = jnp.dtype(ctx.dtype()).itemsize
+        row = ctx.args.batch * ctx.args.d_model * item
+        return 2 * (ctx.world - 1) * row  # the lookup psum model
+
+    def bench(self, ctx: RunContext, state) -> dict:
+        return {
+            "metric": "lookup_us_per_op",
+            "value": state["lookup_us"],
+            "unit": "us",
+            "higher_better": False,
+            "variant": state["variant"],
+            "scatter_us_per_op": state["scatter_us"],
+            "vocab": ctx.args.vocab,
+            "batch": ctx.args.batch,
+            "nbytes": self.bytes_model(ctx, state),
+        }
+
+    def serve_factory(self, mesh, shape, dtype):
+        """Serve-mode handler: ``step_fn(n)`` resolves ``n`` lookup
+        batches against a persistent sharded table (shape = ``(vocab,
+        batch, d_model)``). Lookups do not donate, so failed batches
+        need no rebuild; the variant resolves through the tune cache
+        like any schedule (the serve preload warms it)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_mpi_tests.comm import embedding as E
+        from tpu_mpi_tests.instrument.timers import block
+        from tpu_mpi_tests.utils import check_divisible
+
+        if len(shape) != 3:
+            raise ValueError(
+                f"embedding wants (vocab, batch, d_model), got {shape}"
+            )
+        vocab, batch, d_model = shape
+        world = mesh.devices.size
+        axis_name = mesh.axis_names[0]
+        check_divisible(vocab, world, "embedding rows over mesh axis")
+        t_host, ids_host, _ = _build_table(0, vocab, d_model, batch)
+        table = jax.device_put(
+            jnp.asarray(t_host, jnp.dtype(dtype)),
+            NamedSharding(mesh, P(axis_name, None)),
+        )
+        ids = jax.device_put(
+            jnp.asarray(ids_host), NamedSharding(mesh, P())
+        )
+
+        def step(k: int):
+            out = None
+            for _ in range(k):
+                out = E.embedding_lookup(table, ids, mesh)
+            block(out)
+
+        step(1)  # compile + warm before traffic opens
+        return step
+
+
+SPEC = register_spec(EmbeddingSpec())
+
+
+def main(argv=None) -> int:
+    from tpu_mpi_tests.workloads.runner import make_main
+
+    return make_main(SPEC)(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""The generic workload runner: one flow for every spec.
+
+Everything the hand-written drivers each re-implemented happens here
+exactly once:
+
+* arg parsing through the shared ``base_parser`` (``--fake-devices``,
+  ``--dtype``, ``--jsonl``, ``--telemetry``, ``--memwatch``, ``--tune``
+  and friends all work for every spec by construction);
+* platform/dtype/tune-cache setup + the hang watchdog
+  (``run_guarded``);
+* ``bootstrap → topology → make_mesh`` and the full observability
+  wiring via ``make_reporter`` (manifest, clock sync, telemetry sink,
+  memwatch, tune-record sink, ``--trace-out`` merge);
+* the ``build → step → verify`` hook sequence under a ProfilerGate,
+  with a shared PhaseTimer the spec brackets via ``ctx.phase``;
+* the stable bench row: a spec returning ``bench(...)`` gets a
+  ``WORKLOAD <name>: <metric>=<value> <unit>`` line plus a
+  ``kind: "workload"`` JSONL record — rendered by ``tpumt-report`` and
+  gated by ``--diff`` with no per-spec aggregation code.
+
+``main(argv)`` is the umbrella CLI (``python -m
+tpu_mpi_tests.workloads <name> ...`` / ``tpumt-workload``); each spec
+module also exposes its own ``make_main``-built entry point so
+``python -m tpu_mpi_tests.workloads.moe`` behaves like any driver.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from tpu_mpi_tests.drivers import _common
+from tpu_mpi_tests.workloads.spec import (
+    RunContext,
+    SpecError,
+    WorkloadSpec,
+)
+
+
+def run_body(spec: WorkloadSpec, args) -> int:
+    """The guarded driver body: reporter + hook sequence + bench row."""
+    from tpu_mpi_tests.comm.mesh import bootstrap, make_mesh, topology
+    from tpu_mpi_tests.instrument import PhaseTimer, ProfilerGate
+
+    bootstrap()
+    topo = topology()
+    mesh = None
+    rank, size = 0, 1
+    if spec.needs_mesh:
+        mesh = make_mesh()
+        rank, size = topo.process_index, topo.global_device_count
+    rep = _common.make_reporter(args, rank=rank, size=size)
+    with rep:
+        ctx = RunContext(
+            spec=spec, args=args, rep=rep, topo=topo, mesh=mesh,
+            timer=PhaseTimer(),
+        )
+        try:
+            with ProfilerGate(args.profile_dir):
+                state = spec.build(ctx)
+                state = spec.step(ctx, state)
+            rc = int(spec.verify(ctx, state) or 0)
+        except SpecError as e:
+            return e.rc  # the hook printed its ERROR line already
+        # no bench row on a failed verify: a correctness-broken run
+        # must not seed the --diff-gated metric series with a
+        # valid-looking headline number
+        if rc == 0:
+            row = spec.bench(ctx, state)
+            if row:
+                _emit_bench_row(ctx, row)
+        return rc
+
+
+def _emit_bench_row(ctx: RunContext, row: dict) -> None:
+    """One stable bench line + ``kind: "workload"`` record per run.
+    The record carries ``higher_better`` so the ``--diff`` gate knows
+    the regression direction without a hard-coded metric table."""
+    metric = row["metric"]
+    value = float(row["value"])
+    unit = row.get("unit", "")
+    rec = {
+        "kind": "workload",
+        "workload": ctx.spec.name,
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "higher_better": bool(row.get("higher_better", True)),
+        "dtype": ctx.args.dtype,
+        "world": ctx.world,
+    }
+    for k, v in row.items():
+        if k not in ("metric", "value", "unit", "higher_better"):
+            rec[k] = v
+    ctx.rep.line(
+        f"WORKLOAD {ctx.spec.name}: {metric}={value:.6g}"
+        f"{' ' + unit if unit else ''}",
+        rec,
+    )
+
+
+def make_main(spec: WorkloadSpec):
+    """Build a driver-shaped ``main(argv) -> int`` for one spec."""
+
+    def main(argv=None) -> int:
+        p = _common.base_parser(spec.title or spec.name)
+        spec.add_args(p)
+        args = p.parse_args(argv)
+        spec.check_args(p, args)
+        _common.setup_platform(args)
+        return _common.run_guarded(functools.partial(run_body, spec), args)
+
+    main.__doc__ = spec.title
+    return main
+
+
+def main(argv=None) -> int:
+    """Umbrella CLI: ``tpumt-workload <spec> [spec args...]`` (or
+    ``--list``). The spec name routes to its own ``make_main`` parser,
+    so ``tpumt-workload moe --help`` shows the moe surface."""
+    import sys
+
+    from tpu_mpi_tests import workloads
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("--list", "-l"):
+        for name in workloads.spec_names():
+            print(name)
+        return 0
+    if argv[0] in ("--help", "-h"):
+        print("usage: tpumt-workload <spec> [args...] | --list")
+        print("specs:", ", ".join(workloads.spec_names()))
+        return 0
+    name, rest = argv[0], argv[1:]
+    try:
+        spec = workloads.get_spec(name)
+    except KeyError as e:
+        print(f"ERROR {e.args[0]}", file=sys.stderr)
+        return 2
+    return make_main(spec)(rest)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""The workload-spec contract and the per-run context it executes in.
+
+A :class:`WorkloadSpec` is the declarative unit the generic runner
+(:mod:`~tpu_mpi_tests.workloads.runner`) drives: hooks for the
+pillar-specific parts, attributes for the wiring decisions the runner
+makes on its behalf. The contract mirrors the drivers it replaces —
+``build`` is mesh/sharding/state setup, ``step`` is the measured body
+(it prints the pillar's measured lines and owns its phase timing via
+``ctx.phase``), ``verify`` is the analytic gate, ``bench`` the stable
+row — so porting a driver is moving code, not rewriting it (gated by
+the byte-identical daxpy/stencil1d ports in ``tests/test_workloads.py``).
+
+Spec modules must stay importable without jax (the serve registry and
+``tpumt-report`` import them on login nodes); hooks import jax inside
+their bodies like every driver ``run`` does.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from contextlib import contextmanager
+from typing import Any
+
+
+class SpecError(Exception):
+    """Raised by a spec hook for a user-input/configuration error the
+    runner should turn into a clean nonzero exit — the hook prints its
+    own ERROR line first (the driver convention: no tracebacks for bad
+    flags)."""
+
+    def __init__(self, rc: int = 2):
+        super().__init__(rc)
+        self.rc = rc
+
+
+@dataclasses.dataclass
+class RunContext:
+    """Everything a spec hook may need, built once per run by the
+    runner: parsed args, the Reporter (JSONL + stdout lines), the mesh
+    and topology (None / trivial for ``needs_mesh=False`` specs), and a
+    shared PhaseTimer whose lines/records the spec decides to emit."""
+
+    spec: "WorkloadSpec"
+    args: Any
+    rep: Any
+    topo: Any
+    mesh: Any
+    timer: Any
+
+    @property
+    def world(self) -> int:
+        return self.topo.global_device_count if self.topo else 1
+
+    @property
+    def axis_name(self) -> str:
+        return self.mesh.axis_names[0]
+
+    def dtype(self):
+        """The run's jnp dtype (imports jax — hook-body use only)."""
+        from tpu_mpi_tests.drivers import _common
+
+        return _common.jnp_dtype(self.args)
+
+    @contextmanager
+    def phase(self, name: str):
+        """One timed phase: an XProf trace range + a PhaseTimer phase
+        (sync-honest — the timer blocks at the boundary), the same
+        bracketing every driver hand-rolls."""
+        from tpu_mpi_tests.instrument.trace import trace_range
+
+        with trace_range(name), self.timer.phase(name):
+            yield
+
+
+class WorkloadSpec:
+    """Base class: override the hooks; attributes steer the runner.
+
+    ``name`` is the spec/driver identity (``python -m
+    tpu_mpi_tests.workloads.<name>``, the WORKLOAD row key);
+    ``serve_name`` (default: ``name``) is the serve-mode workload-class
+    name — distinct where a driver historically registered under
+    another name (stencil1d serves as ``halo``). ``needs_mesh=False``
+    specs run single-device with a rank-0/size-1 reporter (the daxpy
+    shape); everything else gets ``bootstrap → topology → make_mesh``.
+    """
+
+    name: str = "?"
+    title: str = ""
+    needs_mesh: bool = True
+
+    # -- CLI -------------------------------------------------------------
+    def add_args(self, p) -> None:
+        """Spec-specific flags on top of the shared ``base_parser``."""
+
+    def check_args(self, p, args) -> None:
+        """Validate; call ``p.error(...)`` on bad values (exit 2)."""
+
+    # -- the run ---------------------------------------------------------
+    def build(self, ctx: RunContext):
+        """Initialize state (device buffers, resolved schedules).
+        Returns the state object threaded through ``step``/``verify``."""
+        raise NotImplementedError
+
+    def step(self, ctx: RunContext, state):
+        """The measured body: warmup + timed phases + the pillar's
+        measured stdout lines/records. Returns the (possibly updated)
+        state. Must end device-synced (``block``/``chain_rate``/span) —
+        the repo's sync-honesty discipline is the spec's obligation."""
+        raise NotImplementedError
+
+    def verify(self, ctx: RunContext, state) -> int:
+        """Analytic verification gate: print FAIL lines and return a
+        nonzero rc on mismatch, 0 on pass."""
+        raise NotImplementedError
+
+    # -- models / rows ---------------------------------------------------
+    def bytes_model(self, ctx: RunContext, state) -> int | None:
+        """Nominal comm payload bytes of one step — the span/bench
+        annotation, not a bandwidth claim. None when the comm wrappers
+        the spec calls already annotate their own spans (the ported
+        pillars) — the model then lives next to the collective."""
+        return None
+
+    def bench(self, ctx: RunContext, state) -> dict | None:
+        """The stable bench row: ``{"metric", "value", "unit",
+        "higher_better", ...extras}`` or None for no row (the ported
+        drivers keep their historical lines instead). The runner prints
+        it as ``WORKLOAD <name>: <metric>=<value> <unit>`` and emits a
+        ``kind: "workload"`` record that ``tpumt-report`` renders and
+        ``--diff`` gates."""
+        return None
+
+    # -- serve mode ------------------------------------------------------
+    @property
+    def serve_name(self) -> str:
+        return self.name
+
+    #: ``(mesh, shape, dtype) -> step_fn(n)`` or None; registered into
+    #: the drivers/_common.py workload registry by ``register_spec``
+    serve_factory = None
