@@ -1,7 +1,7 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke overlap-smoke moe-smoke lint ci clean
+	serve-smoke overlap-smoke moe-smoke chaos-smoke lint ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -286,6 +286,88 @@ moe-smoke:
 		/tmp/_tpumt_moe_smoke.diff.txt
 	@echo "moe-smoke OK: route + decode rows + ROUTE table + diff gate"
 
+# chaos-verified diagnosis smoke (README "Chaos & diagnosis"): inject
+# every fault class — kill, straggler, wedge, OOM ramp, serve flood —
+# and assert tpumt-doctor convicts the right CLASS and the right RANK
+# from the organic telemetry alone (--expect = exactly-one-finding
+# contract), while a clean run yields zero findings. Multi-rank legs
+# run real separate processes under the native launcher with a
+# local-compute workload (this image's CPU backend has no
+# cross-process collectives — the multiproc test family documents
+# that); the kill leg's survivor exits via os._exit to skip the
+# dead-peer distributed-shutdown barrier (~100 s heartbeat timeout).
+# The disarmed-identity half of the acceptance contract (a run without
+# chaos armed is byte-identical to a build without the chaos layer)
+# is pinned by tests/test_chaos.py.
+chaos-smoke:
+	rm -f /tmp/_tpumt_chaos*
+	$(MAKE) -C native tpumt_run
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.stencil1d \
+		--fake-devices 2 --n-global 65536 --telemetry --memwatch \
+		--mem-interval 0.05 --jsonl /tmp/_tpumt_chaos.clean.jsonl
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.clean.jsonl | grep -q '^DOCTOR OK'
+	python -c "import json; \
+		ks = [json.loads(l).get('kind') for l in \
+			open('/tmp/_tpumt_chaos.clean.jsonl')]; \
+		assert 'chaos' not in ks, 'disarmed run must emit no chaos records'"
+	env JAX_PLATFORMS=cpu \
+		TPU_MPI_CHAOS="wedge:op=halo_exchange:after=3:stall_s=60" \
+		python -m tpu_mpi_tests.drivers.stencil1d --fake-devices 2 \
+		--n-global 65536 --overlap 1 --overlap-iters 12 --telemetry \
+		--deadline 6 --jsonl /tmp/_tpumt_chaos.wedge.jsonl; \
+		test $$? -eq 9
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.wedge.jsonl --expect wedge:0
+	env JAX_PLATFORMS=cpu \
+		TPU_MPI_CHAOS="oom:step_mb=8:limit_mb=48:frac=0.8" \
+		python -m tpu_mpi_tests.drivers.daxpy --fake-devices 2 \
+		--n 1048576 --iters 20 --telemetry --memwatch \
+		--mem-interval 0.05 \
+		--jsonl /tmp/_tpumt_chaos.oom.jsonl; test $$? -eq 134
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.oom.jsonl --expect oom:0
+	env JAX_PLATFORMS=cpu \
+		TPU_MPI_CHAOS="kill:rank=1:phase=kernel:after=10" \
+		./native/tpumt_run -n 2 -o /tmp/_tpumt_chaos.kill.rank -- \
+		python -c "import sys, os; \
+			from tpu_mpi_tests.workloads.daxpy import main; \
+			rc = main(sys.argv[1:]); \
+			sys.stdout.flush(); sys.stderr.flush(); os._exit(rc)" \
+		--fake-devices 1 --n 8388608 --iters 150 --telemetry \
+		--memwatch --mem-interval 0.05 \
+		--jsonl /tmp/_tpumt_chaos.kill.jsonl; test $$? -eq 137
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.kill.jsonl --expect missing_rank:1
+	env JAX_PLATFORMS=cpu \
+		TPU_MPI_CHAOS="straggler:rank=1:delay_ms=25" \
+		./native/tpumt_run -n 2 -o /tmp/_tpumt_chaos.strag.rank -- \
+		python -m tpu_mpi_tests.drivers.daxpy --fake-devices 1 \
+		--n 1048576 --iters 40 --telemetry --memwatch \
+		--mem-interval 0.05 --jsonl /tmp/_tpumt_chaos.strag.jsonl
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.strag.jsonl --expect straggler:1
+	env JAX_PLATFORMS=cpu TPU_MPI_CHAOS="flood:burst=300:after=1" \
+		python -m tpu_mpi_tests.drivers.serve --fake-devices 2 \
+		--duration 4 --arrival poisson --rate 20 --seed 7 \
+		--report-interval 1 --max-queue 32 \
+		--workloads daxpy:4096:float32 --telemetry \
+		--jsonl /tmp/_tpumt_chaos.flood.jsonl; test $$? -eq 1
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.flood.jsonl --expect shed_storm:0
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_chaos.kill.jsonl > /tmp/_tpumt_chaos.report.txt
+	grep -q '^DIAGNOSIS missing_rank: rank=1' /tmp/_tpumt_chaos.report.txt
+	python -m tpu_mpi_tests.instrument.timeline \
+		/tmp/_tpumt_chaos.kill.jsonl -o /tmp/_tpumt_chaos.trace.json
+	python -c "import json; \
+		d = json.load(open('/tmp/_tpumt_chaos.trace.json')); \
+		f = [e for e in d['traceEvents'] \
+			if e.get('cat') == 'finding']; \
+		assert f and f[0]['pid'] == 1, f; \
+		print('chaos-smoke trace FINDING marker OK')"
+	@echo "chaos-smoke OK: 5 fault classes convicted (class+rank), clean run silent"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -298,9 +380,10 @@ lint:
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
 # observability smoke, the serving-pipeline smoke, the overlap-engine
-# smoke, the workload-spec pillar smoke, and the lint self-clean gate
+# smoke, the workload-spec pillar smoke, the chaos-verified diagnosis
+# smoke, and the lint self-clean gate
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke \
-	moe-smoke lint
+	moe-smoke chaos-smoke lint
 
 clean:
 	$(MAKE) -C native clean

@@ -1,0 +1,620 @@
+"""Chaos layer (tpu_mpi_tests/chaos/): fault-spec grammar, arm/disarm
+zero-state contract, hook behavior, the disarmed-identity acceptance
+gate, end-to-end fault legs (wedge / oom in subprocesses; kill /
+straggler across real processes under the native launcher), and
+flight-recorder fidelity under a dying rank.
+
+The multi-process legs use a LOCAL-compute workload (daxpy --iters):
+this image's CPU backend has no cross-process collectives (the whole
+test_multiproc family documents that), so the collective-triggered
+variants (op= span faults) are exercised single-process where real
+halo-exchange spans exist, and the rank-identity story is exercised
+across real processes via phase triggers."""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_mpi_tests.chaos import inject
+from tpu_mpi_tests.chaos.spec import (
+    FAULT_CLASSES,
+    FINDING_FOR,
+    parse_chaos_spec,
+)
+from tpu_mpi_tests.instrument import diagnose
+
+REPO = Path(__file__).resolve().parent.parent
+LAUNCHER = REPO / "native" / "tpumt_run"
+
+#: fast-exit shim for the kill leg: the survivor must not sit in jax's
+#: distributed-shutdown barrier (~100 s heartbeat timeout) waiting for
+#: the rank chaos just killed
+FAST_EXIT_DAXPY = (
+    "import sys, os\n"
+    "from tpu_mpi_tests.workloads.daxpy import main\n"
+    "rc = main(sys.argv[1:])\n"
+    "sys.stdout.flush(); sys.stderr.flush()\n"
+    "os._exit(rc)\n"
+)
+
+#: wedge-leg shim: rank 0 (the jax.distributed coordinator) must stay
+#: alive until rank 1's watchdog fires — the --deadline watchdog bounds
+#: the WHOLE run, so rank 0 cannot simply be given more work; instead
+#: it sleeps AFTER its run completes (watchdog already disarmed),
+#: keeping the coordination service up past the peer's fire
+KEEPALIVE_DAXPY = (
+    "import sys, os, time\n"
+    "from tpu_mpi_tests.workloads.daxpy import main\n"
+    "rc = main(sys.argv[1:])\n"
+    "sys.stdout.flush(); sys.stderr.flush()\n"
+    "if os.environ.get('JAX_PROCESS_ID') == '0':\n"
+    "    time.sleep(8)\n"
+    "os._exit(rc)\n"
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    yield
+    inject.disarm()
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_every_fault_class_has_a_finding_class(self):
+        assert set(FINDING_FOR) == set(FAULT_CLASSES)
+        assert set(FINDING_FOR.values()) <= set(diagnose.FINDING_CLASSES)
+
+    def test_full_grammar_round_trip(self):
+        (s,) = parse_chaos_spec("kill:rank=1:op=halo_exchange:after=3")
+        assert (s.fault, s.rank, s.op, s.after) == (
+            "kill", 1, "halo_exchange", 3)
+        two = parse_chaos_spec(
+            "straggler:rank=1:delay_ms=40, oom:step_mb=8:frac=0.5")
+        assert [s.fault for s in two] == ["straggler", "oom"]
+        assert two[0].delay_ms == 40.0 and two[1].frac == 0.5
+
+    @pytest.mark.parametrize("bad", [
+        "boom", "kill", "wedge", "kill:rank=x", "oom:frac=2",
+        "oom:frac=0", "wedge:op=a:phase=b", "kill:op=a:after=0",
+        "straggler:delay_ms=0", "flood:burst=0", "kill:op=a:nope=1",
+        # keys the class ignores are rejected, not silently dropped —
+        # accepting straggler:phase= would arm a uniform straggler
+        # while the spec claims a phase-scoped one
+        "straggler:phase=copyIn:delay_ms=40", "oom:op=daxpy",
+        "flood:phase=kernel:burst=10", "kill:op=a:delay_ms=5",
+        # duplicate keys are rejected, not silently last-wins
+        "kill:rank=1:op=x:rank=0",
+        # a zero stall cap hard-exits before the watchdog can fire
+        "wedge:op=a:stall_s=0",
+        "",
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_chaos_spec(bad)
+
+
+# ---------------------------------------------------------------------------
+# arm / disarm: the zero-state contract
+# ---------------------------------------------------------------------------
+
+
+class TestArm:
+    def test_non_matching_rank_installs_nothing(self):
+        from tpu_mpi_tests.instrument import telemetry, timers
+        from tpu_mpi_tests.serve import loop as serve_loop
+
+        orig_block = timers.block
+        specs = parse_chaos_spec(
+            "kill:rank=1:op=x,straggler:rank=1,flood:rank=1,"
+            "oom:rank=1")
+        assert inject.arm(specs, rank=0) == []
+        assert telemetry._CHAOS_SPAN_HOOK is None
+        assert serve_loop._CHAOS_FLOOD is None
+        assert timers.block is orig_block
+        assert inject.armed() == []
+
+    def test_arm_installs_and_disarm_restores(self):
+        from tpu_mpi_tests.instrument import telemetry, timers
+        from tpu_mpi_tests.serve import loop as serve_loop
+
+        orig_block = timers.block
+        specs = parse_chaos_spec(
+            "straggler:rank=0:op=halo,flood:rank=0,straggler:rank=0,"
+            "oom:rank=0")
+        mine = inject.arm(specs, rank=0)
+        assert len(mine) == 4
+        assert telemetry._CHAOS_SPAN_HOOK is not None
+        assert serve_loop._CHAOS_FLOOD is not None
+        assert timers.block is not orig_block  # uniform straggler wrap
+        assert timers._PHASE_HOOKS  # oom ballast hook
+        inject.disarm()
+        assert telemetry._CHAOS_SPAN_HOOK is None
+        assert serve_loop._CHAOS_FLOOD is None
+        assert timers.block is orig_block
+        assert inject._PHASE_HOOK is None
+        assert inject._BALLAST == []
+
+    def test_rearm_is_idempotent(self):
+        from tpu_mpi_tests.instrument import timers
+
+        orig_block = timers.block
+        specs = parse_chaos_spec("straggler:rank=0")
+        inject.arm(specs, rank=0)
+        inject.arm(specs, rank=0)  # re-arm: must not double-wrap
+        inject.disarm()
+        assert timers.block is orig_block
+
+
+class TestHooks:
+    def test_op_straggler_sleeps_outside_measured_window(self):
+        """The op-scoped straggler's delay lands AFTER the span's
+        clock stops: the culprit's own spans stay honest while its
+        late arrival inflates the siblings' next collective."""
+        from tpu_mpi_tests.instrument import telemetry
+
+        recs = []
+        telemetry.enable(sink=recs.append)
+        try:
+            inject.arm(parse_chaos_spec(
+                "straggler:rank=0:op=halo:delay_ms=60:after=2"),
+                rank=0)
+            t0 = time.perf_counter()
+            with telemetry.comm_span("halo_exchange"):
+                pass
+            first = time.perf_counter() - t0  # event 1: no delay yet
+            t0 = time.perf_counter()
+            with telemetry.comm_span("halo_exchange"):
+                pass
+            second = time.perf_counter() - t0  # event 2: 60 ms outside
+        finally:
+            telemetry.disable()
+            inject.disarm()
+        spans = [r for r in recs if r.get("kind") == "span"]
+        assert len(spans) == 2
+        assert first < 0.05
+        assert second >= 0.055
+        # the measured span itself must NOT include the delay
+        assert all(r["seconds"] < 0.05 for r in spans)
+        # the injection audited itself exactly once
+        fires = [r for r in recs if r.get("kind") == "chaos"
+                 and r.get("event") == "fire"]
+        assert len(fires) == 1
+
+    def test_op_prefix_filter(self):
+        from tpu_mpi_tests.instrument import telemetry
+
+        telemetry.enable(sink=None)
+        try:
+            inject.arm(parse_chaos_spec(
+                "straggler:rank=0:op=halo:delay_ms=80"), rank=0)
+            t0 = time.perf_counter()
+            with telemetry.comm_span("allreduce"):
+                pass
+            assert time.perf_counter() - t0 < 0.05  # no match, no delay
+        finally:
+            telemetry.disable()
+            inject.disarm()
+
+    def test_uniform_straggler_wraps_block(self):
+        from tpu_mpi_tests.instrument import timers
+
+        timers.block([0])  # warm-up: the first block pays jax init
+        inject.arm(parse_chaos_spec(
+            "straggler:rank=0:delay_ms=50:after=2"), rank=0)
+        try:
+            t0 = time.perf_counter()
+            timers.block([1, 2])
+            first = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            timers.block([1, 2])
+            second = time.perf_counter() - t0
+        finally:
+            inject.disarm()
+        assert first < 0.04 and second >= 0.045
+
+    def test_flood_hook_fires_once_at_its_window(self):
+        inject.arm(parse_chaos_spec("flood:burst=37:after=2"), rank=0)
+        try:
+            from tpu_mpi_tests.serve import loop as serve_loop
+
+            hook = serve_loop._CHAOS_FLOOD
+            assert hook(1) == 0
+            assert hook(2) == 37
+            assert hook(2) == 0  # one-shot
+            assert hook(3) == 0
+        finally:
+            inject.disarm()
+
+    def test_flood_sheds_through_the_serve_loop(self):
+        from tpu_mpi_tests.serve.arrival import OpenLoopPoisson
+        from tpu_mpi_tests.serve.loop import ServeLoop
+        from tpu_mpi_tests.serve.workloads import parse_workload_table
+
+        class FakeClock:
+            t = 0.0
+
+            def clock(self):
+                return self.t
+
+            def sleep(self, dt):
+                self.t += dt
+
+        clk = FakeClock()
+        classes = parse_workload_table("daxpy:128:float32")
+        recs = []
+        inject.arm(parse_chaos_spec("flood:burst=100:after=1"), rank=0)
+        try:
+            loop = ServeLoop(
+                classes, {classes[0].key: lambda n: None},
+                OpenLoopPoisson(5.0, seed=0), duration_s=6.0,
+                window_s=2.0, max_queue=16, sink=recs.append,
+                clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+            )
+            (summary,) = loop.run()
+        finally:
+            inject.disarm()
+        assert summary["shed"] >= 80  # burst 100 into a 16-deep queue
+        windows = [r for r in recs if r.get("event") == "window"]
+        assert any(w["shed"] > 0 for w in windows)
+
+    def test_flood_never_inflates_closed_population(self):
+        """Synthetic flood completions must NOT feed the arrival
+        process: a closed loop's fixed client population has to return
+        to exactly --concurrency once the burst drains, or every
+        post-flood window measures a permanently different
+        experiment."""
+        from tpu_mpi_tests.serve.arrival import ClosedLoop
+        from tpu_mpi_tests.serve.loop import ServeLoop
+        from tpu_mpi_tests.serve.workloads import parse_workload_table
+
+        class FakeClock:
+            t = 0.0
+
+            def clock(self):
+                return self.t
+
+            def sleep(self, dt):
+                self.t += dt
+
+        clk = FakeClock()
+        classes = parse_workload_table("daxpy:128:float32")
+        arrival = ClosedLoop(2)
+        fed = []
+        orig = arrival.on_complete
+        arrival.on_complete = lambda n, now: (fed.append(n),
+                                              orig(n, now))
+
+        def handler(n):
+            clk.t += 0.005 * n
+
+        inject.arm(parse_chaos_spec("flood:burst=10:after=1"), rank=0)
+        try:
+            loop = ServeLoop(
+                classes, {classes[0].key: handler}, arrival,
+                duration_s=6.0, window_s=2.0, max_queue=32,
+                clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+            )
+            (summary,) = loop.run()
+        finally:
+            inject.disarm()
+        assert summary["requests"] > 10  # the burst was genuinely served
+        # every completion fed back is an organic client; the 10
+        # synthetic served requests re-armed nothing
+        assert sum(fed) == summary["requests"] - 10
+
+    def test_oom_explicit_limit_wins_over_device_limit(
+        self, monkeypatch
+    ):
+        """An explicit limit_mb is a promise about how far the ramp
+        goes: it must NOT be silently replaced by the device-reported
+        HBM limit (which would ramp toward tens of GB on a real chip).
+        Only the default defers to the hardware."""
+        from tpu_mpi_tests.instrument import memwatch
+
+        monkeypatch.setattr(
+            memwatch, "device_memory_stats",
+            lambda: {"d0": {"bytes_limit": 32 << 30}})
+        monkeypatch.setattr(
+            memwatch, "_live_totals", lambda: (1, 10 << 20))
+        died = []
+        monkeypatch.setattr(
+            inject, "_die",
+            lambda spec, code, why: died.append((code, why)))
+        # explicit 8 MB limit: 10 MB live crosses 0.8*8MB -> dies
+        (s,) = parse_chaos_spec("oom:step_mb=1:limit_mb=8:frac=0.8")
+        inject._grow_ballast(s, "kernel")
+        inject._BALLAST.clear()
+        assert died and died[0][0] == inject.OOM_EXIT
+        # default limit: defers to the 32 GB device limit -> no death
+        died.clear()
+        (s,) = parse_chaos_spec("oom:step_mb=1:frac=0.8")
+        inject._grow_ballast(s, "kernel")
+        inject._BALLAST.clear()
+        assert not died
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder fidelity under a dying rank (single-process half)
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorderFidelity:
+    def test_watchdog_dump_is_exactly_the_jsonl_tail(self):
+        """The last 16 events in the fire dump must be exactly the
+        tail of the JSONL record stream — same events, same order,
+        ages non-increasing (oldest first)."""
+        from tpu_mpi_tests.instrument import telemetry
+        from tpu_mpi_tests.instrument.watchdog import DUMP_EVENTS, Watchdog
+
+        telemetry.registry().reset()
+        recs = []
+        telemetry.enable(sink=recs.append)
+        try:
+            for i in range(20):
+                with telemetry.comm_span(f"op{i:02d}"):
+                    pass
+            telemetry.note_dispatch("wedged-dma", op="rdma_ring")
+            captured = []
+            Watchdog(1.0, "t", _on_timeout=captured.append)._fire()
+        finally:
+            telemetry.disable()
+        (msg,) = captured
+        m = re.search(
+            r"comm ops \(newest last\):\n((?:\s+.*\n)+?)\s+memory at fire:"
+            r"|comm ops \(newest last\):\n((?:\s+.*\n)+?)\s+aborting",
+            msg,
+        )
+        assert m, msg
+        lines = [ln.strip() for ln in (m.group(1) or m.group(2))
+                 .strip().splitlines()]
+        assert len(lines) == DUMP_EVENTS
+        dumped = [ln.split()[0] for ln in lines]
+        # the JSONL stream saw the same events in the same order
+        stream = [r.get("op") if r["kind"] == "span" else r.get("note")
+                  for r in recs if r.get("kind") in ("span", "dispatch")]
+        assert dumped == [
+            s if s == "wedged-dma" else s for s in stream[-DUMP_EVENTS:]
+        ]
+        ages = [float(re.search(r"([\d.]+)s ago$", ln).group(1))
+                for ln in lines]
+        assert ages == sorted(ages, reverse=True)  # oldest first
+
+
+# ---------------------------------------------------------------------------
+# subprocess end-to-end legs
+# ---------------------------------------------------------------------------
+
+
+def _run(code_or_module, args, chaos=None, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("TPU_MPI_CHAOS", None)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    if chaos is not None:
+        env["TPU_MPI_CHAOS"] = chaos
+    if code_or_module.endswith(".py") or "\n" in code_or_module:
+        cmd = [sys.executable, "-c", code_or_module, *args]
+    else:
+        cmd = [sys.executable, "-m", code_or_module, *args]
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+class TestEndToEnd:
+    def test_disarmed_run_identical_to_build_without_chaos(
+        self, tmp_path
+    ):
+        """THE acceptance identity: a disarmed run's stdout (numbers
+        masked) and JSONL record-kind sequence are byte-identical to a
+        run where the chaos package cannot even be imported."""
+        blocked = (
+            "import sys\n"
+            "class Block:\n"
+            "    def find_spec(self, name, path=None, target=None):\n"
+            "        if name.startswith('tpu_mpi_tests.chaos'):\n"
+            "            raise ImportError('chaos layer removed')\n"
+            "sys.meta_path.insert(0, Block())\n"
+            "from tpu_mpi_tests.workloads.daxpy import main\n"
+            "sys.exit(main(sys.argv[1:]))\n"
+        )
+        plain = (
+            "import sys\n"
+            "from tpu_mpi_tests.workloads.daxpy import main\n"
+            "sys.exit(main(sys.argv[1:]))\n"
+        )
+        outs = []
+        for code, jsonl in ((blocked, tmp_path / "a.jsonl"),
+                            (plain, tmp_path / "b.jsonl")):
+            r = _run(code, ["--fake-devices", "2", "--n", "4096",
+                            "--telemetry", "--jsonl", str(jsonl)])
+            assert r.returncode == 0, r.stderr[-2000:]
+            outs.append(r.stdout)
+        mask = re.compile(r"[0-9][0-9.e+-]*")
+
+        def masked(s):
+            return [mask.sub("#", ln) for ln in s.splitlines()
+                    if not ln.startswith("MANIFEST")]  # git sha varies
+
+        assert masked(outs[0]) == masked(outs[1])
+        kinds = [
+            [json.loads(ln).get("kind") for ln in open(p)]
+            for p in (tmp_path / "a.jsonl", tmp_path / "b.jsonl")
+        ]
+        assert kinds[0] == kinds[1]
+        assert "chaos" not in kinds[1]
+
+    def test_wedge_leg_watchdog_convicts_and_dump_matches_jsonl(
+        self, tmp_path
+    ):
+        """Single-process wedge: the injected stall fires the hang
+        watchdog; the doctor convicts wedge on rank 0; the fire dump's
+        event tail matches the JSONL stream (the driver-level half of
+        the fidelity satellite)."""
+        jsonl = tmp_path / "wedge.jsonl"
+        r = _run(
+            "tpu_mpi_tests.drivers.stencil1d",
+            ["--fake-devices", "2", "--n-global", "65536",
+             "--overlap", "1", "--overlap-iters", "12", "--telemetry",
+             "--deadline", "5", "--jsonl", str(jsonl)],
+            chaos="wedge:op=halo_exchange:after=3:stall_s=60",
+        )
+        assert r.returncode == 9, (r.stdout, r.stderr[-2000:])
+        assert "WATCHDOG" in r.stderr
+        (f,) = diagnose.diagnose_files([str(jsonl)])
+        assert f["class"] == "wedge" and f["rank"] == 0
+        # dump tail vs JSONL tail: same events, same order
+        m = re.search(r"comm ops \(newest last\):\n((?:\s+.*\n)+?)"
+                      r"\s+(?:memory at fire:|aborting)", r.stderr)
+        assert m, r.stderr
+        dumped = [ln.strip().split()[0]
+                  for ln in m.group(1).strip().splitlines()]
+        recs = [json.loads(ln) for ln in open(jsonl)]
+        stream = [x.get("op") if x["kind"] == "span" else x.get("note")
+                  for x in recs if x.get("kind") in ("span", "dispatch")]
+        assert dumped == [s.split()[0] for s in stream[-len(dumped):]]
+
+    def test_oom_leg_ramp_convicts(self, tmp_path):
+        jsonl = tmp_path / "oom.jsonl"
+        r = _run(
+            "tpu_mpi_tests.drivers.daxpy",
+            ["--fake-devices", "2", "--n", "1048576", "--iters", "20",
+             "--telemetry", "--memwatch", "--mem-interval", "0.05",
+             "--jsonl", str(jsonl)],
+            chaos="oom:step_mb=8:limit_mb=48:frac=0.8",
+        )
+        assert r.returncode == inject.OOM_EXIT, r.stderr[-2000:]
+        (f,) = diagnose.diagnose_files([str(jsonl)])
+        assert f["class"] == "oom" and f["rank"] == 0
+
+    def test_bad_spec_fails_fast(self, tmp_path):
+        r = _run(
+            "tpu_mpi_tests.drivers.daxpy",
+            ["--fake-devices", "2", "--n", "4096", "--jsonl",
+             str(tmp_path / "x.jsonl")],
+            chaos="explode:rank=1",
+        )
+        assert r.returncode == 2
+        assert "bad --chaos spec" in r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# multi-process legs (real separate processes, native launcher)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(shutil.which("g++") is None,
+                    reason="no C++ toolchain for tpumt_run")
+class TestMultiProcess:
+    @pytest.fixture(scope="class")
+    def tpumt_run(self):
+        subprocess.run(
+            ["make", "-C", str(REPO / "native"), "tpumt_run"],
+            capture_output=True, check=True, timeout=120,
+        )
+        return str(LAUNCHER)
+
+    def _launch(self, tpumt_run, nprocs, *cmd, chaos, out_prefix,
+                timeout=240):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TPU_MPI_CHAOS"] = chaos
+        env["PYTHONPATH"] = str(REPO) + (
+            ":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        proc = subprocess.Popen(
+            [tpumt_run, "-n", str(nprocs), "-o", str(out_prefix),
+             "--", *cmd],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=REPO, env=env, start_new_session=True,
+        )
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, 9)
+            stdout, stderr = proc.communicate()
+            pytest.fail(f"launcher timed out; partial:\n{stdout}\n"
+                        f"{stderr}")
+        return proc.returncode
+
+    def test_kill_leg_convicts_missing_rank(self, tpumt_run, tmp_path):
+        """A rank killed mid-run across REAL processes: its stream
+        truncates without close markers while the survivor records on
+        — the doctor names the dead rank."""
+        jsonl = tmp_path / "kill.jsonl"
+        rc = self._launch(
+            tpumt_run, 2, sys.executable, "-c", FAST_EXIT_DAXPY,
+            "--fake-devices", "1", "--n", "8388608", "--iters", "120",
+            "--telemetry", "--memwatch", "--mem-interval", "0.05",
+            "--jsonl", str(jsonl),
+            chaos="kill:rank=1:phase=kernel:after=10",
+            out_prefix=tmp_path / "kill-out-",
+        )
+        assert rc == inject.KILL_EXIT
+        (f,) = diagnose.diagnose_files([str(jsonl)])
+        assert f["class"] == "missing_rank" and f["rank"] == 1
+        assert f["phase"] == "kernel"
+
+    def test_straggler_leg_convicts_slow_rank(self, tpumt_run,
+                                              tmp_path):
+        jsonl = tmp_path / "strag.jsonl"
+        rc = self._launch(
+            tpumt_run, 2, sys.executable, "-m",
+            "tpu_mpi_tests.drivers.daxpy",
+            "--fake-devices", "1", "--n", "1048576", "--iters", "40",
+            "--telemetry", "--memwatch", "--mem-interval", "0.05",
+            "--jsonl", str(jsonl),
+            chaos="straggler:rank=1:delay_ms=25",
+            out_prefix=tmp_path / "strag-out-",
+        )
+        assert rc == 0
+        (f,) = diagnose.diagnose_files([str(jsonl)])
+        assert f["class"] == "straggler" and f["rank"] == 1
+
+    def test_wedge_dump_fidelity_on_dying_rank(self, tpumt_run,
+                                               tmp_path):
+        """Multi-process half of the fidelity satellite: rank 1 wedges
+        (dispatch note, no completion), its own deadline watchdog
+        dumps, and the dump tail matches rank 1's JSONL stream while
+        rank 0 finishes untouched. (A true killed-peer dump on the
+        SURVIVOR needs cross-process collectives, which this image's
+        CPU backend lacks — on real pods the kill path produces it.)"""
+        jsonl = tmp_path / "wedge.jsonl"
+        rc = self._launch(
+            tpumt_run, 2, sys.executable, "-c", KEEPALIVE_DAXPY,
+            "--fake-devices", "1", "--n", "1048576", "--iters", "40",
+            "--telemetry", "--deadline", "4", "--jsonl", str(jsonl),
+            chaos="wedge:rank=1:phase=kernel:after=3:stall_s=60",
+            out_prefix=tmp_path / "wedge-out-",
+        )
+        assert rc == 9  # rank 1's watchdog hard-exit
+        out0 = (tmp_path / "wedge-out-0.txt").read_text()
+        out1 = (tmp_path / "wedge-out-1.txt").read_text()
+        assert "SUM = " in out0  # rank 0 unaffected
+        assert "WATCHDOG" in out1 and "chaos:wedge" in out1
+        m = re.search(r"comm ops \(newest last\):\n((?:\s+.*\n)+?)"
+                      r"\s+(?:memory at fire:|aborting)", out1)
+        assert m, out1
+        dumped = [ln.strip().split()[0]
+                  for ln in m.group(1).strip().splitlines()]
+        recs = [json.loads(ln)
+                for ln in open(tmp_path / "wedge.p1.jsonl")]
+        stream = [x.get("note") or x.get("op") for x in recs
+                  if x.get("kind") in ("span", "dispatch")]
+        assert dumped == [s.split()[0] for s in stream[-len(dumped):]]
+        (f,) = diagnose.diagnose_files([str(jsonl)])
+        assert f["class"] == "wedge" and f["rank"] == 1

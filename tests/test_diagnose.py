@@ -1,0 +1,449 @@
+"""tpumt-doctor (instrument/diagnose.py): cross-rank root-cause rules
+over synthesized per-rank streams, the --expect CI contract, and the
+DIAGNOSIS/NOTE/marker surfacing in tpumt-report / tpumt-trace."""
+
+import json
+
+import pytest
+
+from tpu_mpi_tests.instrument import aggregate, diagnose, timeline
+
+
+def _write_jsonl(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _manifest(rank, n=2, **extra):
+    return {"kind": "manifest", "process_index": rank,
+            "process_count": n, "platform": "cpu",
+            "global_device_count": n, "device_kinds": ["cpu"],
+            "jax": "0.0-test", "argv": ["chaos-test"], **extra}
+
+
+def _span(rank, op, t, seconds=0.01, world=2):
+    return {"kind": "span", "op": op, "nbytes": 1 << 20, "world": world,
+            "seconds": seconds, "t_start": t, "t_end": t + seconds,
+            "rank": rank}
+
+
+def _mem(t, live, event="sample", **extra):
+    return {"kind": "mem", "event": event, "t": t, "live_bytes": live,
+            **extra}
+
+
+def _summary_marker(rank):
+    return {"kind": "telemetry_summary", "op": "x", "rank": rank,
+            "ops": 1, "bytes": 1, "seconds": 0.0}
+
+
+def _healthy_stream(rank, t0=100.0, n_spans=8):
+    recs = [_manifest(rank)]
+    recs += [_span(rank, "allreduce", t0 + i) for i in range(n_spans)]
+    recs += [_mem(t0 + n_spans, 1000, event="final"),
+             _summary_marker(rank)]
+    return recs
+
+
+@pytest.fixture()
+def clean_run(tmp_path):
+    _write_jsonl(tmp_path / "run.p0.jsonl", _healthy_stream(0))
+    _write_jsonl(tmp_path / "run.p1.jsonl", _healthy_stream(1))
+    return tmp_path
+
+
+def _files(tmp_path):
+    return sorted(str(p) for p in tmp_path.glob("run.p*.jsonl"))
+
+
+class TestRules:
+    def test_clean_run_zero_findings(self, clean_run):
+        assert diagnose.diagnose_files(_files(clean_run)) == []
+
+    def test_missing_rank_convicted_when_siblings_progress(
+        self, tmp_path
+    ):
+        # rank 1 stops at t=103 (no close markers); rank 0 records on
+        # to t=110 and closes cleanly
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     _healthy_stream(0, n_spans=10))
+        recs = [_manifest(1)] + [
+            _span(1, "allreduce", 100.0 + i) for i in range(3)
+        ]
+        _write_jsonl(tmp_path / "run.p1.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "missing_rank" and f["rank"] == 1
+        assert f["last_op"] == "allreduce"
+        assert f["kind"] == "finding"
+
+    def test_missing_rank_sibling_watchdog_raises_confidence(
+        self, tmp_path
+    ):
+        surv = _healthy_stream(0, n_spans=10)
+        surv.insert(-2, {"kind": "watchdog", "phase": "driver",
+                         "deadline_s": 8.0, "t": 109.5, "rank": 0})
+        _write_jsonl(tmp_path / "run.p0.jsonl", surv)
+        _write_jsonl(tmp_path / "run.p1.jsonl", [_manifest(1)] + [
+            _span(1, "allreduce", 100.0 + i) for i in range(3)
+        ])
+        (f,) = [x for x in diagnose.diagnose_files(_files(tmp_path))
+                if x["class"] == "missing_rank"]
+        assert f["confidence"] >= 0.95
+
+    def test_missing_rank_file_absent_entirely(self, tmp_path):
+        # the manifest claims 3 processes; only ranks 0 and 1 merged
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     [_manifest(0, n=3)] + _healthy_stream(0)[1:])
+        _write_jsonl(tmp_path / "run.p1.jsonl",
+                     [_manifest(1, n=3)] + _healthy_stream(1)[1:])
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "missing_rank" and f["rank"] == 2
+        assert "no rank file" in f["detail"]
+
+    def test_lone_truncated_stream_not_convicted(self, tmp_path):
+        """Without siblings (or wedge/oom evidence) a truncated stream
+        is indistinguishable from a user interrupt — no verdict."""
+        _write_jsonl(tmp_path / "run.p0.jsonl", [_manifest(0, n=1)] + [
+            _span(0, "allreduce", 100.0 + i) for i in range(5)
+        ])
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+
+    def test_wedge_convicted_from_dispatch_plus_watchdog(self, tmp_path):
+        recs = [_manifest(0, n=1)]
+        recs += [_span(0, "halo_exchange", 100.0 + i) for i in range(3)]
+        recs += [
+            {"kind": "dispatch", "note": "chaos:wedge halo_exchange",
+             "op": "halo_exchange", "t": 103.5, "rank": 0},
+            {"kind": "watchdog", "phase": "driver", "deadline_s": 6.0,
+             "t": 109.5, "rank": 0},
+        ]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "wedge" and f["rank"] == 0
+        assert f["last_op"] == "halo_exchange"
+        assert len(f["evidence"]) == 2
+
+    def test_wedge_not_convicted_when_spans_close_after_dispatch(
+        self, tmp_path
+    ):
+        """A dispatch note followed by later span closes is a healthy
+        RDMA path, not a wedge — even with a watchdog somewhere."""
+        recs = [_manifest(0, n=1)]
+        recs += [{"kind": "dispatch", "note": "rdma ring", "t": 100.0,
+                  "rank": 0}]
+        recs += [_span(0, "halo_exchange", 100.5 + i) for i in range(5)]
+        recs += [{"kind": "watchdog", "phase": "driver",
+                  "deadline_s": 6.0, "t": 110.0, "rank": 0},
+                 _summary_marker(0)]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        assert not [f for f in diagnose.diagnose_files(_files(tmp_path))
+                    if f["class"] == "wedge"]
+
+    def test_oom_census_ramp_convicted(self, tmp_path):
+        recs = [_manifest(0, n=1)]
+        for i in range(6):
+            recs.append(_mem(100.0 + i, (1 + i) * 16 << 20))
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)  # no final marker
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "oom" and f["rank"] == 0
+        assert f["confidence"] == pytest.approx(0.7)
+        assert "census-only" in f["detail"]
+
+    def test_oom_limit_crossing_raises_confidence(self, tmp_path):
+        limit = 256 << 20
+        recs = [_manifest(0, n=1, hbm_bytes_limit=limit)]
+        for i in range(6):
+            recs.append(_mem(100.0 + i, (1 + i) * 32 << 20,
+                             bytes_in_use=(1 + i) * 32 << 20))
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "oom"
+        assert f["confidence"] == pytest.approx(0.9)
+        assert "hbm_bytes_limit" in f["detail"]
+
+    def test_flat_memory_death_is_not_oom(self, tmp_path):
+        """A killed rank with flat memory must convict as missing_rank,
+        never oom — the ramp is the signature, not the mem records."""
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     _healthy_stream(0, n_spans=10))
+        recs = [_manifest(1)]
+        for i in range(4):
+            recs.append(_mem(100.0 + i, 16 << 20))
+            recs.append(_span(1, "allreduce", 100.2 + i))
+        _write_jsonl(tmp_path / "run.p1.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "missing_rank" and f["rank"] == 1
+
+    def test_straggler_phase_skew_convicts_slow_rank(self, tmp_path):
+        def stream(rank, kernel_s):
+            recs = [_manifest(rank)]
+            recs.append({"kind": "time", "phase": "kernel",
+                         "seconds": kernel_s, "count": 20,
+                         "t_start": 100.0, "t_end": 100.0 + kernel_s,
+                         "rank": rank})
+            recs += [_mem(101.0, 100, event="final"),
+                     _summary_marker(rank)]
+            return recs
+
+        _write_jsonl(tmp_path / "run.p0.jsonl", stream(0, 0.5))
+        _write_jsonl(tmp_path / "run.p1.jsonl", stream(1, 2.0))
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "straggler" and f["rank"] == 1
+        assert "phase kernel" in f["detail"]
+        # anchored at the culprit's last convicting record so
+        # tpumt-trace can place the FINDING marker on its track
+        assert f["t"] == pytest.approx(102.0)
+
+    def test_straggler_collective_inversion_convicts_fast_rank(
+        self, tmp_path
+    ):
+        """Sync-honest collective spans charge the wait to the EARLY
+        rank: the culprit is the one that never waits (min seconds)."""
+        def stream(rank, span_s):
+            recs = [_manifest(rank)]
+            recs += [_span(rank, "halo_exchange", 100.0 + i,
+                           seconds=span_s) for i in range(8)]
+            recs += [_mem(120.0, 100, event="final"),
+                     _summary_marker(rank)]
+            return recs
+
+        _write_jsonl(tmp_path / "run.p0.jsonl", stream(0, 0.2))
+        _write_jsonl(tmp_path / "run.p1.jsonl", stream(1, 0.005))
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "straggler" and f["rank"] == 1
+        assert "invert" in f["detail"]
+
+    def test_straggler_below_threshold_or_count_not_convicted(
+        self, tmp_path
+    ):
+        def stream(rank, kernel_s, count):
+            return [_manifest(rank),
+                    {"kind": "time", "phase": "kernel",
+                     "seconds": kernel_s, "count": count,
+                     "t_start": 100.0, "t_end": 101.0, "rank": rank},
+                    _mem(101.0, 100, event="final"),
+                    _summary_marker(rank)]
+
+        # 1.8x skew: below the 2x conviction threshold
+        _write_jsonl(tmp_path / "run.p0.jsonl", stream(0, 1.0, 20))
+        _write_jsonl(tmp_path / "run.p1.jsonl", stream(1, 1.8, 20))
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+        # huge skew but only 2 calls each: below min_calls
+        _write_jsonl(tmp_path / "run.p0.jsonl", stream(0, 0.1, 2))
+        _write_jsonl(tmp_path / "run.p1.jsonl", stream(1, 3.0, 2))
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+
+    def test_shed_storm_convicted_from_serve_windows(self, tmp_path):
+        recs = [_manifest(0, n=1)]
+        for i in range(4):
+            recs.append({
+                "kind": "serve", "event": "window",
+                "class": "daxpy:4096:float32", "t_start": 100.0 + i,
+                "t_end": 101.0 + i, "arrivals": 100, "requests": 30,
+                "shed": 60 + i * 10, "errors": 0,
+                "queue_max": 32, "rank": 0,
+            })
+        recs += [_summary_marker(0), _mem(110.0, 1, event="final")]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "shed_storm" and f["rank"] == 0
+        assert f["last_op"] == "daxpy:4096:float32"
+
+    def test_quarantined_class_sheds_are_not_a_storm(self, tmp_path):
+        """Graceful degradation (serve --quarantine-after) sheds the
+        quarantined class's load BY DESIGN — the doctor must not
+        convict the exact runs the driver deliberately exits 0 for.
+        An un-quarantined class shedding at the queue bound in the
+        same stream still convicts."""
+        recs = [_manifest(0, n=1), {
+            "kind": "serve", "event": "quarantine", "class": "dead:c",
+            "t": 100.5, "rank": 0,
+        }]
+        for i in range(4):
+            recs.append({
+                "kind": "serve", "event": "window", "class": "dead:c",
+                "t_start": 100.0 + i, "t_end": 101.0 + i,
+                "arrivals": 100, "requests": 0, "shed": 100,
+                "errors": 0, "queue_max": 2, "rank": 0,
+            })
+        recs += [_summary_marker(0), _mem(110.0, 1, event="final")]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+        # the same windows WITHOUT the quarantine record are a storm
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     [recs[0]] + recs[2:])
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "shed_storm"
+        # the exemption is scoped, not retroactive: a quarantine that
+        # lands AFTER the storm windows does not absolve them
+        late = dict(recs[1], t=200.0)
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     [recs[0]] + recs[2:-2] + [late] + recs[-2:])
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "shed_storm"
+
+    def test_small_shed_not_a_storm(self, tmp_path):
+        recs = [_manifest(0, n=1), {
+            "kind": "serve", "event": "window", "class": "c",
+            "t_start": 100.0, "t_end": 101.0, "arrivals": 1000,
+            "requests": 995, "shed": 5, "errors": 0, "queue_max": 4,
+            "rank": 0,
+        }, _summary_marker(0)]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+
+    def test_chaos_audit_records_are_ignored(self, tmp_path):
+        """The injection audit trail must not be usable as evidence:
+        a stream whose ONLY anomaly is a chaos record diagnoses clean."""
+        recs = _healthy_stream(0)
+        recs.insert(3, {"kind": "chaos", "event": "fire",
+                        "fault": "kill", "chaos_rank": 0,
+                        "spec": "kill:op=x", "t": 100.5, "rank": 0})
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        _write_jsonl(tmp_path / "run.p1.jsonl", _healthy_stream(1))
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+
+    def test_pre_timeline_records_diagnose_as_nothing(self, tmp_path):
+        """Old JSONL without timestamps must not fabricate deaths."""
+        recs = [_manifest(0),
+                {"kind": "span", "op": "all_gather", "seconds": 0.5,
+                 "rank": 0}]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        _write_jsonl(tmp_path / "run.p1.jsonl", _healthy_stream(1))
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+
+
+class TestCli:
+    def test_expect_contract(self, tmp_path, capsys):
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     _healthy_stream(0, n_spans=10))
+        _write_jsonl(tmp_path / "run.p1.jsonl", [_manifest(1)] + [
+            _span(1, "allreduce", 100.0 + i) for i in range(3)
+        ])
+        base = str(tmp_path / "run.jsonl")
+        assert diagnose.main([base, "--expect", "missing_rank:1"]) == 0
+        assert "DOCTOR EXPECT OK" in capsys.readouterr().out
+        assert diagnose.main([base, "--expect", "missing_rank:0"]) == 2
+        assert diagnose.main([base, "--expect", "oom:1"]) == 2
+        assert diagnose.main([base, "--expect", "nonsense:1"]) == 2
+        capsys.readouterr()
+        # --json keeps stdout a parseable document: the expect status
+        # line moves to stderr
+        assert diagnose.main(
+            [base, "--json", "--expect", "missing_rank:1"]) == 0
+        cap = capsys.readouterr()
+        assert "DOCTOR EXPECT OK" in cap.err
+        doc = json.loads(cap.out)
+        assert doc["findings"][0]["class"] == "missing_rank"
+
+    def test_clean_exit_zero_findings_exit_one(self, clean_run, capsys):
+        base = str(clean_run / "run.jsonl")
+        assert diagnose.main([base]) == 0
+        assert "DOCTOR OK" in capsys.readouterr().out
+        # now break rank 1
+        _write_jsonl(clean_run / "run.p1.jsonl", [_manifest(1)] + [
+            _span(1, "allreduce", 100.0 + i) for i in range(3)
+        ])
+        assert diagnose.main([base]) == 1
+        out = capsys.readouterr().out
+        assert out.startswith("FINDING missing_rank: rank=1")
+        assert "evidence:" in out
+
+    def test_json_output(self, tmp_path, capsys):
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     _healthy_stream(0, n_spans=10))
+        _write_jsonl(tmp_path / "run.p1.jsonl", [_manifest(1)] + [
+            _span(1, "allreduce", 100.0 + i) for i in range(3)
+        ])
+        assert diagnose.main(
+            [str(tmp_path / "run.jsonl"), "--json"]
+        ) == 1
+        doc = json.loads(capsys.readouterr().out)
+        (f,) = doc["findings"]
+        assert f["kind"] == "finding"
+        assert f["class"] == "missing_rank" and f["rank"] == 1
+
+    def test_missing_input_exits_two(self, tmp_path, capsys):
+        assert diagnose.main([str(tmp_path / "nope.jsonl")]) == 2
+
+
+class TestReportSurfacing:
+    def test_diagnosis_line_in_report(self, tmp_path, capsys):
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     _healthy_stream(0, n_spans=10))
+        _write_jsonl(tmp_path / "run.p1.jsonl", [_manifest(1)] + [
+            _span(1, "allreduce", 100.0 + i) for i in range(3)
+        ])
+        rc = aggregate.main([str(tmp_path / "run.jsonl")])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert any(line.startswith("DIAGNOSIS missing_rank: rank=1")
+                   for line in out.splitlines())
+
+    def test_clean_report_has_no_diagnosis_lines(self, clean_run,
+                                                 capsys):
+        aggregate.main([str(clean_run / "run.jsonl")])
+        assert "DIAGNOSIS" not in capsys.readouterr().out
+
+    def test_incomplete_rank_set_note(self, tmp_path, capsys):
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     [_manifest(0, n=4)] + _healthy_stream(0)[1:])
+        _write_jsonl(tmp_path / "run.p1.jsonl",
+                     [_manifest(1, n=4)] + _healthy_stream(1)[1:])
+        aggregate.main([str(tmp_path / "run.jsonl")])
+        out = capsys.readouterr().out
+        assert "NOTE incomplete rank set (2 of 4 from manifest)" in out
+        assert "missing rank(s) 2,3" in out
+
+    def test_complete_rank_set_no_note(self, clean_run, capsys):
+        aggregate.main([str(clean_run / "run.jsonl")])
+        assert "incomplete rank set" not in capsys.readouterr().out
+
+    def test_diff_refuses_partial_baseline(self, tmp_path, capsys):
+        partial = tmp_path / "a"
+        partial.mkdir()
+        _write_jsonl(partial / "run.p0.jsonl",
+                     [_manifest(0, n=2)] + _healthy_stream(0)[1:])
+        full = tmp_path / "b"
+        full.mkdir()
+        _write_jsonl(full / "run.p0.jsonl", _healthy_stream(0))
+        _write_jsonl(full / "run.p1.jsonl", _healthy_stream(1))
+        rc = aggregate.main([
+            "--diff", str(partial / "run.jsonl"),
+            str(full / "run.jsonl"),
+        ])
+        captured = capsys.readouterr()
+        assert rc == 2
+        assert "partial-rank run" in captured.err
+        # the complete run IS a valid baseline; a partial B side is
+        # compared (what regressed before the crash?) — but never
+        # silently: the survivors-only coverage is a visible NOTE
+        rc = aggregate.main([
+            "--diff", str(full / "run.jsonl"),
+            str(partial / "run.jsonl"),
+        ])
+        captured = capsys.readouterr()
+        assert rc in (0, 1)
+        assert "DIFF NOTE candidate" in captured.out
+        assert "surviving ranks only" in captured.out
+
+    def test_trace_renders_finding_marker_on_culprit_rank(
+        self, tmp_path
+    ):
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     _healthy_stream(0, n_spans=10))
+        _write_jsonl(tmp_path / "run.p1.jsonl", [_manifest(1)] + [
+            _span(1, "allreduce", 100.0 + i) for i in range(3)
+        ])
+        doc = timeline.chrome_trace(_files(tmp_path))
+        marks = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "finding"]
+        assert len(marks) == 1
+        assert marks[0]["ph"] == "i" and marks[0]["s"] == "p"
+        assert marks[0]["pid"] == 1
+        assert marks[0]["name"] == "FINDING missing_rank"
+        assert marks[0]["args"]["confidence"] >= 0.85
+
+    def test_clean_trace_has_no_finding_markers(self, clean_run):
+        doc = timeline.chrome_trace(_files(clean_run))
+        assert not [e for e in doc["traceEvents"]
+                    if e.get("cat") == "finding"]

@@ -166,6 +166,54 @@ def test_tpumt_lint_runs_without_jax(tmp_path):
     assert 'tpumt-lint = "tpu_mpi_tests.analysis.cli:main"' in pyproject
 
 
+def test_tpumt_doctor_runs_without_jax(tmp_path):
+    """The tpumt-doctor console script must import, parse --help, AND
+    diagnose in a process where ``import jax`` raises — the login-node
+    contract tpumt-report/tpumt-trace/tpumt-lint already claim (the
+    doctor triages files copied OFF the pod)."""
+    import json as _json
+
+    def rec(lines, path):
+        path.write_text("".join(_json.dumps(r) + "\n" for r in lines))
+
+    span = lambda rank, t: {  # noqa: E731 — local literal builder
+        "kind": "span", "op": "allreduce", "world": 2,
+        "seconds": 0.01, "t_start": t, "t_end": t + 0.01, "rank": rank}
+    man = lambda rank: {  # noqa: E731
+        "kind": "manifest", "process_index": rank, "process_count": 2}
+    rec([man(0)] + [span(0, 100.0 + i) for i in range(10)]
+        + [{"kind": "telemetry_summary", "op": "x", "rank": 0},
+           {"kind": "mem", "event": "final", "t": 110.0}],
+        tmp_path / "run.p0.jsonl")
+    rec([man(1)] + [span(1, 100.0 + i) for i in range(3)],
+        tmp_path / "run.p1.jsonl")
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.instrument import diagnose\n"
+        "try:\n"
+        "    diagnose.main(['--help'])\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        f"base = {str(tmp_path / 'run.jsonl')!r}\n"
+        "assert diagnose.main([base]) == 1\n"
+        "assert diagnose.main([base, '--expect',\n"
+        "                      'missing_rank:1']) == 0\n"
+        "print('DOCTOR NOJAX OK')\n"
+    )
+    r = run_py(code)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DOCTOR NOJAX OK" in r.stdout
+    assert "FINDING missing_rank: rank=1" in r.stdout
+    pyproject = (REPO / "pyproject.toml").read_text()
+    assert ('tpumt-doctor = "tpu_mpi_tests.instrument.diagnose:main"'
+            in pyproject)
+
+
 def test_graft_dryrun_multichip():
     r = run_py(
         "import __graft_entry__ as g\n"

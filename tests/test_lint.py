@@ -33,6 +33,7 @@ FILE_FAMILIES = [
     ("TPM6", "tpm6"),
     ("TPM7", "tpm7"),
     ("TPM8", "tpm8"),
+    ("TPM10", "tpm10"),
 ]
 
 
@@ -308,6 +309,27 @@ def test_overlap_region_scoping(tmp_path):
     assert "TPM801" not in codes_of(lint_paths([str(p)]))
 
 
+def test_chaos_containment_scoping(tmp_path):
+    """TPM1001 beyond the goldens: a driver-shaped module touching the
+    chaos layer is a finding, while test modules are exempt (tests
+    exist to exercise the faults). The sanctioned arm-point and the
+    chaos package itself are proven exempt by the self-clean gate —
+    drivers/_common and tpu_mpi_tests/chaos both lint in-tree."""
+    src = (
+        "from tpu_mpi_tests.chaos import arm_from_spec\n"
+        "def run(args):\n"
+        "    arm_from_spec('kill:rank=1:op=x', rank=0)\n"
+    )
+    prod = tmp_path / "hotpath.py"
+    prod.write_text(src)
+    codes = codes_of(lint_paths([str(prod)]))
+    assert codes.count("TPM1001") == 2  # the import AND the call
+    for exempt_name in ("test_hotpath.py", "conftest.py"):
+        p = tmp_path / exempt_name
+        p.write_text(src)
+        assert "TPM1001" not in codes_of(lint_paths([str(p)]))
+
+
 def test_cli_human_output_and_exit_codes(capsys):
     rc = cli.main([str(FIXTURES / "tpm1_bad.py")])
     out = capsys.readouterr()
@@ -338,10 +360,11 @@ def test_cli_list_rules_covers_every_family(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     for code in ("TPM101", "TPM201", "TPM301", "TPM302", "TPM401",
-                 "TPM501", "TPM601", "TPM701", "TPM801", "TPM900"):
+                 "TPM501", "TPM601", "TPM701", "TPM801", "TPM900",
+                 "TPM1001"):
         assert code in out
     # table rows match the registry (README is hand-synced to this)
-    assert len(rule_table()) >= 9
+    assert len(rule_table()) >= 10
 
 
 def test_self_clean_gate():

@@ -554,6 +554,39 @@ def test_slo_table_summary_and_text(tmp_path, capsys):
     )
 
 
+def test_slo_table_surfaces_quarantine(tmp_path, capsys):
+    """A summary carrying quarantine accounting (serve
+    --quarantine-after graceful degradation) lands in the SLO row as
+    quarantines=/quar_s=; rows without it keep their exact shape."""
+    recs = _serve_records(2.0, 4.0, 8.0, requests=50, errors=9,
+                          shed=40)
+    recs[-1]["quarantines"] = 1
+    recs[-1]["quarantine_s"] = 12.5
+    n_windows = sum(1 for r in recs if r.get("event") == "window")
+    # lifecycle markers ride the same kind:"serve" stream but must not
+    # count as traffic windows in the row
+    lifecycle = [
+        {"kind": "serve", "event": "quarantine", "rank": 0,
+         "class": "daxpy:4096:float32", "t": 100.0},
+        {"kind": "serve", "event": "recover", "rank": 0,
+         "class": "daxpy:4096:float32", "t": 112.5,
+         "quarantine_s": 12.5},
+    ]
+    _write_jsonl(tmp_path / "s.p0.jsonl", [
+        {"kind": "manifest", "process_index": 0, "process_count": 1},
+        *recs[:-1], *lifecycle, recs[-1],
+    ])
+    s = aggregate.summarize([str(tmp_path / "s.p0.jsonl")])
+    sv = s["serve"]["daxpy:4096:float32"]
+    assert sv["quarantines"] == 1
+    assert sv["quarantine_s"] == pytest.approx(12.5)
+    assert sv["windows"] == n_windows
+    aggregate.main([str(tmp_path / "s.p0.jsonl")])
+    out = capsys.readouterr().out
+    (line,) = [ln for ln in out.splitlines() if ln.startswith("SLO ")]
+    assert "quarantines=1 quar_s=12.5" in line
+
+
 def test_slo_table_synthesized_from_windows(tmp_path):
     """A run that died before its summary still gets an SLO row from
     the window records alone."""

@@ -312,6 +312,142 @@ def test_loop_closed_persistent_failure_backs_off():
     assert summary["batches"] <= 5.0 / 0.05 + 5
 
 
+def test_loop_quarantine_isolates_dead_class():
+    """Graceful degradation: a handler class dead past N consecutive
+    failed batches is quarantined — its arrivals shed, the OTHER class
+    keeps its SLO — instead of error-spinning the whole run; the
+    summary carries the episode accounting."""
+    clk = FakeClock()
+    classes = parse_workload_table(
+        "daxpy:128:float32:1,allreduce:64:float32:1"
+    )
+    dead_key = classes[0].key
+    records = []
+
+    def dead(n):
+        clk.t += 0.001
+        raise RuntimeError("mesh lost")
+
+    def healthy(n):
+        clk.t += 0.001 * n
+
+    loop = ServeLoop(
+        classes, {classes[0].key: dead, classes[1].key: healthy},
+        OpenLoopPoisson(50.0, seed=0),
+        duration_s=8.0, window_s=2.0, max_queue=64,
+        sink=records.append, quarantine_after=3,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    summaries = {s["class"]: s for s in loop.run()}
+    quar = [r for r in records if r.get("event") == "quarantine"]
+    assert len(quar) == 1 and quar[0]["class"] == dead_key
+    assert quar[0]["consecutive_errors"] == 3
+    # exactly 3 failed batches, then isolation: arrivals shed instead
+    dead_sum = summaries[dead_key]
+    assert dead_sum["errors"] > 0 and dead_sum["shed"] > 0
+    assert dead_sum["requests"] == 0
+    # the still-open episode is charged to the summary at run end
+    assert dead_sum["quarantines"] == 1
+    assert dead_sum["quarantine_s"] > 0
+    # a never-recovering class's whole error/shed story is quarantine-
+    # attributed (the triggering streak + quarantine sheds), so the
+    # driver can forgive it all
+    assert dead_sum["quar_errors"] == dead_sum["errors"]
+    assert dead_sum["quar_shed"] == dead_sum["shed"]
+    # the healthy class never noticed
+    ok_sum = summaries[classes[1].key]
+    assert ok_sum["requests"] > 0 and ok_sum["errors"] == 0
+    assert "quarantines" not in ok_sum
+
+
+def test_loop_quarantine_probe_readmits_recovered_class():
+    """The window-boundary probe re-admits a recovered handler and the
+    recover record carries the downtime; the class serves again."""
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+    records = []
+
+    def flaky(n):  # dead until t=4, healthy after
+        clk.t += 0.001
+        if clk.t < 4.0:
+            raise RuntimeError("transient device loss")
+
+    loop = ServeLoop(
+        classes, {classes[0].key: flaky},
+        OpenLoopPoisson(50.0, seed=0),
+        duration_s=10.0, window_s=2.0, max_queue=64,
+        sink=records.append, quarantine_after=3,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    rec = [r for r in records if r.get("event") == "recover"]
+    assert len(rec) == 1 and rec[0]["downtime_s"] > 0
+    assert summary["quarantines"] == 1
+    assert summary["quarantine_s"] == pytest.approx(
+        rec[0]["downtime_s"])
+    assert summary["requests"] > 0  # served again after re-admission
+    # clean after recovery: every error belongs to the episode
+    assert summary["quar_errors"] == summary["errors"]
+    assert summary["quar_shed"] == summary["shed"]
+
+
+def test_loop_quarantine_attribution_excludes_later_failures():
+    """One recovered quarantine is not amnesty: errors from failures
+    OUTSIDE the quarantine streak (here, intermittent post-recovery
+    failures that never re-quarantine) stay unattributed, so the
+    driver still flags the run."""
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+    records = []
+    calls = [0]
+
+    def flaky(n):  # dead until t=4, then fails every other batch
+        clk.t += 0.001
+        if clk.t < 4.0:
+            raise RuntimeError("transient device loss")
+        calls[0] += 1
+        if calls[0] % 2:
+            raise RuntimeError("still sick")
+
+    loop = ServeLoop(
+        classes, {classes[0].key: flaky},
+        OpenLoopPoisson(50.0, seed=0),
+        duration_s=10.0, window_s=2.0, max_queue=64,
+        sink=records.append, quarantine_after=3,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    assert summary["quarantines"] == 1
+    # post-recovery failures accrued errors the episode does NOT cover
+    assert summary["errors"] > summary["quar_errors"] > 0
+
+
+def test_loop_quarantine_off_by_default():
+    """Without --quarantine-after the pre-quarantine behavior is
+    untouched: a dead class error-spins (bounded by the backoff) and
+    no quarantine records appear."""
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+    records = []
+
+    def dead(n):
+        clk.t += 0.001
+        raise RuntimeError("mesh lost")
+
+    loop = ServeLoop(
+        classes, {classes[0].key: dead},
+        OpenLoopPoisson(50.0, seed=0),
+        duration_s=6.0, window_s=2.0, max_queue=64,
+        sink=records.append,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    assert not [r for r in records
+                if r.get("event") in ("quarantine", "recover")]
+    assert "quarantines" not in summary
+    assert summary["errors"] > 0
+
+
 def test_loop_sheds_beyond_max_queue():
     clk = FakeClock()
     classes = parse_workload_table("daxpy:128:float32")
@@ -452,6 +588,48 @@ def test_serve_driver_end_to_end(serve_env, capsys):
     assert rc == 0
     assert any(ln.startswith("SLO daxpy:4096:float32:")
                for ln in rep.splitlines())
+
+
+def test_serve_driver_quarantine_exits_clean(serve_env, capsys,
+                                             monkeypatch):
+    """The graceful-degradation contract end to end: one class's
+    handler stays dead, --quarantine-after isolates it, the OTHER
+    class keeps serving, the SERVE QUARANTINE line surfaces the
+    episode, and the run exits 0 instead of rc-1-ing."""
+    from tpu_mpi_tests.drivers import _common, serve as drv
+
+    real_factory = _common.workload_factory
+
+    def patched(name):
+        if name == "daxpy":
+            def build(mesh, shape, dtype):
+                def dead_handler(n):
+                    raise RuntimeError("handler class stayed dead")
+                return dead_handler
+            return build
+        return real_factory(name)
+
+    monkeypatch.setattr(_common, "workload_factory", patched)
+    jl = serve_env / "quar.jsonl"
+    rc = drv.main([
+        "--duration", "2", "--arrival", "poisson", "--rate", "30",
+        "--seed", "5", "--report-interval", "0.5",
+        "--workloads", "daxpy:4096:float32:1,allreduce:512:float32:1",
+        "--quarantine-after", "2", "--jsonl", str(jl),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "SERVE QUARANTINE daxpy:4096:float32:" in out
+    assert "survived by the other classes" in out
+    recs = [json.loads(ln) for ln in jl.read_text().splitlines()]
+    quar = [r for r in recs if r.get("kind") == "serve"
+            and r.get("event") == "quarantine"]
+    assert quar and quar[0]["class"] == "daxpy:4096:float32"
+    # the healthy class genuinely served
+    ok = [r for r in recs if r.get("kind") == "serve"
+          and r.get("event") == "summary"
+          and r["class"] == "allreduce:512:float32"]
+    assert ok and ok[0]["requests"] > 0 and ok[0]["errors"] == 0
 
 
 def test_serve_driver_rejects_bad_table(serve_env, capsys):

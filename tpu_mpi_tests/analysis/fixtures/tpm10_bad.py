@@ -1,0 +1,14 @@
+"""TPM10xx bad: chaos fault injection reachable from driver-shaped
+code — an armed kill hook shipping inside a hot path."""
+
+from tpu_mpi_tests import chaos
+from tpu_mpi_tests.chaos import inject
+
+
+def run(args):
+    # lazy import is just as reachable — import timing is not the point
+    from tpu_mpi_tests.chaos.inject import arm_from_spec
+
+    arm_from_spec("kill:rank=1:op=allreduce", rank=0)
+    inject.disarm()
+    return chaos.armed()

@@ -1,0 +1,11 @@
+"""TPM10xx good: production code never touches the chaos package —
+observability hooks are rebound BY chaos at arm time, so the clean
+shape here is plain telemetry with no chaos import at all."""
+
+from tpu_mpi_tests.instrument import telemetry
+
+
+def run(args):
+    with telemetry.comm_span("allreduce", nbytes=1024):
+        pass
+    return 0

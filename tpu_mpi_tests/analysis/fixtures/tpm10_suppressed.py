@@ -1,0 +1,9 @@
+"""TPM10xx suppressed: a sanctioned embedder arming chaos outside
+make_reporter, with its why stated — e.g. a standalone soak harness
+that owns its own reporter wiring."""
+
+from tpu_mpi_tests.chaos import arm_from_spec  # tpumt: ignore[TPM1001]
+
+
+def soak(spec, rank):
+    return arm_from_spec(spec, rank)  # tpumt: ignore[TPM1001]

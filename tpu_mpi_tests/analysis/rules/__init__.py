@@ -6,6 +6,9 @@ comments stay meaningful across releases.
 """
 
 from tpu_mpi_tests.analysis.rules.axis_consistency import AxisConsistency
+from tpu_mpi_tests.analysis.rules.chaos_containment import (
+    ChaosContainment,
+)
 from tpu_mpi_tests.analysis.rules.concurrency import UnlockedSharedWrite
 from tpu_mpi_tests.analysis.rules.import_hygiene import ImportHygiene
 from tpu_mpi_tests.analysis.rules.overlap_regions import (
@@ -27,4 +30,5 @@ ALL_RULES = [
     UnlockedSharedWrite(),
     ScheduleConstants(),
     OverlapRegionSync(),
+    ChaosContainment(),
 ]

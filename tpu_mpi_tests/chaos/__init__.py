@@ -1,0 +1,47 @@
+"""Chaos layer: deterministic, env/flag-driven fault injection.
+
+The observability spine (telemetry spans, flight recorder, watchdog,
+memwatch, serve SLO records) exists to explain failures — and until this
+package, nothing in the repo ever *caused* one on purpose. ``chaos``
+closes the loop: ``--chaos <spec>`` / ``TPU_MPI_CHAOS`` arms seeded,
+deterministic faults (killed rank, straggler, wedged dispatch, OOM
+ramp, serve flood) inside the existing hooks, and ``tpumt-doctor``
+(``instrument/diagnose.py``) must then convict the right failure class
+on the right rank from the organic telemetry alone — CI enforces it
+(``make chaos-smoke``; README "Chaos & diagnosis").
+
+Containment: production code must never reach into this package. The
+only sanctioned arm-point is ``drivers/_common.make_reporter`` (lint
+rule TPM1001 enforces it), and a disarmed run installs zero chaos
+state — the hot paths are byte-identical to a build without this
+package.
+
+Re-exports resolve lazily (PEP 562): ``spec`` is stdlib-only, but
+``inject`` touches telemetry/timers at arm time and this package must
+stay importable (for spec parsing) everywhere the CLIs run.
+"""
+
+from __future__ import annotations
+
+_EXPORTS = {
+    "FaultSpec": "spec",
+    "FAULT_CLASSES": "spec",
+    "FINDING_FOR": "spec",
+    "parse_chaos_spec": "spec",
+    "arm": "inject",
+    "arm_from_spec": "inject",
+    "armed": "inject",
+    "disarm": "inject",
+}
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"tpu_mpi_tests.chaos.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

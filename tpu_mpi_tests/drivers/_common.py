@@ -169,6 +169,17 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         "measured warmup delta in README 'Autotuning'",
     )
     p.add_argument(
+        "--chaos",
+        default=None,
+        metavar="SPEC",
+        help="arm deterministic fault injection ($TPU_MPI_CHAOS when "
+        "absent): comma list of class[:key=value]* faults — kill / "
+        "straggler / wedge / oom / flood, e.g. "
+        "'kill:rank=1:op=halo_exchange:after=3' (grammar in README "
+        "'Chaos & diagnosis'); disarmed runs install zero chaos state "
+        "by construction",
+    )
+    p.add_argument(
         "--verbose", action="store_true", help="extra per-device reporting"
     )
     p.add_argument(
@@ -271,7 +282,45 @@ def make_reporter(args, rank: int = 0, size: int = 1):
             print("NOTE --memwatch needs --jsonl (mem records stream to "
                   "the JSONL sink); no memory records will be written")
     _attach_tune_sink(rep)
+    _arm_chaos(args, rep)
     return rep
+
+
+def _arm_chaos(args, rep) -> None:
+    """The ONE sanctioned chaos arm-point (lint rule TPM1001 fails any
+    other import of the chaos package outside tests): with ``--chaos``
+    or ``$TPU_MPI_CHAOS`` set, install the faults targeting this
+    process rank and audit them to the JSONL sink. Without a spec,
+    nothing is imported and nothing is installed — the disarmed run is
+    byte-identical to a build without the chaos layer."""
+    spec_text = getattr(args, "chaos", None) or os.environ.get(
+        "TPU_MPI_CHAOS"
+    )
+    if not spec_text:
+        return
+    import jax
+
+    from tpu_mpi_tests import chaos
+
+    try:
+        # fault targeting AND the audit records key on the TRUE
+        # process index, not rep.rank: meshless specs (daxpy) pass
+        # rank=0 to make_reporter in every process, which would make
+        # `rank=1` faults unarmable there — and would stamp rank 1's
+        # armed/fire records as rank 0 in the merged post-mortem
+        proc = jax.process_index()
+        mine = chaos.arm_from_spec(
+            spec_text, rank=proc,
+            emit=lambda rec: rep.jsonl({**rec, "rank": proc}),
+        )
+    except ValueError as e:
+        print(f"ERROR bad --chaos spec: {e}")
+        raise SystemExit(2) from None
+    for s in mine:
+        rep.line(f"CHAOS armed: {s.describe()}")
+        if s.op and not getattr(args, "telemetry", False):
+            rep.line(f"NOTE chaos fault {s.raw!r} triggers on telemetry "
+                     f"spans but --telemetry is off; it will never fire")
 
 
 def _attach_tune_sink(rep) -> None:

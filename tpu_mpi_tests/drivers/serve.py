@@ -110,6 +110,7 @@ def run(args) -> int:
             seed=args.seed,
             sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}),
             watchdog=wd,
+            quarantine_after=args.quarantine_after,
         )
         summaries = loop.run()
 
@@ -119,6 +120,9 @@ def run(args) -> int:
                 v = rec.get(field)
                 return "-" if v is None else format(v, ".4g")
 
+            quar = (f" quarantines={rec['quarantines']} "
+                    f"quar_s={rec['quarantine_s']:.4g}"
+                    if rec.get("quarantines") else "")
             rep.line(
                 f"SERVE {rec['class']}: "
                 f"offered={rec['offered_hz']:.4g}/s "
@@ -126,8 +130,28 @@ def run(args) -> int:
                 f"n={rec['requests']} err={rec['errors']} "
                 f"shed={rec['shed']} p50={ms('p50_ms')}ms "
                 f"p95={ms('p95_ms')}ms p99={ms('p99_ms')}ms "
-                f"qmax={rec['queue_max']}"
+                f"qmax={rec['queue_max']}{quar}"
             )
+            if rec.get("quarantines"):
+                # graceful degradation worked as designed: the dead
+                # class was isolated and accounted instead of failing
+                # the whole run — surface it loudly, and forgive
+                # exactly the errors/sheds the quarantine accounts
+                # for (the triggering streaks + quarantine-dropped
+                # load). Failures OUTSIDE those episodes still rc-1:
+                # one recovered quarantine is not amnesty for a class
+                # that kept failing afterwards.
+                rep.line(
+                    f"SERVE QUARANTINE {rec['class']}: "
+                    f"{rec['quarantines']} episode(s), "
+                    f"{rec['quarantine_s']:.4g}s quarantined "
+                    f"(err={rec['errors']} shed={rec['shed']} "
+                    f"survived by the other classes)"
+                )
+                if (rec["errors"] > rec.get("quar_errors", 0)
+                        or rec["shed"] > rec.get("quar_shed", 0)):
+                    rc = 1
+                continue
             if rec["errors"] or rec["shed"]:
                 rc = 1
             if rec["arrivals"] and not rec["requests"]:
@@ -198,6 +222,16 @@ def main(argv=None) -> int:
         "cross-window spread is the --diff noise band",
     )
     p.add_argument(
+        "--quarantine-after", type=int, default=None, metavar="N",
+        help="graceful degradation: a class whose handler fails N "
+        "consecutive batches is quarantined (arrivals shed, backlog "
+        "dropped, the other classes keep serving) and probed for "
+        "recovery at each window boundary; quarantine/recovery time "
+        "lands in the SLO table instead of the whole run exiting 1 "
+        "(closed-loop note: requests shed during quarantine thin the "
+        "client population like any shed). Default: off",
+    )
+    p.add_argument(
         "--batch-deadline", type=float, default=None, metavar="S",
         help="idle-aware watchdog: hard-exit if one BATCH exceeds S "
         "seconds (armed only around active dispatch — idle gaps "
@@ -217,6 +251,8 @@ def main(argv=None) -> int:
         p.error("--report-interval must be positive")
     if args.max_queue < 1:
         p.error("--max-queue must be >= 1")
+    if args.quarantine_after is not None and args.quarantine_after < 1:
+        p.error("--quarantine-after must be >= 1 (omit to disable)")
     if args.batch_deadline is not None and args.batch_deadline <= 0:
         # a negative Timer fires immediately: the first batch would die
         # with a bogus "hung collective" diagnosis

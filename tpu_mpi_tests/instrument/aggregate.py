@@ -95,23 +95,12 @@ def expand_rank_files(paths: list[str]) -> list[str]:
 
 
 def _load_records(path: str) -> list[dict]:
-    records = []
-    try:
-        text = Path(path).read_text()
-    except OSError as e:
-        print(f"tpumt-report: cannot open {path}: {e}", file=sys.stderr)
-        return records
-    for line in text.splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(rec, dict):
-            records.append(rec)
-    return records
+    """One parser for the record format repo-wide: delegates to
+    diagnose.load_with_lines (lazy import — diagnose imports this
+    module) and drops the line numbers."""
+    from tpu_mpi_tests.instrument.diagnose import load_with_lines
+
+    return [r for _, r in load_with_lines(path, prog="tpumt-report")]
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -177,10 +166,18 @@ def _merge_mem(memory: dict, rec: dict, rank) -> None:
             }
 
 
-def summarize(files: list[str]) -> dict:
-    """Merge per-rank record streams into the summary structure."""
+def summarize(
+    files: list[str],
+    loaded: dict[str, list[tuple[int, dict]]] | None = None,
+) -> dict:
+    """Merge per-rank record streams into the summary structure.
+    ``loaded`` is pre-parsed ``diagnose.load_with_lines`` output so
+    ``main`` parses each file once for both the report and the
+    DIAGNOSIS table."""
     manifest = None
     manifests = 0
+    rank_indices: set = set()
+    expected_ranks = 0
     phases: dict[str, dict] = {}
     ops: dict[str, dict] = {}
     tuning: dict[str, dict] = {}
@@ -196,11 +193,18 @@ def summarize(files: list[str]) -> dict:
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
-        for rec in _load_records(path):
+        pairs = (loaded or {}).get(path)
+        records = ([r for _, r in pairs] if pairs is not None
+                   else _load_records(path))
+        for rec in records:
             kind = rec.get("kind")
             if kind == "manifest":
                 manifests += 1
                 file_rank = rec.get("process_index", file_rank)
+                rank_indices.add(file_rank)
+                expected_ranks = max(
+                    expected_ranks, int(rec.get("process_count") or 0)
+                )
                 if manifest is None or rec.get("process_index") == 0:
                     manifest = rec
             elif kind == "time":
@@ -387,7 +391,11 @@ def summarize(files: list[str]) -> dict:
                 if rec.get("event") == "summary":
                     # last summary per rank wins (append-mode reruns)
                     sv["summaries"][rank] = rec
-                else:
+                elif rec.get("event") == "window":
+                    # quarantine/recover event records are lifecycle
+                    # markers, not traffic windows — counting them
+                    # here would inflate windows= and pollute the
+                    # crashed-rank synthesis path
                     sv["windows"].append(dict(rec, rank=rank))
 
     def _stats(per_rank: dict) -> dict:
@@ -413,6 +421,17 @@ def summarize(files: list[str]) -> dict:
         "files": list(files),
         "manifest": manifest,
         "manifest_count": manifests,
+        # rank-set completeness: which manifest ranks the merged file
+        # set actually covers — a crashed rank whose file is missing
+        # must be a visible NOTE (and a refused --diff baseline), not
+        # a silently shrunk noise band
+        "rank_set": {
+            "expected": expected_ranks,
+            "seen": sorted(rank_indices),
+            "missing": sorted(
+                set(range(expected_ranks)) - rank_indices
+            ),
+        },
         "phases": {},
         "ops": {},
         "tuning": {name: tuning[name] for name in sorted(tuning)},
@@ -599,6 +618,15 @@ def _serve_row(sv: dict) -> dict:
     }
     for k in ("arrivals", "requests", "errors", "shed", "batches"):
         row[k] = sum(int(r.get(k) or 0) for r in rows)
+    # graceful-degradation accounting (serve --quarantine-after): how
+    # many episodes the class spent quarantined and for how long —
+    # keys absent on pre-quarantine streams so old rows keep shape
+    quarantines = sum(int(r.get("quarantines") or 0) for r in rows)
+    if quarantines:
+        row["quarantines"] = quarantines
+        row["quarantine_s"] = sum(
+            float(r.get("quarantine_s") or 0.0) for r in rows
+        )
     for k in ("offered_hz", "achieved_hz"):
         row[k] = sum(float(r.get(k) or 0.0) for r in rows)
     for k in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
@@ -644,7 +672,8 @@ def _roofline_join(c: dict, label: str, ops: dict, phases: dict) -> dict:
     return out
 
 
-def _print_text(summary: dict, skew_threshold: float) -> None:
+def _print_text(summary: dict, skew_threshold: float,
+                findings: list | None = None) -> None:
     m = summary["manifest"]
     if m:
         kinds = ",".join(m.get("device_kinds", []))
@@ -655,6 +684,15 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
         )
         print(f"ARGV {' '.join(m.get('argv', []))}")
     print(f"FILES {len(summary['files'])}: {' '.join(summary['files'])}")
+    rank_set = summary.get("rank_set") or {}
+    if rank_set.get("missing"):
+        missing = ",".join(str(r) for r in rank_set["missing"])
+        print(
+            f"NOTE incomplete rank set ({len(rank_set['seen'])} of "
+            f"{rank_set['expected']} from manifest): missing rank(s) "
+            f"{missing} — cross-rank stats and noise bands cover the "
+            f"survivors only"
+        )
 
     for name, ph in summary["phases"].items():
         print(
@@ -681,6 +719,11 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
             v = sv.get(key)
             return "-" if v is None else format(v, ".4g")
 
+        quar = (
+            f" quarantines={sv['quarantines']}"
+            f" quar_s={sv['quarantine_s']:.4g}"
+            if sv.get("quarantines") else ""
+        )
         print(
             f"SLO {cls}: ranks={sv['ranks']} "
             f"offered={sv['offered_hz']:.4g}/s "
@@ -688,7 +731,7 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
             f"n={sv['requests']} err={sv['errors']} shed={sv['shed']} "
             f"p50={ms('p50_ms')}ms p95={ms('p95_ms')}ms "
             f"p99={ms('p99_ms')}ms qmax={sv['queue_max']} "
-            f"windows={sv['windows']}"
+            f"windows={sv['windows']}{quar}"
         )
 
     for op, rt in summary.get("route", {}).items():
@@ -774,6 +817,19 @@ def _print_text(summary: dict, skew_threshold: float) -> None:
                 )
     if not stragglers:
         print(f"OK no stragglers above {skew_threshold:g}x")
+
+    # DIAGNOSIS table (instrument/diagnose.py — the tpumt-doctor
+    # rules over the same merged records): printed only when a rule
+    # convicted, so clean runs and pre-chaos streams keep their exact
+    # report shape
+    for f in findings or []:
+        print(
+            f"DIAGNOSIS {f['class']}: rank={f['rank']} "
+            f"confidence={f['confidence']:.2f}"
+            + (f" last_op={f['last_op']}" if f.get("last_op") else "")
+            + (f" phase={f['phase']}" if f.get("phase") else "")
+            + f" — {f['detail']}"
+        )
 
 
 def _print_memory(memory: dict) -> None:
@@ -889,7 +945,10 @@ def _jsonl_metrics(files: list[str]) -> dict[str, dict]:
     """Per-phase / per-op / memory metrics of one JSONL run. The noise
     band of a phase/op is its cross-rank spread (half the max−min over
     the mean); bandwidth uses the p10–p90 spread over p50."""
-    s = summarize(files)
+    return _metrics_from_summary(summarize(files))
+
+
+def _metrics_from_summary(s: dict) -> dict[str, dict]:
     out: dict[str, dict] = {}
 
     def rank_band(st) -> float:
@@ -997,12 +1056,15 @@ def _jsonl_metrics(files: list[str]) -> dict[str, dict]:
     return out
 
 
-def _side_metrics(path: str) -> tuple[str, dict[str, dict]]:
+def _side_metrics(
+    path: str,
+) -> tuple[str, dict[str, dict], dict | None]:
     bench = _load_bench_doc(path)
     if bench is not None:
-        return "bench", _bench_metrics(bench)
+        return "bench", _bench_metrics(bench), None
     files = [f for f in expand_rank_files([path]) if Path(f).exists()]
-    return "jsonl", _jsonl_metrics(files)
+    s = summarize(files)
+    return "jsonl", _metrics_from_summary(s), s.get("rank_set")
 
 
 def diff_main(path_a: str, path_b: str, threshold: float = 0.05) -> int:
@@ -1010,9 +1072,32 @@ def diff_main(path_a: str, path_b: str, threshold: float = 0.05) -> int:
     noise band — the larger of either side's cross-sample/cross-rank
     band and the ``--diff-threshold`` floor. Returns 1 when any flagged
     change is a *regression* (slower / less bandwidth / more memory),
-    0 otherwise."""
-    kind_a, a = _side_metrics(path_a)
-    kind_b, b = _side_metrics(path_b)
+    0 otherwise; 2 when the baseline is a partial-rank run (a crashed
+    rank must not silently shrink the noise band a gate trusts)."""
+    kind_a, a, ranks_a = _side_metrics(path_a)
+    kind_b, b, ranks_b = _side_metrics(path_b)
+    if ranks_a and ranks_a.get("missing"):
+        print(
+            f"DIFF ERROR baseline {path_a} is a partial-rank run "
+            f"({len(ranks_a['seen'])} of {ranks_a['expected']} rank "
+            f"files; missing "
+            f"{','.join(str(r) for r in ranks_a['missing'])}) — a "
+            f"crashed rank's survivors are not a baseline; re-run or "
+            f"pick a complete run",
+            file=sys.stderr,
+        )
+        return 2
+    if ranks_b and ranks_b.get("missing"):
+        # a partial CANDIDATE is still worth diffing (what regressed
+        # before the crash?) but never silently: its bands cover the
+        # survivors only
+        print(
+            f"DIFF NOTE candidate {path_b} is a partial-rank run "
+            f"({len(ranks_b['seen'])} of {ranks_b['expected']} rank "
+            f"files; missing "
+            f"{','.join(str(r) for r in ranks_b['missing'])}) — "
+            f"metrics and noise bands cover the surviving ranks only"
+        )
     print(f"DIFF A={path_a} ({kind_a}) B={path_b} ({kind_b})")
     if kind_a != kind_b:
         print("DIFF NOTE comparing different input kinds; only shared "
@@ -1135,12 +1220,21 @@ def main(argv: list[str] | None = None) -> int:
         for line in ascii_swimlane(files, width=max(args.width, 8)):
             print(line)
         return 0
-    summary = summarize(files)
+    # DIAGNOSIS table: the tpumt-doctor rules over the same files
+    # (lazy import; diagnose imports this module). One parse feeds
+    # both consumers. Best-effort by contract — diagnose_files never
+    # raises.
+    from tpu_mpi_tests.instrument.diagnose import (diagnose_files,
+                                                   load_with_lines)
+
+    loaded = {p: load_with_lines(p, prog="tpumt-report") for p in files}
+    summary = summarize(files, loaded=loaded)
+    findings = diagnose_files(files, loaded=loaded)
     if args.json:
-        json.dump(summary, sys.stdout, indent=1)
+        json.dump(dict(summary, findings=findings), sys.stdout, indent=1)
         print()
     else:
-        _print_text(summary, args.skew_threshold)
+        _print_text(summary, args.skew_threshold, findings)
     return 0
 
 

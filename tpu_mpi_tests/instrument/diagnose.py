@@ -1,0 +1,729 @@
+"""``tpumt-doctor``: root-cause triage over merged per-rank JSONL.
+
+The observability spine records everything a post-mortem needs — spans,
+phases, memory watermarks, watchdog fires, dispatch notes, serve
+windows — but until this module a human had to read four tables and a
+Perfetto trace to answer "which rank, which op, why". The doctor closes
+that loop: given the per-rank file set of one run (the auto-suffixed
+``out.p<i>.jsonl`` files, or explicit paths) it applies cross-rank
+rules and emits structured ``kind: "finding"`` verdicts — failure
+class, culprit rank, last op + phase, evidence record refs, and a
+confidence — exactly one per convicted rank.
+
+Failure classes and the signals that convict them:
+
+* ``missing_rank`` — a rank present in the run's manifest whose record
+  stream ends without its close markers (the memwatch ``final`` record
+  / the ``telemetry_summary`` flush) while siblings kept recording past
+  it — the killed-peer signature. A rank file absent from the set
+  entirely is the strongest form.
+* ``straggler`` — a phase whose per-rank seconds skew past the
+  threshold names the SLOW rank; a *collective* op whose span seconds
+  skew names the FAST rank — in a sync-honest collective the waiters
+  absorb the straggler's lateness, so the rank that never waits is the
+  culprit (the inversion is deliberate and documented in the finding).
+* ``wedge`` — a dispatch note (``kind: "dispatch"`` — an op handed to
+  the device) with no span closing after it, followed by a watchdog
+  fire on the same rank: the op never completed.
+* ``oom`` — a monotone ``bytes_in_use``/``live_bytes`` ramp in the
+  rank's memory records crossing a fraction of ``hbm_bytes_limit``
+  (census-only backends: a sustained growth ratio) before the stream
+  dies.
+* ``shed_storm`` — serve windows with shed ≫ 0 against the offered
+  load: the queue bound is doing the dropping, not the handlers.
+  Classes under quarantine (serve ``--quarantine-after`` graceful
+  degradation, a designed isolation with its own records) are exempt.
+
+The doctor convicts from the ORGANIC telemetry only: ``kind: "chaos"``
+injection-audit records are deliberately ignored, so the chaos-smoke
+(``make chaos-smoke``) genuinely proves the diagnosis, not the audit
+trail. Pure stdlib (no jax import): usable on a login node against
+files copied off the pod, same contract as tpumt-report/tpumt-trace.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tpu_mpi_tests.instrument.aggregate import expand_rank_files
+
+#: the classes a finding can carry (the chaos smoke maps injected
+#: faults onto them via tpu_mpi_tests.chaos.spec.FINDING_FOR)
+FINDING_CLASSES = ("missing_rank", "straggler", "wedge", "oom",
+                   "shed_storm")
+
+#: conviction thresholds — deliberately stricter than tpumt-report's
+#: reporting bands (1.5x skew): the report flags for a human to read,
+#: the doctor CONVICTS, and a clean run must yield zero findings
+DEFAULTS = {
+    "skew_threshold": 2.0,   # phase/op skew for a straggler verdict
+    "margin_s": 0.25,        # absolute seconds behind the fastest rank
+    "min_calls": 5,          # phase/op entries per rank before judging
+    "gap_s": 1.0,            # seconds siblings progressed past a death
+    "ramp_ratio": 3.0,       # census-only oom growth factor
+    "limit_frac": 0.5,       # oom: fraction of hbm_bytes_limit crossed
+    "shed_min": 10,          # serve sheds before a storm verdict
+}
+
+
+def _rec_t(rec: dict):
+    for key in ("t", "t_end", "time_unix", "t_start"):
+        v = rec.get(key)
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+class _Stream:
+    """One rank's record stream plus the digests every rule shares."""
+
+    def __init__(self, rank: int, path: str,
+                 records: list[tuple[int, dict]]):
+        self.rank = rank
+        self.path = path
+        self.records = records
+        self.spans = [(ln, r) for ln, r in records
+                      if r.get("kind") == "span"]
+        self.dispatches = [(ln, r) for ln, r in records
+                           if r.get("kind") == "dispatch"]
+        self.watchdogs = [(ln, r) for ln, r in records
+                          if r.get("kind") == "watchdog"]
+        self.mems = [(ln, r) for ln, r in records
+                     if r.get("kind") == "mem"]
+        self.serves = [(ln, r) for ln, r in records
+                       if r.get("kind") == "serve"]
+        self.times = [(ln, r) for ln, r in records
+                      if r.get("kind") == "time"]
+        ts = [t for _, r in records if (t := _rec_t(r)) is not None]
+        self.last_t = max(ts) if ts else None
+        # close markers: the memwatch final census and the telemetry
+        # counter flush are both emitted by Reporter.close — a stream
+        # that recorded through either channel but lacks its marker
+        # belongs to a process that never reached a clean close
+        has_mem_final = any(r.get("event") == "final"
+                            for _, r in self.mems)
+        has_summary = any(r.get("kind") == "telemetry_summary"
+                          for _, r in records)
+        self.died = bool(
+            (self.mems and not has_mem_final)
+            or (self.spans and not has_summary)
+        )
+
+    def ref(self, ln: int, rec: dict) -> str:
+        t = _rec_t(rec)
+        extra = f" t={t:.3f}" if t is not None else ""
+        kind = rec.get("kind")
+        name = rec.get("op") or rec.get("phase") or rec.get("note") \
+            or rec.get("event") or ""
+        return f"{self.path}:{ln}: {kind} {name}{extra}".rstrip()
+
+    def last_activity(self) -> tuple[str | None, str | None]:
+        """(last op, last phase) the stream witnessed — the dying
+        rank's attribution line."""
+        op = None
+        for ln, r in reversed(self.records):
+            if r.get("kind") in ("span", "dispatch"):
+                op = r.get("op") or r.get("note")
+                break
+        phase = None
+        for ln, r in reversed(self.records):
+            if r.get("kind") == "mem" and r.get("phase"):
+                phase = r["phase"]
+                break
+            if r.get("kind") == "time" and r.get("phase"):
+                phase = r["phase"]
+                break
+        return op, phase
+
+
+def load_with_lines(path: str,
+                    prog: str = "tpumt-doctor") -> list[tuple[int, dict]]:
+    """``[(line_number, record)]`` for one JSONL file — the canonical
+    single-parse form: line numbers feed the evidence refs, and
+    tpumt-report/tpumt-trace load through this once and hand the result
+    to both their own merge and :func:`diagnose_files`, so a report or
+    trace never parses its inputs twice."""
+    out: list[tuple[int, dict]] = []
+    try:
+        text = Path(path).read_text()
+    except OSError as e:
+        print(f"{prog}: cannot open {path}: {e}", file=sys.stderr)
+        return out
+    for i, line in enumerate(text.splitlines(), start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            out.append((i, rec))
+    return out
+
+
+def _choose_segment(
+    records: list[tuple[int, dict]],
+    run_sync_us: int | None = None,
+) -> list[tuple[int, dict]]:
+    """Append-mode JSONL holds several runs back to back; like the
+    trace merger, diagnose one run's segment (each run starts with its
+    manifest): the one carrying ``run_sync_us``'s ``clock_sync`` stamp
+    when given — so trace finding markers land on the SAME run the
+    trace renders — else the newest."""
+    segments: list[list[tuple[int, dict]]] = [[]]
+    for ln, rec in records:
+        if rec.get("kind") == "manifest" and segments[-1]:
+            segments.append([])
+        segments[-1].append((ln, rec))
+    if run_sync_us is not None:
+        for seg in segments:
+            for _ln, rec in seg:
+                if rec.get("kind") == "clock_sync":
+                    if rec.get("run_sync_us") == run_sync_us:
+                        return seg
+                    break
+    return segments[-1]
+
+
+def load_streams(
+    files: list[str],
+    loaded: dict[str, list[tuple[int, dict]]] | None = None,
+    run_sync_us: int | None = None,
+) -> tuple[list[_Stream], dict]:
+    """Per-rank streams (rank = manifest ``process_index``, file order
+    fallback) plus the run-level context: the rank-0 manifest and the
+    expected process count. ``loaded`` maps paths to already-parsed
+    :func:`load_with_lines` output so co-resident CLIs skip a second
+    parse; ``run_sync_us`` selects that run's segment in append-mode
+    files (newest otherwise)."""
+    streams: list[_Stream] = []
+    manifest: dict = {}
+    expected = 0
+    for idx, path in enumerate(files):
+        pairs = (loaded or {}).get(path)
+        if pairs is None:
+            pairs = load_with_lines(path)
+        records = _choose_segment(pairs, run_sync_us)
+        # the chaos layer's injection-audit records are stripped before
+        # any rule sees them: the diagnosis must convict from the
+        # organic telemetry alone, or chaos-smoke proves only that the
+        # audit trail works
+        records = [(ln, r) for ln, r in records
+                   if r.get("kind") != "chaos"]
+        rank = idx
+        for _ln, rec in records:
+            if rec.get("kind") == "manifest":
+                rank = rec.get("process_index", idx)
+                expected = max(expected, int(rec.get("process_count")
+                                             or 0))
+                if not manifest or rec.get("process_index") == 0:
+                    manifest = rec
+        streams.append(_Stream(rank, path, records))
+    return streams, {"manifest": manifest, "expected": expected}
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+def _finding(cls: str, rank, confidence: float, detail: str,
+             evidence: list[str], last_op=None, phase=None,
+             t=None) -> dict:
+    return {
+        "kind": "finding",
+        "class": cls,
+        "rank": rank,
+        "confidence": round(float(confidence), 2),
+        "last_op": last_op,
+        "phase": phase,
+        "t": t,
+        "detail": detail,
+        "evidence": evidence[:6],
+    }
+
+
+def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
+    """Wedge > oom > missing_rank, exactly one verdict for a dead
+    rank. Returns None when the stream carries no timestamped evidence
+    to judge (pre-timeline JSONL must diagnose as nothing, not as a
+    death)."""
+    if s.last_t is None:
+        return None
+    # -- wedge: a dispatched op that never completed, then the watchdog
+    if s.dispatches and s.watchdogs:
+        ln_d, disp = s.dispatches[-1]
+        t_d = _rec_t(disp)
+        wd = [(ln, r) for ln, r in s.watchdogs
+              if (_rec_t(r) or 0) >= (t_d or 0)]
+        progressed = [
+            (ln, r) for ln, r in s.spans
+            if t_d is not None and (r.get("t_end") or 0) > t_d
+        ]
+        if wd and not progressed:
+            ln_w, wrec = wd[-1]
+            op, phase = s.last_activity()
+            return _finding(
+                "wedge", s.rank, 0.9,
+                f"dispatch {disp.get('note') or disp.get('op')!r} never "
+                f"completed: no span closed after it and the watchdog "
+                f"fired {((_rec_t(wrec) or 0) - (t_d or 0)):.1f}s later "
+                f"(phase {wrec.get('phase')!r}, deadline "
+                f"{wrec.get('deadline_s')}s)",
+                [s.ref(ln_d, disp), s.ref(ln_w, wrec)],
+                last_op=disp.get("op") or disp.get("note"), phase=phase,
+                t=_rec_t(wrec),
+            )
+    if not s.died:
+        return None
+    # -- oom: a monotone memory ramp before death
+    series = [
+        (ln, r, r.get("bytes_in_use", r.get("live_bytes")))
+        for ln, r in s.mems
+        if isinstance(r.get("bytes_in_use", r.get("live_bytes")),
+                      (int, float))
+    ]
+    if len(series) >= 4:
+        vals = [v for _, _, v in series]
+        # the ramp must still be setting NEW HIGHS at death: every
+        # process allocates its working set at startup (a "ramp" from
+        # ~0), so growth alone convicts every killed rank — genuine
+        # OOM pressure is growth that never stopped. Judged on the
+        # running-max envelope, not pairwise monotonicity: the series
+        # interleaves the sampler thread (live census, which catches
+        # transient allocation temporaries) with phase-boundary
+        # records, so a terminal dip of a few percent is measurement
+        # jitter, not recovery. The peak's FIRST index is what dates
+        # the last new high — a plateau held until death repeats the
+        # peak value without ever climbing.
+        peak = max(vals)
+        peak_idx = min(i for i, v in enumerate(vals) if v == peak)
+        tail_climbing = (
+            peak_idx >= len(vals) - 3           # a new high near death
+            and vals[-1] >= 0.75 * peak         # pressure held to the end
+            and peak >= vals[max(0, len(vals) - 6)] * 1.1  # tail grew
+        )
+        growth = peak / max(vals[0], 1)
+        limit = (s_manifest_limit(s) or 0)
+        crossed = limit and peak >= opts["limit_frac"] * limit
+        # the census-only growth fallback (no allocator limit to cross)
+        # additionally demands the pressure be DISTINCTIVE: a surviving
+        # sibling that reached the same watermark and closed cleanly
+        # proves that watermark is the workload's working set, not a
+        # runaway — a rank killed the instant its startup ramp tops out
+        # must convict as missing_rank, not oom
+        sib_peaks = [
+            p for o in streams
+            if o is not s and not o.died
+            and (p := _mem_peak(o)) is not None
+        ]
+        runaway = not any(p >= 0.9 * peak for p in sib_peaks)
+        if tail_climbing and (
+            crossed or (growth >= opts["ramp_ratio"] and runaway)
+        ):
+            op, phase = s.last_activity()
+            ln0, r0, v0 = series[0]
+            ln1, r1, _v1 = series[peak_idx]
+            why = (f"crossed {opts['limit_frac']:g} of hbm_bytes_limit "
+                   f"{limit}" if crossed else
+                   f"grew {growth:.1f}x (census-only backend, no "
+                   f"allocator limit)")
+            return _finding(
+                "oom", s.rank, 0.9 if crossed else 0.7,
+                f"monotone memory ramp {v0} -> {peak} bytes over "
+                f"{len(vals)} records {why}, then the stream died "
+                f"without its close markers",
+                [s.ref(ln0, r0), s.ref(ln1, r1)],
+                last_op=op, phase=phase, t=_rec_t(r1),
+            )
+    # -- missing rank: the stream just stops while siblings progress
+    sibs = [o for o in streams if o is not s and o.last_t is not None]
+    if sibs:
+        latest = max(o.last_t for o in sibs)
+        progressed = [
+            o for o in sibs
+            if sum(1 for _, r in o.records
+                   if (_rec_t(r) or 0) > s.last_t) >= 2
+        ]
+        if latest - s.last_t >= opts["gap_s"] and progressed:
+            op, phase = s.last_activity()
+            conf = 0.85
+            ev = [s.ref(*s.records[-1])]
+            for o in progressed[:1]:
+                if o.watchdogs:
+                    conf = 0.95  # a sibling hung waiting for this rank
+                    ev.append(o.ref(*o.watchdogs[-1]))
+            return _finding(
+                "missing_rank", s.rank, conf,
+                f"rank {s.rank} recorded nothing after "
+                f"t={s.last_t:.3f} while {len(progressed)} sibling "
+                f"rank(s) kept recording {latest - s.last_t:.1f}s "
+                f"longer, and its stream has no close markers",
+                ev, last_op=op, phase=phase, t=s.last_t,
+            )
+    # a lone truncated stream stays unconvicted: without siblings (or
+    # wedge/oom evidence above) a kill is indistinguishable from a
+    # user interrupt — the missing-rank rule is a CROSS-rank rule by
+    # definition
+    return None
+
+
+def _mem_peak(s: _Stream) -> int | float | None:
+    vals = [
+        v for _, r in s.mems
+        if isinstance(v := r.get("bytes_in_use", r.get("live_bytes")),
+                      (int, float))
+    ]
+    return max(vals) if vals else None
+
+
+def s_manifest_limit(s: _Stream) -> int | None:
+    for _ln, r in s.records:
+        if r.get("kind") == "manifest":
+            v = r.get("hbm_bytes_limit")
+            if isinstance(v, (int, float)):
+                return int(v)
+    return None
+
+
+def _straggler_findings(streams: list[_Stream], opts) -> list[dict]:
+    """Cross-rank skew over phases (slowest rank convicts) and
+    collective ops (FASTEST rank convicts — sync-honest collective
+    spans charge the wait to whoever arrived early, so the rank that
+    never waits is the one everyone waited for)."""
+    alive = [s for s in streams if not s.died]
+    if len(alive) < 2:
+        return []
+    by_rank: dict = {}
+
+    def judge(table: dict, invert: bool, what: str, conf: float):
+        for name, per_rank in table.items():
+            if len(per_rank) < len(alive):
+                continue
+            if any(c < opts["min_calls"] for _s, c in per_rank.values()):
+                continue
+            secs = {r: v for r, (v, _c) in per_rank.items() if v > 0}
+            if len(secs) < 2:
+                continue
+            worst = max(secs, key=secs.get)
+            best = min(secs, key=secs.get)
+            skew = secs[worst] / secs[best]
+            margin = secs[worst] - secs[best]
+            if skew <= opts["skew_threshold"] or margin <= opts["margin_s"]:
+                continue
+            culprit = best if invert else worst
+            entry = by_rank.setdefault(
+                culprit, {"conf": conf, "items": [],
+                          "first": (what, name)})
+            entry["conf"] = max(entry["conf"], conf)
+            entry["items"].append(
+                f"{what} {name}: rank {worst} spent {secs[worst]:.3g}s "
+                f"vs rank {best}'s {secs[best]:.3g}s "
+                f"({skew:.2g}x)" + (
+                    " — collective spans invert: the fast rank is the "
+                    "late arriver" if invert else "")
+            )
+
+    phases: dict = {}
+    for s in alive:
+        for _ln, r in s.times:
+            name = r.get("phase")
+            if not name:
+                continue
+            secs = float(r.get("seconds") or 0.0)
+            count = int(r.get("count") or 1)
+            tot, cnt = phases.setdefault(name, {}).get(s.rank, (0.0, 0))
+            phases[name][s.rank] = (tot + secs, cnt + count)
+    judge(phases, invert=False, what="phase", conf=0.8)
+
+    ops: dict = {}
+    for s in alive:
+        for _ln, r in s.spans:
+            # collective spans only (world >= 2): a local op's per-rank
+            # asymmetry is load, not a straggler, and the inversion
+            # argument below only holds where ranks wait on each other
+            if int(r.get("world") or 1) < 2 or r.get("async"):
+                continue
+            name = r.get("op", "?")
+            secs = float(r.get("seconds") or 0.0)
+            tot, cnt = ops.setdefault(name, {}).get(s.rank, (0.0, 0))
+            ops[name][s.rank] = (tot + secs, cnt + 1)
+    judge(ops, invert=True, what="collective", conf=0.6)
+
+    by_stream = {s.rank: s for s in alive}
+    out = []
+    for rank, entry in sorted(by_rank.items()):
+        what, name = entry["first"]
+        # anchor the verdict at the culprit's last record of the
+        # convicting phase/op so tpumt-trace can place the FINDING
+        # marker on its track (a skew has no single instant; the last
+        # contribution is where a reader should start looking)
+        s = by_stream.get(rank)
+        anchor = None
+        if s is not None:
+            if what == "phase":
+                ts = [t for _, r in s.times
+                      if r.get("phase") == name
+                      and (t := _rec_t(r)) is not None]
+            else:
+                ts = [t for _, r in s.spans
+                      if r.get("op") == name
+                      and (t := _rec_t(r)) is not None]
+            anchor = max(ts) if ts else None
+        out.append(_finding(
+            "straggler", rank, entry["conf"],
+            "; ".join(entry["items"]),
+            [],
+            # structured attribution, never mined back out of the
+            # human-readable message: a phase skew names a phase, a
+            # collective-span skew names the op
+            last_op=name if what == "collective" else None,
+            phase=name if what == "phase" else None,
+            t=anchor,
+        ))
+    return out
+
+
+def _shed_storm_findings(streams: list[_Stream], opts) -> list[dict]:
+    """Serve windows with shed ≫ 0: the queue bound is shedding load.
+    One finding per rank, naming the worst class."""
+    out = []
+    for s in streams:
+        # a quarantined class's sheds are graceful degradation working
+        # as designed (serve --quarantine-after: targeted isolation,
+        # surfaced as its own event:"quarantine" record and SLO
+        # accounting, driver exits 0) — convicting them as a
+        # queue-bound storm would fail exactly the runs the
+        # degradation exists to save. Scoped from the FIRST quarantine
+        # entry onward: windows a healthy-handler class shed at the
+        # queue bound BEFORE it ever quarantined are a genuine storm.
+        # A summary-only signal (episode windows lost) has no entry
+        # time, so it exempts the whole stream.
+        quar_t: dict = {}
+        for _ln, r in s.serves:
+            cls = r.get("class")
+            if r.get("event") == "quarantine":
+                t = _rec_t(r)
+                prev = quar_t.get(cls, float("inf"))
+                quar_t[cls] = min(prev, t if t is not None
+                                  else float("-inf"))
+            elif r.get("event") == "summary" and r.get("quarantines"):
+                quar_t.setdefault(cls, float("-inf"))
+        per_class: dict = {}
+        for ln, r in s.serves:
+            if r.get("event") != "window":
+                continue
+            cls_q = quar_t.get(r.get("class"))
+            if cls_q is not None and (_rec_t(r) or 0) >= cls_q:
+                continue
+            cls = r.get("class", "?")
+            agg = per_class.setdefault(
+                cls, {"shed": 0, "arrivals": 0, "qmax": 0,
+                      "windows": [], "t": None})
+            agg["shed"] += int(r.get("shed") or 0)
+            agg["arrivals"] += int(r.get("arrivals") or 0)
+            agg["qmax"] = max(agg["qmax"],
+                              int(r.get("queue_max") or 0))
+            if r.get("shed"):
+                agg["windows"].append((ln, r))
+                agg["t"] = _rec_t(r)
+        storms = {
+            cls: a for cls, a in per_class.items()
+            if a["shed"] >= max(opts["shed_min"],
+                                0.02 * max(a["arrivals"], 1))
+        }
+        if not storms:
+            continue
+        worst = max(storms, key=lambda c: storms[c]["shed"])
+        a = storms[worst]
+        ev = [s.ref(ln, r) for ln, r in a["windows"][:3]]
+        total_shed = sum(x["shed"] for x in storms.values())
+        out.append(_finding(
+            "shed_storm", s.rank, 0.85,
+            f"{total_shed} requests shed across "
+            f"{len(storms)} class(es); worst is {worst!r} with "
+            f"{a['shed']} shed of {a['arrivals']} arrivals at queue "
+            f"depth {a['qmax']} — the queue bound is dropping load",
+            ev, last_op=worst, phase="serve", t=a["t"],
+        ))
+    return out
+
+
+def diagnose_streams(streams: list[_Stream], ctx: dict | None = None,
+                     **overrides) -> list[dict]:
+    """Apply every rule; findings sorted most-confident first."""
+    opts = dict(DEFAULTS)
+    opts.update({k: v for k, v in overrides.items() if v is not None})
+    findings: list[dict] = []
+    ctx = ctx or {}
+
+    # ranks in the manifest with no file at all — the strongest form
+    # of a missing rank (a crashed rank whose JSONL never flushed, or
+    # a file lost in transit: either way the run claims n ranks)
+    expected = int(ctx.get("expected") or 0)
+    seen = {s.rank for s in streams}
+    for rank in range(expected):
+        if rank not in seen:
+            findings.append(_finding(
+                "missing_rank", rank, 0.9,
+                f"the manifest declares {expected} processes but no "
+                f"rank file for rank {rank} exists in the merged set",
+                [], t=None,
+            ))
+
+    dead_ranks = set()
+    for s in streams:
+        f = _death_finding(s, streams, opts)
+        if f is not None:
+            findings.append(f)
+            dead_ranks.add(s.rank)
+
+    findings.extend(
+        f for f in _straggler_findings(streams, opts)
+        if f["rank"] not in dead_ranks
+    )
+    findings.extend(
+        f for f in _shed_storm_findings(streams, opts)
+        if f["rank"] not in dead_ranks
+    )
+    findings.sort(key=lambda f: (-f["confidence"], f["class"],
+                                 str(f["rank"])))
+    return findings
+
+
+def diagnose_files(
+    files: list[str],
+    loaded: dict[str, list[tuple[int, dict]]] | None = None,
+    run_sync_us: int | None = None,
+    **overrides,
+) -> list[dict]:
+    """Load + diagnose; the entry point tpumt-report and tpumt-trace
+    reuse. Un-suffixed ``--jsonl`` base paths expand to their
+    ``.p<i>`` rank set like every other CLI; callers that already
+    parsed the files pass :func:`load_with_lines` output as ``loaded``.
+    Never raises — a diagnosis bug must not break the report or the
+    trace it rides along with."""
+    try:
+        files = [f for f in expand_rank_files(files)
+                 if Path(f).exists()]
+        streams, ctx = load_streams(files, loaded=loaded,
+                                    run_sync_us=run_sync_us)
+        return diagnose_streams(streams, ctx, **overrides)
+    except Exception as e:  # noqa: BLE001 — defensive by contract
+        print(f"tpumt-doctor: diagnosis failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def format_finding(f: dict) -> str:
+    parts = [f"FINDING {f['class']}: rank={f['rank']} "
+             f"confidence={f['confidence']:.2f}"]
+    if f.get("last_op"):
+        parts.append(f"last_op={f['last_op']}")
+    if f.get("phase"):
+        parts.append(f"phase={f['phase']}")
+    return " ".join(parts) + f" — {f['detail']}"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpumt-doctor",
+        description="root-cause triage over per-rank telemetry JSONL: "
+        "emits kind:'finding' verdicts (failure class, culprit rank, "
+        "last op, evidence, confidence) from cross-rank rules — "
+        "missing rank, straggler, wedged dispatch, OOM ramp, serve "
+        "shed storm (README 'Chaos & diagnosis')",
+    )
+    p.add_argument(
+        "files", nargs="+",
+        help="per-rank JSONL files; an un-suffixed --jsonl base path "
+        "expands to its .p<i> rank set",
+    )
+    p.add_argument(
+        "--skew-threshold", type=float, default=None, metavar="X",
+        help=f"straggler conviction skew (default "
+        f"{DEFAULTS['skew_threshold']}; tpumt-report FLAGS at 1.5, the "
+        f"doctor CONVICTS — stricter by design)",
+    )
+    p.add_argument(
+        "--gap", type=float, default=None, metavar="S", dest="gap_s",
+        help=f"seconds siblings must outlive a rank before it is "
+        f"missing (default {DEFAULTS['gap_s']})",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit {'findings': [...]} as one JSON document",
+    )
+    p.add_argument(
+        "--expect", default=None, metavar="CLASS:RANK",
+        help="CI contract mode: exit 0 iff the diagnosis is EXACTLY "
+        "one finding of CLASS convicting RANK (e.g. --expect "
+        "missing_rank:1), else exit 2 explaining what was found — "
+        "the chaos-smoke assertion primitive",
+    )
+    args = p.parse_args(argv)
+
+    expect = None
+    if args.expect:
+        try:
+            cls, rank = args.expect.rsplit(":", 1)
+            if cls not in FINDING_CLASSES:
+                raise ValueError(cls)
+            expect = (cls, int(rank))
+        except ValueError:
+            print(f"tpumt-doctor: bad --expect {args.expect!r}; want "
+                  f"CLASS:RANK with CLASS in "
+                  f"{','.join(FINDING_CLASSES)}", file=sys.stderr)
+            return 2
+
+    files = [f for f in expand_rank_files(args.files) if Path(f).exists()]
+    if not files:
+        print("tpumt-doctor: no input files found", file=sys.stderr)
+        return 2
+    streams, ctx = load_streams(files)
+    findings = diagnose_streams(
+        streams, ctx, skew_threshold=args.skew_threshold,
+        gap_s=args.gap_s,
+    )
+
+    if args.json:
+        json.dump({"files": files, "findings": findings}, sys.stdout,
+                  indent=1)
+        print()
+    else:
+        for f in findings:
+            print(format_finding(f))
+            for ref in f.get("evidence") or []:
+                print(f"  evidence: {ref}")
+        if not findings:
+            n = sum(len(s.records) for s in streams)
+            print(f"DOCTOR OK: no findings ({len(streams)} rank "
+                  f"file(s), {n} records)")
+
+    if expect is not None:
+        cls, rank = expect
+        if len(findings) == 1 and findings[0]["class"] == cls \
+                and findings[0]["rank"] == rank:
+            # stderr under --json: stdout is a JSON document a
+            # consumer may be piping into a parser
+            print(f"DOCTOR EXPECT OK: {cls}:{rank}",
+                  file=sys.stderr if args.json else sys.stdout)
+            return 0
+        got = [f"{f['class']}:{f['rank']}" for f in findings]
+        print(f"DOCTOR EXPECT FAIL: wanted exactly [{cls}:{rank}], "
+              f"got {got}", file=sys.stderr)
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -193,6 +193,14 @@ class Telemetry:
 
 _TELEMETRY = Telemetry()
 
+#: chaos arm-point (``tpu_mpi_tests/chaos/inject.py`` rebinds this at
+#: arm time; never set by anything else): called as ``hook(op, when)``
+#: with ``when`` = "enter" before the span's clock starts and "exit"
+#: after the event recorded. Consulted ONLY on the telemetry-enabled
+#: span path — the disabled fast path (one attribute check) and every
+#: disarmed run are untouched, which is the layer's zero-cost contract.
+_CHAOS_SPAN_HOOK: Callable[[str, str], None] | None = None
+
 #: optional cost-model provider (instrument/costs.py registers itself on
 #: its first successful compile probe): ``provider(op, seconds)`` returns
 #: extra span fields ({} for unknown ops) — cost bytes/flops and roofline
@@ -316,6 +324,11 @@ def comm_span(
         return
     from tpu_mpi_tests.instrument.timers import block
 
+    chaos_hook = _CHAOS_SPAN_HOOK
+    if chaos_hook is not None:
+        # entry faults (kill/wedge) land here, BEFORE the clock starts,
+        # so a killed span never records — dead mid-collective
+        chaos_hook(op, "enter")
     span = _Span()
     t0_wall = time.time()
     t0 = time.perf_counter()
@@ -349,6 +362,12 @@ def comm_span(
                 meta=meta,
             )
         )
+        if chaos_hook is not None:
+            # exit faults (the op-scoped straggler) sleep here, AFTER
+            # the event recorded — outside the measured window, so the
+            # culprit's own spans stay honest while its late arrival
+            # inflates the siblings' next collective
+            chaos_hook(op, "exit")
 
 
 class AsyncSpan:

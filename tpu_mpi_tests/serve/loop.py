@@ -50,16 +50,30 @@ MAX_IDLE_SLEEP_S = 0.25
 #: nearly free
 FAIL_BACKOFF_S = 0.05
 
+#: chaos arm-point (``tpu_mpi_tests/chaos/inject.py`` rebinds this at
+#: arm time; never set by anything else): ``hook(window_index) -> int``
+#: synthetic arrivals to flood into the queue at an SLO window
+#: boundary. Consulted once per window — a rare branch on an idle-path
+#: boundary, and a disarmed run (slot None) takes the same code path
+#: as a build without the chaos layer.
+_CHAOS_FLOOD = None
+
 
 class Request:
     """One in-queue request: its workload class and scheduled arrival
-    time (the open-loop latency origin — queue wait counts)."""
+    time (the open-loop latency origin — queue wait counts).
+    ``synthetic`` marks chaos-flood injections: they are served and
+    accounted like any request but never fed back to the arrival
+    process — a closed loop's fixed client population must not be
+    permanently inflated by a transient burst."""
 
-    __slots__ = ("cls", "arrival")
+    __slots__ = ("cls", "arrival", "synthetic")
 
-    def __init__(self, cls: WorkloadClass, arrival: float):
+    def __init__(self, cls: WorkloadClass, arrival: float,
+                 synthetic: bool = False):
         self.cls = cls
         self.arrival = arrival
+        self.synthetic = synthetic
 
 
 class _ClassStats:
@@ -69,7 +83,9 @@ class _ClassStats:
     __slots__ = ("hist", "win_hist", "requests", "errors", "shed",
                  "batches", "arrivals", "queue_max", "win_requests",
                  "win_errors", "win_shed", "win_batches", "win_arrivals",
-                 "win_queue_max")
+                 "win_queue_max", "consec_errors", "quarantines",
+                 "quarantine_s", "streak_errors", "quar_errors",
+                 "quar_shed")
 
     def __init__(self):
         self.hist = LatencyHistogram()
@@ -78,6 +94,21 @@ class _ClassStats:
         self.batches = self.arrivals = self.queue_max = 0
         self.win_requests = self.win_errors = self.win_shed = 0
         self.win_batches = self.win_arrivals = self.win_queue_max = 0
+        # graceful degradation bookkeeping: consecutive failed batches
+        # (reset on any success), completed quarantine episodes, and
+        # total seconds the class spent quarantined
+        self.consec_errors = 0
+        self.quarantines = 0
+        self.quarantine_s = 0.0
+        # quarantine ATTRIBUTION: request-unit errors in the failure
+        # streak that ended in quarantine (streak_errors accumulates,
+        # moves to quar_errors on entry) and sheds caused by the
+        # quarantine itself (dropped backlog + quarantined-arrival
+        # sheds) — so the driver can forgive exactly the degradation
+        # the quarantine accounts for, and nothing else
+        self.streak_errors = 0
+        self.quar_errors = 0
+        self.quar_shed = 0
 
     def window_active(self) -> bool:
         return bool(self.win_arrivals or self.win_requests
@@ -113,6 +144,7 @@ class ServeLoop:
         seed: int = 0,
         sink: Callable[[dict], None] | None = None,
         watchdog=None,
+        quarantine_after: int | None = None,
         clock: Callable[[], float] = time.monotonic,
         wall: Callable[[], float] = time.time,
         sleep: Callable[[float], None] = time.sleep,
@@ -130,6 +162,14 @@ class ServeLoop:
         self.mix = WorkloadMix(classes, seed=seed)
         self.sink = sink
         self.watchdog = watchdog
+        # graceful degradation: after N consecutive failed batches a
+        # class is quarantined — its arrivals shed, the others keep
+        # serving — instead of error-spinning an hours-long run; a
+        # window-boundary probe re-admits it once the handler recovers
+        # (None = off, the pre-quarantine behavior)
+        self.quarantine_after = (int(quarantine_after)
+                                 if quarantine_after else None)
+        self._quarantined: dict[str, float] = {}  # key -> wall t of entry
         self._clock = clock
         self._wall = wall
         self._sleep = sleep
@@ -180,6 +220,11 @@ class ServeLoop:
             "queue_max": qmax,
             **hist.percentiles_ms(),
         }
+        if not window and st.quarantines:
+            rec["quarantines"] = st.quarantines
+            rec["quarantine_s"] = st.quarantine_s
+            rec["quar_errors"] = st.quar_errors
+            rec["quar_shed"] = st.quar_shed
         if offered_dur is not None and dur > offered_dur:
             # how long past the deadline the queue took to drain — a
             # saturated run's backlog, first-class in the record
@@ -187,6 +232,64 @@ class ServeLoop:
         if self.sink is not None:
             self.sink(rec)
         return rec
+
+    # -- graceful degradation ----------------------------------------------
+
+    def _enter_quarantine(self, cls: WorkloadClass, st: _ClassStats,
+                          t_wall: float, queue: list, waiting: dict
+                          ) -> None:
+        """A handler class that stayed dead past ``quarantine_after``
+        consecutive failed batches stops being served: its backlog is
+        shed, future arrivals shed on admission, and the rest of the
+        classes keep their SLO — instead of the whole hours-long run
+        error-spinning to rc 1. Emits ``kind:"serve"
+        event:"quarantine"``; a window-boundary probe re-admits the
+        class when the handler recovers."""
+        self._quarantined[cls.key] = t_wall
+        st.quar_errors += st.streak_errors
+        st.streak_errors = 0
+        dropped = [r for r in queue if r.cls.key == cls.key]
+        if dropped:
+            queue[:] = [r for r in queue if r.cls.key != cls.key]
+            st.shed += len(dropped)
+            st.win_shed += len(dropped)
+            st.quar_shed += len(dropped)
+            waiting[cls.key] = 0
+        if self.sink is not None:
+            self.sink({
+                "kind": "serve", "event": "quarantine", "class": cls.key,
+                "workload": cls.workload, "dtype": cls.dtype,
+                "t": t_wall, "consecutive_errors": st.consec_errors,
+                "dropped": len(dropped),
+            })
+
+    def _probe_quarantined(self, t_wall: float) -> None:
+        """One probe batch (n=1, synthetic — no queued request is
+        risked) per quarantined class per window boundary; success
+        re-admits the class and records the downtime."""
+        for key in list(self._quarantined):
+            if self.watchdog is not None:
+                self.watchdog.arm(f"serve:probe:{key}")
+            try:
+                self.handlers[key](1)
+                ok = True
+            except Exception:
+                ok = False
+            finally:
+                if self.watchdog is not None:
+                    self.watchdog.disarm()
+            if not ok:
+                continue
+            t_q = self._quarantined.pop(key)
+            st = self.stats[key]
+            st.consec_errors = 0
+            st.quarantines += 1
+            st.quarantine_s += max(t_wall - t_q, 0.0)
+            if self.sink is not None:
+                self.sink({
+                    "kind": "serve", "event": "recover", "class": key,
+                    "t": t_wall, "downtime_s": max(t_wall - t_q, 0.0),
+                })
 
     # -- the loop ----------------------------------------------------------
 
@@ -207,33 +310,42 @@ class ServeLoop:
         waiting: dict[str, int] = {}
         window_start = t0
         window_wall = wall0
+        window_index = 0
 
         def wall_at(t_mono: float) -> float:
             return wall0 + (t_mono - t0)
+
+        def admit(t_arr: float, synthetic: bool = False) -> None:
+            """One arrival: draw its class, then queue / shed it. A
+            quarantined class sheds on arrival — the whole point is
+            that its backlog cannot starve the healthy classes."""
+            cls = self.mix.draw()
+            st = self.stats[cls.key]
+            st.arrivals += 1
+            st.win_arrivals += 1
+            if len(queue) >= self.max_queue or cls.key in self._quarantined:
+                # shed and gone: a shed request is never fed back
+                # through on_complete (re-arming what the full
+                # queue just rejected would spin) — closed-loop
+                # callers must keep concurrency <= max_queue or
+                # the population decays (the driver enforces it)
+                st.shed += 1
+                st.win_shed += 1
+                if cls.key in self._quarantined:
+                    st.quar_shed += 1
+                return
+            queue.append(Request(cls, t_arr, synthetic))
+            d = waiting.get(cls.key, 0) + 1
+            waiting[cls.key] = d
+            st.queue_max = max(st.queue_max, d)
+            st.win_queue_max = max(st.win_queue_max, d)
 
         while True:
             now = clock()
             # ingest arrivals scheduled up to now (never past the
             # deadline — the post-deadline drain must terminate)
             for t_arr in self.arrival.take_due(now, limit=t_end):
-                cls = self.mix.draw()
-                st = self.stats[cls.key]
-                st.arrivals += 1
-                st.win_arrivals += 1
-                if len(queue) >= self.max_queue:
-                    # shed and gone: a shed request is never fed back
-                    # through on_complete (re-arming what the full
-                    # queue just rejected would spin) — closed-loop
-                    # callers must keep concurrency <= max_queue or
-                    # the population decays (the driver enforces it)
-                    st.shed += 1
-                    st.win_shed += 1
-                    continue
-                queue.append(Request(cls, t_arr))
-                d = waiting.get(cls.key, 0) + 1
-                waiting[cls.key] = d
-                st.queue_max = max(st.queue_max, d)
-                st.win_queue_max = max(st.win_queue_max, d)
+                admit(t_arr)
             # window boundary: emit + reset (drain windows included)
             if now - window_start >= self.window_s:
                 w_end = wall_at(now)
@@ -248,6 +360,13 @@ class ServeLoop:
                     st.win_queue_max = waiting.get(cls.key, 0)
                 window_start = now
                 window_wall = w_end
+                window_index += 1
+                flood = _CHAOS_FLOOD
+                if flood is not None:
+                    for _ in range(flood(window_index)):
+                        admit(now, synthetic=True)
+                if self._quarantined:
+                    self._probe_quarantined(w_end)
 
             if queue:
                 batch, queue = coalesce(queue, self.max_batch)
@@ -278,14 +397,27 @@ class ServeLoop:
                 if failed:
                     st.errors += len(batch)
                     st.win_errors += len(batch)
+                    st.streak_errors += len(batch)
+                    st.consec_errors += 1
+                    if (self.quarantine_after
+                            and st.consec_errors >= self.quarantine_after
+                            and cls.key not in self._quarantined):
+                        self._enter_quarantine(cls, st, wall_at(done),
+                                               queue, waiting)
                 else:
+                    st.consec_errors = 0
+                    st.streak_errors = 0
                     for req in batch:
                         lat = done - req.arrival
                         st.requests += 1
                         st.win_requests += 1
                         st.hist.record(lat)
                         st.win_hist.record(lat)
-                self.arrival.on_complete(len(batch), done)
+                # synthetic (chaos-flood) completions never re-arm the
+                # arrival process: a closed loop's population must
+                # return to exactly --concurrency once the burst drains
+                organic = sum(1 for r in batch if not r.synthetic)
+                self.arrival.on_complete(organic, done)
                 if failed:
                     self._sleep(FAIL_BACKOFF_S)
                 continue
@@ -301,6 +433,12 @@ class ServeLoop:
                 self._sleep(min(gap, MAX_IDLE_SLEEP_S))
 
         end_wall = wall_at(clock())
+        # a class still quarantined at run end charges its open episode
+        # to the summary's downtime accounting
+        for key, t_q in self._quarantined.items():
+            st = self.stats[key]
+            st.quarantines += 1
+            st.quarantine_s += max(end_wall - t_q, 0.0)
         # final partial window, then the run summaries
         for cls in self.classes:
             st = self.stats[cls.key]

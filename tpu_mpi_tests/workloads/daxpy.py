@@ -41,10 +41,24 @@ class DaxpySpec(WorkloadSpec):
             help="print every y element (the reference always does; "
             "daxpy.cu:84)",
         )
+        p.add_argument(
+            "--iters",
+            type=int,
+            default=1,
+            metavar="K",
+            help="re-run the identical kernel K times (same inputs each "
+            "time, so the result and every verification gate are "
+            "unchanged; the kernel phase is re-entered K times) — the "
+            "steady-state repetition knob for memwatch/chaos "
+            "observation runs. Default 1 = the reference's one-shot "
+            "semantics, stdout byte-identical",
+        )
 
     def check_args(self, p, args) -> None:
         if args.n < 1:
             p.error(f"--n must be positive, got {args.n}")
+        if args.iters < 1:
+            p.error(f"--iters must be positive, got {args.iters}")
 
     def build(self, ctx: RunContext):
         import tpu_mpi_tests.kernels.daxpy as kd
@@ -77,8 +91,13 @@ class DaxpySpec(WorkloadSpec):
             kd.daxpy, (a_dev, state["d_x"], state["d_y"]), label="daxpy",
             phase="kernel", n=ctx.args.n, dtype=ctx.args.dtype,
         )
-        with ctx.phase("kernel"):
-            d_y = block(kd.daxpy(a_dev, state["d_x"], state["d_y"]))
+        # --iters re-runs the IDENTICAL call (original y each time):
+        # the result and every gate below stay those of one
+        # application, while the phase re-enters K times — repeated
+        # boundaries for the memwatch hooks and chaos triggers
+        for _ in range(ctx.args.iters):
+            with ctx.phase("kernel"):
+                d_y = block(kd.daxpy(a_dev, state["d_x"], state["d_y"]))
 
         with ctx.phase("copyOutput"):
             state["y"] = np.asarray(d_y)
