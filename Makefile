@@ -1,7 +1,8 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke overlap-smoke moe-smoke chaos-smoke lint ci clean
+	serve-smoke overlap-smoke moe-smoke chaos-smoke lint lint-smoke ci \
+	clean
 
 test:
 	python -m pytest tests/ -q
@@ -377,13 +378,57 @@ lint:
 	python -m tpu_mpi_tests.analysis.cli \
 		tpu_mpi_tests tpu tests __graft_entry__.py bench.py
 
+# lint-cache smoke (README "Static analysis"): the whole-program
+# analyzer's incrementality contract, asserted via --stats counters on
+# a throwaway cache — a cold run over the repo analyzes every file, a
+# warm re-run of the unchanged tree re-parses ZERO files (pure cache
+# hits, project rules recomputed from the serialized summaries), and
+# touching one file re-analyzes exactly that file. The probe file
+# lives in /tmp so the repo itself is never mutated.
+lint-smoke:
+	rm -rf /tmp/_tpumt_lint_smoke; mkdir -p /tmp/_tpumt_lint_smoke
+	printf 'PROBE = 1\n' > /tmp/_tpumt_lint_smoke/probe.py
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests tpu tests __graft_entry__.py bench.py \
+		/tmp/_tpumt_lint_smoke/probe.py \
+		--cache /tmp/_tpumt_lint_smoke/cache.json \
+		--stats 2> /tmp/_tpumt_lint_smoke/cold.stats
+	python -c "import re; s = open('/tmp/_tpumt_lint_smoke/cold.stats').read(); \
+		f, a, h = map(int, re.search( \
+			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
+		assert f == a > 0 and h == 0, s; \
+		print('lint-smoke cold OK:', a, 'files analyzed')"
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests tpu tests __graft_entry__.py bench.py \
+		/tmp/_tpumt_lint_smoke/probe.py \
+		--cache /tmp/_tpumt_lint_smoke/cache.json \
+		--stats 2> /tmp/_tpumt_lint_smoke/warm.stats
+	python -c "import re; s = open('/tmp/_tpumt_lint_smoke/warm.stats').read(); \
+		f, a, h = map(int, re.search( \
+			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
+		assert a == 0 and h == f > 0, s; \
+		print('lint-smoke warm OK:', h, 'cache hits, 0 files re-parsed')"
+	printf 'PROBE_TOUCHED = 2\n' >> /tmp/_tpumt_lint_smoke/probe.py
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests tpu tests __graft_entry__.py bench.py \
+		/tmp/_tpumt_lint_smoke/probe.py \
+		--cache /tmp/_tpumt_lint_smoke/cache.json \
+		--stats 2> /tmp/_tpumt_lint_smoke/touch.stats
+	python -c "import re; s = open('/tmp/_tpumt_lint_smoke/touch.stats').read(); \
+		f, a, h = map(int, re.search( \
+			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
+		assert a == 1 and h == f - 1, s; \
+		print('lint-smoke touch OK: exactly 1 file re-analyzed')"
+	@echo "lint-smoke OK: cold populate, warm zero-reparse, touched file re-analyzes"
+
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
 # observability smoke, the serving-pipeline smoke, the overlap-engine
 # smoke, the workload-spec pillar smoke, the chaos-verified diagnosis
-# smoke, and the lint self-clean gate
+# smoke, the lint self-clean gate, and the lint-cache incrementality
+# smoke
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke \
-	moe-smoke chaos-smoke lint
+	moe-smoke chaos-smoke lint lint-smoke
 
 clean:
 	$(MAKE) -C native clean

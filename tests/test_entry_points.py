@@ -132,7 +132,11 @@ def test_tpumt_lint_runs_without_jax(tmp_path):
     """The tpumt-lint console script must import, parse --help, AND
     produce findings in a process where ``import jax`` raises — the
     same login-node guarantee tpumt-report/tpumt-trace already claim
-    (the linter is pure stdlib: ast + tokenize)."""
+    (the linter is pure stdlib: ast + tokenize). ISSUE 10 extends the
+    golden to a WHOLE-PROGRAM run: the interprocedural pass (a
+    use-after-donate through a helper in another file) and the analysis
+    cache (off, cold, and warm — zero files re-parsed) must all work
+    under the jax-blocking meta_path hook too."""
     bad = tmp_path / "bad.py"
     bad.write_text(
         "import time\n"
@@ -142,6 +146,24 @@ def test_tpumt_lint_runs_without_jax(tmp_path):
         "    y = jnp.sin(x)\n"
         "    return y, time.perf_counter() - t0\n"
     )
+    # a cross-file finding: the helper forwards into allreduce_sum's
+    # donated position, the driver reads the donated name afterwards
+    pkg = tmp_path / "proj" / "dnt"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def reduce_into(buf, mesh):\n"
+        "    return allreduce_sum(buf, mesh)\n"
+    )
+    (pkg / "driver.py").write_text(
+        "from dnt.helper import reduce_into\n"
+        "def step(x, mesh):\n"
+        "    total = reduce_into(x, mesh)\n"
+        "    return x + total\n"
+    )
+    proj = str(tmp_path / "proj")
+    cache = str(tmp_path / "lint_cache.json")
     code = (
         "import sys\n"
         "class Block:\n"
@@ -150,18 +172,29 @@ def test_tpumt_lint_runs_without_jax(tmp_path):
         "            raise ImportError('jax blocked: login-node sim')\n"
         "sys.meta_path.insert(0, Block())\n"
         "from tpu_mpi_tests.analysis import cli\n"
+        "from tpu_mpi_tests.analysis.core import lint_paths\n"
         "try:\n"
         "    cli.main(['--help'])\n"
         "except SystemExit as e:\n"
         "    assert (e.code or 0) == 0, e.code\n"
         f"assert cli.main([{str(bad)!r}]) == 1\n"
         f"assert cli.main(['--ignore', 'TPM1', {str(bad)!r}]) == 0\n"
-        "print('LINT NOJAX OK')\n"
+        f"assert cli.main(['--no-cache', {proj!r}]) == 1\n"
+        f"s1 = {{}}; f1 = lint_paths([{proj!r}], cache_path={cache!r},\n"
+        "                           stats=s1)\n"
+        "assert [f.code for f in f1] == ['TPM1201'], f1\n"
+        "assert s1['analyzed'] == 3 and s1['cache_hits'] == 0, s1\n"
+        f"s2 = {{}}; f2 = lint_paths([{proj!r}], cache_path={cache!r},\n"
+        "                           stats=s2)\n"
+        "assert f2 == f1, f2\n"
+        "assert s2['analyzed'] == 0 and s2['cache_hits'] == 3, s2\n"
+        "print('LINT NOJAX WHOLE-PROGRAM OK')\n"
     )
     r = run_py(code)
     assert r.returncode == 0, r.stderr[-2000:]
-    assert "LINT NOJAX OK" in r.stdout
+    assert "LINT NOJAX WHOLE-PROGRAM OK" in r.stdout
     assert "tpumt-lint" in r.stdout  # --help went to stdout
+    assert "TPM1201" in r.stdout  # the cross-file finding printed
     pyproject = (REPO / "pyproject.toml").read_text()
     assert 'tpumt-lint = "tpu_mpi_tests.analysis.cli:main"' in pyproject
 

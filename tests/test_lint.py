@@ -24,6 +24,17 @@ from tpu_mpi_tests.analysis.core import (
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = REPO / "tpu_mpi_tests" / "analysis" / "fixtures"
 
+
+@pytest.fixture(autouse=True)
+def _hermetic_cache(tmp_path, monkeypatch):
+    """CLI invocations default the analysis cache ON — point it at a
+    per-test temp file so tests never touch (or depend on) the user's
+    ~/.cache/tpumt/lint.json."""
+    monkeypatch.setenv(
+        "TPU_MPI_LINT_CACHE", str(tmp_path / "_lintcache.json")
+    )
+
+
 #: (family prefix, fixture stem) for the single-file families
 FILE_FAMILIES = [
     ("TPM1", "tpm1"),
@@ -34,6 +45,15 @@ FILE_FAMILIES = [
     ("TPM7", "tpm7"),
     ("TPM8", "tpm8"),
     ("TPM10", "tpm10"),
+]
+
+#: (family prefix, fixture stem) for the ISSUE-10 whole-program
+#: families — mini package trees, because the findings are
+#: interprocedural by construction (helper in one file, hazard in
+#: another)
+TREE_FAMILIES = [
+    ("TPM11", "tpm11"),
+    ("TPM12", "tpm12"),
 ]
 
 
@@ -59,6 +79,256 @@ def test_family_bad_good_suppressed(family, stem):
     )
     # a suppression that fired is used: no TPM900 on the same file
     assert "TPM900" not in codes_of(sup), sup
+
+
+@pytest.mark.parametrize("family,stem", TREE_FAMILIES)
+def test_project_family_bad_good_suppressed_trees(family, stem):
+    """The whole-program families' goldens: each tree splits helper and
+    hazard across files, so a per-file scan of any single file would
+    see nothing — the finding only exists through the summaries."""
+    bad = lint_paths([str(FIXTURES / f"{stem}_bad")])
+    assert any(c.startswith(family) for c in codes_of(bad)), (
+        f"{stem}_bad must raise a {family}xx finding, got {bad}"
+    )
+
+    good = lint_paths([str(FIXTURES / f"{stem}_good")])
+    assert not any(c.startswith(family) for c in codes_of(good)), (
+        f"{stem}_good must be clean of {family}xx, got {good}"
+    )
+
+    sup = lint_paths([str(FIXTURES / f"{stem}_suppressed")])
+    assert not any(c.startswith(family) for c in codes_of(sup)), (
+        f"suppression comment must silence {family}xx, got {sup}"
+    )
+    assert "TPM900" not in codes_of(sup), sup
+
+
+def test_collective_divergence_seeded_mutant(tmp_path):
+    """Mutation gate (acceptance criterion): a seeded rank-divergent
+    collective — rank test in one function, collective through a helper
+    in ANOTHER FILE — is flagged; hoisting the collective out of the
+    branch clears it."""
+    pkg = tmp_path / "spmd"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "comms.py").write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def global_sum(x, mesh):\n"
+        "    return allreduce_sum(x, mesh)\n"
+    )
+    step = pkg / "step.py"
+    step.write_text(
+        "from spmd.comms import global_sum\n"
+        "def run(x, mesh, rank):\n"
+        "    if rank == 0:\n"
+        "        x = global_sum(x, mesh)\n"
+        "    return x\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert "TPM1101" in codes_of(findings), findings
+    f = next(f for f in findings if f.code == "TPM1101")
+    assert f.line == 3 and "allreduce_sum" in f.message, f
+    # the fix: every rank enters the collective
+    step.write_text(
+        "from spmd.comms import global_sum\n"
+        "def run(x, mesh, rank):\n"
+        "    x = global_sum(x, mesh)\n"
+        "    if rank == 0:\n"
+        "        print('done')\n"
+        "    return x\n"
+    )
+    assert "TPM1101" not in codes_of(lint_paths([str(tmp_path)]))
+
+
+def test_collective_divergence_both_branches_equal_is_clean(tmp_path):
+    """A rank branch whose BOTH paths dispatch the same collective
+    sequence does not diverge (e.g. selecting an operand, then the same
+    reduce on each side)."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def run(x, y, mesh, rank):\n"
+        "    if rank == 0:\n"
+        "        out = allreduce_sum(x, mesh)\n"
+        "    else:\n"
+        "        out = allreduce_sum(y, mesh)\n"
+        "    return out\n"
+    )
+    assert "TPM1101" not in codes_of(lint_paths([str(p)]))
+
+
+def test_donation_safety_seeded_mutant_through_helper(tmp_path):
+    """Mutation gate (acceptance criterion): a use-after-donate where
+    the donation happens ONE HELPER LEVEL down (the helper forwards its
+    param into allreduce_sum's donated position 0) is flagged; the
+    rebind idiom clears it."""
+    pkg = tmp_path / "dnt"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "helper.py").write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def reduce_into(buf, mesh):\n"
+        "    return allreduce_sum(buf, mesh)\n"
+    )
+    drv = pkg / "driver.py"
+    drv.write_text(
+        "from dnt.helper import reduce_into\n"
+        "def step(x, mesh):\n"
+        "    total = reduce_into(x, mesh)\n"
+        "    return x + total\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert "TPM1201" in codes_of(findings), findings
+    f = next(f for f in findings if f.code == "TPM1201")
+    assert f.line == 4  # anchored at the read of the deleted buffer
+    assert "reduce_into" in f.message
+    drv.write_text(
+        "from dnt.helper import reduce_into\n"
+        "def step(x, mesh):\n"
+        "    x = reduce_into(x, mesh)\n"
+        "    return x * 2.0\n"
+    )
+    assert "TPM1201" not in codes_of(lint_paths([str(tmp_path)]))
+
+
+def test_donation_safety_loop_and_return_shapes(tmp_path):
+    """TPM1201 beyond the goldens: donating inside a loop that never
+    rebinds feeds a deleted buffer to iteration 2 (flagged at the
+    call); a donation under `return` exits the statement list, so the
+    mutually-exclusive-branch dispatch fork is clean; and same-named
+    locals in SIBLING functions are unrelated (no cross-scope leak)."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def looped(x, mesh, n):\n"
+        "    for _ in range(n):\n"
+        "        allreduce_sum(x, mesh)\n"
+        "    return x\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM1201" in codes_of(findings), findings
+    assert "inside a loop" in findings[0].message
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def fork(x, mesh, host):\n"
+        "    if host:\n"
+        "        return allreduce_sum(x, mesh)\n"
+        "    return x.sum()\n"
+    )
+    assert "TPM1201" not in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def donates(x, mesh):\n"
+        "    x = allreduce_sum(x, mesh)\n"
+        "    return x\n"
+        "def unrelated(x):\n"
+        "    return x + 1\n"  # different scope's x, not a stale read
+    )
+    assert "TPM1201" not in codes_of(lint_paths([str(p)]))
+
+
+def test_axis_program_consistency_seeded_mutant(tmp_path):
+    """Mutation gate (acceptance criterion): a cross-file unbound axis
+    — psum over an axis no file in the program binds — is flagged
+    (TPM502), in a file TPM501 used to SKIP for having no local mesh
+    context; binding the axis in the OTHER file clears it (the
+    same-file skip is lifted, not just re-scoped)."""
+    (tmp_path / "kernel.py").write_text(
+        "from jax import lax\n"
+        "def local_sum(v):\n"
+        "    return lax.psum(v, 'ghost')\n"
+    )
+    mesh = tmp_path / "meshes.py"
+    mesh.write_text(
+        "from jax.sharding import Mesh\n"
+        "def make(devs):\n"
+        "    return Mesh(devs, ('x',))\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert "TPM502" in codes_of(findings), findings
+    f = next(f for f in findings if f.code == "TPM502")
+    assert f.line == 3 and "'ghost'" in f.message, f
+    # alone, the kernel file still skips per-file (no local context) —
+    # the program rule is what closed that hole
+    alone = lint_paths([str(tmp_path / "kernel.py")])
+    assert "TPM501" not in codes_of(alone)
+    assert "TPM502" in codes_of(alone)
+    # bind the axis ANYWHERE in the program: clean
+    mesh.write_text(
+        "from jax.sharding import Mesh\n"
+        "def make(devs):\n"
+        "    return Mesh(devs, ('x', 'ghost'))\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert "TPM502" not in codes_of(findings), findings
+
+
+def test_escaped_async_handle_seeded_mutant(tmp_path):
+    """Mutation gate (acceptance criterion): an async_span handle
+    returned by a helper and assigned to a name the caller never reads
+    is flagged (TPM802) — nobody will done() it; consuming the handle
+    clears it."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.instrument.telemetry import async_span\n"
+        "def start(op):\n"
+        "    h = async_span(op)\n"
+        "    return h\n"
+        "def run(fn, z):\n"
+        "    hh = start('exchange')\n"
+        "    return fn(z)\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM802" in codes_of(findings), findings
+    f = next(f for f in findings if f.code == "TPM802")
+    assert f.line == 6 and "'hh'" in f.message, f
+    p.write_text(
+        "from tpu_mpi_tests.instrument.telemetry import async_span\n"
+        "def start(op):\n"
+        "    h = async_span(op)\n"
+        "    return h\n"
+        "def run(fn, z):\n"
+        "    hh = start('exchange')\n"
+        "    out = fn(z)\n"
+        "    hh.done(out)\n"
+        "    return out\n"
+    )
+    assert "TPM802" not in codes_of(lint_paths([str(p)]))
+
+
+def test_sync_honesty_interprocedural(tmp_path):
+    """TPM102: a timed region that dispatches jax work only THROUGH a
+    helper is dishonest timing one frame deeper — flagged via the
+    summaries; a helper that syncs internally is honest and clean."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "def helper(x):\n"
+        "    return jnp.sin(x)\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = helper(x)\n"
+        "    return y, time.perf_counter() - t0\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM102" in codes_of(findings), findings
+    # TPM101 stays silent — there is no DIRECT dispatch in the region
+    assert "TPM101" not in codes_of(findings)
+    f = next(f for f in findings if f.code == "TPM102")
+    assert f.line == 7 and "helper" in f.message, f
+    p.write_text(
+        "import time\n"
+        "import jax.numpy as jnp\n"
+        "from tpu_mpi_tests.instrument.timers import block\n"
+        "def helper(x):\n"
+        "    return block(jnp.sin(x))\n"
+        "def bench(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = helper(x)\n"
+        "    return y, time.perf_counter() - t0\n"
+    )
+    assert "TPM102" not in codes_of(lint_paths([str(p)]))
 
 
 @pytest.mark.parametrize("variant,expect", [
@@ -359,12 +629,195 @@ def test_cli_list_rules_covers_every_family(capsys):
     rc = cli.main(["--list-rules"])
     out = capsys.readouterr().out
     assert rc == 0
-    for code in ("TPM101", "TPM201", "TPM301", "TPM302", "TPM401",
-                 "TPM501", "TPM601", "TPM701", "TPM801", "TPM900",
-                 "TPM1001"):
+    for code in ("TPM101", "TPM102", "TPM201", "TPM301", "TPM302",
+                 "TPM401", "TPM501", "TPM502", "TPM601", "TPM701",
+                 "TPM801", "TPM802", "TPM900", "TPM1001", "TPM1101",
+                 "TPM1201"):
         assert code in out
     # table rows match the registry (README is hand-synced to this)
-    assert len(rule_table()) >= 10
+    assert len(rule_table()) >= 16
+
+
+def test_cli_sarif_golden(capsys):
+    """Pin the SARIF 2.1.0 subset we emit — the fields CI hosts need to
+    render findings inline: schema/version, driver name + full rule
+    table, and per-result ruleId/level/message/physical location with
+    1-based columns."""
+    rc = cli.main(["--format", "sarif", str(FIXTURES / "tpm1_bad.py")])
+    out = capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.out)
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    assert doc["version"] == "2.1.0"
+    assert len(doc["runs"]) == 1
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "tpumt-lint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == [code for code, _ in rule_table()]
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1
+    res = results[0]
+    assert res["ruleId"] == "TPM101"
+    assert res["level"] == "error"
+    assert "block" in res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("tpm1_bad.py")
+    # SARIF columns are 1-based; the engine's are 0-based
+    assert loc["region"] == {"startLine": 10, "startColumn": 11}
+
+
+def test_cli_sarif_clean_run_is_valid_empty(capsys):
+    rc = cli.main(["--format", "sarif", str(FIXTURES / "tpm1_good.py")])
+    out = capsys.readouterr()
+    assert rc == 0
+    doc = json.loads(out.out)
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cache_cold_warm_touch_cycle(tmp_path):
+    """The incrementality contract (acceptance criterion): a cold run
+    analyzes every file; a warm run over the unchanged tree re-parses
+    ZERO files and reproduces the identical findings — file-scope ones
+    replayed, project-scope ones recomputed from cached facts (the
+    cross-file TPM502 here proves the project pass sees deserialized
+    summaries); touching one file re-analyzes exactly that file."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "kernel.py").write_text(
+        "from jax import lax\n"
+        "def local_sum(v):\n"
+        "    return lax.psum(v, 'ghost')\n"
+    )
+    clean = proj / "meshes.py"
+    clean.write_text(
+        "from jax.sharding import Mesh\n"
+        "def make(devs):\n"
+        "    return Mesh(devs, ('x',))\n"
+    )
+    cache = tmp_path / "cache.json"
+
+    s1: dict = {}
+    f1 = lint_paths([str(proj)], cache_path=str(cache), stats=s1)
+    assert s1 == {"files": 2, "analyzed": 2, "cache_hits": 0}
+    assert "TPM502" in codes_of(f1), f1
+    assert cache.exists() and json.loads(cache.read_text())["entries"]
+
+    s2: dict = {}
+    f2 = lint_paths([str(proj)], cache_path=str(cache), stats=s2)
+    assert s2 == {"files": 2, "analyzed": 0, "cache_hits": 2}
+    assert f2 == f1  # byte-identical findings, zero re-parsing
+
+    clean.write_text(clean.read_text() + "\n# touched\n")
+    s3: dict = {}
+    f3 = lint_paths([str(proj)], cache_path=str(cache), stats=s3)
+    assert s3 == {"files": 2, "analyzed": 1, "cache_hits": 1}
+    assert f3 == f1
+
+
+def test_cache_replays_suppressions_and_file_findings(tmp_path):
+    """Warm runs must replay suppression state too: a used suppression
+    stays silent (no finding, no TPM900) and an unused one keeps
+    warning, identically to the cold run."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "sup.py").write_text(
+        (FIXTURES / "tpm1_suppressed.py").read_text()
+    )
+    (proj / "unused.py").write_text(
+        (FIXTURES / "tpm9_unused.py").read_text()
+    )
+    cache = tmp_path / "cache.json"
+    f1 = lint_paths([str(proj)], cache_path=str(cache))
+    s2: dict = {}
+    f2 = lint_paths([str(proj)], cache_path=str(cache), stats=s2)
+    assert s2["analyzed"] == 0 and s2["cache_hits"] == 2
+    assert f2 == f1
+    assert codes_of(f2) == ["TPM900"]
+
+
+def test_cache_misses_when_package_anchoring_changes(tmp_path):
+    """Content hashes alone can't see an added/removed ``__init__.py``:
+    it re-anchors every module name in the tree without touching the
+    files' bytes, and replaying facts under stale names would make warm
+    project findings diverge from a cold run. The replay validates the
+    module name and degrades to re-analysis instead."""
+    pkg = tmp_path / "dnt"
+    pkg.mkdir()
+    init = pkg / "__init__.py"
+    init.write_text("")
+    (pkg / "helper.py").write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def reduce_into(buf, mesh):\n"
+        "    return allreduce_sum(buf, mesh)\n"
+    )
+    (pkg / "driver.py").write_text(
+        "from dnt.helper import reduce_into\n"
+        "def step(x, mesh):\n"
+        "    total = reduce_into(x, mesh)\n"
+        "    return x + total\n"
+    )
+    cache = tmp_path / "cache.json"
+    f1 = lint_paths([str(tmp_path)], cache_path=str(cache))
+    assert "TPM1201" in codes_of(f1), f1
+
+    init.unlink()  # helper.py / driver.py bytes are unchanged
+    cold = lint_paths([str(tmp_path)])
+    s: dict = {}
+    warm = lint_paths([str(tmp_path)], cache_path=str(cache), stats=s)
+    assert warm == cold, (warm, cold)
+    assert s["analyzed"] == 2 and s["cache_hits"] == 0, s
+
+
+def test_cache_type_corrupted_entry_degrades_to_miss(tmp_path):
+    """An entry with the right hash but a wrong-typed field (a
+    hand-edit, a partial write) must re-analyze that file — never crash
+    the run or replay partial facts."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "bad.py").write_text((FIXTURES / "tpm1_bad.py").read_text())
+    cache = tmp_path / "cache.json"
+    f1 = lint_paths([str(proj)], cache_path=str(cache))
+    doc = json.loads(cache.read_text())
+    (entry,) = doc["entries"].values()
+    entry["findings"] = 0  # right hash, wrong shape
+    cache.write_text(json.dumps(doc))
+    s: dict = {}
+    f2 = lint_paths([str(proj)], cache_path=str(cache), stats=s)
+    assert f2 == f1
+    assert s["analyzed"] == 1 and s["cache_hits"] == 0, s
+
+
+def test_cache_corruption_degrades_to_cold_run(tmp_path):
+    """A truncated/garbage cache file must never fail the lint or
+    change its verdict — it reads as empty and the run goes cold."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "bad.py").write_text((FIXTURES / "tpm1_bad.py").read_text())
+    cache = tmp_path / "cache.json"
+    f1 = lint_paths([str(proj)], cache_path=str(cache))
+    cache.write_text('{"version": 1, "salt": "stale", "entr')
+    s: dict = {}
+    f2 = lint_paths([str(proj)], cache_path=str(cache), stats=s)
+    assert s["analyzed"] == 1 and s["cache_hits"] == 0
+    assert f2 == f1
+
+
+def test_cli_stats_and_no_cache(tmp_path, capsys):
+    """--stats reports the cache-hit counters on stderr; --no-cache
+    forces analyzed == files on every run and writes nothing."""
+    cache = tmp_path / "cli_cache.json"
+    target = str(FIXTURES / "tpm1_good.py")
+    cli.main(["--cache", str(cache), "--stats", target])
+    err = capsys.readouterr().err
+    assert "files=1 analyzed=1 cache_hits=0" in err
+    cli.main(["--cache", str(cache), "--stats", target])
+    err = capsys.readouterr().err
+    assert "files=1 analyzed=0 cache_hits=1" in err
+    cli.main(["--no-cache", "--stats", target])
+    err = capsys.readouterr().err
+    assert "files=1 analyzed=1 cache_hits=0" in err
+    assert "cache=off" in err
 
 
 def test_self_clean_gate():

@@ -16,26 +16,73 @@ from tpu_mpi_tests.analysis.core import lint_paths, rule_table
 _EPILOG = """\
 rule families (stable codes; see README "Static analysis" for the table):
   TPM1xx sync-honesty     timed jax dispatch without a device sync
+                          (TPM102: through a helper, via the summaries)
   TPM2xx trace-purity     host side effects inside traced functions
   TPM3xx x64-safety       float64 silently canonicalized to float32
   TPM4xx import-hygiene   eager `import jax` in login-node CLI closures
   TPM5xx axis-consistency collective axis names vs shard_map/mesh
+                          (TPM502: resolved program-wide, no same-file
+                          skip)
   TPM6xx concurrency      unlocked cross-thread file-handle writes
   TPM7xx schedule-consts  pinned tile/schedule constants bypassing the
                           autotuner's registry/cache (tpu_mpi_tests/tune)
+  TPM8xx overlap-regions  syncs inside declared overlap regions
+                          (TPM802: escaped async handle, never consumed)
   TPM9xx engine           unused/malformed suppressions, parse errors
+  TPM11xx collective-divergence  collective reachable from a
+                          rank-dependent branch: the SPMD deadlock shape
+  TPM12xx donation-safety a name read after being passed in a donated
+                          position and not rebound (use-after-donate)
 
 suppress one finding on its line (unused suppressions are themselves
 findings):   x = jnp.asarray(2.0)  # tpumt: ignore[TPM301]
+
+warm runs reuse the content-hash analysis cache (default
+~/.cache/tpumt/lint.json, $TPU_MPI_LINT_CACHE / --cache override,
+--no-cache disables): unchanged files skip parse + summary entirely;
+editing any analysis-package source invalidates every entry.
 """
+
+
+def _sarif_doc(findings) -> dict:
+    """SARIF 2.1.0, the minimal subset CI hosts render inline: one run,
+    the full rule table as driver rules, one result per finding with a
+    physical location (1-based column per the SARIF spec)."""
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpumt-lint",
+                "informationUri":
+                    "https://github.com/bd4/gpu-mpi-tests",
+                "rules": [
+                    {"id": code,
+                     "shortDescription": {"text": summary}}
+                    for code, summary in rule_table()
+                ],
+            }},
+            "results": [
+                {"ruleId": f.code,
+                 "level": "error",
+                 "message": {"text": f.message},
+                 "locations": [{"physicalLocation": {
+                     "artifactLocation": {"uri": f.path},
+                     "region": {"startLine": f.line,
+                                "startColumn": f.col + 1},
+                 }}]}
+                for f in findings
+            ],
+        }],
+    }
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpumt-lint",
-        description="tpumt-lint: static analyzer for this repo's "
-        "JAX/TPU correctness hazard classes (stdlib-only; runs on "
-        "login nodes without jax).",
+        description="tpumt-lint: whole-program static analyzer for this "
+        "repo's JAX/TPU correctness hazard classes (stdlib-only; runs "
+        "on login nodes without jax).",
         epilog=_EPILOG,
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
@@ -43,7 +90,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="files or directories to lint (directories "
                     "recurse over *.py, skipping fixtures/ and "
                     "__pycache__/)")
-    ap.add_argument("--format", choices=("human", "json"),
+    ap.add_argument("--format", choices=("human", "json", "sarif"),
                     default="human", help="output format")
     ap.add_argument("--select", action="append", metavar="CODES",
                     help="only these codes/families (comma list; "
@@ -55,6 +102,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="override the TPM4xx stdlib-only entry-module "
                     "set (default: the tpumt-* console scripts); "
                     "repeatable")
+    ap.add_argument("--cache", metavar="PATH", default=None,
+                    help="analysis-cache path (default "
+                    "~/.cache/tpumt/lint.json or $TPU_MPI_LINT_CACHE)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the content-hash analysis cache for "
+                    "this run")
+    ap.add_argument("--stats", action="store_true",
+                    help="print files/analyzed/cache-hit counts to "
+                    "stderr")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every registered code and exit")
     args = ap.parse_args(argv)
@@ -70,12 +126,33 @@ def main(argv: list[str] | None = None) -> int:
     entry_modules = None
     if args.entry_module:
         entry_modules = {m: m for m in args.entry_module}
+    cache_path = None
+    if not args.no_cache:
+        if args.cache:
+            cache_path = args.cache
+        else:
+            from tpu_mpi_tests.analysis.lintcache import (
+                default_cache_path,
+            )
+
+            cache_path = default_cache_path()
+    stats: dict = {}
     findings = lint_paths(
         args.paths,
         select=args.select,
         ignore=args.ignore,
         entry_modules=entry_modules,
+        cache_path=cache_path,
+        stats=stats,
     )
+    if args.stats:
+        print(
+            f"tpumt-lint stats: files={stats.get('files', 0)} "
+            f"analyzed={stats.get('analyzed', 0)} "
+            f"cache_hits={stats.get('cache_hits', 0)} "
+            f"cache={cache_path or 'off'}",
+            file=sys.stderr,
+        )
 
     if args.format == "json":
         print(json.dumps(
@@ -83,6 +160,8 @@ def main(argv: list[str] | None = None) -> int:
              "findings": [f.as_dict() for f in findings]},
             indent=2,
         ))
+    elif args.format == "sarif":
+        print(json.dumps(_sarif_doc(findings), indent=2))
     else:
         for f in findings:
             print(f.format())

@@ -1,11 +1,21 @@
 """``tpumt-lint`` engine: file walking, rule registry, suppressions.
 
 The engine is deliberately small: it parses each file once (``ast``),
-hands the tree to every registered file-scope rule, hands the whole file
-set to project-scope rules (import-reachability needs the graph), then
-applies ``# tpumt: ignore[TPMxxx]`` suppression comments and reports any
+hands the tree to every registered file-scope rule, extracts the file's
+serializable *facts* (module imports, function summaries, axis
+bindings — :mod:`tpu_mpi_tests.analysis.program`), hands the whole fact
+set to project-scope rules (import reachability, collective divergence,
+donation safety all need the cross-file view), then applies
+``# tpumt: ignore[TPMxxx]`` suppression comments and reports any
 suppression that silenced nothing (an unused suppression is itself a
 finding — stale ignores are how gated bug classes sneak back in).
+
+Incrementality (ISSUE 10): file-scope findings and facts depend only on
+the file's bytes, so both are cached under a content hash
+(:mod:`tpu_mpi_tests.analysis.lintcache`) — an unchanged file skips
+parse + rules + summary extraction entirely, and the project pass runs
+over deserialized summaries. Project findings are recomputed every run
+(they depend on the whole file set) but that pass is cheap by design.
 
 Stdlib-only by contract (verified by ``tests/test_entry_points.py``):
 the linter must run on login nodes where ``import jax`` raises.
@@ -14,6 +24,7 @@ the linter must run on login nodes where ``import jax`` raises.
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import re
 import tokenize
@@ -85,6 +96,121 @@ def last_attr(node: ast.AST) -> str | None:
     if isinstance(node, ast.Name):
         return node.id
     return None
+
+
+# ---------------------------------------------------------------------------
+# shared AST heuristics (previously rules/_util.py, hoisted so the
+# whole-program facts extractor can use them without importing the rule
+# registry — rules/_util re-exports them for the rule modules)
+
+#: call targets that put a function under a jax trace — the bodies they
+#: receive run ONCE at trace time, not per execution
+TRACE_ENTRIES = {"jit", "shard_map", "pallas_call"}
+
+#: origin-module prefixes whose calls dispatch device work in this repo
+DEVICE_ORIGINS = ("jax", "tpu_mpi_tests.kernels", "tpu_mpi_tests.comm")
+
+#: origins whose return values are device-dispatching callables (the
+#: compiled-fn factories: halo iterate builders, pick_kernel_tier, ...)
+FACTORY_ORIGINS = DEVICE_ORIGINS + ("tpu_mpi_tests.drivers",)
+
+
+def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def has_trace_entry(node: ast.AST) -> bool:
+    """True when the expression mentions jit/shard_map/pallas_call —
+    used on decorators (``@functools.partial(jax.jit, ...)`` included)
+    and on call targets (``jax.jit(f)``)."""
+    for n in ast.walk(node):
+        name = None
+        if isinstance(n, ast.Attribute):
+            name = n.attr
+        elif isinstance(n, ast.Name):
+            name = n.id
+        if name in TRACE_ENTRIES:
+            return True
+    return False
+
+
+def traced_functions(ctx: "FileContext") -> list[ast.AST]:
+    """Function nodes (defs and lambdas) whose body runs under a jax
+    trace: jit/shard_map/pallas_call decorators, or being passed as the
+    first argument to such a call (``shard_map(body, mesh=...)``,
+    ``pl.pallas_call(kernel, ...)``, ``jax.jit(f)``)."""
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(n.name, []).append(n)
+
+    traced: list[ast.AST] = []
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(has_trace_entry(d) for d in n.decorator_list):
+                traced.append(n)
+        elif isinstance(n, ast.Call) and has_trace_entry(n.func) and n.args:
+            first = n.args[0]
+            if isinstance(first, ast.Lambda):
+                traced.append(first)
+            elif isinstance(first, ast.Name):
+                traced.extend(defs_by_name.get(first.id, ()))
+    return traced
+
+
+def device_callables(ctx: "FileContext") -> set[str]:
+    """Local names that dispatch device work when called: functions with
+    a trace-entry decorator, or names assigned from a call into jax /
+    the comm / kernels layers (compiled-fn factories)."""
+    out: set[str] = set()
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(has_trace_entry(d) for d in n.decorator_list):
+                out.add(n.name)
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            resolved = ctx.imports.resolve(n.value.func) or ""
+            if not (resolved.startswith(FACTORY_ORIGINS)
+                    or has_trace_entry(n.value.func)):
+                continue
+            for t in n.targets:
+                targets = t.elts if isinstance(
+                    t, (ast.Tuple, ast.List)
+                ) else [t]
+                out.update(e.id for e in targets
+                           if isinstance(e, ast.Name))
+    return out
+
+
+def is_device_call(ctx: "FileContext", call: ast.Call,
+                   local_device: set[str]) -> bool:
+    """Does this call plausibly dispatch (async) device work?"""
+    parts = attr_parts(call.func)
+    if not parts:
+        return False
+    if parts[0] in local_device and len(parts) == 1:
+        return True
+    origin = ctx.imports.origin(parts[0])
+    return bool(origin and origin.startswith(DEVICE_ORIGINS))
+
+
+def stmt_lists(tree: ast.AST) -> Iterator[list[ast.stmt]]:
+    """Every statement list in the tree (module/function/branch bodies)."""
+    for n in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(n, field, None)
+            if isinstance(stmts, list) and stmts and isinstance(
+                stmts[0], ast.stmt
+            ):
+                yield stmts
+
+
+def call_name(node: ast.AST) -> str:
+    return last_attr(node) or "<call>"
+
+
+# ---------------------------------------------------------------------------
 
 
 class ImportMap:
@@ -163,19 +289,33 @@ class FileContext:
 
 
 class ProjectContext:
-    """The full linted file set, for cross-file rules. Module names map
-    to LISTS of contexts: two linted roots can legitimately contain
-    same-named modules (e.g. fixture mini-trees), and collapsing them
-    to one would silently drop files from the reachability scan."""
+    """The full linted file set as serializable per-file *facts*
+    (:func:`tpu_mpi_tests.analysis.program.extract_facts`) — project
+    rules consume facts, never trees, so a warm-cache run hands them the
+    identical view without re-parsing anything. Module names map to
+    LISTS of facts: two linted roots can legitimately contain same-named
+    modules (e.g. fixture mini-trees), and collapsing them to one would
+    silently drop files from the reachability scan."""
 
-    def __init__(self, contexts: list[FileContext],
+    def __init__(self, facts: list[dict],
                  entry_modules: dict[str, str]):
-        self.contexts = contexts
+        self.facts = facts
         self.entry_modules = entry_modules
-        self.by_module: dict[str, list[FileContext]] = {}
-        for c in contexts:
-            if c.module:
-                self.by_module.setdefault(c.module, []).append(c)
+        self.by_module: dict[str, list[dict]] = {}
+        for ff in facts:
+            if ff["module"]:
+                self.by_module.setdefault(ff["module"], []).append(ff)
+        self._index = None
+
+    @property
+    def index(self):
+        """Lazily-built whole-program symbol table / call graph
+        (:class:`tpu_mpi_tests.analysis.program.ProjectIndex`)."""
+        if self._index is None:
+            from tpu_mpi_tests.analysis.program import ProjectIndex
+
+            self._index = ProjectIndex(self.facts)
+        return self._index
 
 
 _SUPPRESS_RE = re.compile(r"tpumt:\s*ignore\[([A-Za-z0-9_,\s]*)\]")
@@ -197,6 +337,14 @@ class Suppression:
     def __post_init__(self):
         if self.used_codes is None:
             self.used_codes = set()
+
+    def as_dict(self) -> dict:
+        return {"codes": sorted(self.codes), "lines": sorted(self.lines),
+                "comment_line": self.comment_line}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Suppression":
+        return cls(set(d["codes"]), set(d["lines"]), d["comment_line"])
 
 
 def collect_suppressions(
@@ -262,6 +410,32 @@ class CodeFilter:
         return not any(code.startswith(p) for p in self.ignore)
 
 
+def replay_cache_entry(
+    entry: dict, path: str,
+) -> tuple[list[Finding], dict, list[Suppression], list[int]] | None:
+    """Rebuild a cached file's analysis, or None — read as a miss — on
+    ANY shape mismatch (a hand-edited/corrupted entry must degrade to a
+    cold parse, never crash the run) or when the filesystem-derived
+    module name changed out from under the cached facts: an added or
+    removed ``__init__.py`` re-anchors :func:`module_name` without
+    touching the file's bytes, and replaying facts under the stale name
+    would make warm project findings diverge from a cold run."""
+    try:
+        facts = entry["facts"]
+        if facts["module"] != module_name(path):
+            return None
+        findings = [
+            Finding(d["path"], int(d["line"]), int(d["col"]),
+                    d["code"], d["message"])
+            for d in entry["findings"]
+        ]
+        supps = [Suppression.from_dict(s) for s in entry["supps"]]
+        malformed = [int(x) for x in entry["malformed"]]
+    except (TypeError, KeyError, ValueError, AttributeError):
+        return None
+    return findings, facts, supps, malformed
+
+
 def all_rules() -> list:
     """The registered rule instances (imported lazily so ``--help`` and
     suppression parsing never load the rule modules)."""
@@ -304,12 +478,31 @@ def lint_paths(
     select: Iterable[str] | None = None,
     ignore: Iterable[str] | None = None,
     entry_modules: dict[str, str] | None = None,
+    cache_path: str | None = None,
+    stats: dict | None = None,
 ) -> list[Finding]:
     """Lint files/directories; returns sorted, suppression-filtered
-    findings (unused/malformed suppressions included as findings)."""
+    findings (unused/malformed suppressions included as findings).
+
+    ``cache_path`` enables the content-hash analysis cache
+    (:mod:`tpu_mpi_tests.analysis.lintcache`): unchanged files replay
+    their cached file-scope findings + facts instead of re-parsing. The
+    default (None) is uncached — library callers and tests stay
+    hermetic; the CLI opts in. ``stats``, when a dict, receives
+    ``files``/``analyzed``/``cache_hits`` counts."""
+    from tpu_mpi_tests.analysis.program import extract_facts
+
     code_filter = CodeFilter(select, ignore)
-    contexts: list[FileContext] = []
     raw: set[Finding] = set()
+    facts_list: list[dict] = []
+    suppressions: dict[str, tuple[list[Suppression], list[int]]] = {}
+    n_files = n_analyzed = n_hits = 0
+
+    cache = None
+    if cache_path:
+        from tpu_mpi_tests.analysis.lintcache import LintCache
+
+        cache = LintCache(cache_path)
 
     # a missing or non-.py path is a broken gate, never a clean one: a
     # renamed directory in the `make lint` path list must fail loudly,
@@ -324,46 +517,74 @@ def lint_paths(
             raw.add(Finding(str(p), 1, 0, "TPM902",
                             "not a python file"))
 
+    rules = all_rules()
+    file_rules = [r for r in rules if r.scope == "file"]
+
     for f in iter_files(paths):
         path = str(f)
         try:
             source = f.read_text()
+        except OSError as e:
+            raw.add(Finding(path, 1, 0, "TPM902", f"cannot parse: {e}"))
+            continue
+        n_files += 1
+        digest = hashlib.sha256(source.encode()).hexdigest()
+
+        entry = cache.get(path, digest) if cache else None
+        if entry is not None:
+            replay = replay_cache_entry(entry, path)
+            if replay is not None:
+                n_hits += 1
+                cached_findings, facts, supps, malformed = replay
+                raw.update(cached_findings)
+                facts_list.append(facts)
+                suppressions[path] = (supps, malformed)
+                continue
+
+        try:
             tree = ast.parse(source, filename=path)
-        except (OSError, SyntaxError, ValueError) as e:
+        except (SyntaxError, ValueError) as e:
             line = getattr(e, "lineno", None) or 1
             raw.add(Finding(path, line, 0, "TPM902",
                             f"cannot parse: {e}"))
             continue
-        contexts.append(FileContext(path, source, tree))
-
-    rules = all_rules()
-    for ctx in contexts:
-        for rule in rules:
-            if rule.scope != "file":
-                continue
+        n_analyzed += 1
+        ctx = FileContext(path, source, tree)
+        file_findings: list[Finding] = []
+        for rule in file_rules:
             for line, col, code, msg in rule.check(ctx):
-                raw.add(Finding(ctx.path, line, col, code, msg))
-    proj = ProjectContext(contexts, entry_modules or DEFAULT_ENTRY_MODULES)
+                file_findings.append(Finding(ctx.path, line, col, code, msg))
+        facts = extract_facts(ctx)
+        supps, malformed = collect_suppressions(source)
+        raw.update(file_findings)
+        facts_list.append(facts)
+        suppressions[path] = (supps, malformed)
+        if cache is not None:
+            cache.put(path, digest, {
+                "findings": [x.as_dict() for x in file_findings],
+                "facts": facts,
+                "supps": [s.as_dict() for s in supps],
+                "malformed": malformed,
+            })
+
+    proj = ProjectContext(facts_list, entry_modules or DEFAULT_ENTRY_MODULES)
     for rule in rules:
         if rule.scope != "project":
             continue
         for path, line, col, code, msg in rule.check_project(proj):
             raw.add(Finding(path, line, col, code, msg))
 
-    suppressions = {
-        ctx.path: collect_suppressions(ctx.source) for ctx in contexts
-    }
     findings: list[Finding] = []
-    for f in raw:
-        if not code_filter.selected(f.code):
+    for fd in raw:
+        if not code_filter.selected(fd.code):
             continue
         matched = False
-        for supp in suppressions.get(f.path, ((), ()))[0]:
-            if f.line in supp.lines and f.code in supp.codes:
-                supp.used_codes.add(f.code)
+        for supp in suppressions.get(fd.path, ((), ()))[0]:
+            if fd.line in supp.lines and fd.code in supp.codes:
+                supp.used_codes.add(fd.code)
                 matched = True
         if not matched:
-            findings.append(f)
+            findings.append(fd)
 
     for path, (supps, malformed) in suppressions.items():
         for supp in supps:
@@ -384,5 +605,10 @@ def lint_paths(
                     "`# tpumt: ignore[TPM101]` (comma-list of codes)",
                 ))
 
+    if cache is not None:
+        cache.save()
+    if stats is not None:
+        stats.update(files=n_files, analyzed=n_analyzed,
+                     cache_hits=n_hits)
     findings.sort()
     return findings

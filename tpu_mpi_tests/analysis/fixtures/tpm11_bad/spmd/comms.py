@@ -1,0 +1,8 @@
+"""Helper whose call graph dispatches a collective — the divergence
+check must see through this frame via the project summaries."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def global_sum(x, mesh):
+    return allreduce_sum(x, mesh)

@@ -1,0 +1,12 @@
+"""TPM1101 bad: only rank 0 enters the collective (through a helper) —
+the other ranks never arrive and the mesh deadlocks."""
+
+from jax import process_index
+
+from spmd.comms import global_sum
+
+
+def step(x, mesh):
+    if process_index() == 0:
+        x = global_sum(x, mesh)
+    return x

@@ -1,0 +1,8 @@
+"""Helper whose call graph dispatches a collective — fine when every
+rank calls it unconditionally."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def global_sum(x, mesh):
+    return allreduce_sum(x, mesh)

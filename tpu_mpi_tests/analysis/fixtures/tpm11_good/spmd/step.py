@@ -1,0 +1,14 @@
+"""TPM1101 good: the collective runs on every rank; the rank branch
+only prints — both paths dispatch the same (empty) collective
+sequence."""
+
+from jax import process_index
+
+from spmd.comms import global_sum
+
+
+def step(x, mesh):
+    x = global_sum(x, mesh)
+    if process_index() == 0:
+        print("step done")
+    return x

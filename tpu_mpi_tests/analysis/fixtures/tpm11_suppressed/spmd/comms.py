@@ -1,0 +1,7 @@
+"""Helper whose call graph dispatches a collective (suppressed tree)."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def global_sum(x, mesh):
+    return allreduce_sum(x, mesh)

@@ -1,0 +1,14 @@
+"""TPM1101 suppressed: the sanctioned rank-0-only shape — this step
+runs under a single-process tune sweep where no sibling rank exists to
+deadlock against, and the suppression's why-comment says so."""
+
+from jax import process_index
+
+from spmd.comms import global_sum
+
+
+def step(x, mesh):
+    # single-process sweep: rank 0 IS the whole mesh here
+    if process_index() == 0:  # tpumt: ignore[TPM1101]
+        x = global_sum(x, mesh)
+    return x

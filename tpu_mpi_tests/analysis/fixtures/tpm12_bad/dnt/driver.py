@@ -1,0 +1,10 @@
+"""TPM1201 bad: x is donated through reduce_into (one helper level —
+allreduce_sum donates position 0) and read again afterwards: the buffer
+is already deleted."""
+
+from dnt.helper import reduce_into
+
+
+def step(x, mesh):
+    total = reduce_into(x, mesh)
+    return x + total

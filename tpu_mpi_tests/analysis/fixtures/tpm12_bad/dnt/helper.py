@@ -1,0 +1,8 @@
+"""One helper level: a param forwarded into a donated position of the
+callee is effectively donated here too (summary composition)."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def reduce_into(buf, mesh):
+    return allreduce_sum(buf, mesh)

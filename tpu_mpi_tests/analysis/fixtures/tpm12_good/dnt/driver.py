@@ -1,0 +1,9 @@
+"""TPM1201 good: the in-place idiom — the result is rebound to the
+donated name, so every later read sees the live replacement buffer."""
+
+from dnt.helper import reduce_into
+
+
+def step(x, mesh):
+    x = reduce_into(x, mesh)
+    return x * 2.0

@@ -1,0 +1,11 @@
+"""TPM1201 suppressed: this probe reads the donated buffer ON PURPOSE —
+it exists to demonstrate the use-after-donate failure mode, and the
+why-comment says so."""
+
+from dnt.helper import reduce_into
+
+
+def step(x, mesh):
+    total = reduce_into(x, mesh)
+    # the MPI_IN_PLACE-style probe: touching the deleted buffer IS the demo
+    return x + total  # tpumt: ignore[TPM1201]

@@ -1,0 +1,832 @@
+"""Whole-program layer for ``tpumt-lint`` (ISSUE 10 tentpole).
+
+Turns the per-file lexical linter into an interprocedural analyzer:
+:func:`extract_facts` distills each parsed file into a JSON-serializable
+*facts* record — module-level imports (the TPM4xx graph edges), axis
+bindings/uses (TPM5xx), dispatch-less timed regions (TPM1xx), donation
+data flow (TPM12xx), and one bottom-up summary per function:
+
+* **dispatches** — the body (own scope, nested defs excluded) calls into
+  jax / the comm / kernels layers or a local compiled-fn;
+* **syncs** — it calls a ``block``/``block_until_ready``/``comm_span``-
+  class synchronizer;
+* **events** — the ordered sequence of collective dispatches and
+  outgoing calls (the call-graph edges plus the TPM11xx comparison
+  alphabet);
+* **donates** — positional params donated via ``donate_argnums`` or
+  forwarded into a donated position of a callee (one helper level by
+  summary composition);
+* **returns_handle** — it returns an ``async_span`` dispatch-window
+  handle (directly or through another returning helper);
+* **rank_ifs** — branches guarded by rank-dependent control flow
+  (``process_index()`` / ``rank == 0``-shaped tests) with each branch's
+  event sequence.
+
+:class:`ProjectIndex` is the project-scope view: a module symbol table
+over every linted file's facts plus memoized transitive resolution
+(call-graph closure) for the properties above. Facts round-trip through
+JSON unchanged, which is what makes the analysis cache
+(:mod:`tpu_mpi_tests.analysis.lintcache`) able to skip parse + summary
+for unchanged files while the project pass still sees the whole program.
+
+Known limits (documented in README "Static analysis"): resolution is
+name-based — dynamic dispatch, method calls through objects, ``*args``
+forwarding (except the sanctioned ``span_call``/``DispatchWindow.call``
+shapes) and handles stored into containers are invisible to the
+summaries. The rules built on top are conservative accordingly.
+
+Stdlib-only by contract, like the rest of the analysis package.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import (
+    FileContext,
+    attr_parts,
+    device_callables,
+    is_device_call,
+    last_attr,
+    stmt_lists,
+    walk_calls,
+)
+
+# ---------------------------------------------------------------------------
+# shared vocabularies (the sync-honesty constants live here so both the
+# file-scope rule and the facts extractor read ONE definition without
+# the extractor importing the rule registry)
+
+#: clock reads that start/stop a timing region
+CLOCKS = {"time.perf_counter", "time.monotonic"}
+
+#: call targets (final component) that synchronize device work before the
+#: clock is read again — chain_rate/dispatch_rate embed the discipline
+SYNC_NAMES = {
+    "block", "block_until_ready", "comm_span", "span_call", "timed",
+    "host_value", "device_get", "chain_rate", "dispatch_rate",
+    "sync_global_devices", "barrier",
+}
+
+#: calls whose string literals BIND axis names for a file (TPM5xx)
+AXIS_DEF_CALLS = {
+    "shard_map", "Mesh", "AbstractMesh", "make_mesh", "NamedSharding",
+    "PartitionSpec", "P",
+}
+
+#: collective/axis-query calls checked, with the axis argument position
+AXIS_USES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "ppermute": 1, "all_gather": 1, "all_to_all": 1, "pshuffle": 1,
+    "pbroadcast": 1, "axis_index": 0, "axis_size": 0,
+    "pcast_varying": 1, "pcast": 1,
+}
+
+#: origins whose AXIS_USES calls are real collectives (a local helper
+#: coincidentally named `all_gather` is not checked)
+USE_ORIGINS = ("jax", "tpu_mpi_tests.compat")
+
+#: final-name vocabulary of collective dispatch points for the TPM11xx
+#: divergence alphabet: the jax host-level collectives plus this repo's
+#: comm-layer wrappers (every one of them enters an operation ALL ranks
+#: of the mesh must enter together)
+COLLECTIVE_CALLS = {
+    # jax / multihost
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "ppermute",
+    "pshuffle", "pbroadcast", "all_to_all", "process_allgather",
+    # tpu_mpi_tests.comm wrappers
+    "all_gather", "all_gather_rdma", "all_gather_inplace",
+    "allreduce_sum", "allreduce_rdma", "reduce_scatter_sum",
+    "reduce_sum", "barrier", "halo_exchange", "ring_attention",
+    "ulysses_attention", "route_tokens", "embedding_lookup",
+    "embedding_scatter_add", "per_rank_sums", "per_rank_err_norms",
+}
+
+#: origin prefixes a resolved collective call must come from — a local
+#: helper that happens to share a name resolves through its own summary
+#: instead
+COLLECTIVE_ORIGINS = ("jax", "tpu_mpi_tests")
+
+#: repo wrappers known to donate positional arguments (TPM12xx): every
+#: one jits its payload with ``donate_argnums=0`` under the hood — the
+#: ``x = allreduce(x)`` in-place idiom. Position → donated.
+KNOWN_DONATING = {
+    "allreduce_sum": (0,),
+    "allreduce_rdma": (0,),
+    "all_gather_inplace": (0,),
+    "reduce_scatter_sum": (0,),
+    "halo_exchange": (0,),
+    "embedding_scatter_add": (0,),
+}
+
+#: call shapes that forward ``*args`` to a callee passed at position 1
+#: (``span_call(op, fn, *args)`` / ``DispatchWindow.call(op, fn, *args)``)
+#: — the donating-chain plumbing ISSUE 7 made pervasive
+FORWARDER_CALLS = {"span_call", "call"}
+
+#: calls that mint an async dispatch-window handle (TPM8xx)
+HANDLE_SOURCES = {"async_span"}
+
+#: names whose comparison in an `if` test makes the branch rank-dependent
+RANK_NAMES = {"rank", "proc", "proc_index", "process_index", "pidx",
+              "rank_id"}
+#: call targets (final component) in an `if` test that read the rank
+RANK_CALLS = {"process_index"}
+
+# summary-expansion recursion bound, not a device schedule knob — there
+# is nothing to tune and no topology it varies with
+_MAX_DEPTH = 16  # tpumt: ignore[TPM701]
+
+
+# ---------------------------------------------------------------------------
+# small walkers
+
+
+def _own_nodes(root: ast.AST) -> Iterator[ast.AST]:
+    """In-order walk of ``root``'s subtree, skipping nested function and
+    lambda bodies — "own scope": what executes when this code object
+    runs, not what it merely defines."""
+    for child in ast.iter_child_nodes(root):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from _own_nodes(child)
+
+
+def _walk_functions(
+    tree: ast.Module,
+) -> list[tuple[str, ast.AST]]:
+    """``(qualname, node)`` for every def, in document order — nested
+    defs and methods get dotted qualnames (``outer.inner``,
+    ``Cls.meth``)."""
+    out: list[tuple[str, ast.AST]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child))
+                visit(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def canon_target(ctx: FileContext, func: ast.AST) -> str | None:
+    """Canonical dotted target of a call: import origins substituted and
+    relative imports resolved against the file's module, so the project
+    index can look the name up. None for non-name-rooted calls."""
+    resolved = ctx.imports.resolve(func)
+    if not resolved:
+        return None
+    if resolved.startswith("."):
+        resolved = _resolve_relative(
+            resolved, ctx.module, ctx.path.endswith("__init__.py")
+        )
+    return resolved
+
+
+def _is_collective(canon: str | None, last: str | None) -> bool:
+    if not canon or last not in COLLECTIVE_CALLS:
+        return False
+    return canon.startswith(COLLECTIVE_ORIGINS)
+
+
+# ---------------------------------------------------------------------------
+# module-level imports (the TPM4xx graph edges; hoisted from
+# rules/import_hygiene so facts extraction owns the single definition)
+
+
+def _resolve_relative(module: str, current: str, is_pkg: bool) -> str:
+    """``.foo``/``..foo`` against the importing module's package."""
+    level = len(module) - len(module.lstrip("."))
+    name = module[level:]
+    parts = current.split(".") if current else []
+    if not is_pkg:
+        parts = parts[:-1]
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+    return ".".join(parts + ([name] if name else []))
+
+
+def _catches_import_error(stmt: ast.Try) -> bool:
+    for h in stmt.handlers:
+        if h.type is None:
+            return True  # bare except
+        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for t in types:
+            name = getattr(t, "id", None) or getattr(t, "attr", None)
+            if name in ("ImportError", "ModuleNotFoundError",
+                        "Exception", "BaseException"):
+                return True
+    return False
+
+
+def module_level_imports(
+    ctx: FileContext,
+) -> list[list]:
+    """``[line, module, from_names]`` for every import executed at module
+    import time: top-level statements plus those nested in module-level
+    ``if``/``try`` (conditional imports still run), but nothing inside a
+    function or class body (lazy by construction), nothing under an
+    ``if TYPE_CHECKING:`` guard (never runs), and nothing in a
+    ``try: ... except ImportError:`` body (the canonical safe optional
+    import — handler bodies are still scanned)."""
+    out: list[list] = []
+    is_pkg = ctx.path.endswith("__init__.py")
+
+    def scan(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    out.append([stmt.lineno, a.name, []])
+            elif isinstance(stmt, ast.ImportFrom):
+                mod = ("." * stmt.level) + (stmt.module or "")
+                if mod.startswith("."):
+                    mod = _resolve_relative(mod, ctx.module, is_pkg)
+                out.append([stmt.lineno, mod,
+                            [a.name for a in stmt.names]])
+            elif isinstance(stmt, ast.If):
+                if any(
+                    isinstance(n, (ast.Name, ast.Attribute))
+                    and (getattr(n, "id", None) == "TYPE_CHECKING"
+                         or getattr(n, "attr", None) == "TYPE_CHECKING")
+                    for n in ast.walk(stmt.test)
+                ):
+                    continue
+                scan(stmt.body)
+                scan(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                if not _catches_import_error(stmt):
+                    scan(stmt.body)
+                scan(stmt.orelse)
+                scan(stmt.finalbody)
+                for h in stmt.handlers:
+                    scan(h.body)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                scan(stmt.body)
+
+    scan(ctx.tree.body)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# timed regions (the TPM1xx detector, shared with rules/sync_honesty)
+
+
+def _clock_assign(ctx: FileContext, stmt: ast.stmt) -> str | None:
+    """``t0 = time.perf_counter()`` → ``"t0"``; else None."""
+    if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Call)):
+        return None
+    if ctx.imports.resolve(stmt.value.func) in CLOCKS:
+        return stmt.targets[0].id
+    return None
+
+
+def _uses_in_sub(stmt: ast.stmt, name: str) -> bool:
+    """Does the statement read the clock delta (``... - t0``)?"""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
+            for side in (n.left, n.right):
+                if isinstance(side, ast.Name) and side.id == name:
+                    return True
+    return False
+
+
+def _rebinds(stmt: ast.stmt, name: str) -> bool:
+    if isinstance(stmt, ast.Assign):
+        return any(isinstance(t, ast.Name) and t.id == name
+                   for t in stmt.targets)
+    return False
+
+
+def iter_timed_regions(ctx: FileContext) -> Iterator[list[ast.stmt]]:
+    """Every clock-pair region in the file: the statements between a
+    ``t0 = perf_counter()`` assignment and the first read of its delta
+    (``... - t0``), inclusive. A rebind of the clock name before any
+    delta read abandons the region (clock restarted)."""
+    for stmts in stmt_lists(ctx.tree):
+        for i, stmt in enumerate(stmts):
+            t = _clock_assign(ctx, stmt)
+            if not t:
+                continue
+            region: list[ast.stmt] = []
+            for j in range(i + 1, len(stmts)):
+                region.append(stmts[j])
+                if _uses_in_sub(stmts[j], t):
+                    yield region
+                    break
+                if _rebinds(stmts[j], t):
+                    break  # clock restarted before any delta read
+
+
+# ---------------------------------------------------------------------------
+# facts extraction
+
+
+def _rank_dependent(test: ast.AST) -> bool:
+    """Is this `if` test a function of the process rank? Conservative:
+    a ``process_index()`` call anywhere in it, or a comparison whose
+    side is a rank-named variable/attribute (``rank == 0``,
+    ``topo.process_index != 0``)."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            if (last_attr(n.func) or "") in RANK_CALLS:
+                return True
+        elif isinstance(n, ast.Compare):
+            for side in [n.left] + list(n.comparators):
+                name = None
+                if isinstance(side, ast.Name):
+                    name = side.id
+                elif isinstance(side, ast.Attribute):
+                    name = side.attr
+                if name in RANK_NAMES:
+                    return True
+    return False
+
+
+def _branch_events(ctx: FileContext, stmts: list[ast.stmt]) -> list:
+    """Ordered ``["coll", op]`` / ``["call", target]`` events in a
+    statement list's own scope (nested defs excluded)."""
+    ev: list = []
+    for s in stmts:
+        for n in [s] + list(_own_nodes(s)):
+            if not isinstance(n, ast.Call):
+                continue
+            canon = canon_target(ctx, n.func)
+            last = last_attr(n.func)
+            if _is_collective(canon, last):
+                ev.append(["coll", last])
+            elif canon:
+                ev.append(["call", canon])
+    return ev
+
+
+def _donate_positions(node: ast.AST) -> list[int]:
+    """``donate_argnums`` positions from the def's decorators (the
+    ``functools.partial(jax.jit, donate_argnums=...)`` idiom included)."""
+    pos: set[int] = set()
+    for dec in node.decorator_list:
+        for n in ast.walk(dec):
+            if not isinstance(n, ast.Call):
+                continue
+            for kw in n.keywords:
+                if kw.arg != "donate_argnums":
+                    continue
+                v = kw.value
+                vals = v.elts if isinstance(
+                    v, (ast.Tuple, ast.List)
+                ) else [v]
+                for e in vals:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, int
+                    ):
+                        pos.add(e.value)
+    return sorted(pos)
+
+
+def _function_facts(ctx: FileContext, qual: str, node: ast.AST,
+                    local_device: set[str]) -> dict:
+    params = [a.arg for a in (node.args.posonlyargs + node.args.args)]
+    pidx = {p: i for i, p in enumerate(params)}
+    dispatches = syncs = returns_handle = False
+    events: list = []
+    forwards: list = []
+    return_targets: list[str] = []
+    rank_ifs: list[dict] = []
+    handle_names: set[str] = set()
+    assigned_calls: list[list] = []
+    loads = {
+        n.id for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+    for n in _own_nodes(node):
+        if isinstance(n, ast.Call):
+            canon = canon_target(ctx, n.func)
+            last = last_attr(n.func)
+            if last in SYNC_NAMES:
+                syncs = True
+            if is_device_call(ctx, n, local_device):
+                dispatches = True
+            if _is_collective(canon, last):
+                events.append(["coll", last])
+            elif canon:
+                events.append(["call", canon])
+            if canon is None:
+                continue
+            if (last in FORWARDER_CALLS and len(n.args) > 1
+                    and isinstance(n.args[1], ast.Name)):
+                inner = canon_target(ctx, n.args[1])
+                for i, a in enumerate(n.args[2:], start=2):
+                    if isinstance(a, ast.Name) and a.id in pidx and inner:
+                        forwards.append([pidx[a.id], inner, i - 2])
+            else:
+                for i, a in enumerate(n.args):
+                    if isinstance(a, ast.Name) and a.id in pidx:
+                        forwards.append([pidx[a.id], canon, i])
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            canon = canon_target(ctx, n.value.func)
+            tnames = [t.id for t in n.targets if isinstance(t, ast.Name)]
+            if not canon:
+                continue
+            if canon.rsplit(".", 1)[-1] in HANDLE_SOURCES:
+                handle_names.update(tnames)
+            else:
+                for t in tnames:
+                    assigned_calls.append(
+                        [t, canon, n.lineno, n.col_offset]
+                    )
+        elif isinstance(n, ast.Return) and n.value is not None:
+            v = n.value
+            if isinstance(v, ast.Call):
+                canon = canon_target(ctx, v.func)
+                if canon and canon.rsplit(".", 1)[-1] in HANDLE_SOURCES:
+                    returns_handle = True
+                elif canon:
+                    return_targets.append(canon)
+            elif isinstance(v, ast.Name) and v.id in handle_names:
+                returns_handle = True
+        elif isinstance(n, ast.If) and _rank_dependent(n.test):
+            rank_ifs.append({
+                "line": n.lineno, "col": n.col_offset,
+                "then": _branch_events(ctx, n.body),
+                "orelse": _branch_events(ctx, n.orelse),
+            })
+
+    return {
+        "name": qual,
+        "line": node.lineno,
+        "params": params,
+        "donates": _donate_positions(node),
+        "dispatches": dispatches,
+        "syncs": syncs,
+        "events": events,
+        "forwards": forwards,
+        "returns_handle": returns_handle,
+        "return_targets": return_targets,
+        "rank_ifs": rank_ifs,
+        # unconsumed call-result handles: assigned, then never read —
+        # the TPM802 candidates (a name loaded ANYWHERE in the def,
+        # nested closures included, counts as consumed)
+        "handle_drops": [a for a in assigned_calls if a[0] not in loads],
+    }
+
+
+def _axis_facts(ctx: FileContext) -> tuple[list[str], list[list]]:
+    bound: set[str] = set()
+    for call in walk_calls(ctx.tree):
+        if last_attr(call.func) in AXIS_DEF_CALLS:
+            for n in ast.walk(call):
+                if isinstance(n, ast.Constant) and isinstance(
+                    n.value, str
+                ):
+                    bound.add(n.value)
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(
+                    v.value, str
+                ):
+                    bound.add(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    bound.update(
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    )
+
+    uses: list[list] = []
+    for call in walk_calls(ctx.tree):
+        name = last_attr(call.func)
+        if name not in AXIS_USES:
+            continue
+        chain = attr_parts(call.func)
+        if not chain:
+            continue
+        origin = ctx.imports.origin(chain[0]) or ""
+        if not origin.startswith(USE_ORIGINS):
+            continue
+        axis_arg = None
+        pos = AXIS_USES[name]
+        if len(call.args) > pos:
+            axis_arg = call.args[pos]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    axis_arg = kw.value
+        if axis_arg is None:
+            continue
+        lits = []
+        if isinstance(axis_arg, ast.Constant) and isinstance(
+            axis_arg.value, str
+        ):
+            lits.append((axis_arg.value, axis_arg))
+        elif isinstance(axis_arg, (ast.Tuple, ast.List)):
+            lits.extend(
+                (e.value, e) for e in axis_arg.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)
+            )
+        for axis, anode in lits:
+            uses.append([anode.lineno, anode.col_offset, name, axis])
+    return sorted(bound), uses
+
+
+def _timed_region_facts(ctx: FileContext,
+                        local_device: set[str]) -> list[dict]:
+    """Regions TPM101 cannot judge alone: no sync, no DIRECT dispatch —
+    but outgoing calls whose summaries may dispatch (TPM102's input)."""
+    out: list[dict] = []
+    for region in iter_timed_regions(ctx):
+        calls: list[list] = []
+        has_sync = has_direct = False
+        for stmt in region:
+            for call in walk_calls(stmt):
+                if last_attr(call.func) in SYNC_NAMES:
+                    has_sync = True
+                    break
+                if is_device_call(ctx, call, local_device):
+                    has_direct = True
+                    continue
+                canon = canon_target(ctx, call.func)
+                if canon:
+                    calls.append([canon, call.lineno, call.col_offset])
+            if has_sync:
+                break
+        if not has_sync and not has_direct and calls:
+            out.append({"calls": calls})
+    return out
+
+
+def _dflow_facts(ctx: FileContext) -> list[dict]:
+    """Donation data flow: per statement list, each statement's calls
+    (with positional arg names), subsequent reads and rebinds of those
+    arg names — enough for TPM1201's read-after-donate scan without
+    keeping the tree around.
+
+    Two scope/flow guards keep the scan honest: a ``def``/``class``
+    statement contributes nothing to its ENCLOSING list (its body is a
+    different scope — same-named locals in sibling functions are
+    unrelated), and a donating call under a ``return``/``raise`` is not
+    recorded (control exits the list, so no later statement runs on
+    that path — the ``if host_staged: return span_call(zg, ...)``
+    dispatch-fork idiom is safe by construction)."""
+    loop_bodies: set[int] = set()
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+            loop_bodies.add(id(n.body))
+
+    nested_skip: dict[int, list[ast.AST]] = {}
+
+    def stmt_own(stmt: ast.stmt) -> list[ast.AST]:
+        if id(stmt) not in nested_skip:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                nested_skip[id(stmt)] = []  # its own scope, not ours
+            else:
+                nested_skip[id(stmt)] = [stmt] + list(_own_nodes(stmt))
+        return nested_skip[id(stmt)]
+
+    out: list[dict] = []
+    for stmts in stmt_lists(ctx.tree):
+        per_stmt_calls: list[list[dict]] = []
+        arg_names: set[str] = set()
+        for stmt in stmts:
+            calls: list[dict] = []
+            exiting: set[int] = set()
+            for n in stmt_own(stmt):
+                if isinstance(n, (ast.Return, ast.Raise)):
+                    exiting.update(id(w) for w in ast.walk(n))
+            for n in stmt_own(stmt):
+                if not isinstance(n, ast.Call) or id(n) in exiting:
+                    continue
+                canon = canon_target(ctx, n.func)
+                if not canon:
+                    continue
+                args = [a.id if isinstance(a, ast.Name) else None
+                        for a in n.args]
+                if not any(args):
+                    continue
+                fwd = None
+                if (canon.rsplit(".", 1)[-1] in FORWARDER_CALLS
+                        and len(n.args) > 1
+                        and isinstance(n.args[1], ast.Name)):
+                    fwd = canon_target(ctx, n.args[1])
+                calls.append({"line": n.lineno, "col": n.col_offset,
+                              "target": canon, "args": args,
+                              "fwd": fwd})
+                arg_names.update(a for a in args if a)
+            per_stmt_calls.append(calls)
+        if not arg_names:
+            continue
+        entries: list[dict] = []
+        for stmt, calls in zip(stmts, per_stmt_calls):
+            reads: list[list] = []
+            binds: set[str] = set()
+            seen_read: set[str] = set()
+            for n in stmt_own(stmt):
+                if not isinstance(n, ast.Name) or n.id not in arg_names:
+                    continue
+                if isinstance(n.ctx, ast.Load):
+                    if n.id not in seen_read:
+                        seen_read.add(n.id)
+                        reads.append([n.id, n.lineno])
+                elif isinstance(n.ctx, ast.Store):
+                    binds.add(n.id)
+            entries.append({"line": stmt.lineno, "calls": calls,
+                            "reads": reads, "binds": sorted(binds)})
+        out.append({"loop": id(stmts) in loop_bodies, "stmts": entries})
+    return out
+
+
+def extract_facts(ctx: FileContext) -> dict:
+    """The file's whole-program facts record — pure data, JSON-stable
+    (cold extraction and a cache round-trip produce identical project
+    findings)."""
+    local_device = device_callables(ctx)
+    axis_bound, axis_uses = _axis_facts(ctx)
+    return {
+        "path": ctx.path,
+        "module": ctx.module,
+        "mod_imports": module_level_imports(ctx),
+        "axis_bound": axis_bound,
+        "axis_uses": axis_uses,
+        "timed_regions": _timed_region_facts(ctx, local_device),
+        "dflow": _dflow_facts(ctx),
+        "functions": [
+            _function_facts(ctx, qual, node, local_device)
+            for qual, node in _walk_functions(ctx.tree)
+        ],
+    }
+
+
+# ---------------------------------------------------------------------------
+# project index
+
+
+class ProjectIndex:
+    """Module symbol table + call graph over the linted facts, with
+    memoized transitive resolution of the per-function summaries."""
+
+    def __init__(self, facts_list: list[dict]):
+        self.facts = facts_list
+        self.functions: dict[str, list[dict]] = {}
+        self._fn_module: dict[int, str] = {}
+        self._fn_by_module: dict[str, list[tuple[str, dict]]] = {}
+        for ff in facts_list:
+            for fn in ff["functions"]:
+                key = f'{ff["module"]}.{fn["name"]}' if ff["module"] \
+                    else fn["name"]
+                self.functions.setdefault(key, []).append(fn)
+                self._fn_module[id(fn)] = ff["module"]
+                self._fn_by_module.setdefault(
+                    ff["module"], []
+                ).append((fn["name"], fn))
+        self._memo: dict[tuple, bool] = {}
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_funcs(self, target: str | None,
+                      module: str) -> list[dict]:
+        """Facts for a canonical call target seen from ``module``: an
+        exact module-qualified match first, then (for bare names) any
+        same-module nested def with that final name — bare calls to
+        closures are common in driver bodies and skipping them would
+        blind every interprocedural family to the dominant local-helper
+        idiom."""
+        if not target:
+            return []
+        if "." in target:
+            return self.functions.get(target, [])
+        exact = self.functions.get(
+            f"{module}.{target}" if module else target, []
+        )
+        if exact:
+            return exact
+        suffix = f".{target}"
+        return [fn for name, fn in self._fn_by_module.get(module, [])
+                if name.endswith(suffix)]
+
+    def _module_of(self, fn: dict) -> str:
+        return self._fn_module.get(id(fn), "")
+
+    # -- transitive summaries ---------------------------------------------
+
+    def _trans(self, fn: dict, key: str, direct) -> bool:
+        memo_key = (key, id(fn))
+        if memo_key in self._memo:
+            return self._memo[memo_key]
+        self._memo[memo_key] = False  # cycle guard
+        val = direct(fn)
+        if not val:
+            mod = self._module_of(fn)
+            for kind, target in fn["events"]:
+                if kind != "call":
+                    continue
+                if any(self._trans(g, key, direct)
+                       for g in self.resolve_funcs(target, mod)):
+                    val = True
+                    break
+        self._memo[memo_key] = val
+        return val
+
+    def dispatches(self, fn: dict) -> bool:
+        """Does this function's call graph dispatch device work?"""
+        return self._trans(
+            fn, "disp",
+            lambda f: f["dispatches"]
+            or any(e[0] == "coll" for e in f["events"]),
+        )
+
+    def syncs(self, fn: dict) -> bool:
+        """Does its call graph reach a block/comm_span-class sync?"""
+        return self._trans(fn, "sync", lambda f: f["syncs"])
+
+    def returns_handle(self, fn: dict) -> bool:
+        """Does it return an async_span handle (directly or through a
+        returning helper)?"""
+        memo_key = ("handle", id(fn))
+        if memo_key in self._memo:
+            return self._memo[memo_key]
+        self._memo[memo_key] = False
+        val = fn["returns_handle"]
+        if not val:
+            mod = self._module_of(fn)
+            for target in fn["return_targets"]:
+                if any(self.returns_handle(g)
+                       for g in self.resolve_funcs(target, mod)):
+                    val = True
+                    break
+        self._memo[memo_key] = val
+        return val
+
+    # -- collective sequences (TPM11xx) ------------------------------------
+
+    def collective_seq(self, events: list, module: str,
+                       _depth: int = 0,
+                       _stack: frozenset = frozenset()) -> list[str]:
+        """Flatten an event list into the ordered collective-op sequence
+        its execution dispatches, expanding calls through the summaries
+        (first match per target; cycle- and depth-guarded)."""
+        if _depth > _MAX_DEPTH:
+            return []
+        out: list[str] = []
+        for kind, val in events:
+            if kind == "coll":
+                out.append(val)
+                continue
+            funcs = self.resolve_funcs(val, module)
+            if not funcs:
+                continue
+            g = funcs[0]
+            if id(g) in _stack:
+                continue
+            out.extend(self.collective_seq(
+                g["events"], self._module_of(g), _depth + 1,
+                _stack | {id(g)},
+            ))
+        return out
+
+    # -- donation (TPM12xx) -------------------------------------------------
+
+    def call_donates(self, target: str | None, module: str,
+                     _depth: int = 0) -> set[int]:
+        """Donated positional-argument positions of a call to
+        ``target``: the curated comm-wrapper table plus any project
+        function's effective donations (its own ``donate_argnums`` or a
+        param forwarded into a donated position of ITS callee — the
+        one-helper-level composition)."""
+        out: set[int] = set()
+        if not target or _depth > 3:
+            return out
+        last = target.rsplit(".", 1)[-1]
+        if last in KNOWN_DONATING and (
+            target == last or target.startswith("tpu_mpi_tests")
+        ):
+            out.update(KNOWN_DONATING[last])
+        for fn in self.resolve_funcs(target, module):
+            out.update(fn["donates"])
+            mod = self._module_of(fn)
+            for ppos, fwd_target, cpos in fn["forwards"]:
+                if cpos in self.call_donates(fwd_target, mod, _depth + 1):
+                    out.add(ppos)
+        return out
+
+    def site_donates(self, call: dict, module: str) -> set[int]:
+        """Donated positions at a recorded dflow call site, the
+        span_call/DispatchWindow.call forwarding shape included (callee
+        at arg 1, payload from arg 2 on)."""
+        out = set(self.call_donates(call["target"], module))
+        if call.get("fwd"):
+            out |= {p + 2
+                    for p in self.call_donates(call["fwd"], module)}
+        return out

@@ -2,33 +2,53 @@
 
 Each rule module documents the shipped bug its family encodes; codes are
 stable (a code is never reused for a different hazard) so suppression
-comments stay meaningful across releases.
+comments stay meaningful across releases. File-scope rules see one
+parsed file; project-scope rules see the whole linted program as
+serialized facts (``analysis/program.py``) — the ISSUE-10 families
+(TPM11xx/TPM12xx) and the interprocedural upgrades (TPM102/TPM502/
+TPM802) all live there.
 """
 
-from tpu_mpi_tests.analysis.rules.axis_consistency import AxisConsistency
+from tpu_mpi_tests.analysis.rules.axis_consistency import (
+    AxisConsistency,
+    AxisProgramConsistency,
+)
 from tpu_mpi_tests.analysis.rules.chaos_containment import (
     ChaosContainment,
 )
+from tpu_mpi_tests.analysis.rules.collective_divergence import (
+    CollectiveDivergence,
+)
 from tpu_mpi_tests.analysis.rules.concurrency import UnlockedSharedWrite
+from tpu_mpi_tests.analysis.rules.donation_safety import DonationSafety
 from tpu_mpi_tests.analysis.rules.import_hygiene import ImportHygiene
 from tpu_mpi_tests.analysis.rules.overlap_regions import (
+    EscapedAsyncHandle,
     OverlapRegionSync,
 )
 from tpu_mpi_tests.analysis.rules.schedule_constants import (
     ScheduleConstants,
 )
-from tpu_mpi_tests.analysis.rules.sync_honesty import SyncHonesty
+from tpu_mpi_tests.analysis.rules.sync_honesty import (
+    InterprocSyncHonesty,
+    SyncHonesty,
+)
 from tpu_mpi_tests.analysis.rules.trace_purity import TracePurity
 from tpu_mpi_tests.analysis.rules.x64_safety import X64Safety
 
 ALL_RULES = [
     SyncHonesty(),
+    InterprocSyncHonesty(),
     TracePurity(),
     X64Safety(),
     ImportHygiene(),
     AxisConsistency(),
+    AxisProgramConsistency(),
     UnlockedSharedWrite(),
     ScheduleConstants(),
     OverlapRegionSync(),
+    EscapedAsyncHandle(),
     ChaosContainment(),
+    CollectiveDivergence(),
+    DonationSafety(),
 ]

@@ -1,114 +1,21 @@
-"""Shared AST helpers for the rule modules."""
+"""Shared AST helpers for the rule modules.
 
-from __future__ import annotations
+The implementations moved to :mod:`tpu_mpi_tests.analysis.core` when the
+whole-program facts extractor (``analysis/program.py``) started needing
+them — importing them from here would drag the rule registry into the
+extractor's import path. This module re-exports them so rule code keeps
+its ``_util.`` spelling.
+"""
 
-import ast
-from typing import Iterator
-
-from tpu_mpi_tests.analysis.core import FileContext, attr_parts, last_attr
-
-#: call targets that put a function under a jax trace — the bodies they
-#: receive run ONCE at trace time, not per execution
-TRACE_ENTRIES = {"jit", "shard_map", "pallas_call"}
-
-#: origin-module prefixes whose calls dispatch device work in this repo
-DEVICE_ORIGINS = ("jax", "tpu_mpi_tests.kernels", "tpu_mpi_tests.comm")
-
-#: origins whose return values are device-dispatching callables (the
-#: compiled-fn factories: halo iterate builders, pick_kernel_tier, ...)
-FACTORY_ORIGINS = DEVICE_ORIGINS + ("tpu_mpi_tests.drivers",)
-
-
-def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
-    for n in ast.walk(node):
-        if isinstance(n, ast.Call):
-            yield n
-
-
-def has_trace_entry(node: ast.AST) -> bool:
-    """True when the expression mentions jit/shard_map/pallas_call —
-    used on decorators (``@functools.partial(jax.jit, ...)`` included)
-    and on call targets (``jax.jit(f)``)."""
-    for n in ast.walk(node):
-        name = None
-        if isinstance(n, ast.Attribute):
-            name = n.attr
-        elif isinstance(n, ast.Name):
-            name = n.id
-        if name in TRACE_ENTRIES:
-            return True
-    return False
-
-
-def traced_functions(ctx: FileContext) -> list[ast.AST]:
-    """Function nodes (defs and lambdas) whose body runs under a jax
-    trace: jit/shard_map/pallas_call decorators, or being passed as the
-    first argument to such a call (``shard_map(body, mesh=...)``,
-    ``pl.pallas_call(kernel, ...)``, ``jax.jit(f)``)."""
-    defs_by_name: dict[str, list[ast.AST]] = {}
-    for n in ast.walk(ctx.tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            defs_by_name.setdefault(n.name, []).append(n)
-
-    traced: list[ast.AST] = []
-    for n in ast.walk(ctx.tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(has_trace_entry(d) for d in n.decorator_list):
-                traced.append(n)
-        elif isinstance(n, ast.Call) and has_trace_entry(n.func) and n.args:
-            first = n.args[0]
-            if isinstance(first, ast.Lambda):
-                traced.append(first)
-            elif isinstance(first, ast.Name):
-                traced.extend(defs_by_name.get(first.id, ()))
-    return traced
-
-
-def device_callables(ctx: FileContext) -> set[str]:
-    """Local names that dispatch device work when called: functions with
-    a trace-entry decorator, or names assigned from a call into jax /
-    the comm / kernels layers (compiled-fn factories)."""
-    out: set[str] = set()
-    for n in ast.walk(ctx.tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if any(has_trace_entry(d) for d in n.decorator_list):
-                out.add(n.name)
-        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
-            resolved = ctx.imports.resolve(n.value.func) or ""
-            if not (resolved.startswith(FACTORY_ORIGINS)
-                    or has_trace_entry(n.value.func)):
-                continue
-            for t in n.targets:
-                targets = t.elts if isinstance(
-                    t, (ast.Tuple, ast.List)
-                ) else [t]
-                out.update(e.id for e in targets
-                           if isinstance(e, ast.Name))
-    return out
-
-
-def is_device_call(ctx: FileContext, call: ast.Call,
-                   local_device: set[str]) -> bool:
-    """Does this call plausibly dispatch (async) device work?"""
-    parts = attr_parts(call.func)
-    if not parts:
-        return False
-    if parts[0] in local_device and len(parts) == 1:
-        return True
-    origin = ctx.imports.origin(parts[0])
-    return bool(origin and origin.startswith(DEVICE_ORIGINS))
-
-
-def stmt_lists(tree: ast.AST) -> Iterator[list[ast.stmt]]:
-    """Every statement list in the tree (module/function/branch bodies)."""
-    for n in ast.walk(tree):
-        for field in ("body", "orelse", "finalbody"):
-            stmts = getattr(n, field, None)
-            if isinstance(stmts, list) and stmts and isinstance(
-                stmts[0], ast.stmt
-            ):
-                yield stmts
-
-
-def call_name(node: ast.AST) -> str:
-    return last_attr(node) or "<call>"
+from tpu_mpi_tests.analysis.core import (  # noqa: F401
+    DEVICE_ORIGINS,
+    FACTORY_ORIGINS,
+    TRACE_ENTRIES,
+    call_name,
+    device_callables,
+    has_trace_entry,
+    is_device_call,
+    stmt_lists,
+    traced_functions,
+    walk_calls,
+)

@@ -3,12 +3,22 @@
 The bug class: collective axis names are stringly-typed; a ``lax.psum``
 over an axis the enclosing ``shard_map`` never bound fails only at trace
 time on a real mesh — and on a 1-device CI mesh some mismatches trace
-fine and ship. The rule is same-file by design (the comm layer threads
-``axis_name`` variables through, which the linter leaves alone): a
-string-literal axis in a collective must appear among the axis-name
-literals bound by a ``shard_map``/``Mesh``/``make_mesh``/
-``PartitionSpec`` in the same file. Files with no mesh/shard_map context
-are skipped — there is nothing to check against.
+fine and ship.
+
+* **TPM501** (file scope): a string-literal axis in a collective must
+  appear among the axis-name literals bound by a ``shard_map``/``Mesh``/
+  ``make_mesh``/``PartitionSpec`` in the same file. Files with no local
+  mesh context are left to —
+* **TPM502** (project scope, ISSUE 10): the same check for files the
+  per-file rule used to skip entirely, resolved against the axis
+  literals bound *anywhere in the linted program* (the facts carry each
+  file's binding set). A helper module whose ``psum`` axis is bound by
+  the driver that imports it now lints clean; an axis bound nowhere in
+  the program is now a finding instead of a silent skip.
+
+The axis vocabulary lives in :mod:`tpu_mpi_tests.analysis.program`
+(``AXIS_DEF_CALLS``/``AXIS_USES``/``USE_ORIGINS``) so the facts
+extractor and this rule read one definition.
 """
 
 from __future__ import annotations
@@ -18,28 +28,16 @@ from typing import Iterator
 
 from tpu_mpi_tests.analysis.core import (
     FileContext,
+    ProjectContext,
     attr_parts,
     last_attr,
 )
+from tpu_mpi_tests.analysis.program import (
+    AXIS_DEF_CALLS,
+    AXIS_USES,
+    USE_ORIGINS,
+)
 from tpu_mpi_tests.analysis.rules import _util
-
-#: calls whose string literals BIND axis names for the file
-AXIS_DEF_CALLS = {
-    "shard_map", "Mesh", "AbstractMesh", "make_mesh", "NamedSharding",
-    "PartitionSpec", "P",
-}
-
-#: collective/axis-query calls checked, with the axis argument position
-AXIS_USES = {
-    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
-    "ppermute": 1, "all_gather": 1, "all_to_all": 1, "pshuffle": 1,
-    "pbroadcast": 1, "axis_index": 0, "axis_size": 0,
-    "pcast_varying": 1, "pcast": 1,
-}
-
-#: origins whose AXIS_USES calls are real collectives (a local helper
-#: coincidentally named `all_gather` is not checked)
-USE_ORIGINS = ("jax", "tpu_mpi_tests.compat")
 
 
 def _axis_literals(node: ast.AST) -> list[tuple[str, ast.AST]]:
@@ -111,3 +109,34 @@ class AxisConsistency:
                         f"{known}) — a mismatched axis fails only at "
                         f"trace time on a real mesh",
                     )
+
+
+class AxisProgramConsistency:
+    name = "axis-consistency-program"
+    scope = "project"
+    codes = {
+        "TPM502": "collective axis name not bound by any shard_map/mesh "
+                  "anywhere in the linted program (file has no local "
+                  "mesh context)",
+    }
+
+    def check_project(self, proj: ProjectContext) -> Iterator[tuple]:
+        bound: set[str] = set()
+        for ff in proj.facts:
+            bound.update(ff["axis_bound"])
+        for ff in proj.facts:
+            if ff["axis_bound"]:
+                continue  # TPM501's same-file jurisdiction
+            for line, col, op, axis in ff["axis_uses"]:
+                if axis in bound:
+                    continue
+                yield (
+                    ff["path"], line, col, "TPM502",
+                    f"axis '{axis}' in {op}() is not bound by any "
+                    f"shard_map/mesh anywhere in the linted program "
+                    f"({len(bound)} program-wide binding"
+                    f"{'s' if len(bound) != 1 else ''}) — this file has "
+                    f"no mesh context of its own, so the per-file rule "
+                    f"used to skip it; a mismatched axis fails only at "
+                    f"trace time on a real mesh",
+                )

@@ -1,0 +1,108 @@
+"""TPM12xx — donation safety (use-after-donate).
+
+The bug class PR 7's DispatchWindow made pervasive: the in-place idiom
+``x = allreduce(x)`` donates its operand (``donate_argnums=0`` on every
+comm wrapper's jitted core), so after the call the *old* buffer is
+deleted. Rebinding the result to the same name is the whole point; but
+pass ``x`` in a donated position, bind the result elsewhere, and any
+later read of ``x`` hits a deleted jax.Array —
+``RuntimeError: Array has been deleted`` at best, and on some paths a
+silent garbage read from reused HBM. The failure fires at *runtime*, on
+the *device*, often only at real mesh sizes — exactly the class the
+reference suite's ``MPI_IN_PLACE`` probes exist to catch after the
+fact.
+
+Detection over the per-file donation-flow facts plus the project
+summaries (so it sees through one level of helper: a function that
+forwards its param into a donated position of its callee effectively
+donates that param too — ``span_call``/``DispatchWindow.call``
+forwarding included):
+
+* **read-after-donate** (straight line): a statement list where ``x``
+  is passed in a donated position, the statement does not rebind ``x``,
+  and a later statement reads ``x`` before any rebind. Anchored at the
+  read — that is where the deleted buffer is touched.
+* **donate-in-loop**: a donating call inside a ``for``/``while`` body
+  that never rebinds the donated name anywhere in that body — the
+  second iteration feeds an already-deleted buffer. Anchored at the
+  call.
+
+Conservative: any rebind anywhere in an intervening statement's subtree
+stops the scan, attribute/expression arguments are ignored (only bare
+names track), and unresolvable callees contribute no donations.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import ProjectContext
+
+
+class DonationSafety:
+    name = "donation-safety"
+    scope = "project"
+    codes = {
+        "TPM1201": "local name read after being passed in a donated "
+                   "position and not rebound — the buffer is deleted "
+                   "(use-after-donate)",
+    }
+
+    def check_project(self, proj: ProjectContext) -> Iterator[tuple]:
+        idx = proj.index
+        for ff in proj.facts:
+            module = ff["module"]
+            for lst in ff["dflow"]:
+                yield from self._check_list(ff, idx, module, lst)
+
+    def _check_list(self, ff, idx, module, lst) -> Iterator[tuple]:
+        stmts = lst["stmts"]
+        all_binds: set[str] = set()
+        for st in stmts:
+            all_binds.update(st["binds"])
+        for i, st in enumerate(stmts):
+            for call in st["calls"]:
+                donated = idx.site_donates(call, module)
+                if not donated:
+                    continue
+                short = call["target"].rsplit(".", 1)[-1]
+                for p in sorted(donated):
+                    if p >= len(call["args"]):
+                        continue
+                    name = call["args"][p]
+                    if not name or name in st["binds"]:
+                        # `x = f(x)` (or a branch that rebinds): the
+                        # donated buffer is replaced — the idiom
+                        continue
+                    for later in stmts[i + 1:]:
+                        read = next(
+                            (ln for n, ln in later["reads"]
+                             if n == name), None,
+                        )
+                        if read is not None:
+                            yield (
+                                ff["path"], read, 0, "TPM1201",
+                                f"'{name}' is read here but was "
+                                f"donated to '{short}' at line "
+                                f"{call['line']} and never rebound — "
+                                f"the buffer is deleted "
+                                f"(use-after-donate); rebind the "
+                                f"result ({name} = {short}(...)) or "
+                                f"pass a copy ({name} + 0)",
+                            )
+                            break
+                        if name in later["binds"]:
+                            break
+                    else:
+                        if lst["loop"] and name not in all_binds:
+                            yield (
+                                ff["path"], call["line"], call["col"],
+                                "TPM1201",
+                                f"'{name}' is donated to '{short}' "
+                                f"inside a loop that never rebinds it "
+                                f"— the next iteration reads a "
+                                f"deleted buffer (use-after-donate); "
+                                f"chain the result "
+                                f"({name} = {short}(...)) or pass a "
+                                f"copy ({name} + 0)",
+                            )

@@ -7,92 +7,21 @@ of them at once (the PR 2 review fix that made the package ``__init__``
 re-exports lazy, PEP 562). This rule walks the module-level import graph
 from each entry module and flags any module-level jax import in the
 reachable set — imports inside functions (the lazy idiom every
-jax-touching module here uses) are exempt by construction.
+jax-touching module here uses) are exempt by construction, as is the
+``try: import jax / except ImportError:`` optional-import shape (it
+imports fine where jax is absent; imports in the *handler* still fire).
+
+The graph edges come from the per-file facts
+(``facts["mod_imports"]``, extracted by
+:func:`tpu_mpi_tests.analysis.program.module_level_imports`), so a
+warm-cache run walks the identical graph without re-parsing anything.
 """
 
 from __future__ import annotations
 
-import ast
 from typing import Iterator
 
-from tpu_mpi_tests.analysis.core import FileContext, ProjectContext
-
-
-def _resolve_relative(module: str, current: str, is_pkg: bool) -> str:
-    """``.foo``/``..foo`` against the importing module's package."""
-    level = len(module) - len(module.lstrip("."))
-    name = module[level:]
-    parts = current.split(".")
-    if not is_pkg:
-        parts = parts[:-1]
-    if level > 1:
-        parts = parts[: len(parts) - (level - 1)]
-    return ".".join(parts + ([name] if name else []))
-
-
-def _module_level_imports(
-    ctx: FileContext,
-) -> list[tuple[int, str, list[str]]]:
-    """``(line, module, from_names)`` for every import executed at module
-    import time: top-level statements plus those nested in module-level
-    ``if``/``try`` (conditional imports still run), but nothing inside a
-    function or class body (lazy by construction) and nothing under an
-    ``if TYPE_CHECKING:`` guard (never runs)."""
-    out: list[tuple[int, str, list[str]]] = []
-    is_pkg = ctx.path.endswith("__init__.py")
-
-    def scan(stmts):
-        for stmt in stmts:
-            if isinstance(stmt, ast.Import):
-                for a in stmt.names:
-                    out.append((stmt.lineno, a.name, []))
-            elif isinstance(stmt, ast.ImportFrom):
-                mod = ("." * stmt.level) + (stmt.module or "")
-                if mod.startswith("."):
-                    mod = _resolve_relative(mod, ctx.module, is_pkg)
-                out.append((stmt.lineno, mod,
-                            [a.name for a in stmt.names]))
-            elif isinstance(stmt, ast.If):
-                if any(
-                    isinstance(n, (ast.Name, ast.Attribute))
-                    and (getattr(n, "id", None) == "TYPE_CHECKING"
-                         or getattr(n, "attr", None) == "TYPE_CHECKING")
-                    for n in ast.walk(stmt.test)
-                ):
-                    continue
-                scan(stmt.body)
-                scan(stmt.orelse)
-            elif isinstance(stmt, ast.Try):
-                # `try: import jax / except ImportError:` is the
-                # canonical SAFE optional import — it imports fine
-                # where jax is absent, so the guarded body is exempt.
-                # Handler bodies are still scanned: an import there
-                # runs exactly when the body already failed, so a jax
-                # import in the handler does break the guarantee.
-                if not _catches_import_error(stmt):
-                    scan(stmt.body)
-                scan(stmt.orelse)
-                scan(stmt.finalbody)
-                for h in stmt.handlers:
-                    scan(h.body)
-            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-                scan(stmt.body)
-
-    scan(ctx.tree.body)
-    return out
-
-
-def _catches_import_error(stmt: ast.Try) -> bool:
-    for h in stmt.handlers:
-        if h.type is None:
-            return True  # bare except
-        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
-        for t in types:
-            name = getattr(t, "id", None) or getattr(t, "attr", None)
-            if name in ("ImportError", "ModuleNotFoundError",
-                        "Exception", "BaseException"):
-                return True
-    return False
+from tpu_mpi_tests.analysis.core import ProjectContext
 
 
 def _parents(module: str) -> list[str]:
@@ -110,10 +39,10 @@ class ImportHygiene:
     }
 
     def check_project(self, proj: ProjectContext) -> Iterator[tuple]:
-        mods = proj.by_module  # module name -> [FileContext, ...]
+        mods = proj.by_module  # module name -> [facts, ...]
         # BFS over the module-level import graph; chain[m] remembers one
         # path back to the entry point for the finding message. Every
-        # context sharing a module name contributes edges and is
+        # facts record sharing a module name contributes edges and is
         # scanned: duplicate names across linted roots must widen the
         # reachable set, never silently drop a file from it.
         chain: dict[str, list[str]] = {}
@@ -125,8 +54,8 @@ class ImportHygiene:
                     queue.append(m)
         while queue:
             cur = queue.pop(0)
-            for ctx in mods[cur]:
-                for _line, target, names in _module_level_imports(ctx):
+            for ff in mods[cur]:
+                for _line, target, names in ff["mod_imports"]:
                     edges = [target] + [f"{target}.{n}" for n in names]
                     for t in edges:
                         for m in _parents(t) + [t]:
@@ -135,14 +64,14 @@ class ImportHygiene:
                                 queue.append(m)
 
         for m in sorted(chain):
-            for ctx in mods[m]:
-                for line, target, _names in _module_level_imports(ctx):
+            for ff in mods[m]:
+                for line, target, _names in ff["mod_imports"]:
                     if target == "jax" or target.startswith("jax."):
                         entry = chain[m][0]
                         script = proj.entry_modules.get(entry, entry)
                         via = " -> ".join(chain[m])
                         yield (
-                            ctx.path, line, 0, "TPM401",
+                            ff["path"], line, 0, "TPM401",
                             f"module-level import of '{target}' breaks "
                             f"the stdlib-only guarantee of {script} "
                             f"(import chain: {via}) — import jax "

@@ -27,6 +27,13 @@ a ``.block_until_ready()`` method at a line strictly inside an open
 region is a TPM801 finding. An unconsumed handle leaves its region
 open to the end of the function — a dangling dispatch-window span is
 exactly when an accidental sync hides longest.
+
+**TPM802** (project scope, ISSUE 10) is the interprocedural escape the
+lexical rule cannot see: a helper *returns* its ``async_span`` handle
+(the summaries track ``returns_handle`` transitively) and the caller
+assigns it to a name it never reads again — nobody will ever ``done()``
+it, so the dispatch-window span stays open to process exit and the
+overlap accounting silently loses the op.
 """
 
 from __future__ import annotations
@@ -34,7 +41,11 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from tpu_mpi_tests.analysis.core import FileContext, attr_parts
+from tpu_mpi_tests.analysis.core import (
+    FileContext,
+    ProjectContext,
+    attr_parts,
+)
 
 #: call targets that open an overlap region when bound to a name
 PREFETCH_NAMES = {"async_span"}
@@ -134,3 +145,34 @@ class OverlapRegionSync:
                     f"why-comment if this sync IS the overlapped "
                     f"compute phase",
                 )
+
+
+class EscapedAsyncHandle:
+    name = "overlap-regions-escape"
+    scope = "project"
+    codes = {
+        "TPM802": "async dispatch-window handle returned to a caller "
+                  "that never consumes it — the span stays open to "
+                  "process exit",
+    }
+
+    def check_project(self, proj: ProjectContext) -> Iterator[tuple]:
+        idx = proj.index
+        for ff in proj.facts:
+            for fn in ff["functions"]:
+                for name, target, line, col in fn["handle_drops"]:
+                    funcs = idx.resolve_funcs(target, ff["module"])
+                    if not funcs:
+                        continue
+                    if any(idx.returns_handle(g) for g in funcs):
+                        short = target.rsplit(".", 1)[-1]
+                        yield (
+                            ff["path"], line, col, "TPM802",
+                            f"'{name}' holds the async_span handle "
+                            f"returned by '{short}' but is never read "
+                            f"again — no one will done()/wait() it, so "
+                            f"the dispatch-window span stays open to "
+                            f"process exit and its op drops out of the "
+                            f"overlap accounting; consume the handle "
+                            f"or drain it through a DispatchWindow",
+                        )

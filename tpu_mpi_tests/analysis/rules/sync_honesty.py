@@ -9,6 +9,15 @@ The reference suite brackets every timed phase with a device sync
 ``instrument.timers.block`` / ``block_until_ready`` / ``comm_span`` /
 ``PhaseTimer.timed`` — a monotonic-clock pair whose timed region
 dispatches device work without any of them is dishonest timing.
+
+Two rules share the region detector
+(:func:`tpu_mpi_tests.analysis.program.iter_timed_regions`):
+
+* **TPM101** (file scope): the region itself dispatches device work.
+* **TPM102** (project scope, ISSUE 10): the region dispatches *through
+  a helper* — it calls a function whose whole-program summary
+  dispatches jax work and never syncs. Same dishonest measurement, one
+  call frame deeper; invisible to any per-file scan.
 """
 
 from __future__ import annotations
@@ -16,47 +25,17 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from tpu_mpi_tests.analysis.core import FileContext, last_attr
+from tpu_mpi_tests.analysis.core import (
+    FileContext,
+    ProjectContext,
+    last_attr,
+)
+from tpu_mpi_tests.analysis.program import (  # noqa: F401 (re-export)
+    CLOCKS,
+    SYNC_NAMES,
+    iter_timed_regions,
+)
 from tpu_mpi_tests.analysis.rules import _util
-
-#: clock reads that start/stop a timing region
-CLOCKS = {"time.perf_counter", "time.monotonic"}
-
-#: call targets (final component) that synchronize device work before the
-#: clock is read again — chain_rate/dispatch_rate embed the discipline
-SYNC_NAMES = {
-    "block", "block_until_ready", "comm_span", "span_call", "timed",
-    "host_value", "device_get", "chain_rate", "dispatch_rate",
-    "sync_global_devices", "barrier",
-}
-
-
-def _clock_assign(ctx: FileContext, stmt: ast.stmt) -> str | None:
-    """``t0 = time.perf_counter()`` → ``"t0"``; else None."""
-    if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
-            and isinstance(stmt.targets[0], ast.Name)
-            and isinstance(stmt.value, ast.Call)):
-        return None
-    if ctx.imports.resolve(stmt.value.func) in CLOCKS:
-        return stmt.targets[0].id
-    return None
-
-
-def _uses_in_sub(stmt: ast.stmt, name: str) -> bool:
-    """Does the statement read the clock delta (``... - t0``)?"""
-    for n in ast.walk(stmt):
-        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub):
-            for side in (n.left, n.right):
-                if isinstance(side, ast.Name) and side.id == name:
-                    return True
-    return False
-
-
-def _rebinds(stmt: ast.stmt, name: str) -> bool:
-    if isinstance(stmt, ast.Assign):
-        return any(isinstance(t, ast.Name) and t.id == name
-                   for t in stmt.targets)
-    return False
 
 
 class SyncHonesty:
@@ -69,24 +48,8 @@ class SyncHonesty:
 
     def check(self, ctx: FileContext) -> Iterator[tuple]:
         local_device = _util.device_callables(ctx)
-        for stmts in _util.stmt_lists(ctx.tree):
-            yield from self._scan_list(ctx, stmts, local_device)
-
-    def _scan_list(self, ctx, stmts, local_device):
-        for i, stmt in enumerate(stmts):
-            t = _clock_assign(ctx, stmt)
-            if not t:
-                continue
-            region: list[ast.stmt] = []
-            for j in range(i + 1, len(stmts)):
-                region.append(stmts[j])
-                if _uses_in_sub(stmts[j], t):
-                    yield from self._check_region(
-                        ctx, region, local_device
-                    )
-                    break
-                if _rebinds(stmts[j], t):
-                    break  # clock restarted before any delta read
+        for region in iter_timed_regions(ctx):
+            yield from self._check_region(ctx, region, local_device)
 
     def _check_region(self, ctx, region, local_device):
         dispatches: list[ast.Call] = []
@@ -105,3 +68,35 @@ class SyncHonesty:
                 f"measurement; wrap the result in block()/"
                 f"block_until_ready() or use comm_span/PhaseTimer.timed",
             )
+
+
+class InterprocSyncHonesty:
+    name = "sync-honesty-interproc"
+    scope = "project"
+    codes = {
+        "TPM102": "timed region calls a helper whose call graph "
+                  "dispatches jax work with no device sync "
+                  "(interprocedural TPM101)",
+    }
+
+    def check_project(self, proj: ProjectContext) -> Iterator[tuple]:
+        idx = proj.index
+        for ff in proj.facts:
+            for region in ff["timed_regions"]:
+                for target, line, col in region["calls"]:
+                    funcs = idx.resolve_funcs(target, ff["module"])
+                    if not funcs:
+                        continue
+                    if any(idx.dispatches(fn) and not idx.syncs(fn)
+                           for fn in funcs):
+                        short = target.rsplit(".", 1)[-1]
+                        yield (
+                            ff["path"], line, col, "TPM102",
+                            f"timed region calls '{short}' whose call "
+                            f"graph dispatches jax work and never "
+                            f"syncs — the clock pair measures its "
+                            f"dispatch, not its compute; sync inside "
+                            f"the region (block()/block_until_ready/"
+                            f"comm_span) or inside the helper",
+                        )
+                        break  # one finding per region, like TPM101
