@@ -1,8 +1,8 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke overlap-smoke moe-smoke chaos-smoke lint lint-smoke ci \
-	clean
+	serve-smoke overlap-smoke moe-smoke chaos-smoke live-smoke lint \
+	lint-smoke ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -369,6 +369,85 @@ chaos-smoke:
 		print('chaos-smoke trace FINDING marker OK')"
 	@echo "chaos-smoke OK: 5 fault classes convicted (class+rank), clean run silent"
 
+# live-observability smoke (README "Live observability"): (a) a serve
+# run armed with --metrics-port must expose well-formed OpenMetrics at
+# /metrics MID-RUN (curl'd while the loop serves) with nonzero serve
+# counters, and leave the health heartbeat trail (incl. the final
+# marker) in its JSONL; (b) tpumt-top renders a frame from the
+# finished stream; (c) under an injected chaos straggler across two
+# real processes, tpumt-doctor --follow must convict straggler:1 WHILE
+# the ensemble is still executing (doctor exits 0, then kill -0 proves
+# the run was still alive) and the post-mortem doctor over the SAME
+# organic stream must agree — the online/offline shared-kernel
+# contract, byte-level-pinned in tests/test_live.py.
+live-smoke:
+	rm -f /tmp/_tpumt_live*
+	$(MAKE) -C native tpumt_run
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 --duration 10 --arrival poisson --rate 30 \
+		--seed 7 --report-interval 1 --batch-deadline 120 \
+		--workloads daxpy:4096:float32 \
+		--metrics-port 0 --metrics-interval 0.25 \
+		--jsonl /tmp/_tpumt_live.serve.jsonl \
+		> /tmp/_tpumt_live.serve.out 2>&1 & \
+	SERVE_PID=$$!; \
+	ok=1; \
+	for i in $$(seq 1 160); do \
+		PORT=$$(sed -n \
+			's#.*OpenMetrics at http://0.0.0.0:\([0-9]*\)/metrics.*#\1#p' \
+			/tmp/_tpumt_live.serve.out 2>/dev/null | head -1); \
+		if [ -n "$$PORT" ] \
+		&& curl -sf http://127.0.0.1:$$PORT/metrics \
+			-o /tmp/_tpumt_live.metrics.txt 2>/dev/null \
+		&& awk '$$1 ~ /^tpumt_serve_requests_total/ \
+			{ if ($$2+0 > 0) found=1 } END { exit !found }' \
+			/tmp/_tpumt_live.metrics.txt; \
+		then ok=0; break; fi; sleep 0.25; done; \
+	wait $$SERVE_PID; test $$ok -eq 0
+	python -c "import re; \
+		lines = open('/tmp/_tpumt_live.metrics.txt').read() \
+			.strip().splitlines(); \
+		assert lines[-1] == '# EOF', lines[-3:]; \
+		bad = [l for l in lines if not l.startswith('#') and not \
+			re.match(r'^[A-Za-z_:][A-Za-z0-9_:]*(\{[^{}]*\})? \S+$$', \
+			l)]; \
+		assert not bad, bad; \
+		assert any(l.startswith('# TYPE tpumt_serve_requests counter') \
+			for l in lines), 'missing TYPE line'; \
+		print('live-smoke exporter OK:', len(lines), \
+			'well-formed OpenMetrics lines mid-run')"
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_live.serve.jsonl')]; \
+		hb = [r for r in recs if r.get('kind') == 'health' \
+			and r.get('event') == 'heartbeat']; \
+		assert hb and hb[-1].get('final'), 'no heartbeat trail'; \
+		assert any('queue_depth' in r for r in recs \
+			if r.get('kind') == 'serve' \
+			and r.get('event') == 'window'), 'no live queue depth'; \
+		print('live-smoke heartbeats OK:', len(hb), 'beats')"
+	python -m tpu_mpi_tests.instrument.live \
+		/tmp/_tpumt_live.serve.jsonl > /tmp/_tpumt_live.top.txt
+	grep -q 'daxpy:4096:float32' /tmp/_tpumt_live.top.txt
+	grep -q '^BEAT ' /tmp/_tpumt_live.top.txt
+	env JAX_PLATFORMS=cpu \
+		TPU_MPI_CHAOS="straggler:rank=1:delay_ms=25" \
+		./native/tpumt_run -n 2 -o /tmp/_tpumt_live.rank -- \
+		python -m tpu_mpi_tests.drivers.daxpy --fake-devices 1 \
+		--n 1048576 --iters 200 --metrics-port 0 \
+		--metrics-interval 0.2 \
+		--jsonl /tmp/_tpumt_live.strag.jsonl & \
+	RUN_PID=$$!; \
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_live.strag.jsonl --follow \
+		--expect straggler:1 --interval 0.3 --timeout 120; DRC=$$?; \
+	if kill -0 $$RUN_PID 2>/dev/null; then ALIVE=0; else ALIVE=1; fi; \
+	wait $$RUN_PID; \
+	test $$DRC -eq 0 && test $$ALIVE -eq 0
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_live.strag.jsonl --expect straggler:1
+	@echo "live-smoke OK: mid-run OpenMetrics + heartbeat trail + tpumt-top frame + online straggler conviction"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -425,10 +504,11 @@ lint-smoke:
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
 # observability smoke, the serving-pipeline smoke, the overlap-engine
 # smoke, the workload-spec pillar smoke, the chaos-verified diagnosis
-# smoke, the lint self-clean gate, and the lint-cache incrementality
+# smoke, the live-observability smoke (OpenMetrics endpoint + online
+# doctor), the lint self-clean gate, and the lint-cache incrementality
 # smoke
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke \
-	moe-smoke chaos-smoke lint lint-smoke
+	moe-smoke chaos-smoke live-smoke lint lint-smoke
 
 clean:
 	$(MAKE) -C native clean

@@ -247,6 +247,103 @@ def test_tpumt_doctor_runs_without_jax(tmp_path):
             in pyproject)
 
 
+def test_tpumt_top_runs_without_jax(tmp_path):
+    """The tpumt-top console script and the OpenMetrics renderer must
+    import, parse --help, render a frame over a golden JSONL tail, and
+    expose well-formed OpenMetrics in a process where ``import jax``
+    raises — the login-node contract of the other CLIs, applied to a
+    run that has not ended yet (files tailed off a shared fs)."""
+    import json as _json
+
+    recs = [
+        {"kind": "manifest", "process_index": 0, "process_count": 1,
+         "platform": "cpu", "global_device_count": 2},
+        {"kind": "span", "op": "halo_exchange", "nbytes": 1 << 20,
+         "world": 2, "seconds": 0.01, "gbps": 0.105,
+         "t_start": 100.0, "t_end": 100.01},
+        {"kind": "serve", "event": "window", "class": "daxpy:64:float32",
+         "arrivals": 5, "requests": 5, "errors": 0, "shed": 0,
+         "queue_depth": 1, "p50_ms": 1.0, "p95_ms": 2.0, "p99_ms": 3.0,
+         "offered_hz": 5.0, "achieved_hz": 5.0, "t_end": 101.0},
+        {"kind": "health", "event": "heartbeat", "rank": 0, "seq": 1,
+         "t": 101.5},
+    ]
+    (tmp_path / "run.jsonl").write_text(
+        "".join(_json.dumps(r) + "\n" for r in recs))
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.instrument import live\n"
+        "from tpu_mpi_tests.instrument.export import render_openmetrics\n"
+        "from tpu_mpi_tests.instrument.metrics import MetricsRegistry\n"
+        "try:\n"
+        "    live.main(['--help'])\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        f"base = {str(tmp_path / 'run.jsonl')!r}\n"
+        "assert live.main([base]) == 0\n"
+        "reg = MetricsRegistry()\n"
+        "import json\n"
+        "for ln in open(base):\n"
+        "    reg.observe(json.loads(ln))\n"
+        "text = render_openmetrics(reg)\n"
+        "assert text.rstrip().endswith('# EOF'), text[-50:]\n"
+        "assert 'tpumt_serve_requests_total' in text\n"
+        "print('TOP NOJAX OK')\n"
+    )
+    r = run_py(code)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "TOP NOJAX OK" in r.stdout
+    assert "halo_exchange" in r.stdout  # the rendered OPS row
+    assert "daxpy:64:float32" in r.stdout  # the rendered SLO row
+    pyproject = (REPO / "pyproject.toml").read_text()
+    assert ('tpumt-top = "tpu_mpi_tests.instrument.live:main"'
+            in pyproject)
+
+
+def test_tpumt_doctor_follow_runs_without_jax(tmp_path):
+    """--follow (the online doctor) shares the login-node contract:
+    tail + convict with jax blocked."""
+    import json as _json
+
+    recs0 = [{"kind": "manifest", "process_index": 0,
+              "process_count": 2}]
+    recs1 = [{"kind": "manifest", "process_index": 1,
+              "process_count": 2}]
+    for i in range(1, 11):
+        t = 100.0 + i
+        recs0.append({"kind": "time", "event": "progress",
+                      "phase": "kernel", "seconds": 0.1 * i,
+                      "count": 5 * i, "t": t})
+        recs1.append({"kind": "time", "event": "progress",
+                      "phase": "kernel", "seconds": 0.5 * i,
+                      "count": 5 * i, "t": t})
+    for recs, name in ((recs0, "run.p0.jsonl"), (recs1, "run.p1.jsonl")):
+        (tmp_path / name).write_text(
+            "".join(_json.dumps(r) + "\n" for r in recs))
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.instrument import diagnose\n"
+        f"base = {str(tmp_path / 'run.jsonl')!r}\n"
+        "assert diagnose.main([base, '--follow', '--expect',\n"
+        "                      'straggler:1', '--interval', '0.05',\n"
+        "                      '--timeout', '20']) == 0\n"
+        "print('FOLLOW NOJAX OK')\n"
+    )
+    r = run_py(code)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "FOLLOW NOJAX OK" in r.stdout
+
+
 def test_graft_dryrun_multichip():
     r = run_py(
         "import __graft_entry__ as g\n"

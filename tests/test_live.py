@@ -1,0 +1,636 @@
+"""tpumt-top (instrument/live.py) and the ONLINE doctor
+(tpumt-doctor --follow): the incremental JSONL tailer, the shared
+ghost-sibling run filter, dashboard rendering, and the
+online-equals-offline byte-identity acceptance (shared rule kernels)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_mpi_tests.instrument import diagnose, live
+
+
+def _write_jsonl(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _manifest(rank, n=2):
+    return {"kind": "manifest", "process_index": rank,
+            "process_count": n, "platform": "cpu",
+            "global_device_count": n}
+
+
+def _clock_sync(run_id):
+    return {"kind": "clock_sync", "run_sync_us": run_id, "offset_s": 0.0}
+
+
+def _progress(phase, seconds, count, t):
+    return {"kind": "time", "event": "progress", "phase": phase,
+            "seconds": seconds, "count": count, "t": t}
+
+
+def _final_time(phase, seconds, count, t):
+    return {"kind": "time", "phase": phase, "seconds": seconds,
+            "count": count, "t": t}
+
+
+def _close_markers(t):
+    return [{"kind": "telemetry_summary", "op": "x"},
+            {"kind": "mem", "event": "final", "t": t}]
+
+
+def _straggler_run(run_id=777, n=30, slow_factor=4.0, t0=100.0):
+    """Two ranks' record streams: rank 1's kernel phase runs
+    ``slow_factor`` slower — progress snapshots during the run, final
+    records + close markers at the end."""
+    streams = {0: [_manifest(0), _clock_sync(run_id)],
+               1: [_manifest(1), _clock_sync(run_id)]}
+    for i in range(1, n + 1):
+        t = t0 + i
+        streams[0].append(_progress("kernel", 0.1 * i, 5 * i, t))
+        streams[1].append(_progress("kernel", 0.1 * slow_factor * i,
+                                    5 * i, t))
+        for rank in (0, 1):
+            # local (world=1) telemetry spans: mid-run the stream has
+            # recorded spans but no summary marker yet, which is what
+            # makes offline semantics read it as not-yet-judgeable
+            streams[rank].append(
+                {"kind": "span", "op": "local_step", "nbytes": 0,
+                 "world": 1, "seconds": 0.01, "t_start": t,
+                 "t_end": t + 0.01})
+    t_end = t0 + n + 1
+    streams[0].append(_final_time("kernel", 0.1 * n, 5 * n, t_end))
+    streams[1].append(_final_time("kernel", 0.1 * slow_factor * n,
+                                  5 * n, t_end))
+    for rank in (0, 1):
+        streams[rank].extend(_close_markers(t_end))
+    return streams
+
+
+class TestFileTail:
+    def test_incremental_with_partial_lines(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        p.write_text('{"kind": "a"}\n{"kind": ')
+        tail = live.FileTail(str(p))
+        recs = tail.poll()
+        assert [(ln, r["kind"]) for ln, r in recs] == [(1, "a")]
+        # the partial line is NOT consumed until its newline arrives
+        with open(p, "a") as f:
+            f.write('"b"}\n')
+        recs = tail.poll()
+        assert [(ln, r["kind"]) for ln, r in recs] == [(2, "b")]
+        assert tail.poll() == []
+
+    def test_line_numbers_skip_garbage(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        p.write_text('{"kind": "a"}\nnot json\n{"kind": "b"}\n')
+        tail = live.FileTail(str(p))
+        assert [(ln, r["kind"]) for ln, r in tail.poll()] \
+            == [(1, "a"), (3, "b")]
+
+    def test_truncation_restarts(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        p.write_text('{"kind": "a"}\n{"kind": "b"}\n')
+        tail = live.FileTail(str(p))
+        tail.poll()
+        p.write_text('{"kind": "c"}\n')
+        assert [(ln, r["kind"]) for ln, r in tail.poll()] == [(1, "c")]
+
+    def test_missing_file_is_quietly_empty(self, tmp_path):
+        tail = live.FileTail(str(tmp_path / "nope.jsonl"))
+        assert tail.poll() == []
+
+
+class TestRunTail:
+    def test_stale_sibling_of_an_earlier_run_is_ignored(self, tmp_path):
+        """The ghost-track hazard (PR-2's offline fix, shared helper):
+        a leftover .p1 file stamped by an EARLIER run at the same base
+        path must not be tailed as a live rank."""
+        _write_jsonl(tmp_path / "out.p0.jsonl",
+                     [_manifest(0), _clock_sync(111)])
+        _write_jsonl(tmp_path / "out.p1.jsonl",
+                     [_manifest(1), _clock_sync(42)])  # stale run
+        old = time.time() - 3600
+        os.utime(tmp_path / "out.p1.jsonl", (old, old))
+        tail = live.RunTail([str(tmp_path / "out.jsonl")])
+        recs = tail.poll()
+        assert tail.files() == [str(tmp_path / "out.p0.jsonl")]
+        assert all(p.endswith(".p0.jsonl") for p, _ln, _r in recs)
+
+    def test_same_run_sibling_is_admitted(self, tmp_path):
+        _write_jsonl(tmp_path / "out.p0.jsonl",
+                     [_manifest(0), _clock_sync(111)])
+        _write_jsonl(tmp_path / "out.p1.jsonl",
+                     [_manifest(1), _clock_sync(111)])
+        tail = live.RunTail([str(tmp_path / "out.jsonl")])
+        tail.poll()
+        assert len(tail.files()) == 2
+
+    def test_rank_file_appearing_mid_follow_is_picked_up(self, tmp_path):
+        _write_jsonl(tmp_path / "out.p0.jsonl",
+                     [_manifest(0), _clock_sync(111)])
+        tail = live.RunTail([str(tmp_path / "out.jsonl")])
+        tail.poll()
+        assert len(tail.files()) == 1
+        _write_jsonl(tmp_path / "out.p1.jsonl",
+                     [_manifest(1), _clock_sync(111)])
+        recs = tail.poll()
+        assert len(tail.files()) == 2
+        assert any(p.endswith(".p1.jsonl") for p, _ln, _r in recs)
+
+
+class TestRunIdScan:
+    def test_fast_scan_matches_full_parse(self, tmp_path):
+        """The admission fast path must agree with the canonical
+        timeline parser on every file shape: multiple appended runs,
+        stampless segments, garbage lines, and decoys."""
+        from tpu_mpi_tests.instrument import timeline
+
+        p = tmp_path / "runs.jsonl"
+        recs = (
+            [_manifest(0), _clock_sync(11),
+             {"kind": "span", "op": "clock_sync_decoy",
+              "note": '"clock_sync"'}]
+            + [_manifest(0)]  # stampless middle segment
+            + [_manifest(0), _clock_sync(33)]
+        )
+        body = "".join(json.dumps(r) + "\n" for r in recs)
+        p.write_text(body + "not json but \"clock_sync\" anyway\n")
+        ids, newest = live._scan_run_ids(str(p))
+        assert ids == timeline.run_sync_ids(str(p)) == {11, 33}
+        # newest = the newest segment's stamp, per the canonical
+        # segmenter the offline consumers use
+        segs = timeline._run_segments(
+            [r for r in recs] + [])
+        ref = None
+        for seg in segs:
+            rid = timeline._segment_run_id(seg)
+            if rid is not None:
+                ref = rid
+        assert newest == ref == 33
+
+    def test_missing_file_scans_empty(self, tmp_path):
+        assert live._scan_run_ids(str(tmp_path / "no.jsonl")) \
+            == (set(), None)
+
+
+class TestDashboard:
+    def _fed(self):
+        dash = live.Dashboard()
+        for rec in [
+            _manifest(0),
+            {"kind": "serve", "event": "window",
+             "class": "daxpy:4096:float32", "arrivals": 10,
+             "requests": 9, "errors": 0, "shed": 1, "queue_depth": 2,
+             "p50_ms": 1.2, "p95_ms": 2.5, "p99_ms": 4.0,
+             "offered_hz": 10.0, "achieved_hz": 9.0, "t_end": 105.0},
+            {"kind": "span", "op": "halo_exchange", "nbytes": 1 << 20,
+             "world": 2, "seconds": 0.01, "gbps": 0.105, "t_end": 105.5},
+            {"kind": "mem", "rank": 0, "bytes_in_use": 3 << 20,
+             "peak_bytes_in_use": 4 << 20, "t": 106.0},
+            {"kind": "overlap", "op": "halo", "depth": 2,
+             "overlap_frac": 0.91, "drain_s": 0.002},
+            {"kind": "health", "event": "heartbeat", "rank": 0,
+             "seq": 3, "t": 106.5},
+            {"kind": "health", "event": "tune_stale", "op": "halo",
+             "signal": "gbps", "sag_pct": 31.0, "t": 107.0},
+        ]:
+            dash.feed(rec)
+        return dash
+
+    def test_render_sections(self):
+        dash = self._fed()
+        frame = live.render(dash, ["out.p0.jsonl"])
+        assert "SLO" in frame and "daxpy:4096:float32" in frame
+        assert "OPS" in frame and "halo_exchange" in frame
+        assert "MEM" in frame and "3.0MiB" in frame
+        assert "OVLP" in frame and "frac=0.910" in frame
+        assert "HEALTH" in frame and "tune_stale" in frame
+        assert "sag=31.0%" in frame
+        assert "BEAT" in frame
+
+    def test_rerun_appended_to_same_file_resets_the_model(self):
+        """Append-mode JSONL holds several runs back to back; like
+        every other consumer, the dashboard must show only the newest
+        segment — a second manifest on a followed path starts the
+        model over (and sibling ranks' manifests of the SAME new run
+        do not re-reset it)."""
+        dash = live.Dashboard()
+        span = {"kind": "span", "op": "allreduce", "nbytes": 4096,
+                "world": 2, "seconds": 0.01, "t_end": 100.0}
+        dash.feed(_manifest(0), "p0")
+        dash.feed(_manifest(1), "p1")
+        for _ in range(5):
+            dash.feed(span, "p0")
+        assert dash.registry.value("tpumt_spans",
+                                   (("op", "allreduce"),)) == 5
+        # the rerun: new manifests on both paths, then fresh traffic
+        dash.feed(_manifest(0), "p0")
+        dash.feed(span, "p0")
+        dash.feed(_manifest(1), "p1")  # sibling manifest: NO re-reset
+        dash.feed(span, "p0")
+        assert dash.registry.value("tpumt_spans",
+                                   (("op", "allreduce"),)) == 2
+
+    def test_render_empty_model_is_just_the_header(self):
+        frame = live.render(live.Dashboard(), [])
+        assert frame.splitlines()[0].startswith("tpumt-top")
+        assert "SLO" not in frame
+
+    def test_main_single_frame(self, tmp_path, capsys):
+        _write_jsonl(tmp_path / "out.jsonl", [
+            _manifest(0, n=1),
+            {"kind": "span", "op": "allreduce", "nbytes": 4096,
+             "world": 2, "seconds": 0.001, "gbps": 4.1, "t_end": 100.0},
+        ])
+        assert live.main([str(tmp_path / "out.jsonl")]) == 0
+        outp = capsys.readouterr().out
+        assert "tpumt-top" in outp and "allreduce" in outp
+
+    def test_main_missing_path_exits_two(self, tmp_path, capsys):
+        """One-shot mode shares the sibling CLIs' no-input guard: a
+        typo'd path must not read as a clean empty frame."""
+        assert live.main([str(tmp_path / "typo.jsonl")]) == 2
+        assert "no input files found" in capsys.readouterr().err
+
+    def test_main_frames_flag_bounds_follow(self, tmp_path, capsys):
+        _write_jsonl(tmp_path / "out.jsonl", [_manifest(0, n=1)])
+        t0 = time.monotonic()
+        assert live.main([str(tmp_path / "out.jsonl"), "--frames", "2",
+                          "--interval", "0.05"]) == 0
+        assert time.monotonic() - t0 < 10.0
+        assert capsys.readouterr().out.count("tpumt-top") == 2
+
+
+class TestOnlineOfflineAgreement:
+    def test_incremental_equals_batch_byte_identical(self, tmp_path):
+        """THE shared-kernel acceptance: feeding a completed organic
+        stream record-by-record through the incremental digests yields
+        byte-identical findings to the offline batch load."""
+        streams = _straggler_run()
+        files = {}
+        for rank, recs in streams.items():
+            p = tmp_path / f"run.p{rank}.jsonl"
+            _write_jsonl(p, recs)
+            files[rank] = str(p)
+        batch = diagnose.diagnose_files(sorted(files.values()))
+        assert [f["class"] for f in batch] == ["straggler"]
+
+        inc_streams = []
+        for rank, recs in streams.items():
+            s = diagnose._Stream(rank, files[rank])
+            for ln, rec in enumerate(recs, start=1):
+                s.add(ln, rec)
+            inc_streams.append(s)
+        inc = diagnose.diagnose_streams(
+            inc_streams, {"manifest": streams[0][0], "expected": 2})
+        assert json.dumps(inc, sort_keys=True) \
+            == json.dumps(batch, sort_keys=True)
+
+    def test_followed_mode_convicts_midrun_from_progress_only(self):
+        """Mid-run there are no close markers and no final records —
+        followed=True must still convict the slow rank from the
+        cumulative progress snapshots alone."""
+        streams = _straggler_run()
+        inc = []
+        for rank in (0, 1):
+            s = diagnose._Stream(rank, f"run.p{rank}.jsonl")
+            # feed only a prefix: manifests + progress + spans, no
+            # finals and no close markers — the mid-run state
+            for ln, rec in enumerate(streams[rank][:40], start=1):
+                s.add(ln, rec)
+            inc.append(s)
+        assert not any(s.closed for s in inc)
+        offline = diagnose.diagnose_streams(inc, {})
+        assert offline == []  # mid-run streams judge as nothing offline
+        online = diagnose.diagnose_streams(inc, {}, followed=True)
+        assert [(f["class"], f["rank"]) for f in online] \
+            == [("straggler", 1)]
+
+    def test_final_time_records_override_progress(self):
+        """A completed stream must diagnose identically with and
+        without the live progress trail — finals win."""
+        base = _straggler_run()
+        stripped = {
+            rank: [r for r in recs
+                   if not (r.get("kind") == "time"
+                           and r.get("event") == "progress")]
+            for rank, recs in base.items()
+        }
+
+        def load(streams):
+            out = []
+            for rank in (0, 1):
+                s = diagnose._Stream(rank, f"p{rank}")
+                for ln, rec in enumerate(streams[rank], start=1):
+                    s.add(ln, rec)
+                out.append(s)
+            return diagnose.diagnose_streams(out, {})
+
+        with_trail = load(base)
+        without_trail = load(stripped)
+        assert json.dumps(with_trail, sort_keys=True) \
+            == json.dumps(without_trail, sort_keys=True)
+
+    def test_follow_cli_convicts_while_writer_is_alive(self, tmp_path):
+        """The live-conviction acceptance, in-process: a writer thread
+        streams the straggler run; tpumt-doctor --follow --expect must
+        exit 0 BEFORE the writer finishes."""
+        streams = _straggler_run(n=40)
+        base = tmp_path / "run.jsonl"
+        paths = {r: tmp_path / f"run.p{r}.jsonl" for r in (0, 1)}
+        writer_done = threading.Event()
+
+        def writer():
+            handles = {r: open(paths[r], "a") for r in (0, 1)}
+            idx = {r: 0 for r in (0, 1)}
+            # header first, then interleave the bodies slowly
+            for r in (0, 1):
+                for rec in streams[r][:2]:
+                    handles[r].write(json.dumps(rec) + "\n")
+                handles[r].flush()
+                idx[r] = 2
+            n = max(len(streams[r]) for r in (0, 1))
+            for i in range(2, n):
+                for r in (0, 1):
+                    if i < len(streams[r]):
+                        handles[r].write(
+                            json.dumps(streams[r][i]) + "\n")
+                        handles[r].flush()
+                time.sleep(0.05)
+            for h in handles.values():
+                h.close()
+            writer_done.set()
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        rc = diagnose.main([str(base), "--follow", "--expect",
+                            "straggler:1", "--interval", "0.05",
+                            "--timeout", "30"])
+        convicted_live = not writer_done.is_set()
+        t.join(timeout=30)
+        assert rc == 0
+        assert convicted_live, "conviction must land mid-run"
+        # and the SAME organic stream post-mortem agrees
+        assert diagnose.main([str(base), "--expect",
+                              "straggler:1"]) == 0
+
+    def test_follow_final_output_matches_offline(self, tmp_path,
+                                                 capsys):
+        """--follow on a COMPLETED stream finalizes immediately (all
+        ranks closed) and its verdict lines are byte-identical to the
+        offline doctor's."""
+        streams = _straggler_run()
+        for rank, recs in streams.items():
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", recs)
+        base = str(tmp_path / "run.jsonl")
+        rc_follow = diagnose.main([base, "--follow", "--interval",
+                                   "0.05", "--timeout", "10"])
+        out_follow = capsys.readouterr().out
+        rc_offline = diagnose.main([base])
+        out_offline = capsys.readouterr().out
+        assert rc_follow == rc_offline == 1
+        follow_findings = [ln for ln in out_follow.splitlines()
+                           if ln.startswith("FINDING")]
+        offline_findings = [ln for ln in out_offline.splitlines()
+                            if ln.startswith("FINDING")]
+        # the final (offline-semantics) pass prints the identical
+        # verdict the post-mortem doctor prints; the live pass printed
+        # it once already as it landed
+        assert follow_findings[-len(offline_findings):] \
+            == offline_findings
+
+    def test_follow_json_expect_early_exit_emits_document(
+        self, tmp_path, capsys
+    ):
+        """--json keeps stdout a parseable JSON document on EVERY exit
+        path — including the live --expect early exit (the EXPECT OK
+        status goes to stderr there, like offline)."""
+        streams = _straggler_run()
+        for rank, recs in streams.items():
+            # mid-run prefix only: conviction comes from followed mode
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl",
+                         recs[:40])
+        rc = diagnose.main([str(tmp_path / "run.jsonl"), "--follow",
+                            "--json", "--expect", "straggler:1",
+                            "--interval", "0.05", "--timeout", "10"])
+        cap = capsys.readouterr()
+        assert rc == 0
+        doc = json.loads(cap.out)
+        assert [(f["class"], f["rank"]) for f in doc["findings"]] \
+            == [("straggler", 1)]
+        assert "DOCTOR EXPECT OK" in cap.err
+
+    def test_follow_never_appearing_file_finalizes(self, tmp_path,
+                                                   monkeypatch):
+        """A typo'd path / crashed-before-open run must not hang the
+        follower forever even without --timeout: the no-files wait is
+        floored, then finalizes."""
+        monkeypatch.setattr(diagnose, "NO_FILE_GRACE_S", 0.2)
+        t0 = time.monotonic()
+        rc = diagnose.main([str(tmp_path / "never.jsonl"), "--follow",
+                            "--interval", "0.05", "--idle", "0.1"])
+        assert time.monotonic() - t0 < 10.0
+        # same contract as offline on a missing path: exit 2, never a
+        # clean "DOCTOR OK" for a file that was never followed
+        assert rc == 2
+
+    def test_follow_header_only_gap_holds_past_idle(self, tmp_path,
+                                                    monkeypatch):
+        """A stream that has only its manifest/clock_sync header (the
+        driver is still importing jax / compiling) must not finalize
+        at --idle — the startup floor holds until the first workload
+        record."""
+        monkeypatch.setattr(diagnose, "NO_FILE_GRACE_S", 1.0)
+        _write_jsonl(tmp_path / "run.p0.jsonl",
+                     [_manifest(0, n=1), _clock_sync(1)])
+        t0 = time.monotonic()
+        rc = diagnose.main([str(tmp_path / "run.jsonl"), "--follow",
+                            "--interval", "0.05", "--idle", "0.1"])
+        elapsed = time.monotonic() - t0
+        assert elapsed >= 0.9, "finalized during the startup gap"
+        assert rc == 0  # header-only run: empty diagnosis
+
+    def test_followed_mode_gives_grace_to_unopened_rank_files(self):
+        """Mid-run, a manifest-declared sibling whose file has not
+        appeared yet (still importing jax) must NOT convict as
+        missing_rank — the absent-file rule is post-mortem-only; the
+        follower's FINAL pass still applies it."""
+        streams = _straggler_run()
+        s = diagnose._Stream(0, "run.p0.jsonl")
+        for ln, rec in enumerate(streams[0][:20], start=1):
+            s.add(ln, rec)
+        ctx = {"manifest": streams[0][0], "expected": 2}
+        online = diagnose.diagnose_streams([s], ctx, followed=True)
+        assert online == []
+        offline = diagnose.diagnose_streams([s], ctx, followed=False)
+        assert [(f["class"], f["rank"]) for f in offline] \
+            == [("missing_rank", 1)]
+
+    def test_followed_mode_oom_exonerated_by_live_sibling(self):
+        """Mid-follow every mem-recording stream is still missing its
+        final marker — a sibling ACTIVELY recording at the same
+        watermark must still exonerate a census-only growth ramp, or
+        two healthy growing ranks convict each other of oom live."""
+        def grower(rank):
+            s = diagnose._Stream(rank, f"run.p{rank}.jsonl")
+            s.add(1, _manifest(rank))
+            for i in range(1, 9):
+                # both ranks grow 8x with the tail still climbing —
+                # the same (legitimate) working-set ramp on each
+                s.add(1 + i, {"kind": "mem", "event": "sample",
+                              "t": 100.0 + i,
+                              "live_bytes": 1000 * i})
+            return s
+
+        inc = [grower(0), grower(1)]
+        online = diagnose.diagnose_streams(inc, {}, followed=True)
+        assert [f for f in online if f["class"] == "oom"] == []
+
+    def test_follow_rerun_resets_expected_rank_count(self, tmp_path,
+                                                     capsys):
+        """A 2-process rerun appended after a 4-process run must not
+        inherit expected=4: the follower's final pass would otherwise
+        convict phantom missing ranks the offline (newest-segment)
+        doctor never sees."""
+        streams = _straggler_run()
+        four = [{**_manifest(r, n=4), "process_index": r}
+                for r in (0, 1)]
+        for rank, recs in streams.items():
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl",
+                         [four[rank]] + recs)  # old 4-proc segment,
+            # then the full 2-proc run appended (manifest n=2 inside)
+        base = str(tmp_path / "run.jsonl")
+        rc = diagnose.main([base, "--follow", "--json", "--interval",
+                            "0.05", "--timeout", "10"])
+        follow_doc = json.loads(capsys.readouterr().out)
+        rc_off = diagnose.main([base, "--json"])
+        offline_doc = json.loads(capsys.readouterr().out)
+        # the straggler verdict, NOT missing_rank:2/3 phantoms —
+        # byte-identical to the offline newest-segment doctor
+        assert rc == rc_off == 1
+        assert [(f["class"], f["rank"])
+                for f in follow_doc["findings"]] \
+            == [("straggler", 1)]
+        assert json.dumps(follow_doc["findings"], sort_keys=True) \
+            == json.dumps(offline_doc["findings"], sort_keys=True)
+
+    def test_shed_storm_older_than_retention_still_convicts(
+        self, monkeypatch
+    ):
+        """Windows evicted from the bounded digest fold into a settled
+        aggregate: a storm in the first windows of a long run must
+        still convict post-mortem with its ORIGINAL evidence refs,
+        exactly like the pre-digest unbounded scan."""
+        monkeypatch.setattr(diagnose, "SHED_WINDOWS_KEPT", 8)
+
+        def win(i, shed):
+            return {"kind": "serve", "event": "window", "class": "c",
+                    "arrivals": 20, "shed": shed, "queue_max": 30,
+                    "t_end": 100.0 + i}
+
+        s = diagnose._Stream(0, "run.p0.jsonl")
+        s.add(1, _manifest(0, n=1))
+        ln = 2
+        for i in range(5):          # the early storm
+            s.add(ln, win(i, 15))
+            ln += 1
+        for i in range(5, 60):      # long clean tail evicts the storm
+            s.add(ln, win(i, 0))
+            ln += 1
+        assert len(s.serve_windows["c"]) == 8  # digest stayed bounded
+        (f,) = diagnose.diagnose_streams([s], {})
+        assert f["class"] == "shed_storm"
+        assert "75 shed" in f["detail"] or "75 requests shed" \
+            in f["detail"]
+        # evidence refs point at the ORIGINAL first shed windows
+        assert f["evidence"] and ":2:" in f["evidence"][0]
+
+    def test_quarantined_storm_stays_exempt_across_eviction(
+        self, monkeypatch
+    ):
+        """The summary-only total-retro-exemption (-inf boundary,
+        arriving at stream END) must still exempt windows that were
+        already folded into the settled aggregate."""
+        monkeypatch.setattr(diagnose, "SHED_WINDOWS_KEPT", 8)
+        s = diagnose._Stream(0, "run.p0.jsonl")
+        s.add(1, _manifest(0, n=1))
+        ln = 2
+        for i in range(40):
+            s.add(ln, {"kind": "serve", "event": "window", "class": "c",
+                       "arrivals": 20, "shed": 15, "queue_max": 30,
+                       "t_end": 100.0 + i})
+            ln += 1
+        s.add(ln, {"kind": "serve", "event": "summary", "class": "c",
+                   "quarantines": 2, "t_end": 200.0})
+        assert diagnose.diagnose_streams([s], {}) == []
+
+    def test_follow_ctrl_c_finalizes_instead_of_traceback(
+        self, tmp_path, monkeypatch
+    ):
+        """Ctrl-C on a live watch must end with the final
+        offline-semantics verdict, not a KeyboardInterrupt traceback."""
+        streams = _straggler_run()
+        for rank, recs in streams.items():
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", recs)
+
+        real_sleep = time.sleep
+        calls = {"n": 0}
+
+        def interrupting_sleep(s):
+            calls["n"] += 1
+            if calls["n"] >= 1:
+                raise KeyboardInterrupt
+            real_sleep(s)
+
+        monkeypatch.setattr(diagnose.time, "sleep", interrupting_sleep)
+        # closed streams normally finalize before any sleep; follow an
+        # INCOMPLETE copy so the loop reaches its sleep
+        _write_jsonl(tmp_path / "run.p1.jsonl", streams[1][:8])
+        rc = diagnose.main([str(tmp_path / "run.jsonl"), "--follow",
+                            "--interval", "0.01", "--idle", "1e9",
+                            "--timeout", "1e9"])
+        assert rc == 1  # the finalize verdict, not an uncaught crash
+
+    def test_follow_idle_finalizes_truncated_stream(self, tmp_path):
+        """A run that died (files stop growing, no close markers) must
+        not hang the follower: --idle finalizes with the offline
+        verdict."""
+        streams = _straggler_run()
+        # rank 1 dies early: no finals, no close markers
+        _write_jsonl(tmp_path / "run.p0.jsonl", streams[0])
+        _write_jsonl(tmp_path / "run.p1.jsonl", streams[1][:8])
+        rc = diagnose.main([str(tmp_path / "run.jsonl"), "--follow",
+                            "--interval", "0.05", "--idle", "0.3",
+                            "--timeout", "10"])
+        assert rc == 1
+        # and the final verdict is the offline one: the truncated rank
+        # convicts as missing while its healthy sibling closed cleanly
+        offline = diagnose.diagnose_files(
+            [str(tmp_path / "run.p0.jsonl"),
+             str(tmp_path / "run.p1.jsonl")])
+        assert [(f["class"], f["rank"]) for f in offline] \
+            == [("missing_rank", 1)]
+
+
+class TestNoJaxContract:
+    def test_live_module_imports_without_jax(self):
+        """live.py, metrics.py, and export.py must already be imported
+        by this test run; the real no-jax subprocess contract is pinned
+        in test_entry_points.py — here we pin the cheap invariant that
+        none of them imported jax at module scope."""
+        import tpu_mpi_tests.instrument.export  # noqa: F401
+        import tpu_mpi_tests.instrument.metrics  # noqa: F401
+
+        src = ""
+        for mod in ("live", "metrics", "export"):
+            p = os.path.join(os.path.dirname(live.__file__),
+                             f"{mod}.py")
+            src += open(p).read()
+        import re
+
+        assert not re.search(r"^import jax|^from jax", src,
+                             re.MULTILINE)

@@ -235,6 +235,26 @@ def _run_loop(rate, duration, service_s=0.001, window_s=2.0, seed=0,
     return records, summaries
 
 
+def test_window_records_carry_standing_queue_depth():
+    """Every window record reports the STANDING backlog at emission
+    time (``queue_depth``) alongside the high-water mark
+    (``queue_max``) — the live serve-pressure signal the metrics tee
+    forwards with the loop knowing nothing about metrics. A saturated
+    run must show a nonzero standing depth in some window, and the
+    standing depth can never exceed that window's high-water mark."""
+    # service far slower than arrivals: the queue builds a backlog
+    records, _ = _run_loop(rate=50.0, duration=10.0, service_s=0.1,
+                           max_batch=1)
+    windows = [r for r in records if r["event"] == "window"]
+    assert windows
+    assert all(isinstance(r.get("queue_depth"), int) for r in windows)
+    assert all(r["queue_depth"] <= r["queue_max"] for r in windows)
+    assert any(r["queue_depth"] > 0 for r in windows)
+    # summaries keep their pre-live shape: no standing-depth field
+    assert all("queue_depth" not in r for r in records
+               if r["event"] == "summary")
+
+
 def test_loop_record_count_independent_of_request_count():
     """Bounded-memory acceptance: 10x the traffic must NOT mean 10x the
     records — emission is per (class, window), never per request."""

@@ -38,6 +38,7 @@ DEFAULT_ENTRY_MODULES = {
     "tpu_mpi_tests.instrument.aggregate": "tpumt-report",
     "tpu_mpi_tests.instrument.timeline": "tpumt-trace",
     "tpu_mpi_tests.instrument.diagnose": "tpumt-doctor",
+    "tpu_mpi_tests.instrument.live": "tpumt-top",
     "tpu_mpi_tests.analysis.cli": "tpumt-lint",
     # the rule modules load lazily at lint time (all_rules()), which the
     # static reachability walk cannot see — root them explicitly so an

@@ -27,6 +27,11 @@ _EXPORTS = {
     "MemWatch": "memwatch",
     "mem_record": "memwatch",
     "compile_probe": "costs",
+    "MetricsRegistry": "metrics",
+    "PhaseProgress": "metrics",
+    "MetricsExporter": "export",
+    "Heartbeat": "export",
+    "render_openmetrics": "export",
 }
 __all__ = list(_EXPORTS)
 

@@ -208,6 +208,12 @@ def summarize(
                 if manifest is None or rec.get("process_index") == 0:
                     manifest = rec
             elif kind == "time":
+                if rec.get("event") == "progress":
+                    # live cumulative snapshots (metrics plane) repeat
+                    # the running total every interval — summing them
+                    # with the final PhaseTimer record would multiply
+                    # every phase total; the final record is the truth
+                    continue
                 rank = rec.get("rank", file_rank)
                 secs = float(rec.get("seconds", 0.0))
                 ph = phases.setdefault(

@@ -46,9 +46,13 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
+from collections import deque
 from pathlib import Path
 
 from tpu_mpi_tests.instrument.aggregate import expand_rank_files
+
+_INF = float("inf")
 
 #: the classes a finding can carry (the chaos smoke maps injected
 #: faults onto them via tpu_mpi_tests.chaos.spec.FINDING_FOR)
@@ -77,40 +81,225 @@ def _rec_t(rec: dict):
     return None
 
 
+#: timestamps retained per stream for the sibling-progress test (the
+#: missing-rank rule needs "did ≥2 records land after t?" — a bounded
+#: recent-suffix answers it exactly for any realistic record cadence)
+RECENT_TS = 128
+
+#: serve windows retained per class for the shed-storm digest: windows
+#: arrive once per report interval, so this covers ~40 min of default
+#: cadence; older windows age out of the (bounded) online digest
+SHED_WINDOWS_KEPT = 512
+
+#: --follow floor on the no-files-yet wait (seconds): jax import alone
+#: can take tens of seconds before the first record, so the --idle
+#: default must not finalize an empty follow that early — but a file
+#: that never appears still finalizes instead of hanging forever
+NO_FILE_GRACE_S = 60.0
+
+
 class _Stream:
-    """One rank's record stream plus the digests every rule shares."""
+    """One rank's record stream digested INCREMENTALLY.
+
+    Records are fed one at a time through :meth:`add` — the follow-mode
+    doctor feeds them as they are written, the offline constructor
+    feeds the whole file — and every rule reads only these bounded
+    digests. That is the online/offline agreement contract: both
+    doctors run the SAME rule kernels over the SAME digest code, so a
+    completed stream diagnoses byte-identically whether it was tailed
+    live or read post-mortem (pinned in tests/test_live.py). State is
+    bounded by construction: fixed-size deques, per-name aggregate
+    dicts, and single last-record slots — never the record list."""
 
     def __init__(self, rank: int, path: str,
-                 records: list[tuple[int, dict]]):
+                 records: list[tuple[int, dict]] | None = None):
         self.rank = rank
         self.path = path
-        self.records = records
-        self.spans = [(ln, r) for ln, r in records
-                      if r.get("kind") == "span"]
-        self.dispatches = [(ln, r) for ln, r in records
-                           if r.get("kind") == "dispatch"]
-        self.watchdogs = [(ln, r) for ln, r in records
-                          if r.get("kind") == "watchdog"]
-        self.mems = [(ln, r) for ln, r in records
-                     if r.get("kind") == "mem"]
-        self.serves = [(ln, r) for ln, r in records
-                       if r.get("kind") == "serve"]
-        self.times = [(ln, r) for ln, r in records
-                      if r.get("kind") == "time"]
-        ts = [t for _, r in records if (t := _rec_t(r)) is not None]
-        self.last_t = max(ts) if ts else None
+        self.n_records = 0
+        self.last_t: float | None = None
+        self.last_record: tuple[int, dict] | None = None
+        self._has_span = self._has_mem = False
+        self._has_summary = self._has_mem_final = False
+        self.hbm_limit: int | None = None
+        self._last_op: str | None = None
+        self._last_phase: str | None = None
+        # wedge digest: the last dispatch note and what followed it
+        self.last_dispatch: tuple[int, dict, float | None] | None = None
+        self._span_after_dispatch = False
+        self._wd_after_dispatch: tuple[int, dict] | None = None
+        self.last_watchdog: tuple[int, dict] | None = None
+        # sibling-progress digest (missing_rank)
+        self.recent_ts: deque = deque(maxlen=RECENT_TS)
+        # oom digest: running-max envelope of the memory series
+        self.mem_first: tuple[int, dict, float] | None = None
+        self.mem_peak: float | None = None
+        self.mem_peak_rec: tuple[int, dict] | None = None
+        self.mem_peak_idx: int | None = None
+        self.mem_n = 0
+        self.mem_tail: deque = deque(maxlen=6)
+        # straggler digest: per-phase/per-op totals. Final PhaseTimer
+        # records accumulate (`phase_fin`); live cumulative progress
+        # snapshots (metrics plane, event:"progress") keep latest-wins
+        # (`phase_prog`) and are OVERRIDDEN by finals — so a completed
+        # stream reads identically with or without the live trail
+        self.phase_fin: dict[str, tuple[float, int]] = {}
+        self.phase_prog: dict[str, tuple[float, int]] = {}
+        self.phase_last_t: dict[str, float] = {}
+        self.op_tot: dict[str, tuple[float, int]] = {}
+        self.op_last_t: dict[str, float] = {}
+        # shed-storm digest: a bounded deque of recent raw windows per
+        # class (the exemption boundary can arrive AFTER the windows it
+        # exempts, so filtering happens at judge time), windows evicted
+        # from it fold into a settled aggregate using the boundary
+        # known at eviction time, and the first shed windows are kept
+        # separately as evidence — so a storm older than the retention
+        # window still convicts with its original evidence refs
+        self.quar_t: dict = {}
+        self.serve_windows: dict[str, deque] = {}
+        self.serve_settled: dict[str, dict] = {}
+        self.serve_first_shed: dict[str, list] = {}
+        for ln, rec in (records or []):
+            self.add(ln, rec)
+
+    @property
+    def died(self) -> bool:
         # close markers: the memwatch final census and the telemetry
         # counter flush are both emitted by Reporter.close — a stream
         # that recorded through either channel but lacks its marker
         # belongs to a process that never reached a clean close
-        has_mem_final = any(r.get("event") == "final"
-                            for _, r in self.mems)
-        has_summary = any(r.get("kind") == "telemetry_summary"
-                          for _, r in records)
-        self.died = bool(
-            (self.mems and not has_mem_final)
-            or (self.spans and not has_summary)
-        )
+        return bool((self._has_mem and not self._has_mem_final)
+                    or (self._has_span and not self._has_summary))
+
+    @property
+    def closed(self) -> bool:
+        """A clean-close marker was seen — follow mode's signal that
+        this rank's run ended on purpose."""
+        return self._has_summary or self._has_mem_final
+
+    def add(self, ln: int, rec: dict) -> None:
+        self.n_records += 1
+        self.last_record = (ln, rec)
+        t = _rec_t(rec)
+        if t is not None:
+            self.recent_ts.append(t)
+            if self.last_t is None or t > self.last_t:
+                self.last_t = t
+        kind = rec.get("kind")
+        if kind == "manifest":
+            v = rec.get("hbm_bytes_limit")
+            if isinstance(v, (int, float)):
+                self.hbm_limit = int(v)
+        elif kind == "span":
+            self._has_span = True
+            self._last_op = rec.get("op") or rec.get("note")
+            if self.last_dispatch is not None:
+                t_d = self.last_dispatch[2]
+                if t_d is not None and (rec.get("t_end") or 0) > t_d:
+                    self._span_after_dispatch = True
+            name = rec.get("op", "?")
+            if t is not None and t > self.op_last_t.get(name, -_INF):
+                self.op_last_t[name] = t
+            # collective spans only (world >= 2): a local op's per-rank
+            # asymmetry is load, not a straggler, and the inversion
+            # argument only holds where ranks wait on each other
+            if int(rec.get("world") or 1) >= 2 and not rec.get("async"):
+                tot, cnt = self.op_tot.get(name, (0.0, 0))
+                self.op_tot[name] = (
+                    tot + float(rec.get("seconds") or 0.0), cnt + 1)
+        elif kind == "dispatch":
+            self._last_op = rec.get("op") or rec.get("note")
+            self.last_dispatch = (ln, rec, t)
+            self._span_after_dispatch = False
+            self._wd_after_dispatch = None
+        elif kind == "watchdog":
+            self.last_watchdog = (ln, rec)
+            if self.last_dispatch is not None:
+                if (t or 0) >= (self.last_dispatch[2] or 0):
+                    self._wd_after_dispatch = (ln, rec)
+        elif kind == "mem":
+            self._has_mem = True
+            if rec.get("event") == "final":
+                self._has_mem_final = True
+            if rec.get("phase"):
+                self._last_phase = rec["phase"]
+            v = rec.get("bytes_in_use", rec.get("live_bytes"))
+            if isinstance(v, (int, float)):
+                if self.mem_first is None:
+                    self.mem_first = (ln, rec, v)
+                if self.mem_peak is None or v > self.mem_peak:
+                    # strict > keeps the FIRST index of each new high:
+                    # a plateau held until death repeats the peak value
+                    # without moving the index
+                    self.mem_peak = v
+                    self.mem_peak_rec = (ln, rec)
+                    self.mem_peak_idx = self.mem_n
+                self.mem_tail.append(v)
+                self.mem_n += 1
+        elif kind == "time":
+            name = rec.get("phase")
+            if name:
+                self._last_phase = name
+                secs = float(rec.get("seconds") or 0.0)
+                count = int(rec.get("count") or 1)
+                if rec.get("event") == "progress":
+                    self.phase_prog[name] = (secs, count)
+                else:
+                    tot, cnt = self.phase_fin.get(name, (0.0, 0))
+                    self.phase_fin[name] = (tot + secs, cnt + count)
+                if t is not None and t > self.phase_last_t.get(name,
+                                                               -_INF):
+                    self.phase_last_t[name] = t
+        elif kind == "telemetry_summary":
+            self._has_summary = True
+        elif kind == "serve":
+            cls = rec.get("class")
+            event = rec.get("event")
+            if event == "quarantine":
+                prev = self.quar_t.get(cls, _INF)
+                self.quar_t[cls] = min(
+                    prev, t if t is not None else -_INF)
+            elif event == "summary" and rec.get("quarantines"):
+                self.quar_t.setdefault(cls, -_INF)
+            elif event == "window":
+                cls_key = rec.get("class", "?")
+                if rec.get("shed"):
+                    fs = self.serve_first_shed.setdefault(cls_key, [])
+                    if len(fs) < 3:
+                        fs.append((ln, rec))
+                dq = self.serve_windows.setdefault(cls_key, deque())
+                dq.append((ln, rec))
+                while len(dq) > SHED_WINDOWS_KEPT:
+                    self._settle_window(cls_key, *dq.popleft())
+
+    def _settle_window(self, cls_key: str, ln: int, r: dict) -> None:
+        """Fold a window evicted from the bounded recent deque into the
+        settled aggregate, applying the exemption boundary known NOW.
+        A quarantine boundary arriving more than
+        :data:`SHED_WINDOWS_KEPT` windows after the windows it would
+        exempt is the one edge the bounded digest gives up — except
+        total retro-exemption (the summary-only ``-inf`` boundary),
+        which the judge handles by dropping the whole settled range."""
+        t = _rec_t(r) or 0
+        cls_q = self.quar_t.get(r.get("class"))
+        if cls_q is not None and t >= cls_q:
+            return
+        st = self.serve_settled.setdefault(cls_key, {
+            "shed": 0, "arrivals": 0, "qmax": 0, "t": None,
+            "t_min": _INF})
+        st["shed"] += int(r.get("shed") or 0)
+        st["arrivals"] += int(r.get("arrivals") or 0)
+        st["qmax"] = max(st["qmax"], int(r.get("queue_max") or 0))
+        st["t_min"] = min(st["t_min"], t)
+        if r.get("shed"):
+            st["t"] = _rec_t(r)
+
+    def phase_totals(self) -> dict[str, tuple[float, int]]:
+        """Per-phase (seconds, calls): finals where the stream has
+        them, latest live progress snapshot otherwise."""
+        out = dict(self.phase_fin)
+        for name, pair in self.phase_prog.items():
+            out.setdefault(name, pair)
+        return out
 
     def ref(self, ln: int, rec: dict) -> str:
         t = _rec_t(rec)
@@ -123,20 +312,7 @@ class _Stream:
     def last_activity(self) -> tuple[str | None, str | None]:
         """(last op, last phase) the stream witnessed — the dying
         rank's attribution line."""
-        op = None
-        for ln, r in reversed(self.records):
-            if r.get("kind") in ("span", "dispatch"):
-                op = r.get("op") or r.get("note")
-                break
-        phase = None
-        for ln, r in reversed(self.records):
-            if r.get("kind") == "mem" and r.get("phase"):
-                phase = r["phase"]
-                break
-            if r.get("kind") == "time" and r.get("phase"):
-                phase = r["phase"]
-                break
-        return op, phase
+        return self._last_op, self._last_phase
 
 
 def load_with_lines(path: str,
@@ -247,7 +423,8 @@ def _finding(cls: str, rank, confidence: float, detail: str,
     }
 
 
-def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
+def _death_finding(s: _Stream, streams: list[_Stream], opts,
+                   followed: bool = False) -> dict | None:
     """Wedge > oom > missing_rank, exactly one verdict for a dead
     rank. Returns None when the stream carries no timestamped evidence
     to judge (pre-timeline JSONL must diagnose as nothing, not as a
@@ -255,40 +432,26 @@ def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
     if s.last_t is None:
         return None
     # -- wedge: a dispatched op that never completed, then the watchdog
-    if s.dispatches and s.watchdogs:
-        ln_d, disp = s.dispatches[-1]
-        t_d = _rec_t(disp)
-        wd = [(ln, r) for ln, r in s.watchdogs
-              if (_rec_t(r) or 0) >= (t_d or 0)]
-        progressed = [
-            (ln, r) for ln, r in s.spans
-            if t_d is not None and (r.get("t_end") or 0) > t_d
-        ]
-        if wd and not progressed:
-            ln_w, wrec = wd[-1]
-            op, phase = s.last_activity()
-            return _finding(
-                "wedge", s.rank, 0.9,
-                f"dispatch {disp.get('note') or disp.get('op')!r} never "
-                f"completed: no span closed after it and the watchdog "
-                f"fired {((_rec_t(wrec) or 0) - (t_d or 0)):.1f}s later "
-                f"(phase {wrec.get('phase')!r}, deadline "
-                f"{wrec.get('deadline_s')}s)",
-                [s.ref(ln_d, disp), s.ref(ln_w, wrec)],
-                last_op=disp.get("op") or disp.get("note"), phase=phase,
-                t=_rec_t(wrec),
-            )
+    if s.last_dispatch is not None and s._wd_after_dispatch is not None \
+            and not s._span_after_dispatch:
+        ln_d, disp, t_d = s.last_dispatch
+        ln_w, wrec = s._wd_after_dispatch
+        op, phase = s.last_activity()
+        return _finding(
+            "wedge", s.rank, 0.9,
+            f"dispatch {disp.get('note') or disp.get('op')!r} never "
+            f"completed: no span closed after it and the watchdog "
+            f"fired {((_rec_t(wrec) or 0) - (t_d or 0)):.1f}s later "
+            f"(phase {wrec.get('phase')!r}, deadline "
+            f"{wrec.get('deadline_s')}s)",
+            [s.ref(ln_d, disp), s.ref(ln_w, wrec)],
+            last_op=disp.get("op") or disp.get("note"), phase=phase,
+            t=_rec_t(wrec),
+        )
     if not s.died:
         return None
     # -- oom: a monotone memory ramp before death
-    series = [
-        (ln, r, r.get("bytes_in_use", r.get("live_bytes")))
-        for ln, r in s.mems
-        if isinstance(r.get("bytes_in_use", r.get("live_bytes")),
-                      (int, float))
-    ]
-    if len(series) >= 4:
-        vals = [v for _, _, v in series]
+    if s.mem_n >= 4:
         # the ramp must still be setting NEW HIGHS at death: every
         # process allocates its working set at startup (a "ramp" from
         # ~0), so growth alone convicts every killed rank — genuine
@@ -300,15 +463,16 @@ def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
         # jitter, not recovery. The peak's FIRST index is what dates
         # the last new high — a plateau held until death repeats the
         # peak value without ever climbing.
-        peak = max(vals)
-        peak_idx = min(i for i, v in enumerate(vals) if v == peak)
+        peak = s.mem_peak
+        tail6_first = (s.mem_tail[0] if s.mem_n >= 6
+                       else s.mem_first[2])
         tail_climbing = (
-            peak_idx >= len(vals) - 3           # a new high near death
-            and vals[-1] >= 0.75 * peak         # pressure held to the end
-            and peak >= vals[max(0, len(vals) - 6)] * 1.1  # tail grew
+            s.mem_peak_idx >= s.mem_n - 3       # a new high near death
+            and s.mem_tail[-1] >= 0.75 * peak   # pressure held to the end
+            and peak >= tail6_first * 1.1       # tail grew
         )
-        growth = peak / max(vals[0], 1)
-        limit = (s_manifest_limit(s) or 0)
+        growth = peak / max(s.mem_first[2], 1)
+        limit = s.hbm_limit or 0
         crossed = limit and peak >= opts["limit_frac"] * limit
         # the census-only growth fallback (no allocator limit to cross)
         # additionally demands the pressure be DISTINCTIVE: a surviving
@@ -316,18 +480,23 @@ def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
         # proves that watermark is the workload's working set, not a
         # runaway — a rank killed the instant its startup ramp tops out
         # must convict as missing_rank, not oom
+        # mid-follow every mem-recording stream is still missing its
+        # final marker ("died"), which would empty this exoneration
+        # set and convict healthy growing ranks — a sibling ACTIVELY
+        # recording at the same watermark proves the working set just
+        # as well as one that closed cleanly
         sib_peaks = [
-            p for o in streams
-            if o is not s and not o.died
-            and (p := _mem_peak(o)) is not None
+            o.mem_peak for o in streams
+            if o is not s and (followed or not o.died)
+            and o.mem_peak is not None
         ]
         runaway = not any(p >= 0.9 * peak for p in sib_peaks)
         if tail_climbing and (
             crossed or (growth >= opts["ramp_ratio"] and runaway)
         ):
             op, phase = s.last_activity()
-            ln0, r0, v0 = series[0]
-            ln1, r1, _v1 = series[peak_idx]
+            ln0, r0, v0 = s.mem_first
+            ln1, r1 = s.mem_peak_rec
             why = (f"crossed {opts['limit_frac']:g} of hbm_bytes_limit "
                    f"{limit}" if crossed else
                    f"grew {growth:.1f}x (census-only backend, no "
@@ -335,7 +504,7 @@ def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
             return _finding(
                 "oom", s.rank, 0.9 if crossed else 0.7,
                 f"monotone memory ramp {v0} -> {peak} bytes over "
-                f"{len(vals)} records {why}, then the stream died "
+                f"{s.mem_n} records {why}, then the stream died "
                 f"without its close markers",
                 [s.ref(ln0, r0), s.ref(ln1, r1)],
                 last_op=op, phase=phase, t=_rec_t(r1),
@@ -346,17 +515,16 @@ def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
         latest = max(o.last_t for o in sibs)
         progressed = [
             o for o in sibs
-            if sum(1 for _, r in o.records
-                   if (_rec_t(r) or 0) > s.last_t) >= 2
+            if sum(1 for t in o.recent_ts if t > s.last_t) >= 2
         ]
         if latest - s.last_t >= opts["gap_s"] and progressed:
             op, phase = s.last_activity()
             conf = 0.85
-            ev = [s.ref(*s.records[-1])]
+            ev = [s.ref(*s.last_record)]
             for o in progressed[:1]:
-                if o.watchdogs:
+                if o.last_watchdog is not None:
                     conf = 0.95  # a sibling hung waiting for this rank
-                    ev.append(o.ref(*o.watchdogs[-1]))
+                    ev.append(o.ref(*o.last_watchdog))
             return _finding(
                 "missing_rank", s.rank, conf,
                 f"rank {s.rank} recorded nothing after "
@@ -372,30 +540,17 @@ def _death_finding(s: _Stream, streams: list[_Stream], opts) -> dict | None:
     return None
 
 
-def _mem_peak(s: _Stream) -> int | float | None:
-    vals = [
-        v for _, r in s.mems
-        if isinstance(v := r.get("bytes_in_use", r.get("live_bytes")),
-                      (int, float))
-    ]
-    return max(vals) if vals else None
-
-
-def s_manifest_limit(s: _Stream) -> int | None:
-    for _ln, r in s.records:
-        if r.get("kind") == "manifest":
-            v = r.get("hbm_bytes_limit")
-            if isinstance(v, (int, float)):
-                return int(v)
-    return None
-
-
-def _straggler_findings(streams: list[_Stream], opts) -> list[dict]:
+def _straggler_findings(streams: list[_Stream], opts,
+                        alive: list[_Stream] | None = None) -> list[dict]:
     """Cross-rank skew over phases (slowest rank convicts) and
     collective ops (FASTEST rank convicts — sync-honest collective
     spans charge the wait to whoever arrived early, so the rank that
-    never waits is the one everyone waited for)."""
-    alive = [s for s in streams if not s.died]
+    never waits is the one everyone waited for). ``alive`` overrides
+    the default not-died selection — follow mode passes the streams
+    that are not death-convicted, since mid-run EVERY stream is still
+    missing its close markers."""
+    if alive is None:
+        alive = [s for s in streams if not s.died]
     if len(alive) < 2:
         return []
     by_rank: dict = {}
@@ -430,28 +585,14 @@ def _straggler_findings(streams: list[_Stream], opts) -> list[dict]:
 
     phases: dict = {}
     for s in alive:
-        for _ln, r in s.times:
-            name = r.get("phase")
-            if not name:
-                continue
-            secs = float(r.get("seconds") or 0.0)
-            count = int(r.get("count") or 1)
-            tot, cnt = phases.setdefault(name, {}).get(s.rank, (0.0, 0))
-            phases[name][s.rank] = (tot + secs, cnt + count)
+        for name, pair in s.phase_totals().items():
+            phases.setdefault(name, {})[s.rank] = pair
     judge(phases, invert=False, what="phase", conf=0.8)
 
     ops: dict = {}
     for s in alive:
-        for _ln, r in s.spans:
-            # collective spans only (world >= 2): a local op's per-rank
-            # asymmetry is load, not a straggler, and the inversion
-            # argument below only holds where ranks wait on each other
-            if int(r.get("world") or 1) < 2 or r.get("async"):
-                continue
-            name = r.get("op", "?")
-            secs = float(r.get("seconds") or 0.0)
-            tot, cnt = ops.setdefault(name, {}).get(s.rank, (0.0, 0))
-            ops[name][s.rank] = (tot + secs, cnt + 1)
+        for name, pair in s.op_tot.items():
+            ops.setdefault(name, {})[s.rank] = pair
     judge(ops, invert=True, what="collective", conf=0.6)
 
     by_stream = {s.rank: s for s in alive}
@@ -465,15 +606,8 @@ def _straggler_findings(streams: list[_Stream], opts) -> list[dict]:
         s = by_stream.get(rank)
         anchor = None
         if s is not None:
-            if what == "phase":
-                ts = [t for _, r in s.times
-                      if r.get("phase") == name
-                      and (t := _rec_t(r)) is not None]
-            else:
-                ts = [t for _, r in s.spans
-                      if r.get("op") == name
-                      and (t := _rec_t(r)) is not None]
-            anchor = max(ts) if ts else None
+            anchor = (s.phase_last_t if what == "phase"
+                      else s.op_last_t).get(name)
         out.append(_finding(
             "straggler", rank, entry["conf"],
             "; ".join(entry["items"]),
@@ -502,35 +636,54 @@ def _shed_storm_findings(streams: list[_Stream], opts) -> list[dict]:
         # entry onward: windows a healthy-handler class shed at the
         # queue bound BEFORE it ever quarantined are a genuine storm.
         # A summary-only signal (episode windows lost) has no entry
-        # time, so it exempts the whole stream.
-        quar_t: dict = {}
-        for _ln, r in s.serves:
-            cls = r.get("class")
-            if r.get("event") == "quarantine":
-                t = _rec_t(r)
-                prev = quar_t.get(cls, float("inf"))
-                quar_t[cls] = min(prev, t if t is not None
-                                  else float("-inf"))
-            elif r.get("event") == "summary" and r.get("quarantines"):
-                quar_t.setdefault(cls, float("-inf"))
+        # time, so it exempts the whole stream. The digest keeps the
+        # raw windows per class (bounded deque) precisely because the
+        # exemption boundary can arrive AFTER the windows it exempts —
+        # the filter runs at judge time, over the retained set.
         per_class: dict = {}
-        for ln, r in s.serves:
-            if r.get("event") != "window":
-                continue
-            cls_q = quar_t.get(r.get("class"))
-            if cls_q is not None and (_rec_t(r) or 0) >= cls_q:
-                continue
-            cls = r.get("class", "?")
-            agg = per_class.setdefault(
-                cls, {"shed": 0, "arrivals": 0, "qmax": 0,
-                      "windows": [], "t": None})
-            agg["shed"] += int(r.get("shed") or 0)
-            agg["arrivals"] += int(r.get("arrivals") or 0)
-            agg["qmax"] = max(agg["qmax"],
-                              int(r.get("queue_max") or 0))
-            if r.get("shed"):
-                agg["windows"].append((ln, r))
-                agg["t"] = _rec_t(r)
+        for cls, dq in s.serve_windows.items():
+            agg = None
+            settled = s.serve_settled.get(cls)
+            if settled:
+                # settled windows were exemption-filtered at eviction;
+                # a boundary that later moved to (or before) the whole
+                # settled range — the summary-only -inf case — drops
+                # the aggregate wholesale
+                boundary = None
+                for _ln, r0 in dq:
+                    boundary = s.quar_t.get(r0.get("class"))
+                    break
+                if not (boundary is not None
+                        and boundary <= settled["t_min"]):
+                    agg = per_class.setdefault(
+                        cls, {"shed": 0, "arrivals": 0, "qmax": 0,
+                              "windows": [], "t": None})
+                    agg["shed"] += settled["shed"]
+                    agg["arrivals"] += settled["arrivals"]
+                    agg["qmax"] = max(agg["qmax"], settled["qmax"])
+                    agg["t"] = settled["t"]
+            for ln, r in dq:
+                cls_q = s.quar_t.get(r.get("class"))
+                if cls_q is not None and (_rec_t(r) or 0) >= cls_q:
+                    continue
+                agg = per_class.setdefault(
+                    cls, {"shed": 0, "arrivals": 0, "qmax": 0,
+                          "windows": [], "t": None})
+                agg["shed"] += int(r.get("shed") or 0)
+                agg["arrivals"] += int(r.get("arrivals") or 0)
+                agg["qmax"] = max(agg["qmax"],
+                                  int(r.get("queue_max") or 0))
+                if r.get("shed"):
+                    agg["t"] = _rec_t(r)
+            # evidence = the FIRST shed windows ever seen (kept outside
+            # the bounded deque), judge-time exemption-filtered like
+            # everything else
+            if agg is not None:
+                agg["windows"] = [
+                    (ln, r) for ln, r in s.serve_first_shed.get(cls, [])
+                    if not ((q := s.quar_t.get(r.get("class")))
+                            is not None and (_rec_t(r) or 0) >= q)
+                ]
         storms = {
             cls: a for cls, a in per_class.items()
             if a["shed"] >= max(opts["shed_min"],
@@ -554,8 +707,16 @@ def _shed_storm_findings(streams: list[_Stream], opts) -> list[dict]:
 
 
 def diagnose_streams(streams: list[_Stream], ctx: dict | None = None,
-                     **overrides) -> list[dict]:
-    """Apply every rule; findings sorted most-confident first."""
+                     followed: bool = False, **overrides) -> list[dict]:
+    """Apply every rule; findings sorted most-confident first.
+
+    ``followed`` is the ONLINE mode: mid-run every stream is still
+    missing its close markers (nothing has closed yet), so the
+    straggler rule's alive set becomes "not death-convicted" instead of
+    "not died". On a COMPLETED stream the two are identical (closed
+    streams are not died; truncated ones get their death finding), so
+    the follow-mode doctor's final pass runs with ``followed=False``
+    and agrees with the offline doctor byte for byte."""
     opts = dict(DEFAULTS)
     opts.update({k: v for k, v in overrides.items() if v is not None})
     findings: list[dict] = []
@@ -563,27 +724,35 @@ def diagnose_streams(streams: list[_Stream], ctx: dict | None = None,
 
     # ranks in the manifest with no file at all — the strongest form
     # of a missing rank (a crashed rank whose JSONL never flushed, or
-    # a file lost in transit: either way the run claims n ranks)
-    expected = int(ctx.get("expected") or 0)
-    seen = {s.rank for s in streams}
-    for rank in range(expected):
-        if rank not in seen:
-            findings.append(_finding(
-                "missing_rank", rank, 0.9,
-                f"the manifest declares {expected} processes but no "
-                f"rank file for rank {rank} exists in the merged set",
-                [], t=None,
-            ))
+    # a file lost in transit: either way the run claims n ranks).
+    # POST-MORTEM only: while following a live run, a sibling rank
+    # that has not opened its file yet (still importing jax) is
+    # indistinguishable from one that never will — the follower's
+    # FINAL pass (followed=False) applies this rule
+    if not followed:
+        expected = int(ctx.get("expected") or 0)
+        seen = {s.rank for s in streams}
+        for rank in range(expected):
+            if rank not in seen:
+                findings.append(_finding(
+                    "missing_rank", rank, 0.9,
+                    f"the manifest declares {expected} processes but "
+                    f"no rank file for rank {rank} exists in the "
+                    f"merged set",
+                    [], t=None,
+                ))
 
     dead_ranks = set()
     for s in streams:
-        f = _death_finding(s, streams, opts)
+        f = _death_finding(s, streams, opts, followed=followed)
         if f is not None:
             findings.append(f)
             dead_ranks.add(s.rank)
 
+    alive = ([s for s in streams if s.rank not in dead_ranks]
+             if followed else None)
     findings.extend(
-        f for f in _straggler_findings(streams, opts)
+        f for f in _straggler_findings(streams, opts, alive=alive)
         if f["rank"] not in dead_ranks
     )
     findings.extend(
@@ -634,6 +803,174 @@ def format_finding(f: dict) -> str:
     return " ".join(parts) + f" — {f['detail']}"
 
 
+def _print_findings(findings: list[dict], streams: list[_Stream],
+                    as_json: bool, files: list[str]) -> None:
+    if as_json:
+        json.dump({"files": files, "findings": findings}, sys.stdout,
+                  indent=1)
+        print()
+        return
+    for f in findings:
+        print(format_finding(f))
+        for ref in f.get("evidence") or []:
+            print(f"  evidence: {ref}")
+    if not findings:
+        n = sum(s.n_records for s in streams)
+        print(f"DOCTOR OK: no findings ({len(streams)} rank "
+              f"file(s), {n} records)")
+
+
+def _expect_verdict(findings: list[dict], expect, as_json: bool) -> int:
+    cls, rank = expect
+    if len(findings) == 1 and findings[0]["class"] == cls \
+            and findings[0]["rank"] == rank:
+        # stderr under --json: stdout is a JSON document a
+        # consumer may be piping into a parser
+        print(f"DOCTOR EXPECT OK: {cls}:{rank}",
+              file=sys.stderr if as_json else sys.stdout)
+        return 0
+    got = [f"{f['class']}:{f['rank']}" for f in findings]
+    print(f"DOCTOR EXPECT FAIL: wanted exactly [{cls}:{rank}], "
+          f"got {got}", file=sys.stderr)
+    return 2
+
+
+def follow(args, expect) -> int:
+    """The ONLINE doctor: tail the rank files as they are written
+    (``instrument/live.py`` tailer — the same incremental reader
+    ``tpumt-top`` uses, ghost-sibling-filtered by the shared run-stamp
+    helper), feed each new record into the SAME :class:`_Stream`
+    digests the offline doctor builds, and re-judge every poll with
+    ``followed=True``. New convictions print the moment they land —
+    while the run is still executing. With ``--expect`` the process
+    exits 0 the instant the diagnosis is exactly the expected finding
+    (the live CI primitive ``make live-smoke`` uses against an
+    injected chaos straggler).
+
+    Termination without ``--expect`` (or when it never matches): when
+    every followed stream saw its clean-close marker, when no file
+    grew for ``--idle`` seconds, or at ``--timeout`` — then a FINAL
+    pass runs with offline semantics (``followed=False``), so the
+    verdicts printed at the end are byte-identical to running the
+    post-mortem doctor on the same files (pinned in
+    tests/test_live.py)."""
+    from tpu_mpi_tests.instrument.live import RunTail
+
+    tail = RunTail(args.files)
+    streams: dict[str, _Stream] = {}
+    ctx: dict = {"manifest": {}, "expected": 0}
+    printed: set = set()
+    t0 = time.monotonic()
+    last_data = t0
+    # --idle applies only once WORKLOAD records flow: a driver writes
+    # its manifest/clock_sync header within a second and then spends
+    # tens of seconds in jax import + XLA compile before the first
+    # span/phase — a header-only quiet gap must not finalize a healthy
+    # run as "over"
+    saw_body = False
+    thresholds = {"skew_threshold": args.skew_threshold,
+                  "gap_s": args.gap_s}
+
+    def slist() -> list[_Stream]:
+        return list(streams.values())
+
+    def finalize() -> int:
+        if not streams:
+            # same contract as the offline doctor on a missing path: a
+            # typo'd/never-created file must not read as a clean run
+            print("tpumt-doctor: no input files found", file=sys.stderr)
+            return 2
+        findings = diagnose_streams(slist(), ctx, followed=False,
+                                    **thresholds)
+        _print_findings(findings, slist(), args.json, tail.files())
+        if expect is not None:
+            return _expect_verdict(findings, expect, args.json)
+        return 1 if findings else 0
+
+    try:
+        while True:
+            grew = False
+            for path, ln, rec in tail.poll():
+                grew = True
+                kind = rec.get("kind")
+                if kind == "manifest":
+                    # a new segment at a followed path = a rerun
+                    # appended to the same file: fresh digest, same as
+                    # the offline newest-segment selection — and the
+                    # run context restarts with it, or a 2-process
+                    # rerun after a 4-process run would inherit
+                    # expected=4 and convict phantom missing ranks the
+                    # offline (newest-segment) doctor never sees
+                    if path in streams:
+                        ctx["expected"] = 0
+                        ctx["manifest"] = {}
+                        # the new run's convictions must print live
+                        # even when they repeat the old run's
+                        # (class, rank) — the dedup is per run
+                        printed.clear()
+                    streams[path] = _Stream(
+                        rec.get("process_index", tail.index(path)),
+                        path)
+                    ctx["expected"] = max(
+                        ctx["expected"],
+                        int(rec.get("process_count") or 0))
+                    if not ctx["manifest"] \
+                            or rec.get("process_index") == 0:
+                        ctx["manifest"] = rec
+                if kind not in ("manifest", "clock_sync", "chaos"):
+                    saw_body = True
+                if kind == "chaos":
+                    continue  # organic signals only, like offline load
+                s = streams.get(path)
+                if s is None:
+                    s = streams[path] = _Stream(tail.index(path), path)
+                s.add(ln, rec)
+            now = time.monotonic()
+            if grew:
+                last_data = now
+                findings = diagnose_streams(slist(), ctx,
+                                            followed=True, **thresholds)
+                for f in findings:
+                    key = (f["class"], f["rank"])
+                    if key not in printed and not args.json:
+                        printed.add(key)
+                        print(format_finding(f), flush=True)
+                if expect is not None and len(findings) == 1:
+                    f = findings[0]
+                    if (f["class"], f["rank"]) == expect:
+                        if args.json:
+                            # --json keeps stdout a parseable document
+                            # on EVERY exit path, this one included
+                            _print_findings(findings, slist(), True,
+                                            tail.files())
+                        print(f"DOCTOR EXPECT OK: "
+                              f"{expect[0]}:{expect[1]} (live, "
+                              f"{now - t0:.1f}s after follow start)",
+                              file=(sys.stderr if args.json
+                                    else sys.stdout),
+                              flush=True)
+                        return 0
+            if streams and all(s.closed for s in streams.values()):
+                return finalize()
+            # the wait is floored well above --idle until the first
+            # WORKLOAD record: startup (jax import, XLA compile, a
+            # header-only stream) legitimately takes tens of quiet
+            # seconds — but a file that never appears, or a run that
+            # never produces a body, must finalize, not hang
+            wait_limit = (args.idle if saw_body
+                          else max(args.idle, NO_FILE_GRACE_S))
+            if now - last_data >= wait_limit:
+                return finalize()
+            if args.timeout is not None and now - t0 >= args.timeout:
+                return finalize()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        # Ctrl-C on a live watch ends it like a timeout: the final
+        # offline-semantics verdict, not a traceback
+        print("", file=sys.stderr)
+        return finalize()
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpumt-doctor",
@@ -664,6 +1001,37 @@ def main(argv: list[str] | None = None) -> int:
         help="emit {'findings': [...]} as one JSON document",
     )
     p.add_argument(
+        "--follow", action="store_true",
+        help="ONLINE mode: tail the rank files as they are written and "
+        "convict WHILE the run executes (same rule kernels as the "
+        "post-mortem pass — the final verdict on a completed stream is "
+        "byte-identical to running without --follow); with --expect, "
+        "exit 0 the moment the diagnosis is exactly the expected "
+        "finding (README 'Live observability')",
+    )
+    p.add_argument(
+        "--interval", type=float, default=0.5, metavar="S",
+        help="--follow poll period in seconds (default 0.5)",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=None, metavar="S",
+        help="--follow: give up and run the final (offline-semantics) "
+        "diagnosis after S seconds (default: no limit)",
+    )
+    p.add_argument(
+        "--idle", type=float, default=10.0, metavar="S",
+        help="--follow: treat the run as over when no followed file "
+        "grew for S seconds (default 10) and run the final diagnosis; "
+        "until the first WORKLOAD record (beyond manifest/clock_sync) "
+        "the wait is floored at 60 s — driver startup spends tens of "
+        "quiet seconds in jax import/compile — after which a "
+        "never-appearing run finalizes instead of hanging. NOTE: "
+        "--follow replays existing content at the path first, exactly "
+        "like the offline doctor would judge it — rotate or remove a "
+        "previous run's files when you mean to watch only an upcoming "
+        "run",
+    )
+    p.add_argument(
         "--expect", default=None, metavar="CLASS:RANK",
         help="CI contract mode: exit 0 iff the diagnosis is EXACTLY "
         "one finding of CLASS convicting RANK (e.g. --expect "
@@ -685,6 +1053,9 @@ def main(argv: list[str] | None = None) -> int:
                   f"{','.join(FINDING_CLASSES)}", file=sys.stderr)
             return 2
 
+    if args.follow:
+        return follow(args, expect)
+
     files = [f for f in expand_rank_files(args.files) if Path(f).exists()]
     if not files:
         print("tpumt-doctor: no input files found", file=sys.stderr)
@@ -694,34 +1065,9 @@ def main(argv: list[str] | None = None) -> int:
         streams, ctx, skew_threshold=args.skew_threshold,
         gap_s=args.gap_s,
     )
-
-    if args.json:
-        json.dump({"files": files, "findings": findings}, sys.stdout,
-                  indent=1)
-        print()
-    else:
-        for f in findings:
-            print(format_finding(f))
-            for ref in f.get("evidence") or []:
-                print(f"  evidence: {ref}")
-        if not findings:
-            n = sum(len(s.records) for s in streams)
-            print(f"DOCTOR OK: no findings ({len(streams)} rank "
-                  f"file(s), {n} records)")
-
+    _print_findings(findings, streams, args.json, files)
     if expect is not None:
-        cls, rank = expect
-        if len(findings) == 1 and findings[0]["class"] == cls \
-                and findings[0]["rank"] == rank:
-            # stderr under --json: stdout is a JSON document a
-            # consumer may be piping into a parser
-            print(f"DOCTOR EXPECT OK: {cls}:{rank}",
-                  file=sys.stderr if args.json else sys.stdout)
-            return 0
-        got = [f"{f['class']}:{f['rank']}" for f in findings]
-        print(f"DOCTOR EXPECT FAIL: wanted exactly [{cls}:{rank}], "
-              f"got {got}", file=sys.stderr)
-        return 2
+        return _expect_verdict(findings, expect, args.json)
     return 1 if findings else 0
 
 

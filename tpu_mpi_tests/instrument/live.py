@@ -1,0 +1,497 @@
+"""``tpumt-top``: follow-mode console dashboard over the live JSONL
+trail, plus the incremental tail engine the online doctor shares.
+
+The post-mortem CLIs (``tpumt-report``/``tpumt-trace``/``tpumt-doctor``)
+parse completed files; this module watches files AS THEY ARE WRITTEN:
+
+* :class:`FileTail` — byte-offset incremental JSONL reader: each poll
+  reads only the newly appended bytes, consumes complete lines only (a
+  partially flushed record waits for its newline), and keeps the
+  absolute line numbers ``diagnose`` evidence refs use.
+* :class:`RunTail` — the rank-set tailer: re-expands the ``.p<i>``
+  sibling set every poll (ranks appear as their files are created) and
+  admits only files of the ACTIVE run via the shared ghost-track filter
+  (:func:`~tpu_mpi_tests.instrument.timeline.file_in_run` — the same
+  ``run_sync_us`` stamp logic the ``--trace-out`` merge uses, one copy):
+  a stale ``out.p1.jsonl`` left by an earlier run at the same base path
+  never becomes a ghost rank. ``tpumt-doctor --follow`` drives its
+  :class:`~tpu_mpi_tests.instrument.diagnose._Stream` digests from this
+  same tailer.
+* :class:`Dashboard` + :func:`render` — ``tpumt-top`` itself: records
+  feed a standalone
+  :class:`~tpu_mpi_tests.instrument.metrics.MetricsRegistry` (the same
+  aggregation the in-process exporter serves) plus a handful of
+  last-value slots, rendered as per-class SLO, per-op rolling GB/s,
+  HBM watermarks, overlap fractions, and recent health events.
+
+Without ``--follow`` one frame renders from the files' current contents
+and the process exits — the post-mortem snapshot. With ``--follow`` the
+frame refreshes every ``--interval`` until ``q`` or Ctrl-C (or
+``--frames N`` rendered frames, the scriptable exit).
+
+Pure stdlib, no jax import: a login node can watch files on a shared
+filesystem while the pod writes them — the same contract as the other
+CLIs, applied to a run that has not ended yet.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import deque
+from contextlib import contextmanager
+from pathlib import Path
+
+from tpu_mpi_tests.instrument.aggregate import expand_rank_files
+from tpu_mpi_tests.instrument.metrics import MetricsRegistry
+from tpu_mpi_tests.instrument.timeline import file_in_run
+
+#: stampless files older than this many seconds before the tailer
+#: started are treated as leftovers of an earlier run
+ADMIT_GRACE_S = 60.0
+
+
+def _scan_run_ids(path: str) -> tuple[set, object]:
+    """``(all run_sync_us stamps, the newest one)`` for one JSONL file
+    WITHOUT a full JSON parse: only lines mentioning ``clock_sync`` are
+    decoded, so admitting a multi-GB serving log costs one cheap line
+    scan instead of the 2 extra full parses
+    ``timeline.run_sync_ids``/``newest_run_sync_id`` would spend
+    (semantic equivalence is pinned in tests/test_live.py). Appended
+    runs land in file order, so the last stamp is the newest
+    segment's."""
+    ids: set = set()
+    newest = None
+    try:
+        with open(path, "rb") as f:
+            for raw in f:
+                if b'"clock_sync"' not in raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict) \
+                        or rec.get("kind") != "clock_sync":
+                    continue
+                rid = rec.get("run_sync_us")
+                if rid is not None:
+                    ids.add(rid)
+                    newest = rid
+    except OSError:
+        pass
+    return ids, newest
+
+
+class FileTail:
+    """Incremental JSONL reader for one file: ``poll()`` returns the
+    ``(line_number, record)`` pairs appended since the last poll,
+    consuming complete lines only. A shrunk file (truncate/rotate)
+    restarts from the top."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        self._line_no = 0
+        self._buf = b""
+
+    def poll(self) -> list[tuple[int, dict]]:
+        out: list[tuple[int, dict]] = []
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return out
+        if size < self._offset:
+            self._offset = 0
+            self._line_no = 0
+            self._buf = b""
+        if size == self._offset and not self._buf:
+            return out
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self._offset)
+                data = f.read()
+                self._offset = f.tell()
+        except OSError:
+            return out
+        self._buf += data
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl < 0:
+                break  # a partial line waits for its newline
+            line, self._buf = self._buf[:nl], self._buf[nl + 1:]
+            self._line_no += 1
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append((self._line_no, rec))
+        return out
+
+
+class RunTail:
+    """Tail the live rank set of one run across its ``.p<i>`` files."""
+
+    def __init__(self, paths: list[str], grace_s: float = ADMIT_GRACE_S):
+        self._paths = list(paths)
+        self._grace = grace_s
+        self._started = time.time()
+        self._tails: dict[str, FileTail] = {}
+        self._order: dict[str, int] = {}
+        self._rejected: dict[str, float] = {}  # path -> mtime at verdict
+        self._run_id = None
+
+    def files(self) -> list[str]:
+        return sorted(self._tails)
+
+    def index(self, path: str) -> int:
+        return self._order.get(path, 0)
+
+    def _admit(self) -> None:
+        cands = [f for f in expand_rank_files(self._paths)
+                 if Path(f).exists()]
+        fresh = [f for f in cands
+                 if f not in self._tails]
+        if not fresh:
+            return
+        newest = None
+        scanned: dict[str, set] = {}
+        if self._run_id is None:
+            # active run = the newest segment stamp of the most
+            # recently written candidate (None when none carries one)
+            def mtime(f):
+                try:
+                    return Path(f).stat().st_mtime
+                except OSError:
+                    return 0.0
+
+            newest = max(cands, key=mtime, default=None)
+            if newest is not None:
+                ids, self._run_id = _scan_run_ids(newest)
+                scanned[newest] = ids
+        cutoff = self._started - self._grace
+        for f in fresh:
+            try:
+                mt = Path(f).stat().st_mtime
+            except OSError:
+                continue
+            prev = self._rejected.get(f)
+            if prev is not None and mt <= prev:
+                continue  # still the same stale bytes: stay rejected
+            ids = scanned.get(f)
+            if ids is None:
+                ids, _ = _scan_run_ids(f)
+            if f == newest or file_in_run(f, self._run_id,
+                                          mtime_after=cutoff, ids=ids):
+                self._rejected.pop(f, None)
+                self._tails[f] = FileTail(f)
+                self._order.setdefault(f, len(self._order))
+            else:
+                self._rejected[f] = mt
+
+    def poll(self) -> list[tuple[str, int, dict]]:
+        """All newly appended ``(path, line_number, record)`` across
+        the (re-expanded, run-filtered) rank set."""
+        self._admit()
+        out: list[tuple[str, int, dict]] = []
+        for path in sorted(self._tails):
+            for ln, rec in self._tails[path].poll():
+                if rec.get("kind") == "clock_sync" \
+                        and rec.get("run_sync_us") is not None:
+                    # a rerun appended to a followed file moves the
+                    # active-run identity forward with it
+                    self._run_id = rec["run_sync_us"]
+                out.append((path, ln, rec))
+        return out
+
+
+class Dashboard:
+    """The ``tpumt-top`` model: a standalone metrics registry (same
+    aggregation the in-process exporter serves) plus last-value slots
+    for the sections the registry does not keep whole records for."""
+
+    def __init__(self):
+        self._manifests_seen: set[str] = set()
+        self._reset()
+
+    def _reset(self) -> None:
+        self.registry = MetricsRegistry()
+        self.manifest: dict = {}
+        self.slo: dict[str, dict] = {}
+        self.mem: dict = {}
+        self.overlap: dict[str, dict] = {}
+        self.heartbeat: dict = {}   # rank -> last heartbeat record
+        self.findings: deque = deque(maxlen=4)
+        self.n_records = 0
+        self.last_wall: float | None = None
+
+    def feed(self, rec: dict, path: str = "") -> None:
+        kind = rec.get("kind")
+        if kind == "manifest":
+            # a SECOND manifest on a path this dashboard already
+            # follows = a rerun appended to the same file (the Reporter
+            # opens JSONL in append mode): start the model over, like
+            # every other consumer's newest-segment selection. The
+            # seen-set clears with the reset so the new run's sibling
+            # manifests (one per rank) do not re-reset.
+            if path in self._manifests_seen:
+                self._reset()
+                self._manifests_seen.clear()
+            self._manifests_seen.add(path)
+        self.n_records += 1
+        self.registry.observe(rec)
+        for key in ("t", "t_end"):
+            v = rec.get(key)
+            if isinstance(v, (int, float)):
+                if self.last_wall is None or v > self.last_wall:
+                    self.last_wall = v
+        if kind == "manifest":
+            if not self.manifest or rec.get("process_index") == 0:
+                self.manifest = rec
+        elif kind == "serve" and rec.get("event") == "window":
+            self.slo[rec.get("class", "?")] = rec
+        elif kind == "mem":
+            self.mem[rec.get("rank", 0)] = rec
+        elif kind == "overlap":
+            self.overlap[rec.get("op", "?")] = rec
+        elif kind == "health" and rec.get("event") == "heartbeat":
+            self.heartbeat[rec.get("rank", 0)] = rec
+        elif kind == "finding":
+            self.findings.append(rec)
+
+
+def _fmt(v, width: int = 8, digits: int = 3) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.{digits}f}".rjust(width)
+    return str(v).rjust(width)
+
+
+def _sample_map(snap: dict, name: str, label: str) -> dict:
+    fam = snap.get(name)
+    if not fam:
+        return {}
+    return {dict(labels).get(label, ""): v
+            for labels, v in fam["samples"]}
+
+
+def render(dash: Dashboard, files: list[str]) -> str:
+    """One dashboard frame as text (pure function of the model — the
+    golden-render tests call this directly)."""
+    snap = dash.registry.snapshot()
+    man = dash.manifest
+    head = [f"tpumt-top — {len(files)} rank file(s), "
+            f"{dash.n_records} records"]
+    if man:
+        head.append(f"platform={man.get('platform', '?')} "
+                    f"procs={man.get('process_count', '?')} "
+                    f"devices={man.get('global_device_count', '?')}")
+    lines = ["  ".join(head)]
+
+    if dash.heartbeat:
+        parts = []
+        for rank in sorted(dash.heartbeat):
+            hb = dash.heartbeat[rank]
+            age = (dash.last_wall - hb.get("t", 0)
+                   if dash.last_wall is not None else None)
+            state = "closed" if hb.get("final") else (
+                f"{age:.1f}s ago" if age is not None else "live")
+            parts.append(f"rank {rank}: {state}")
+        lines.append("BEAT  " + " | ".join(parts))
+
+    if dash.slo:
+        lines.append(
+            f"SLO   {'class':28s} {'off/s':>8s} {'ach/s':>8s} "
+            f"{'p50ms':>8s} {'p95ms':>8s} {'p99ms':>8s} "
+            f"{'err':>5s} {'shed':>5s} {'q':>4s}")
+        for cls in sorted(dash.slo):
+            w = dash.slo[cls]
+            lines.append(
+                f"      {cls:28s} {_fmt(w.get('offered_hz'))} "
+                f"{_fmt(w.get('achieved_hz'))} {_fmt(w.get('p50_ms'))} "
+                f"{_fmt(w.get('p95_ms'))} {_fmt(w.get('p99_ms'))} "
+                f"{_fmt(w.get('errors'), 5)} {_fmt(w.get('shed'), 5)} "
+                f"{_fmt(w.get('queue_depth', w.get('queue_max')), 4)}")
+
+    ops = _sample_map(snap, "tpumt_spans", "op")
+    if ops:
+        # GB/s is the ROLLING-window median (the gauge keeps the last
+        # value for the exporter; a dashboard column must not show
+        # whichever outlier span landed last)
+        gbps = _sample_map(snap, "tpumt_span_gbps_window", "op")
+        lat = _sample_map(snap, "tpumt_span_latency_seconds", "op")
+        roof = _sample_map(snap, "tpumt_roofline_frac", "op")
+        lines.append(
+            f"OPS   {'op':28s} {'ops':>8s} {'GB/s':>8s} "
+            f"{'p50ms':>8s} {'p99ms':>8s} {'roof%':>6s}")
+        for op in sorted(ops):
+            q = lat.get(op) or {}
+            p50 = q.get("p50")
+            p99 = q.get("p99")
+            rf = roof.get(op)
+            g = gbps.get(op) or {}
+            lines.append(
+                f"      {op:28s} {_fmt(int(ops[op]))} "
+                f"{_fmt(g.get('p50'))} "
+                f"{_fmt(p50 * 1e3 if p50 is not None else None)} "
+                f"{_fmt(p99 * 1e3 if p99 is not None else None)} "
+                f"{_fmt(rf * 100 if rf is not None else None, 6, 1)}")
+
+    if dash.mem:
+        parts = []
+        for rank in sorted(dash.mem):
+            m = dash.mem[rank]
+            in_use = m.get("bytes_in_use", m.get("live_bytes"))
+            peak = m.get("peak_bytes_in_use")
+            txt = f"rank {rank}: {_human_bytes(in_use)}"
+            if peak is not None:
+                txt += f" (peak {_human_bytes(peak)})"
+            parts.append(txt)
+        lines.append("MEM   " + " | ".join(parts))
+
+    if dash.overlap:
+        parts = [
+            f"{op}: depth={o.get('depth')} "
+            f"frac={o.get('overlap_frac', 0):.3f} "
+            f"drain={o.get('drain_s', 0):.4f}s"
+            for op, o in sorted(dash.overlap.items())
+        ]
+        lines.append("OVLP  " + " | ".join(parts))
+
+    health = list(dash.registry.health_events)
+    for f in dash.findings:
+        health.append(f)
+    if health:
+        lines.append("HEALTH")
+        for h in health[-5:]:
+            if h.get("kind") == "finding":
+                lines.append(f"      FINDING {h.get('class')} rank="
+                             f"{h.get('rank')} conf="
+                             f"{h.get('confidence')}")
+            else:
+                desc = h.get("event", "?")
+                if desc == "tune_stale":
+                    desc += (f" op={h.get('op')} signal="
+                             f"{h.get('signal')} sag="
+                             f"{h.get('sag_pct')}%")
+                lines.append(f"      {desc}")
+    return "\n".join(lines)
+
+
+def _human_bytes(v) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return "-"
+
+
+@contextmanager
+def _keyreader():
+    """cbreak stdin for the ``q`` key in follow mode; inert when stdin
+    is not a tty (piped/CI use)."""
+    if not sys.stdin.isatty():
+        yield None
+        return
+    try:
+        import termios
+        import tty
+    except ImportError:  # non-POSIX: no key handling
+        yield None
+        return
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setcbreak(fd)
+        yield fd
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+def _wait_key(fd, seconds: float) -> bool:
+    """Sleep up to ``seconds``; True when ``q`` was pressed."""
+    if fd is None:
+        time.sleep(seconds)
+        return False
+    import select
+
+    r, _, _ = select.select([sys.stdin], [], [], seconds)
+    if r:
+        return sys.stdin.read(1).lower() == "q"
+    return False
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpumt-top",
+        description="live console dashboard over the telemetry JSONL "
+        "trail: tails the per-rank out.p<i>.jsonl set while a run "
+        "writes it and renders per-class SLO, per-op rolling GB/s, "
+        "HBM watermarks, overlap fractions, heartbeats, and health "
+        "events (README 'Live observability'); without --follow, one "
+        "frame from the files' current contents",
+    )
+    p.add_argument(
+        "files", nargs="+",
+        help="per-rank JSONL files; an un-suffixed --jsonl base path "
+        "expands to its .p<i> rank set (stale siblings of earlier runs "
+        "at the same base path are filtered out by run stamp)",
+    )
+    p.add_argument(
+        "--follow", "-f", action="store_true",
+        help="keep refreshing until q or Ctrl-C (default: render one "
+        "frame and exit)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=1.0, metavar="S",
+        help="refresh period in seconds (default 1.0)",
+    )
+    p.add_argument(
+        "--frames", type=int, default=0, metavar="N",
+        help="stop after N rendered frames (scriptable exit for "
+        "smokes; >1 implies --follow)",
+    )
+    args = p.parse_args(argv)
+
+    dash = Dashboard()
+    tail = RunTail(args.files)
+    follow_mode = args.follow or args.frames > 1
+    frames = 0
+    try:
+        with _keyreader() as fd:
+            while True:
+                for path, _ln, rec in tail.poll():
+                    dash.feed(rec, path)
+                if not follow_mode and not tail.files():
+                    # one-shot mode on a missing path: the sibling
+                    # CLIs' no-input guard, not a clean empty frame
+                    # (follow mode keeps waiting — the files may be
+                    # about to appear)
+                    print("tpumt-top: no input files found",
+                          file=sys.stderr)
+                    return 2
+                if follow_mode and sys.stdout.isatty():
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(render(dash, tail.files()), flush=True)
+                frames += 1
+                if not follow_mode or (args.frames
+                                       and frames >= args.frames):
+                    return 0
+                if _wait_key(fd, args.interval):
+                    return 0
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,8 @@ class Reporter:
         self._jsonl_lock = threading.Lock()
         self._telemetry = False
         self._memwatch = None
+        self._metrics = None
+        self._live: list = []
         self._created_at = time.time()  # trace merge excludes older files
         # this run's clock_sync identity (set by make_reporter): the
         # trace merge uses it to recognize same-run sibling rank files
@@ -207,7 +209,33 @@ class Reporter:
         final census record) before the JSONL file closes."""
         self._memwatch = memwatch
 
+    def attach_metrics(self, registry):
+        """Tee every record this reporter emits into a live
+        :class:`~tpu_mpi_tests.instrument.metrics.MetricsRegistry` —
+        the zero-new-call-sites contract of the live observability
+        plane: whatever already flows to JSONL also updates the named
+        series. A reporter without a registry pays one ``None`` check."""
+        self._metrics = registry
+
+    def attach_live(self, *stoppables):
+        """Own live-plane components (heartbeat thread, metrics
+        exporter, phase-progress hook): closing the reporter calls
+        ``stop()`` on each — in attach order, BEFORE the JSONL file
+        closes, so final heartbeats/snapshots still land in the
+        stream."""
+        self._live.extend(stoppables)
+
     def jsonl(self, record: dict[str, Any]):
+        # the live-metrics tee runs OUTSIDE the lock (observe is
+        # internally locked, and a tune_stale health record emitted from
+        # inside observe re-enters jsonl — holding the lock here would
+        # deadlock that path) and BEFORE the path check, so metrics work
+        # even when no JSONL file was configured
+        if self._metrics is not None:
+            try:
+                self._metrics.observe(record)
+            except Exception:
+                pass
         # serialized under a lock and written as ONE write() call: the
         # watchdog emits its timeline record from a timer thread, and an
         # interleaved json.dump (many small writes) with a main-thread
@@ -222,6 +250,12 @@ class Reporter:
             self._jsonl_file.flush()
 
     def close(self):
+        live, self._live = self._live, []
+        for obj in live:
+            try:
+                obj.stop()  # final heartbeat/snapshot lands before close
+            except Exception:
+                pass
         if self._memwatch is not None:
             memwatch, self._memwatch = self._memwatch, None
             try:
@@ -271,20 +305,18 @@ class Reporter:
             return
         from tpu_mpi_tests.instrument.aggregate import expand_rank_files
         from tpu_mpi_tests.instrument.timeline import (
-            run_sync_ids,
+            file_in_run,
             write_trace,
         )
 
         def current(f: str) -> bool:
             if self.jsonl_path and Path(f) == Path(self.jsonl_path):
                 return True  # this rank's own file
-            sibling_ids = run_sync_ids(f)
-            if self.run_sync_us is not None and sibling_ids:
-                return self.run_sync_us in sibling_ids
-            try:
-                return Path(f).stat().st_mtime >= self._created_at - 5.0
-            except OSError:
-                return False
+            # the shared ghost-track filter (timeline.file_in_run, also
+            # used by tpumt-top / tpumt-doctor --follow): stamp match
+            # first, mtime window only for stampless files
+            return file_in_run(f, self.run_sync_us,
+                               mtime_after=self._created_at - 5.0)
 
         files = [f for f in expand_rank_files([self._jsonl_base])
                  if Path(f).exists() and current(f)]

@@ -86,6 +86,32 @@ def run_sync_ids(path: str) -> set:
     }
 
 
+def file_in_run(path: str, run_sync_us, mtime_after=None,
+                ids: "set | None" = None) -> bool:
+    """Whether ``path`` belongs to the run identified by
+    ``run_sync_us`` — THE shared ghost-track filter (one copy of the
+    logic): the ``--trace-out`` auto-merge, ``tpumt-top``, and
+    ``tpumt-doctor --follow`` all use it to keep stale ``.p<i>``
+    sibling files from an earlier run at the same base path out of the
+    current run's set. Primary identity is the shared ``clock_sync``
+    stamp (a file qualifies when ANY of its appended runs carries it);
+    files with no stamp at all (older format / handshake unavailable)
+    fall back to the ``mtime_after`` window, and pass when no window
+    was given. ``ids`` is the file's precomputed :func:`run_sync_ids`
+    set — the follow-mode tailer passes a cheaply scanned one so
+    admitting a multi-GB file does not cost a full JSON parse."""
+    if ids is None:
+        ids = run_sync_ids(path)
+    if run_sync_us is not None and ids:
+        return run_sync_us in ids
+    if mtime_after is None:
+        return True
+    try:
+        return Path(path).stat().st_mtime >= mtime_after
+    except OSError:
+        return False
+
+
 def rank_streams(
     files: list[str], run_sync_us: int | None = None,
     loaded: dict[str, list[tuple[int, dict]]] | None = None,
@@ -162,6 +188,12 @@ def _collect(streams):
                                     "dispatch_depth")),
                 ))
             elif kind == "time":
+                if rec.get("event") == "progress":
+                    # live cumulative snapshots (metrics plane): their
+                    # t_start..t_end window is the phase's whole
+                    # lifetime so far — rendering each would stack
+                    # ever-longer ghost spans over the real phases
+                    continue
                 if rec.get("t_start") is None:
                     unplaced += 1
                     continue

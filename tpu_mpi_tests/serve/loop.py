@@ -182,7 +182,8 @@ class ServeLoop:
 
     def _emit(self, event: str, cls: WorkloadClass, st: _ClassStats,
               t_start: float, t_end: float, window: bool,
-              offered_dur: float | None = None) -> dict:
+              offered_dur: float | None = None,
+              queue_depth: int | None = None) -> dict:
         """``offered_dur`` divides the offered rate when the record's
         span is longer than the window arrivals were generated in: a
         summary covers traffic + drain, and dividing arrivals by the
@@ -220,6 +221,12 @@ class ServeLoop:
             "queue_max": qmax,
             **hist.percentiles_ms(),
         }
+        if queue_depth is not None:
+            # the STANDING backlog at emission time (queue_max is the
+            # window's high-water mark): the live pressure signal the
+            # metrics tee turns into the serve queue-depth gauge — this
+            # loop knows nothing about metrics, only its own record
+            rec["queue_depth"] = queue_depth
         if not window and st.quarantines:
             rec["quarantines"] = st.quarantines
             rec["quarantine_s"] = st.quarantine_s
@@ -353,7 +360,8 @@ class ServeLoop:
                     st = self.stats[cls.key]
                     if st.window_active():
                         self._emit("window", cls, st, window_wall,
-                                   w_end, window=True)
+                                   w_end, window=True,
+                                   queue_depth=waiting.get(cls.key, 0))
                     st.reset_window()
                     # requests already waiting carry into the new
                     # window's depth — a backlog is not depth zero
@@ -444,7 +452,8 @@ class ServeLoop:
             st = self.stats[cls.key]
             if st.window_active():
                 self._emit("window", cls, st, window_wall, end_wall,
-                           window=True)
+                           window=True,
+                           queue_depth=waiting.get(cls.key, 0))
             st.reset_window()
         return [
             self._emit("summary", self._by_key[key], st, wall0,
