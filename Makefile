@@ -2,7 +2,7 @@
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
 	serve-smoke overlap-smoke moe-smoke chaos-smoke live-smoke lint \
-	lint-smoke ci clean
+	lint-smoke records records-check ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -457,6 +457,18 @@ lint:
 	python -m tpu_mpi_tests.analysis.cli \
 		tpu_mpi_tests tpu tests __graft_entry__.py bench.py
 
+# regenerate RECORDS.md — the JSONL record-kind schema table extracted
+# from the producer/consumer facts (tpu_mpi_tests/analysis/records.py);
+# the TPM14xx lint family enforces the same contract
+records:
+	python -m tpu_mpi_tests.analysis.records
+
+# CI staleness gate: regenerate, then fail if the committed table
+# drifted from the code (the generate → git diff --exit-code pattern)
+records-check:
+	$(MAKE) records
+	git diff --exit-code -- RECORDS.md
+
 # lint-cache smoke (README "Static analysis"): the whole-program
 # analyzer's incrementality contract, asserted via --stats counters on
 # a throwaway cache — a cold run over the repo analyzes every file, a
@@ -498,17 +510,41 @@ lint-smoke:
 			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
 		assert a == 1 and h == f - 1, s; \
 		print('lint-smoke touch OK: exactly 1 file re-analyzed')"
-	@echo "lint-smoke OK: cold populate, warm zero-reparse, touched file re-analyzes"
+	python -c "import json; json.dump({'version': 1, \
+		'salt': 'pre-bump-engine', 'entries': \
+		{'/tmp/_tpumt_lint_smoke/probe.py': {'hash': 'stale'}}}, \
+		open('/tmp/_tpumt_lint_smoke/salted.json', 'w'))"
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests tpu tests __graft_entry__.py bench.py \
+		/tmp/_tpumt_lint_smoke/probe.py \
+		--cache /tmp/_tpumt_lint_smoke/salted.json \
+		--stats 2> /tmp/_tpumt_lint_smoke/salt_cold.stats
+	python -c "import re; s = open('/tmp/_tpumt_lint_smoke/salt_cold.stats').read(); \
+		f, a, h = map(int, re.search( \
+			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
+		assert f == a > 0 and h == 0, s; \
+		print('lint-smoke salt-bump OK: stale-engine cache invalidated once,', a, 'files re-judged')"
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests tpu tests __graft_entry__.py bench.py \
+		/tmp/_tpumt_lint_smoke/probe.py \
+		--cache /tmp/_tpumt_lint_smoke/salted.json \
+		--stats 2> /tmp/_tpumt_lint_smoke/salt_warm.stats
+	python -c "import re; s = open('/tmp/_tpumt_lint_smoke/salt_warm.stats').read(); \
+		f, a, h = map(int, re.search( \
+			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
+		assert a == 0 and h == f > 0, s; \
+		print('lint-smoke salt-warm OK:', h, 'cache hits, 0 files re-parsed')"
+	@echo "lint-smoke OK: cold populate, warm zero-reparse, touched file re-analyzes, salt bump invalidates exactly once"
 
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
 # observability smoke, the serving-pipeline smoke, the overlap-engine
 # smoke, the workload-spec pillar smoke, the chaos-verified diagnosis
 # smoke, the live-observability smoke (OpenMetrics endpoint + online
-# doctor), the lint self-clean gate, and the lint-cache incrementality
-# smoke
+# doctor), the lint self-clean gate, the lint-cache incrementality +
+# engine-salt smoke, and the RECORDS.md staleness gate
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke \
-	moe-smoke chaos-smoke live-smoke lint lint-smoke
+	moe-smoke chaos-smoke live-smoke lint lint-smoke records-check
 
 clean:
 	$(MAKE) -C native clean

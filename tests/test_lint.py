@@ -45,6 +45,11 @@ FILE_FAMILIES = [
     ("TPM7", "tpm7"),
     ("TPM8", "tpm8"),
     ("TPM10", "tpm10"),
+    # ISSUE-12 flow-sensitive families (single-file goldens; the
+    # interprocedural shapes are pinned by the seeded mutants below)
+    ("TPM1102", "tpm1102"),
+    ("TPM1301", "tpm1301"),
+    ("TPM140", "tpm14"),
 ]
 
 #: (family prefix, fixture stem) for the ISSUE-10 whole-program
@@ -155,6 +160,505 @@ def test_collective_divergence_both_branches_equal_is_clean(tmp_path):
         "    return out\n"
     )
     assert "TPM1101" not in codes_of(lint_paths([str(p)]))
+
+
+def test_tpm1101_false_negative_regressions():
+    """The ROADMAP carry-over goldens: under the PR-10 LEXICAL engine
+    both shapes in tpm11_truthy_bad.py linted CLEAN — `_rank_dependent`
+    only matched Compare nodes against rank-NAMED variables, so the
+    truthiness test (`if not rank:`, no Compare at all) and the
+    process_index() local alias (`r = process_index(); if r == 0:`)
+    were invisible, and branch event sequences did not model control
+    flow. The CFG engine must convict both."""
+    findings = lint_paths([str(FIXTURES / "tpm11_truthy_bad.py")])
+    assert codes_of(findings) == ["TPM1101", "TPM1101"], findings
+    lines = sorted(f.line for f in findings)
+    assert lines == [24, 31], findings  # the two `if` guards
+
+
+def test_early_return_guard_convicts_tpm1102():
+    """The second carry-over shape: `if rank != 0: return` BEFORE a
+    collective. The lexical engine compared the two branch bodies —
+    both collective-free — and missed it (documented false negative);
+    the CFG engine models the return as an exit edge and convicts it
+    as TPM1102, the early-exit half of the divergence family. TPM1101
+    must stay silent on the same `if` (exactly one code per divergent
+    branch)."""
+    findings = lint_paths([str(FIXTURES / "tpm1102_bad.py")])
+    assert codes_of(findings) == ["TPM1102"], findings
+    f = findings[0]
+    assert f.line == 13 and "allreduce_sum" in f.message, f
+
+
+def test_early_exit_divergence_seeded_mutant(tmp_path):
+    """Mutation gate (acceptance criterion): an early-return rank guard
+    before an allreduce THROUGH A HELPER IN ANOTHER FILE is the
+    mutant's SOLE finding; hoisting the collective above the guarded
+    exit clears it."""
+    pkg = tmp_path / "spmd"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "comms.py").write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def global_sum(x, mesh):\n"
+        "    return allreduce_sum(x, mesh)\n"
+    )
+    step = pkg / "step.py"
+    step.write_text(
+        "from spmd.comms import global_sum\n"
+        "def run(x, mesh, rank):\n"
+        "    if rank != 0:\n"
+        "        return x\n"
+        "    x = global_sum(x, mesh)\n"
+        "    return x\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert codes_of(findings) == ["TPM1102"], findings
+    f = findings[0]
+    assert f.line == 3 and "allreduce_sum" in f.message, f
+    # the fix: every rank enters the collective before the exit
+    step.write_text(
+        "from spmd.comms import global_sum\n"
+        "def run(x, mesh, rank):\n"
+        "    x = global_sum(x, mesh)\n"
+        "    if rank != 0:\n"
+        "        return x\n"
+        "    return x\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+def test_early_exit_continue_in_loop_diverges(tmp_path):
+    """A rank-guarded `continue` before a per-iteration collective is
+    the same deadlock one loop level down: rank 0 runs N allreduces,
+    everyone else runs zero. The CFG cuts the back edge, so the
+    continue path visibly skips the collective."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def pump(xs, mesh, rank):\n"
+        "    out = []\n"
+        "    for x in xs:\n"
+        "        if rank != 0:\n"
+        "            continue\n"
+        "        out.append(allreduce_sum(x, mesh))\n"
+        "    return out\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM1102" in codes_of(findings), findings
+
+
+def test_early_exit_inside_loop_sees_post_loop_collective(tmp_path):
+    """Loop-exit reachability regression (code-review finding): the
+    loop's fall-through must have a forward path to post-loop code, or
+    (a) a rank-guarded return INSIDE a loop before a post-loop
+    collective — the PR's headline deadlock class one level down — is
+    silently missed, and (b) a rank-guarded `break` before a post-loop
+    collective EVERY rank reaches is falsely convicted."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def ret_in_loop(xs, x, mesh, rank):\n"
+        "    for _ in xs:\n"
+        "        if rank != 0:\n"
+        "            return x\n"
+        "    return allreduce_sum(x, mesh)\n"
+    )
+    assert "TPM1102" in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def break_then_all_reduce(xs, x, mesh, rank):\n"
+        "    for _ in xs:\n"
+        "        if rank != 0:\n"
+        "            break\n"
+        "    return allreduce_sum(x, mesh)\n"  # ALL ranks reach this
+    )
+    findings = lint_paths([str(p)])
+    assert not any(c.startswith("TPM11") for c in codes_of(findings)), \
+        findings
+
+
+def test_ambiguous_proc_truthiness_is_not_a_rank_test(tmp_path):
+    """Code-review regression: `proc` is usually a subprocess handle —
+    `if not self.proc: return` before a collective is a liveness check,
+    not a rank guard, and must not convict; a COMPARISON against proc
+    (`proc == 0`) keeps its lexical-era rank meaning."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def step(self, x, mesh):\n"
+        "    if not self.proc:\n"
+        "        return x\n"
+        "    return allreduce_sum(x, mesh)\n"
+    )
+    assert not any(c.startswith("TPM11")
+                   for c in codes_of(lint_paths([str(p)])))
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def step(x, mesh, proc):\n"
+        "    if proc != 0:\n"
+        "        return x\n"
+        "    return allreduce_sum(x, mesh)\n"
+    )
+    assert "TPM1102" in codes_of(lint_paths([str(p)]))
+
+
+def test_broadcast_consistency_params_and_imports_are_bound(tmp_path):
+    """Code-review regression: kwonly/vararg/kwarg parameters and
+    imported names are bound on EVERY rank — refreshing one under a
+    rank guard is not a one-sided binding and must not convict."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from jax import process_index\n"
+        "import mylib\n"
+        "def f(x, *rest, cfg=None, **kw):\n"
+        "    if process_index() == 0:\n"
+        "        cfg = refine(cfg)\n"
+        "        rest = tuple(kw)\n"
+        "        kw = dict(cfg=cfg)\n"
+        "        mylib = patch()\n"
+        "    return use(x, cfg, rest, kw, mylib)\n"
+    )
+    assert "TPM1301" not in codes_of(lint_paths([str(p)]))
+
+
+def test_broadcast_consistency_none_then_rebind_is_clean(tmp_path):
+    """_real_bound regression (code-review finding): the placeholder
+    filter is per store SITE, not per name — an else arm that
+    None-initializes and then really binds (`winner = None;
+    winner = local_fallback()`) holds a value on every rank and must
+    not convict. The annotated placeholder (`winner: object = None`)
+    is the same absence-of-a-value and must still convict."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from jax import process_index\n"
+        "def pick(sweep, fallback, apply_fn, space, x):\n"
+        "    if process_index() == 0:\n"
+        "        winner = sweep(space)\n"
+        "    else:\n"
+        "        winner = None\n"
+        "        winner = fallback(space)\n"
+        "    return apply_fn(x, winner)\n"
+    )
+    assert "TPM1301" not in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from jax import process_index\n"
+        "def pick(sweep, apply_fn, space, x):\n"
+        "    if process_index() == 0:\n"
+        "        winner = sweep(space)\n"
+        "    else:\n"
+        "        winner: object = None\n"
+        "    return apply_fn(x, winner)\n"
+    )
+    assert "TPM1301" in codes_of(lint_paths([str(p)]))
+
+
+def test_broadcast_consistency_prebranch_none_placeholder(tmp_path):
+    """Code-review regression: the hazard's most common spelling —
+    `winner = None` BEFORE the rank guard — is the same
+    absence-of-a-value as the else-arm placeholder and must convict;
+    an AugAssign on the unguarded path is a READ of the one-sided
+    value (not a kill) and convicts at its own line."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from jax import process_index\n"
+        "def pick(sweep, apply_fn, space, x):\n"
+        "    winner = None\n"
+        "    if process_index() == 0:\n"
+        "        winner = sweep(space)\n"
+        "    return apply_fn(x, winner)\n"
+    )
+    assert "TPM1301" in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from jax import process_index\n"
+        "def pick(sweep, apply_fn, space, x):\n"
+        "    if process_index() == 0:\n"
+        "        w = sweep(space)\n"
+        "    else:\n"
+        "        w = None\n"
+        "    w += 1\n"
+        "    return apply_fn(x, w)\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM1301" in codes_of(findings), findings
+    f = next(x for x in findings if x.code == "TPM1301")
+    assert f.line == 7, f  # the `w += 1` read of the divergent value
+
+
+def test_broadcast_consistency_postjoin_rebind_kills_value(tmp_path):
+    """Code-review regression: an unconditional rebind on the shared
+    path (`plan = load_cached(...)` on every rank) replaces the
+    one-sided value — a read AFTER the rebind is safe and must not
+    convict; a read BEFORE it still does."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from jax import process_index\n"
+        "def pick(sweep, load_cached, apply_fn, space, x):\n"
+        "    if process_index() == 0:\n"
+        "        plan = sweep(space)\n"
+        "    plan = load_cached(space)\n"
+        "    return apply_fn(x, plan)\n"
+    )
+    assert "TPM1301" not in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from jax import process_index\n"
+        "def pick(sweep, load_cached, apply_fn, persist, space, x):\n"
+        "    if process_index() == 0:\n"
+        "        plan = sweep(space)\n"
+        "    persist(plan)\n"
+        "    plan = load_cached(space)\n"
+        "    return apply_fn(x, plan)\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM1301" in codes_of(findings), findings
+    f = next(x for x in findings if x.code == "TPM1301")
+    assert f.line == 5, f  # the pre-rebind read, not the safe one
+
+
+def test_broadcast_consistency_seeded_mutant(tmp_path):
+    """Mutation gate (acceptance criterion): an unbroadcast rank-0
+    tune-winner — bound under the rank guard, None on the other arm,
+    then dispatched into per-rank work — is the mutant's SOLE finding;
+    routing it through broadcast_one_to_all clears it."""
+    pkg = tmp_path / "fleet"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "sweep.py").write_text(
+        "def sweep_halo(space):\n"
+        "    return min(space)\n"
+    )
+    main = pkg / "main.py"
+    main.write_text(
+        "from jax import process_index\n"
+        "from fleet.sweep import sweep_halo\n"
+        "def tune_and_apply(space, apply_fn, x):\n"
+        "    if process_index() == 0:\n"
+        "        winner = sweep_halo(space)\n"
+        "    else:\n"
+        "        winner = None\n"
+        "    return apply_fn(x, winner)\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert codes_of(findings) == ["TPM1301"], findings
+    f = findings[0]
+    assert f.line == 8 and "'winner'" in f.message, f
+    # the fix: replicate before any rank acts on the value
+    main.write_text(
+        "from jax import process_index\n"
+        "from jax.experimental.multihost_utils import "
+        "broadcast_one_to_all\n"
+        "from fleet.sweep import sweep_halo\n"
+        "def tune_and_apply(space, apply_fn, x):\n"
+        "    if process_index() == 0:\n"
+        "        winner = sweep_halo(space)\n"
+        "    else:\n"
+        "        winner = None\n"
+        "    winner = broadcast_one_to_all(winner)\n"
+        "    return apply_fn(x, winner)\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+def test_symmetric_loop_collective_in_rank_branch_is_clean(tmp_path):
+    """Block-ordering regression (code-review finding): a rank branch
+    whose guarded arm runs the collective IN A LOOP and whose other arm
+    runs the same collective straight-line must compare equal — the
+    loop's after-block must number after its body, or the post-loop
+    barrier would sort before the in-loop allreduce and fabricate a
+    divergence."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum, "
+        "barrier\n"
+        "def step(x, mesh, rank, k):\n"
+        "    if rank == 0:\n"
+        "        for _ in range(k):\n"
+        "            x = allreduce_sum(x, mesh)\n"
+        "        x = barrier(x, mesh)\n"
+        "    else:\n"
+        "        x = allreduce_sum(x, mesh)\n"
+        "        x = barrier(x, mesh)\n"
+        "    return x\n"
+    )
+    findings = lint_paths([str(p)])
+    assert not any(c.startswith("TPM11") for c in codes_of(findings)), \
+        findings
+
+
+def test_broadcast_consistency_rank_gated_read_is_clean(tmp_path):
+    """Code-review regression: a value bound under a rank guard and
+    read ONLY under another rank guard (the rank-0-only logger shape)
+    never crosses to the unguarded ranks — TPM1301 must not convict
+    it. An unguarded read of the same name elsewhere still does."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from jax import process_index\n"
+        "def report_loop(make_log, recs):\n"
+        "    if process_index() == 0:\n"
+        "        log = make_log()\n"
+        "    for rec in recs:\n"
+        "        if process_index() == 0:\n"
+        "            log.write(rec)\n"
+        "    return len(recs)\n"
+    )
+    assert "TPM1301" not in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "from jax import process_index\n"
+        "def report_loop(make_log, recs, flush):\n"
+        "    if process_index() == 0:\n"
+        "        log = make_log()\n"
+        "    flush(log)\n"
+        "    return len(recs)\n"
+    )
+    assert "TPM1301" in codes_of(lint_paths([str(p)]))
+
+
+def test_record_producer_scopes_do_not_bleed(tmp_path):
+    """Code-review regression: two functions both naming their local
+    record dict `rec` must keep separate schemas — a build-up store in
+    one function must not credit the OTHER function's kind with the
+    field (which would mask a real TPM1401)."""
+    (tmp_path / "w.py").write_text(
+        "def a(sink):\n"
+        '    rec = {"kind": "alpha", "x": 1}\n'
+        "    sink(rec)\n"
+        "def b(sink):\n"
+        '    rec = {"kind": "beta", "y": 2}\n'
+        '    rec["z"] = 3\n'
+        "    sink(rec)\n"
+    )
+    (tmp_path / "r.py").write_text(
+        "def read(records):\n"
+        "    out = []\n"
+        "    for rec in records:\n"
+        '        if rec.get("kind") == "alpha":\n'
+        '            out.append(rec.get("z"))\n'
+        "    return out\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert codes_of(findings) == ["TPM1401"], findings
+    assert "'z'" in findings[0].message
+
+
+def test_broadcast_consistency_prebound_name_is_clean(tmp_path):
+    """A name bound BEFORE the rank branch and merely refreshed under
+    the guard is out of TPM1301's scope (every rank holds a value), and
+    a value consumed only inside its own guarded branch never crosses
+    paths — both stay clean."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from jax import process_index\n"
+        "def report(stats, render):\n"
+        "    lines = []\n"
+        "    if process_index() == 0:\n"
+        "        lines = render(stats)\n"
+        "    return lines\n"
+        "def local_only(stats, render, emit):\n"
+        "    if process_index() == 0:\n"
+        "        text = render(stats)\n"
+        "        emit(text)\n"
+        "    return stats\n"
+    )
+    assert "TPM1301" not in codes_of(lint_paths([str(p)]))
+
+
+def test_record_contract_seeded_mutant(tmp_path):
+    """Mutation gate (acceptance criterion): a consumer reading a field
+    no producer emits — the producer lives in ANOTHER file — is the
+    mutant's SOLE finding; reading the produced field clears it."""
+    (tmp_path / "writer.py").write_text(
+        "def write(sink, us):\n"
+        '    sink({"kind": "lat", "event": "window",\n'
+        '          "p50_us": us, "n": 1})\n'
+    )
+    reader = tmp_path / "reader.py"
+    reader.write_text(
+        "def latencies(records):\n"
+        "    vals = []\n"
+        "    for rec in records:\n"
+        '        if rec.get("kind") == "lat":\n'
+        '            vals.append(rec.get("p99_us"))\n'
+        "    return vals\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert codes_of(findings) == ["TPM1401"], findings
+    f = findings[0]
+    assert f.line == 5 and "'p99_us'" in f.message, f
+    reader.write_text(
+        "def latencies(records):\n"
+        "    vals = []\n"
+        "    for rec in records:\n"
+        '        if rec.get("kind") == "lat":\n'
+        '            vals.append(rec.get("p50_us"))\n'
+        "    return vals\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
+
+
+def test_record_contract_unknown_kind_tpm1402(tmp_path):
+    """A consumer filtering on a kind nothing produces is TPM1402,
+    anchored at the kind test — and the field check stands down for
+    that variable (the unknown schema would make every read a false
+    TPM1401)."""
+    (tmp_path / "writer.py").write_text(
+        "def write(sink):\n"
+        '    sink({"kind": "lat", "p50_us": 1})\n'
+    )
+    (tmp_path / "reader.py").write_text(
+        "def count(records):\n"
+        "    n = 0\n"
+        "    for rec in records:\n"
+        '        if rec.get("kind") == "latency":\n'
+        '            n += rec.get("whatever", 0)\n'
+        "    return n\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert codes_of(findings) == ["TPM1402"], findings
+    assert "'latency'" in findings[0].message
+
+
+def test_record_contract_flow_sensitive_attribution(tmp_path):
+    """The flow-sensitivity contract: (a) each arm of a kind-dispatch
+    chain is judged against ITS kind's schema only — a field valid for
+    'a' read under the 'b' arm convicts; (b) reads exclusively on the
+    complement side of a positive kind test (`else:` of == 'a') are
+    unjudgeable and never flagged; (c) an open producer (**spread)
+    silences the field check for its kind."""
+    (tmp_path / "writer.py").write_text(
+        "def write(sink, extra):\n"
+        '    sink({"kind": "a", "x": 1})\n'
+        '    sink({"kind": "b", "y": 2})\n'
+        '    sink({"kind": "c", **extra})\n'
+    )
+    reader = tmp_path / "reader.py"
+    reader.write_text(
+        "def split(records):\n"
+        "    xs, ys = [], []\n"
+        "    for rec in records:\n"
+        '        kind = rec.get("kind")\n'
+        '        if kind == "a":\n'
+        '            xs.append(rec.get("x"))\n'
+        '        elif kind == "b":\n'
+        '            ys.append(rec.get("x"))\n'
+        "    return xs, ys\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert codes_of(findings) == ["TPM1401"], findings
+    f = findings[0]
+    assert f.line == 8 and "kind b" in f.message, f
+    reader.write_text(
+        "def split(records):\n"
+        "    out = []\n"
+        "    for rec in records:\n"
+        '        if rec.get("kind") == "a":\n'
+        '            out.append(rec.get("x"))\n'
+        "        else:\n"
+        '            out.append(rec.get("anything"))\n'
+        '            if rec.get("kind") == "c":\n'
+        '                out.append(rec.get("dynamic_field"))\n'
+        "    return out\n"
+    )
+    assert lint_paths([str(tmp_path)]) == []
 
 
 def test_donation_safety_seeded_mutant_through_helper(tmp_path):
@@ -632,10 +1136,11 @@ def test_cli_list_rules_covers_every_family(capsys):
     for code in ("TPM101", "TPM102", "TPM201", "TPM301", "TPM302",
                  "TPM401", "TPM501", "TPM502", "TPM601", "TPM701",
                  "TPM801", "TPM802", "TPM900", "TPM1001", "TPM1101",
-                 "TPM1201"):
+                 "TPM1102", "TPM1201", "TPM1301", "TPM1401",
+                 "TPM1402"):
         assert code in out
     # table rows match the registry (README is hand-synced to this)
-    assert len(rule_table()) >= 16
+    assert len(rule_table()) >= 20
 
 
 def test_cli_sarif_golden(capsys):
@@ -786,6 +1291,101 @@ def test_cache_type_corrupted_entry_degrades_to_miss(tmp_path):
     f2 = lint_paths([str(proj)], cache_path=str(cache), stats=s)
     assert f2 == f1
     assert s["analyzed"] == 1 and s["cache_hits"] == 0, s
+
+
+def test_cache_evicts_deleted_paths_on_save(tmp_path):
+    """The ISSUE-12 carry-over nit: entries for deleted/renamed files
+    must leave the cache at save() instead of accumulating until an
+    engine-salt reset — lint two files, delete one, lint again, and the
+    stale entry is gone (even though the second run had nothing new to
+    write)."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    keep = proj / "keep.py"
+    keep.write_text("KEEP = 1\n")
+    gone = proj / "gone.py"
+    gone.write_text("GONE = 1\n")
+    cache = tmp_path / "cache.json"
+    lint_paths([str(proj)], cache_path=str(cache))
+    entries = json.loads(cache.read_text())["entries"]
+    assert set(entries) == {str(keep), str(gone)}
+
+    gone.unlink()
+    s: dict = {}
+    lint_paths([str(proj)], cache_path=str(cache), stats=s)
+    assert s == {"files": 1, "analyzed": 0, "cache_hits": 1}
+    entries = json.loads(cache.read_text())["entries"]
+    assert set(entries) == {str(keep)}, entries
+
+
+def test_cache_engine_salt_mismatch_invalidates_once(tmp_path):
+    """The engine-salt contract this PR's `lint-smoke` pins in CI: a
+    cache written by a DIFFERENT engine (stale salt — e.g. the one-time
+    bump this PR's rule changes cause) reads as empty, the next run
+    re-analyzes everything exactly once, and the run after that is all
+    cache hits again."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mod.py").write_text("X = 1\n")
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps({
+        "version": 1, "salt": "pre-bump-engine",
+        "entries": {str(proj / "mod.py"): {"hash": "stale"}},
+    }))
+    s1: dict = {}
+    lint_paths([str(proj)], cache_path=str(cache), stats=s1)
+    assert s1 == {"files": 1, "analyzed": 1, "cache_hits": 0}
+    s2: dict = {}
+    lint_paths([str(proj)], cache_path=str(cache), stats=s2)
+    assert s2 == {"files": 1, "analyzed": 0, "cache_hits": 1}
+
+
+def test_records_generator_and_check_mode(tmp_path, capsys):
+    """RECORDS.md generation (acceptance criterion): the table is
+    non-empty for every record kind the four stdlib consumers parse,
+    --check passes on a fresh file and fails (exit 1) once the file
+    drifts — the `make records` / CI staleness gate."""
+    from tpu_mpi_tests.analysis import records as records_mod
+
+    out = tmp_path / "RECORDS.md"
+    rc = records_mod.main(["-o", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    text = out.read_text()
+    # every kind the shipped consumers filter on has a non-empty row
+    kinds, _stamps = records_mod.collect(
+        [str(REPO / "tpu_mpi_tests"), str(REPO / "tpu")], REPO
+    )
+    consumed = {k for k, e in kinds.items() if e["consumers"]}
+    assert consumed >= {"span", "time", "serve", "mem", "manifest",
+                        "health", "overlap", "chaos", "vmem"}
+    for kind in consumed:
+        assert f"| `{kind}` |" in text, kind
+        row = next(ln for ln in text.splitlines()
+                   if ln.startswith(f"| `{kind}` |"))
+        cells = [c.strip() for c in row.split("|")]
+        assert cells[3] and cells[3] != "—", (kind, row)  # fields
+        assert cells[5] and cells[5] != "—", (kind, row)  # consumers
+    # the envelope stamp (rank via {**rec, ...} sink wrappers) is doc'd
+    assert "Envelope fields" in text and "`rank`" in text
+
+    rc = records_mod.main(["-o", str(out), "--check"])
+    capsys.readouterr()
+    assert rc == 0
+    out.write_text(text + "drift\n")
+    rc = records_mod.main(["-o", str(out), "--check"])
+    err = capsys.readouterr().err
+    assert rc == 1 and "stale" in err
+
+
+def test_records_in_repo_is_fresh(capsys):
+    """The committed RECORDS.md matches the code — the same gate
+    `make ci` runs (generate → diff)."""
+    from tpu_mpi_tests.analysis import records as records_mod
+
+    rc = records_mod.main(["--check"])
+    capsys.readouterr()
+    assert rc == 0
 
 
 def test_cache_corruption_degrades_to_cold_run(tmp_path):

@@ -31,8 +31,18 @@ rule families (stable codes; see README "Static analysis" for the table):
   TPM9xx engine           unused/malformed suppressions, parse errors
   TPM11xx collective-divergence  collective reachable from a
                           rank-dependent branch: the SPMD deadlock shape
+                          (TPM1101 diverging paths; TPM1102 rank-guarded
+                          early exit before a collective — both
+                          flow-sensitive over the per-function CFG)
   TPM12xx donation-safety a name read after being passed in a donated
                           position and not rebound (use-after-donate)
+  TPM13xx broadcast-consistency  a value bound only on a rank-guarded
+                          path consumed without broadcast/
+                          process_allgather — ranks silently diverge
+  TPM14xx record-contract JSONL fields consumed but never produced
+                          (TPM1401) / kinds consumed but never emitted
+                          (TPM1402); RECORDS.md is the generated
+                          schema table (`make records`)
 
 suppress one finding on its line (unused suppressions are themselves
 findings):   x = jnp.asarray(2.0)  # tpumt: ignore[TPM301]

@@ -40,6 +40,7 @@ DEFAULT_ENTRY_MODULES = {
     "tpu_mpi_tests.instrument.diagnose": "tpumt-doctor",
     "tpu_mpi_tests.instrument.live": "tpumt-top",
     "tpu_mpi_tests.analysis.cli": "tpumt-lint",
+    "tpu_mpi_tests.analysis.records": "tpumt-records",
     # the rule modules load lazily at lint time (all_rules()), which the
     # static reachability walk cannot see — root them explicitly so an
     # eager jax import in a rule module is still caught
@@ -51,6 +52,16 @@ DEFAULT_ENTRY_MODULES = {
 #: ``analysis/fixtures/``) out of the self-clean gate; explicit file
 #: arguments are always linted, which is how the golden tests reach them.
 SKIP_DIRS = {"__pycache__", "fixtures", "node_modules"}
+
+def is_test_file(path) -> bool:
+    """Test modules are exempt from the contract-style rules (record
+    contract, chaos containment): tests assert on the artifacts, they
+    are not contract parties. Accepts a path OR a bare module
+    component (``test_foo.py`` and ``test_foo`` both match)."""
+    name = Path(str(path)).name
+    stem = name[:-3] if name.endswith(".py") else name
+    return stem.startswith("test_") or stem == "conftest"
+
 
 _ENGINE_CODES = {
     "TPM900": "unused suppression: the silenced finding is gone",

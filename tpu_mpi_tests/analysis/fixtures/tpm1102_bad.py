@@ -1,0 +1,16 @@
+"""TPM1102 bad: every non-zero rank leaves the function before the
+collective — only rank 0 arrives at the allreduce and the mesh
+deadlocks. The ISSUE-10 lexical engine compared the two BRANCH BODIES
+(both collective-free here) and shipped this exact shape as a
+documented false negative; the CFG engine sees the ``return`` as an
+exit edge, so the continuing path's allreduce is missing from the
+guarded path's sequence."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def global_mean(x, mesh, rank, world):
+    if rank != 0:
+        return x
+    total = allreduce_sum(x, mesh)
+    return total / world

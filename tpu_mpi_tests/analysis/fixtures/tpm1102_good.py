@@ -1,0 +1,13 @@
+"""TPM1102 good: the collective runs on every rank BEFORE the
+rank-guarded exit — both paths dispatch the same collective sequence,
+so the early return only shapes what each rank does with the already-
+reduced value."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def global_mean(x, mesh, rank, world):
+    total = allreduce_sum(x, mesh)
+    if rank != 0:
+        return total
+    return total / world

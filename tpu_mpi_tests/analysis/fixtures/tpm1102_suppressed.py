@@ -1,0 +1,14 @@
+"""TPM1102 suppressed: the sanctioned single-process shape — this
+helper only ever runs under the one-process tune sweep, where no
+sibling rank exists to deadlock against, and the suppression's
+why-comment says so."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def global_mean(x, mesh, rank, world):
+    # single-process sweep entry: rank 0 IS the whole mesh here
+    if rank != 0:  # tpumt: ignore[TPM1102]
+        return x
+    total = allreduce_sum(x, mesh)
+    return total / world

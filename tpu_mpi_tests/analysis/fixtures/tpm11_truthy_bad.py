@@ -1,0 +1,33 @@
+"""TPM1101 regression goldens for the lexical engine's documented
+false negatives (the ROADMAP carry-over nits, closed by the ISSUE-12
+CFG engine).
+
+Two shapes the PR-10 ``_rank_dependent`` could not see — it only
+matched Compare nodes whose side was a rank-NAMED variable:
+
+* a truthiness rank test (``if not rank:`` — no Compare node at all);
+* the rank stored in an arbitrarily-named local (``r = process_index()``)
+  and compared later (``r == 0`` — a Compare, but against a name the
+  lexical vocabulary did not know).
+
+Both deadlock identically to the canonical ``rank == 0`` guard: only
+rank 0 enters the allreduce.
+"""
+
+from jax import process_index
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+
+
+def truthy_guard(x, mesh):
+    rank = process_index()
+    if not rank:
+        x = allreduce_sum(x, mesh)
+    return x
+
+
+def alias_guard(x, mesh):
+    r = process_index()
+    if r == 0:
+        x = allreduce_sum(x, mesh)
+    return x

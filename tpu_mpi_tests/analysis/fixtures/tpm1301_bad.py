@@ -1,0 +1,16 @@
+"""TPM1301 bad: the rank-0-only sweep's winner is applied by EVERY
+rank without a broadcast — rank 0 applies the measured schedule while
+the other ranks apply the ``None`` placeholder, and the fleet silently
+diverges (the exact hazard ROADMAP item 1(a)'s fleet tuning must not
+write). The ``winner = None`` arm is not a binding: it is the absence
+of the value."""
+
+from jax import process_index
+
+
+def tune_and_apply(sweep, apply_schedule, space, x):
+    if process_index() == 0:
+        winner = sweep(space)
+    else:
+        winner = None
+    return apply_schedule(x, winner)

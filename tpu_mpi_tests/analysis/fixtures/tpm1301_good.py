@@ -1,0 +1,15 @@
+"""TPM1301 good: the rank-0 winner passes through a broadcast-class
+collective before any rank acts on it — every rank applies the same
+replicated value, which is the SPMD-honest fleet-tuning shape."""
+
+from jax import process_index
+from jax.experimental.multihost_utils import broadcast_one_to_all
+
+
+def tune_and_apply(sweep, apply_schedule, space, x):
+    if process_index() == 0:
+        winner = sweep(space)
+    else:
+        winner = None
+    winner = broadcast_one_to_all(winner)
+    return apply_schedule(x, winner)

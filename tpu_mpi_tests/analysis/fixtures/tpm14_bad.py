@@ -1,0 +1,25 @@
+"""TPM14xx bad: the record contract drifted in both directions — a
+consumer reads a field its kind's producer never emits (TPM1401: the
+``.get`` default is served forever and the table silently zeroes), and
+another consumer filters on a kind nothing produces (TPM1402: its rows
+can never exist)."""
+
+
+def emit_probe(sink, t, v):
+    sink({"kind": "probe", "event": "sample", "t": t, "value": v})
+
+
+def probe_values(records):
+    out = []
+    for rec in records:
+        if rec.get("kind") == "probe":
+            out.append(rec.get("val"))
+    return out
+
+
+def count_v2(records):
+    n = 0
+    for rec in records:
+        if rec.get("kind") == "probe_v2":
+            n += 1
+    return n

@@ -1,0 +1,15 @@
+"""TPM14xx good: the consumer reads exactly what the producer emits
+and filters only on kinds that exist — the contract the generated
+RECORDS.md table documents."""
+
+
+def emit_probe(sink, t, v):
+    sink({"kind": "probe", "event": "sample", "t": t, "value": v})
+
+
+def probe_values(records):
+    out = []
+    for rec in records:
+        if rec.get("kind") == "probe":
+            out.append(rec.get("value"))
+    return out

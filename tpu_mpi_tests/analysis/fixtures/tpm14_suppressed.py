@@ -1,0 +1,26 @@
+"""TPM14xx suppressed: a consumer deliberately reading ahead of its
+producer — the field and kind land with the NEXT producer release, and
+the why-comments say so (forward-compat reads are the one sanctioned
+drift direction: the .get default is the documented fallback)."""
+
+
+def emit_probe(sink, t, v):
+    sink({"kind": "probe", "event": "sample", "t": t, "value": v})
+
+
+def probe_values(records):
+    out = []
+    for rec in records:
+        if rec.get("kind") == "probe":
+            # v2 producers add calibrated values; default until then
+            out.append(rec.get("val", 0.0))  # tpumt: ignore[TPM1401]
+    return out
+
+
+def count_v2(records):
+    n = 0
+    for rec in records:
+        # the v2 stream lands with the next producer release
+        if rec.get("kind") == "probe_v2":  # tpumt: ignore[TPM1402]
+            n += 1
+    return n

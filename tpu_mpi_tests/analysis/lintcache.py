@@ -59,7 +59,17 @@ def engine_salt() -> str:
 
 class LintCache:
     """path → {hash, findings, facts, supps, malformed} with atomic
-    merge-on-write saves."""
+    merge-on-write saves. Keys are RESOLVED ABSOLUTE paths: relative
+    keys would alias across working directories in a shared cache
+    (the default lives under ``~/.cache``), and the stale-path eviction
+    below could not tell "deleted" from "relative to somewhere else"."""
+
+    @staticmethod
+    def _key(path: str) -> str:
+        try:
+            return str(Path(path).resolve())
+        except OSError:
+            return str(path)
 
     def __init__(self, path: str):
         self.path = Path(path)
@@ -83,7 +93,7 @@ class LintCache:
         return entries if isinstance(entries, dict) else {}
 
     def get(self, path: str, digest: str) -> dict | None:
-        entry = self._entries.get(path)
+        entry = self._entries.get(self._key(path))
         if not isinstance(entry, dict) or entry.get("hash") != digest:
             return None
         # shape/type validation happens at replay
@@ -92,17 +102,28 @@ class LintCache:
         return entry
 
     def put(self, path: str, digest: str, entry: dict) -> None:
-        self._entries[path] = {"hash": digest, **entry}
+        self._entries[self._key(path)] = {"hash": digest, **entry}
         self._dirty = True
 
     def save(self) -> None:
+        # evict entries for deleted/renamed files (ISSUE 12 carry-over
+        # nit): without this, stale paths accumulate until the next
+        # engine-salt reset — a long-lived dev cache only ever grew
+        stale = [p for p in self._entries if not Path(p).exists()]
+        for p in stale:
+            del self._entries[p]
+            self._dirty = True
         if not self._dirty:
             return
         tmp = None
         try:
             # merge-on-write: concurrent linters over disjoint path sets
-            # keep each other's entries (last writer wins per path)
-            merged = self._read(self.path)
+            # keep each other's entries (last writer wins per path);
+            # the eviction filter applies to the on-disk side too
+            merged = {
+                p: e for p, e in self._read(self.path).items()
+                if Path(p).exists()
+            }
             merged.update(self._entries)
             self.path.parent.mkdir(parents=True, exist_ok=True)
             fd, tmp = tempfile.mkstemp(

@@ -19,8 +19,22 @@ data flow (TPM12xx), and one bottom-up summary per function:
 * **returns_handle** — it returns an ``async_span`` dispatch-window
   handle (directly or through another returning helper);
 * **rank_ifs** — branches guarded by rank-dependent control flow
-  (``process_index()`` / ``rank == 0``-shaped tests) with each branch's
-  event sequence.
+  (``process_index()`` / ``rank == 0`` comparisons, truthiness tests
+  like ``if not rank:``, and locals aliasing a ``process_index()``
+  call) with each *path's* event sequence computed over the function's
+  control-flow graph (:mod:`tpu_mpi_tests.analysis.cfg`): a ``return``
+  or ``raise`` inside a branch truncates that path, so the events after
+  the join belong only to the paths that actually reach them — the
+  TPM1101/TPM1102 split. Each branch also carries the names bound on
+  exactly one side and their first read on the other path (the TPM1301
+  broadcast-consistency input);
+
+* **record contract** — per file, the JSONL record schemas its dict
+  literals *produce* (keyed by their constant ``kind``, ``**``-spreads
+  and ``.update()`` marking the schema open) and the record fields its
+  functions *consume* (``rec.get("...")``/subscripts on a variable
+  whose ``kind`` the function tested) — the TPM14xx input and the
+  ``RECORDS.md`` source of truth.
 
 :class:`ProjectIndex` is the project-scope view: a module symbol table
 over every linted file's facts plus memoized transitive resolution
@@ -43,6 +57,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
+from tpu_mpi_tests.analysis import cfg as cfg_mod
 from tpu_mpi_tests.analysis.core import (
     FileContext,
     attr_parts,
@@ -128,11 +143,24 @@ FORWARDER_CALLS = {"span_call", "call"}
 #: calls that mint an async dispatch-window handle (TPM8xx)
 HANDLE_SOURCES = {"async_span"}
 
-#: names whose comparison in an `if` test makes the branch rank-dependent
+#: names whose mention in an `if` test makes the branch rank-dependent
 RANK_NAMES = {"rank", "proc", "proc_index", "process_index", "pidx",
               "rank_id"}
+#: rank names too ambiguous for the TRUTHINESS widening: `proc` is
+#: commonly a subprocess handle, and `if not self.proc:` is a liveness
+#: check, not a rank test — these still match in comparisons
+#: (`proc == 0`), never as bare mentions
+AMBIGUOUS_RANK_NAMES = {"proc"}
 #: call targets (final component) in an `if` test that read the rank
 RANK_CALLS = {"process_index"}
+
+#: call targets (final component) that replicate a rank-local value to
+#: every rank — the sanctioned exits from a rank-guarded binding before
+#: per-rank work may consume it (TPM1301's allowlist)
+BROADCAST_CALLS = {
+    "broadcast", "broadcast_one_to_all", "pbroadcast",
+    "process_allgather", "bcast",
+}
 
 # summary-expansion recursion bound, not a device schedule knob — there
 # is nothing to tune and no topology it varies with
@@ -332,11 +360,21 @@ def iter_timed_regions(ctx: FileContext) -> Iterator[list[ast.stmt]]:
 # facts extraction
 
 
-def _rank_dependent(test: ast.AST) -> bool:
+def _rank_dependent(test: ast.AST,
+                    extra_names: frozenset | set = frozenset()) -> bool:
     """Is this `if` test a function of the process rank? Conservative:
-    a ``process_index()`` call anywhere in it, or a comparison whose
-    side is a rank-named variable/attribute (``rank == 0``,
-    ``topo.process_index != 0``)."""
+    a ``process_index()`` call anywhere in it, a comparison whose side
+    is a rank-named variable/attribute (``rank == 0``,
+    ``topo.process_index != 0``), or a bare truthiness mention
+    (``if not rank:``, ``if rank:``) of an UNAMBIGUOUS rank name. The
+    lexical engine only matched Compare sides, which is how
+    ``if not rank:`` shipped as a documented TPM1101 false negative;
+    the ambiguous names (``proc`` — usually a subprocess handle) keep
+    the comparison-only behavior so liveness checks don't convict.
+    ``extra_names`` carries the function's local ``process_index()``
+    aliases."""
+    cmp_names = RANK_NAMES | set(extra_names)
+    truthy_names = cmp_names - AMBIGUOUS_RANK_NAMES
     for n in ast.walk(test):
         if isinstance(n, ast.Call):
             if (last_attr(n.func) or "") in RANK_CALLS:
@@ -348,26 +386,263 @@ def _rank_dependent(test: ast.AST) -> bool:
                     name = side.id
                 elif isinstance(side, ast.Attribute):
                     name = side.attr
-                if name in RANK_NAMES:
+                if name in cmp_names:
                     return True
+        elif isinstance(n, ast.Name):
+            if n.id in truthy_names and isinstance(n.ctx, ast.Load):
+                return True
+        elif isinstance(n, ast.Attribute):
+            if n.attr in truthy_names:
+                return True
     return False
 
 
-def _branch_events(ctx: FileContext, stmts: list[ast.stmt]) -> list:
-    """Ordered ``["coll", op]`` / ``["call", target]`` events in a
-    statement list's own scope (nested defs excluded)."""
+def _rank_aliases(node: ast.AST) -> set[str]:
+    """Local names that hold the process rank: assigned from a
+    ``process_index()``-class call (``r = jax.process_index()``, the
+    walrus form included) or pure aliases of a rank name (``r = rank``).
+    Document-order scan, so alias chains resolve."""
+    out: set[str] = set()
+
+    def value_is_rank(v: ast.AST) -> bool:
+        # DIRECT forms only: `r = rank`, `r = topo.process_index`,
+        # `r = jax.process_index()`. A rank call merely nested in the
+        # value (`rep = Reporter(proc_index=process_index())`) must NOT
+        # taint the whole assigned object as a rank.
+        if isinstance(v, ast.Name):
+            return v.id in RANK_NAMES or v.id in out
+        if isinstance(v, ast.Attribute):
+            return v.attr in RANK_NAMES
+        if isinstance(v, ast.Call):
+            return (last_attr(v.func) or "") in RANK_CALLS
+        return False
+
+    for n in _own_nodes(node):
+        if isinstance(n, ast.Assign) and value_is_rank(n.value):
+            out.update(t.id for t in n.targets
+                       if isinstance(t, ast.Name))
+        elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                and isinstance(n.target, ast.Name) \
+                and value_is_rank(n.value):
+            out.add(n.target.id)
+        elif isinstance(n, ast.NamedExpr) and value_is_rank(n.value):
+            if isinstance(n.target, ast.Name):
+                out.add(n.target.id)
+    return out
+
+
+def _unit_nodes(unit: ast.AST) -> Iterator[ast.AST]:
+    """The unit (a simple statement or a test/iter expression) plus its
+    own-scope subtree."""
+    yield unit
+    yield from _own_nodes(unit)
+
+
+def _path_events(ctx: FileContext, graph: cfg_mod.CFG,
+                 entry: cfg_mod.Block) -> list:
+    """Ordered ``["coll", op]`` / ``["call", target]`` events along the
+    forward paths from ``entry`` to the function exit (loops unrolled
+    once). Unlike the old lexical branch events, a path that ``return``s
+    early simply does not contain the events after the join."""
     ev: list = []
-    for s in stmts:
-        for n in [s] + list(_own_nodes(s)):
-            if not isinstance(n, ast.Call):
-                continue
-            canon = canon_target(ctx, n.func)
-            last = last_attr(n.func)
-            if _is_collective(canon, last):
-                ev.append(["coll", last])
-            elif canon:
-                ev.append(["call", canon])
+    for block in graph.reachable(entry):
+        for unit in block.units:
+            for n in _unit_nodes(unit):
+                if not isinstance(n, ast.Call):
+                    continue
+                canon = canon_target(ctx, n.func)
+                last = last_attr(n.func)
+                if _is_collective(canon, last):
+                    ev.append(["coll", last])
+                elif canon:
+                    ev.append(["call", canon])
     return ev
+
+
+def _real_bound(stmts: list[ast.stmt]) -> set[str]:
+    """Names meaningfully bound in a branch body (own scope): every
+    Store target except pure ``= None`` placeholders — ``winner = None``
+    on the unguarded side is the *absence* of a value, which is exactly
+    what TPM1301 needs to see through. Per STORE SITE, not per name: a
+    name that is None-initialized and then really bound in the same
+    branch (``winner = None`` … ``winner = fallback()``) is bound."""
+    none_targets: set[int] = set()
+    real: set[str] = set()
+    for s in stmts:
+        for n in _unit_nodes(s):
+            if isinstance(n, ast.Assign) and isinstance(
+                n.value, ast.Constant
+            ) and n.value.value is None:
+                none_targets.update(
+                    id(t) for t in n.targets
+                    if isinstance(t, ast.Name)
+                )
+            elif isinstance(n, ast.AnnAssign) and isinstance(
+                n.value, ast.Constant
+            ) and n.value.value is None and isinstance(
+                n.target, ast.Name
+            ):
+                # `winner: T = None` — the annotated placeholder form
+                none_targets.add(id(n.target))
+    for s in stmts:
+        for n in _unit_nodes(s):
+            if isinstance(n, ast.Name) and isinstance(
+                n.ctx, ast.Store
+            ) and id(n) not in none_targets:
+                real.add(n.id)
+    return real
+
+
+def _first_reads(graph: cfg_mod.CFG, entry: cfg_mod.Block,
+                 names: set[str],
+                 exclude: set[int] = frozenset()) -> list[list]:
+    """First Load of each name along the forward paths from ``entry``:
+    ``[name, line, col, enclosing_call]`` where ``enclosing_call`` is
+    the final attr of the call the name is a DIRECT argument of (the
+    broadcast-allowlist witness), or None. Blocks in ``exclude`` (the
+    exclusive regions of OTHER rank guards — a read there only runs on
+    some ranks, usually the same rank-0 that bound the value) are not
+    scanned. A rebind of the name ON THE SCANNED PATH before any read
+    (``plan = load_cached(...)`` on every rank) kills the one-sided
+    value — reads after it see the rebound value and are safe."""
+    out: dict[str, list] = {}
+    dead: set[str] = set()
+    for block in graph.reachable(entry):
+        if block.idx in exclude:
+            continue
+        for unit in block.units:
+            callmap: dict[int, str | None] = {}
+            for n in _unit_nodes(unit):
+                if not isinstance(n, ast.Call):
+                    continue
+                target = last_attr(n.func)
+                for a in list(n.args) + [
+                    kw.value for kw in n.keywords
+                ]:
+                    if isinstance(a, ast.Name):
+                        callmap[id(a)] = target
+            # loads first (an RHS read in `plan = f(plan)` happens
+            # before the rebind), then stores kill the name — except
+            # `= None` placeholder stores (the unguarded arm's
+            # `winner = None` is the absence the rule exists to see)
+            none_ids: set[int] = set()
+            aug_ids: set[int] = set()
+            for n in _unit_nodes(unit):
+                if isinstance(n, ast.Assign) and isinstance(
+                    n.value, ast.Constant
+                ) and n.value.value is None:
+                    none_ids.update(id(t) for t in n.targets)
+                elif isinstance(n, ast.AnnAssign) and isinstance(
+                    n.value, ast.Constant
+                ) and n.value.value is None:
+                    none_ids.add(id(n.target))
+                elif isinstance(n, ast.AugAssign):
+                    # `w += 1` READS the old value (its target has
+                    # Store ctx only): a read site, never a kill
+                    aug_ids.add(id(n.target))
+            for n in _unit_nodes(unit):
+                is_aug_read = id(n) in aug_ids
+                if isinstance(n, ast.Name) and (
+                    isinstance(n.ctx, ast.Load) or is_aug_read
+                ) and n.id in names and n.id not in out \
+                        and n.id not in dead:
+                    out[n.id] = [n.id, n.lineno, n.col_offset,
+                                 callmap.get(id(n))]
+            for n in _unit_nodes(unit):
+                if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, ast.Store
+                ) and n.id in names and id(n) not in none_ids \
+                        and id(n) not in aug_ids:
+                    dead.add(n.id)
+    return sorted(out.values())
+
+
+def _rank_if_facts(ctx: FileContext, node: ast.AST,
+                   graph: cfg_mod.CFG | None = None) -> list[dict]:
+    """Every rank-dependent ``if`` in the function as a flow-sensitive
+    fact: path-to-exit event sequences, early-exit bits, and the
+    one-side-bound names with their first unguarded-path read."""
+    aliases = _rank_aliases(node)
+    if graph is None:
+        graph = cfg_mod.build(node)
+    # pre-branch stores, with the `= None` placeholder filter applied
+    # per site (a `winner = None` BEFORE the rank guard is the same
+    # absence-of-a-value as one in the else arm)
+    none_targets: set[int] = set()
+    for n in _own_nodes(node):
+        if isinstance(n, ast.Assign) and isinstance(
+            n.value, ast.Constant
+        ) and n.value.value is None:
+            none_targets.update(id(t) for t in n.targets)
+        elif isinstance(n, ast.AnnAssign) and isinstance(
+            n.value, ast.Constant
+        ) and n.value.value is None:
+            none_targets.add(id(n.target))
+    before_lines: list[tuple[int, str]] = [
+        (n.lineno, n.id) for n in _own_nodes(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        and id(n) not in none_targets
+    ]
+    # every-rank bindings that exist before any branch runs: ALL
+    # parameter kinds (a kwonly/vararg/kwarg refreshed under a rank
+    # guard is still bound everywhere) and imported names (a module
+    # alias monkeypatched on rank 0 exists on every rank regardless)
+    a = node.args
+    always_bound = {p.arg for p in (a.posonlyargs + a.args
+                                    + a.kwonlyargs)}
+    for va in (a.vararg, a.kwarg):
+        if va is not None:
+            always_bound.add(va.arg)
+    always_bound |= set(ctx.imports.modules) | set(ctx.imports.names)
+    rank_branches = [
+        br for br in graph.branches
+        if _rank_dependent(br.node.test, aliases)
+    ]
+    # blocks exclusively inside SOME rank guard: a read there executes
+    # only on the ranks that take that guard — reading a rank-0-bound
+    # value under another rank-0 test is the idiomatic rank-0-only
+    # reporter shape, not a divergence (conservative: a mismatched
+    # guard rank is a false negative, never a false positive)
+    gated_per_branch: dict[int, set[int]] = {}
+    gated_all: set[int] = set()
+    for br in rank_branches:
+        rt = {b.idx for b in graph.reachable(br.then_entry)}
+        re_ = {b.idx for b in graph.reachable(br.else_entry)}
+        exc = (rt - re_) | (re_ - rt)
+        gated_per_branch[id(br)] = exc
+        gated_all |= exc
+
+    out: list[dict] = []
+    for br in rank_branches:
+        s = br.node
+        bound_then = _real_bound(s.body)
+        bound_else = _real_bound(s.orelse)
+        bound_before = set(always_bound) | {
+            name for line, name in before_lines if line < s.lineno
+        }
+        only_then = bound_then - bound_else - bound_before
+        only_else = bound_else - bound_then - bound_before
+        other_gated = gated_all - gated_per_branch[id(br)]
+        unbcast: list[list] = []
+        if only_then:
+            unbcast.extend(
+                _first_reads(graph, br.else_entry, only_then,
+                             exclude=other_gated)
+            )
+        if only_else:
+            unbcast.extend(
+                _first_reads(graph, br.then_entry, only_else,
+                             exclude=other_gated)
+            )
+        out.append({
+            "line": s.lineno, "col": s.col_offset,
+            "then": _path_events(ctx, graph, br.then_entry),
+            "orelse": _path_events(ctx, graph, br.else_entry),
+            "then_exits": br.then_exits,
+            "else_exits": br.else_exits,
+            "unbcast": sorted(unbcast),
+        })
+    return out
 
 
 def _donate_positions(node: ast.AST) -> list[int]:
@@ -394,14 +669,14 @@ def _donate_positions(node: ast.AST) -> list[int]:
 
 
 def _function_facts(ctx: FileContext, qual: str, node: ast.AST,
-                    local_device: set[str]) -> dict:
+                    local_device: set[str],
+                    graph: cfg_mod.CFG | None = None) -> dict:
     params = [a.arg for a in (node.args.posonlyargs + node.args.args)]
     pidx = {p: i for i, p in enumerate(params)}
     dispatches = syncs = returns_handle = False
     events: list = []
     forwards: list = []
     return_targets: list[str] = []
-    rank_ifs: list[dict] = []
     handle_names: set[str] = set()
     assigned_calls: list[list] = []
     loads = {
@@ -455,12 +730,6 @@ def _function_facts(ctx: FileContext, qual: str, node: ast.AST,
                     return_targets.append(canon)
             elif isinstance(v, ast.Name) and v.id in handle_names:
                 returns_handle = True
-        elif isinstance(n, ast.If) and _rank_dependent(n.test):
-            rank_ifs.append({
-                "line": n.lineno, "col": n.col_offset,
-                "then": _branch_events(ctx, n.body),
-                "orelse": _branch_events(ctx, n.orelse),
-            })
 
     return {
         "name": qual,
@@ -473,7 +742,7 @@ def _function_facts(ctx: FileContext, qual: str, node: ast.AST,
         "forwards": forwards,
         "returns_handle": returns_handle,
         "return_targets": return_targets,
-        "rank_ifs": rank_ifs,
+        "rank_ifs": _rank_if_facts(ctx, node, graph),
         # unconsumed call-result handles: assigned, then never read —
         # the TPM802 candidates (a name loaded ANYWHERE in the def,
         # nested closures included, counts as consumed)
@@ -648,12 +917,352 @@ def _dflow_facts(ctx: FileContext) -> list[dict]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# record-contract facts (TPM14xx / RECORDS.md)
+
+
+#: sink chokepoints a record dict flows through verbatim — the
+#: Reporter's JSONL writer and the telemetry registry's raw emit
+SINK_CALLS = {"jsonl", "emit"}
+
+
+def _record_producer_facts(
+    ctx: FileContext,
+) -> tuple[list[list], list[list]]:
+    """``(schemas, stamps)`` — every JSONL record schema the file
+    produces plus the envelope fields its sink wrappers stamp on.
+
+    A *schema* is a dict literal / ``dict(...)`` call carrying a
+    constant-string ``kind``, as ``[kind, event, fields, open, line]``.
+    Fields include constant subscript stores on the name the dict was
+    assigned to (``rec["phase"] = ...`` — the memwatch build-up idiom).
+    ``open`` marks schemas with dynamic parts — a ``**spread``, a
+    non-constant key/subscript, or a later ``.update()`` on the name
+    (the ``CommEvent.record`` meta idiom) — which the field check must
+    not judge.
+
+    A *stamp* is ``[fields, line]`` from a dict literal that has a
+    ``**spread`` but NO ``kind`` of its own and is passed directly into
+    a ``jsonl``/``emit`` sink call — the
+    ``rep.jsonl({**rec, "rank": rep.proc_index})`` envelope idiom:
+    every record flowing through the wrapper gains those fields, so
+    they are available on every kind.
+
+    The name-linked idioms (build-up stores, ``.update()``) resolve
+    PER SCOPE — module level, or one function's own nodes: two
+    functions both calling their local record ``rec`` must not bleed
+    fields or open-ness into each other's kinds."""
+    schemas: list[list] = []
+    stamps: list[list] = []
+    scopes = [list(_own_nodes(ctx.tree))] + [
+        list(_own_nodes(fn))
+        for _qual, fn in _walk_functions(ctx.tree)
+    ]
+    for nodes in scopes:
+        s, st = _scope_producer_facts(nodes)
+        schemas.extend(s)
+        stamps.extend(st)
+    schemas.sort(key=lambda r: (r[0], r[1] or "", r[4]))
+    stamps.sort(key=lambda r: r[1])
+    return schemas, stamps
+
+
+def _scope_producer_facts(
+    nodes: list[ast.AST],
+) -> tuple[list[list], list[list]]:
+    updated: set[str] = set()
+    sub_stores: dict[str, set[str]] = {}
+    dyn_stores: set[str] = set()
+    dict_targets: dict[int, list[str]] = {}
+    sink_args: set[int] = set()
+    for n in nodes:
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "update" \
+                    and isinstance(n.func.value, ast.Name):
+                updated.add(n.func.value.id)
+            if (last_attr(n.func) or "") in SINK_CALLS:
+                sink_args.update(id(a) for a in n.args)
+        elif isinstance(n, ast.Assign) and isinstance(
+            n.value, ast.Dict
+        ):
+            dict_targets[id(n.value)] = [
+                t.id for t in n.targets if isinstance(t, ast.Name)
+            ]
+        elif isinstance(n, ast.AnnAssign) and isinstance(
+            n.value, ast.Dict
+        ) and isinstance(n.target, ast.Name):
+            # `rec: dict[str, Any] = {...}` — the annotated form of
+            # the same build-up idiom
+            dict_targets[id(n.value)] = [n.target.id]
+        elif isinstance(n, ast.Subscript) and isinstance(
+            n.ctx, ast.Store
+        ) and isinstance(n.value, ast.Name):
+            if isinstance(n.slice, ast.Constant) and isinstance(
+                n.slice.value, str
+            ):
+                sub_stores.setdefault(n.value.id, set()).add(
+                    n.slice.value
+                )
+            else:
+                dyn_stores.add(n.value.id)
+
+    schemas: list[list] = []
+    stamps: list[list] = []
+    for n in nodes:
+        kind = event = None
+        fields: set[str] = set()
+        open_ = has_spread = False
+        if isinstance(n, ast.Dict):
+            for k, v in zip(n.keys, n.values):
+                if k is None:  # **spread
+                    open_ = has_spread = True
+                    continue
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    open_ = True
+                    continue
+                fields.add(k.value)
+                if isinstance(v, ast.Constant) and isinstance(
+                    v.value, str
+                ):
+                    if k.value == "kind":
+                        kind = v.value
+                    elif k.value == "event":
+                        event = v.value
+            for t in dict_targets.get(id(n), ()):
+                fields.update(sub_stores.get(t, ()))
+                if t in updated or t in dyn_stores:
+                    open_ = True
+        elif isinstance(n, ast.Call) and last_attr(n.func) == "dict":
+            for kw in n.keywords:
+                if kw.arg is None:  # **spread
+                    open_ = has_spread = True
+                    continue
+                fields.add(kw.arg)
+                if isinstance(kw.value, ast.Constant) and isinstance(
+                    kw.value.value, str
+                ):
+                    if kw.arg == "kind":
+                        kind = kw.value.value
+                    elif kw.arg == "event":
+                        event = kw.value.value
+        else:
+            continue
+        if kind is not None:
+            schemas.append([kind, event, sorted(fields - {"kind"}),
+                            open_, n.lineno])
+        elif has_spread and "kind" not in fields and fields \
+                and id(n) in sink_args:
+            stamps.append([sorted(fields), n.lineno])
+    return schemas, stamps
+
+
+def _kind_access_var(n: ast.AST) -> str | None:
+    """``X.get("kind")`` / ``X["kind"]`` → ``"X"``; else None."""
+    if isinstance(n, ast.Call) and isinstance(
+        n.func, ast.Attribute
+    ) and n.func.attr == "get" and isinstance(
+        n.func.value, ast.Name
+    ) and n.args and isinstance(n.args[0], ast.Constant) \
+            and n.args[0].value == "kind":
+        return n.func.value.id
+    if isinstance(n, ast.Subscript) and isinstance(
+        n.value, ast.Name
+    ) and isinstance(n.slice, ast.Constant) \
+            and n.slice.value == "kind":
+        return n.value.id
+    return None
+
+
+_KIND_CMP_OPS = (ast.Eq, ast.NotEq, ast.In, ast.NotIn)
+
+
+def _field_access(n: ast.AST) -> tuple[str, str] | None:
+    """``X.get("field", ...)`` / ``X["field"]`` (Load) →
+    ``(var, field)``; else None."""
+    if isinstance(n, ast.Call) and isinstance(
+        n.func, ast.Attribute
+    ) and n.func.attr == "get" and isinstance(
+        n.func.value, ast.Name
+    ) and n.args and isinstance(n.args[0], ast.Constant) \
+            and isinstance(n.args[0].value, str):
+        return n.func.value.id, n.args[0].value
+    if isinstance(n, ast.Subscript) and isinstance(
+        n.value, ast.Name
+    ) and isinstance(n.slice, ast.Constant) and isinstance(
+        n.slice.value, str
+    ) and isinstance(n.ctx, ast.Load):
+        return n.value.id, n.slice.value
+    return None
+
+
+def _kind_compares(expr: ast.AST, alias: dict[str, str]) -> list:
+    """Every kind test inside an expression:
+    ``(recvar, consts, positive)`` — ``rec.get("kind") == "span"``,
+    ``kind in ("a", "b")`` through a ``kind = rec.get("kind")`` alias,
+    and the negative forms (``!=`` / ``not in``)."""
+    out: list = []
+    for n in ast.walk(expr):
+        if not isinstance(n, ast.Compare) or len(n.ops) != 1:
+            continue
+        op = n.ops[0]
+        if not isinstance(op, _KIND_CMP_OPS):
+            continue
+        recvar = None
+        consts: list[str] = []
+        for side in [n.left] + list(n.comparators):
+            rv = _kind_access_var(side)
+            if rv:
+                recvar = rv
+            elif isinstance(side, ast.Name) and side.id in alias:
+                recvar = alias[side.id]
+            elif isinstance(side, ast.Constant) and isinstance(
+                side.value, str
+            ):
+                consts.append(side.value)
+            elif isinstance(side, (ast.Tuple, ast.List, ast.Set)):
+                consts.extend(
+                    e.value for e in side.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+        if recvar and consts:
+            positive = isinstance(op, (ast.Eq, ast.In))
+            out.append((recvar, consts, positive, n.lineno))
+    return out
+
+
+def _record_consumer_facts(
+    ctx: FileContext,
+    graphs: dict[int, cfg_mod.CFG] | None = None,
+) -> list[dict]:
+    """Per function: each record variable whose ``kind`` the function
+    tests against string constants (directly, or through a
+    ``kind = rec.get("kind")`` alias — the dominant consumer idiom) and
+    the constant fields it reads off that variable, *flow-sensitively
+    attributed* over the CFG:
+
+    * a read in the blocks exclusively reachable from a kind test's
+      TRUE edge (its ``elif`` arm, say) belongs to exactly the kinds
+      that test established — the big per-kind dispatch loops judge
+      each arm against its own schema, not the union;
+    * a read exclusively on the FALSE side of a positive test (the
+      ``else:`` of ``if h.get("kind") == "finding":``) is governed by
+      an unknown complement schema and is skipped — negative tests
+      (``!= "span"``) govern their false side instead;
+    * a read in shared code (before the dispatch, after the join, or
+      inside a comprehension the statement CFG cannot split) falls back
+      to the union of every kind the function tested.
+
+    Output: ``{"var", "kinds", "line", "groups": [{"kinds", "fields"}]}``
+    where an empty group ``kinds`` means the union fallback.
+    """
+    out: list[dict] = []
+    for _qual, fn in _walk_functions(ctx.tree):
+        nodes = list(_own_nodes(fn))
+        alias: dict[str, str] = {}
+        for n in nodes:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                rv = _kind_access_var(n.value)
+                if rv:
+                    alias[n.targets[0].id] = rv
+
+        all_kinds: dict[str, set[str]] = {}
+        klines: dict[str, int] = {}
+        for recvar, consts, _pos, line in _kind_compares(fn, alias):
+            all_kinds.setdefault(recvar, set()).update(consts)
+            klines.setdefault(recvar, line)
+        if not all_kinds:
+            continue
+
+        graph = (graphs or {}).get(id(fn)) or cfg_mod.build(fn)
+        # var -> block idx -> governing kinds (positive regions); and
+        # var -> block idxs whose schema is an unknown complement
+        governed: dict[str, dict[int, set[str]]] = {}
+        skipped: dict[str, set[int]] = {}
+        # test-expression units attribute their own reads to their own
+        # positive kinds (`elif kind == "serve" and rec.get("event")..`)
+        test_kinds: dict[int, dict[str, set[str]]] = {}
+        for br in graph.branches:
+            cmps = _kind_compares(br.node.test, alias)
+            if not cmps:
+                continue
+            reach_then = {b.idx for b in graph.reachable(br.then_entry)}
+            reach_else = {b.idx for b in graph.reachable(br.else_entry)}
+            exc_then = reach_then - reach_else
+            exc_else = reach_else - reach_then
+            for recvar, consts, positive, _line in cmps:
+                gov_region, skip_region = (
+                    (exc_then, exc_else) if positive
+                    else (exc_else, exc_then)
+                )
+                gv = governed.setdefault(recvar, {})
+                for idx in gov_region:
+                    gv.setdefault(idx, set()).update(consts)
+                skipped.setdefault(recvar, set()).update(skip_region)
+                if positive:
+                    test_kinds.setdefault(id(br.node.test), {}) \
+                        .setdefault(recvar, set()).update(consts)
+
+        # group reads: frozenset of governing kinds (empty = union)
+        groups: dict[str, dict[frozenset, dict[str, list]]] = {
+            v: {} for v in all_kinds
+        }
+        for block in graph.blocks:
+            for unit in block.units:
+                tk = test_kinds.get(id(unit), {})
+                for n in _unit_nodes(unit):
+                    acc = _field_access(n)
+                    if not acc:
+                        continue
+                    var, fname = acc
+                    if var not in all_kinds or fname == "kind":
+                        continue
+                    if var in tk:
+                        key = frozenset(tk[var])
+                    else:
+                        gov = governed.get(var, {}).get(block.idx)
+                        if gov:
+                            key = frozenset(gov)
+                        elif block.idx in skipped.get(var, ()):
+                            continue  # unknown complement schema
+                        else:
+                            key = frozenset()  # union fallback
+                    groups[var].setdefault(key, {}).setdefault(
+                        fname, [fname, n.lineno, n.col_offset]
+                    )
+        for var in sorted(all_kinds):
+            out.append({
+                "var": var,
+                "kinds": sorted(all_kinds[var]),
+                "line": klines[var],
+                "groups": [
+                    {"kinds": sorted(key),
+                     "fields": sorted(fields.values())}
+                    for key, fields in sorted(
+                        groups[var].items(),
+                        key=lambda kv: sorted(kv[0]),
+                    )
+                    if fields
+                ],
+            })
+    return out
+
+
 def extract_facts(ctx: FileContext) -> dict:
     """The file's whole-program facts record — pure data, JSON-stable
     (cold extraction and a cache round-trip produce identical project
     findings)."""
     local_device = device_callables(ctx)
     axis_bound, axis_uses = _axis_facts(ctx)
+    rec_produced, rec_stamps = _record_producer_facts(ctx)
+    # one CFG per function, shared by the rank-branch and the
+    # record-consumer passes (they walk the same function list)
+    functions = _walk_functions(ctx.tree)
+    graphs = {id(node): cfg_mod.build(node)
+              for _qual, node in functions}
     return {
         "path": ctx.path,
         "module": ctx.module,
@@ -662,9 +1271,13 @@ def extract_facts(ctx: FileContext) -> dict:
         "axis_uses": axis_uses,
         "timed_regions": _timed_region_facts(ctx, local_device),
         "dflow": _dflow_facts(ctx),
+        "rec_produced": rec_produced,
+        "rec_stamps": rec_stamps,
+        "rec_consumed": _record_consumer_facts(ctx, graphs),
         "functions": [
-            _function_facts(ctx, qual, node, local_device)
-            for qual, node in _walk_functions(ctx.tree)
+            _function_facts(ctx, qual, node, local_device,
+                            graphs[id(node)])
+            for qual, node in functions
         ],
     }
 

@@ -5,13 +5,18 @@ stable (a code is never reused for a different hazard) so suppression
 comments stay meaningful across releases. File-scope rules see one
 parsed file; project-scope rules see the whole linted program as
 serialized facts (``analysis/program.py``) — the ISSUE-10 families
-(TPM11xx/TPM12xx) and the interprocedural upgrades (TPM102/TPM502/
-TPM802) all live there.
+(TPM11xx/TPM12xx), the interprocedural upgrades (TPM102/TPM502/
+TPM802), and the ISSUE-12 flow-sensitive families (TPM1102 early-exit
+divergence, TPM1301 broadcast-consistency, TPM14xx record-contract)
+all live there.
 """
 
 from tpu_mpi_tests.analysis.rules.axis_consistency import (
     AxisConsistency,
     AxisProgramConsistency,
+)
+from tpu_mpi_tests.analysis.rules.broadcast_consistency import (
+    BroadcastConsistency,
 )
 from tpu_mpi_tests.analysis.rules.chaos_containment import (
     ChaosContainment,
@@ -19,12 +24,18 @@ from tpu_mpi_tests.analysis.rules.chaos_containment import (
 from tpu_mpi_tests.analysis.rules.collective_divergence import (
     CollectiveDivergence,
 )
+from tpu_mpi_tests.analysis.rules.early_exit_divergence import (
+    EarlyExitDivergence,
+)
 from tpu_mpi_tests.analysis.rules.concurrency import UnlockedSharedWrite
 from tpu_mpi_tests.analysis.rules.donation_safety import DonationSafety
 from tpu_mpi_tests.analysis.rules.import_hygiene import ImportHygiene
 from tpu_mpi_tests.analysis.rules.overlap_regions import (
     EscapedAsyncHandle,
     OverlapRegionSync,
+)
+from tpu_mpi_tests.analysis.rules.record_contract import (
+    RecordContract,
 )
 from tpu_mpi_tests.analysis.rules.schedule_constants import (
     ScheduleConstants,
@@ -50,5 +61,8 @@ ALL_RULES = [
     EscapedAsyncHandle(),
     ChaosContainment(),
     CollectiveDivergence(),
+    EarlyExitDivergence(),
     DonationSafety(),
+    BroadcastConsistency(),
+    RecordContract(),
 ]

@@ -1,0 +1,65 @@
+"""TPM1301 — rank-guarded binding consumed without a broadcast (ISSUE 12).
+
+The fleet-tuning / pod-serving hazard ROADMAP items 1(a) and 2 are about
+to write, dogfooded before those PRs land: rank 0 computes something
+(a tune-sweep winner, a batch plan) inside a rank guard, and then EVERY
+rank acts on the name —
+
+    if process_index() == 0:
+        winner = sweep(space)       # only rank 0 has the real value
+    else:
+        winner = None               # placeholder, not a value
+    apply_schedule(winner)          # ranks now disagree
+
+Nothing deadlocks immediately, which makes this worse than TPM1101: the
+ranks silently run different schedules (or crash later on the None),
+and the divergence only surfaces as a wrong answer or a hang several
+collectives downstream. The SPMD-honest shape routes the value through
+a replicating collective first — ``broadcast``/``broadcast_one_to_all``
+/``process_allgather``/``pbroadcast`` (the curated
+:data:`tpu_mpi_tests.analysis.program.BROADCAST_CALLS` set).
+
+Detection, over the per-function CFG facts: a name bound on exactly one
+side of a rank-dependent ``if`` (a ``= None`` placeholder on the other
+side does not count as a binding), not bound before the branch, whose
+first read along the OTHER path is not a direct argument of a
+broadcast-class call. Anchored at that read — the point where an
+unreplicated value enters per-rank work.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tpu_mpi_tests.analysis.core import ProjectContext
+from tpu_mpi_tests.analysis.program import BROADCAST_CALLS
+
+
+class BroadcastConsistency:
+    name = "broadcast-consistency"
+    scope = "project"
+    codes = {
+        "TPM1301": "value bound only on a rank-guarded path is read on "
+                   "the unguarded path without passing through a "
+                   "broadcast-class collective",
+    }
+
+    def check_project(self, proj: ProjectContext) -> Iterator[tuple]:
+        for ff in proj.facts:
+            for fn in ff["functions"]:
+                for ri in fn["rank_ifs"]:
+                    for name, line, col, call in ri["unbcast"]:
+                        if call in BROADCAST_CALLS:
+                            continue
+                        yield (
+                            ff["path"], line, col, "TPM1301",
+                            f"'{name}' is bound only on the "
+                            f"rank-guarded path of the branch at line "
+                            f"{ri['line']} but read here on the path "
+                            f"the other ranks take — they see a stale "
+                            f"or placeholder value and the ranks "
+                            f"diverge; replicate it first "
+                            f"(broadcast/broadcast_one_to_all/"
+                            f"process_allgather) or compute it on "
+                            f"every rank",
+                        )

@@ -31,7 +31,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from tpu_mpi_tests.analysis.core import FileContext
+from tpu_mpi_tests.analysis.core import FileContext, is_test_file
 
 CHAOS_PKG = "tpu_mpi_tests.chaos"
 
@@ -44,8 +44,7 @@ def _exempt(module: str) -> bool:
         return True
     if module in SANCTIONED_MODULES:
         return True
-    last = module.rsplit(".", 1)[-1]
-    return last.startswith("test_") or last == "conftest"
+    return is_test_file(module.rsplit(".", 1)[-1])
 
 
 def _is_chaos(target: str) -> bool:

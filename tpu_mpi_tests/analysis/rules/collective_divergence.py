@@ -11,12 +11,18 @@ check is interprocedural: a rank-guarded branch that calls a helper
 whose call graph dispatches a collective diverges just the same.
 
 Detection (conservative by design): for every ``if`` whose test is
-rank-dependent — a ``process_index()`` call or a comparison against a
-rank-named variable/attribute — flatten each branch's event sequence
-into the collective ops its execution dispatches (call targets expanded
-through the project summaries) and compare. Equal sequences (usually
-both empty: rank-0-only *printing* is everywhere and fine) pass; any
-difference is a finding anchored at the ``if``.
+rank-dependent — a ``process_index()`` call, a comparison against a
+rank-named variable/attribute, or (ISSUE 12) a truthiness test like
+``if not rank:`` / a tested local aliasing ``process_index()`` —
+flatten each *path's* event sequence (computed to function exit over
+the CFG, so branches that ``return`` early carry only what they
+actually run) into the collective ops its execution dispatches (call
+targets expanded through the project summaries) and compare. Equal
+sequences (usually both empty: rank-0-only *printing* is everywhere
+and fine) pass; any difference is a finding anchored at the ``if``.
+Branches where exactly one side exits the function early belong to
+TPM1102 (``rules/early_exit_divergence``) — this rule skips them so
+every divergent ``if`` carries exactly one code.
 
 Sanctioned rank-0-only sites (a single-process tune sweep, a rank-0
 report/trace merge) carry the standard inline suppression with a
@@ -49,6 +55,8 @@ class CollectiveDivergence:
         for ff in proj.facts:
             for fn in ff["functions"]:
                 for ri in fn["rank_ifs"]:
+                    if ri["then_exits"] != ri["else_exits"]:
+                        continue  # the early-exit shape: TPM1102's
                     a = idx.collective_seq(ri["then"], ff["module"])
                     b = idx.collective_seq(ri["orelse"], ff["module"])
                     if a == b:
