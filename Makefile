@@ -452,9 +452,11 @@ live-smoke:
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
 # golden fixtures (analysis/fixtures/) are deliberately bad and are
-# excluded from recursive walks by the linter itself.
+# excluded from recursive walks by the linter itself. --jobs 2
+# exercises the multiprocessing fact-extraction path on every CI run
+# (ISSUE 13); warm-cache runs re-parse zero files regardless of N.
 lint:
-	python -m tpu_mpi_tests.analysis.cli \
+	python -m tpu_mpi_tests.analysis.cli --jobs 2 \
 		tpu_mpi_tests tpu tests __graft_entry__.py bench.py
 
 # regenerate RECORDS.md — the JSONL record-kind schema table extracted
@@ -534,7 +536,27 @@ lint-smoke:
 			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
 		assert a == 0 and h == f > 0, s; \
 		print('lint-smoke salt-warm OK:', h, 'cache hits, 0 files re-parsed')"
-	@echo "lint-smoke OK: cold populate, warm zero-reparse, touched file re-analyzes, salt bump invalidates exactly once"
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests/analysis/fixtures/tpm16_bad \
+		--cache /tmp/_tpumt_lint_smoke/races.json --format json \
+		> /tmp/_tpumt_lint_smoke/races_cold.json || true
+	python -m tpu_mpi_tests.analysis.cli \
+		tpu_mpi_tests/analysis/fixtures/tpm16_bad \
+		--cache /tmp/_tpumt_lint_smoke/races.json --format json \
+		--stats > /tmp/_tpumt_lint_smoke/races_warm.json \
+		2> /tmp/_tpumt_lint_smoke/races_warm.stats || true
+	python -c "import json, re; \
+		cold = json.load(open('/tmp/_tpumt_lint_smoke/races_cold.json')); \
+		warm = json.load(open('/tmp/_tpumt_lint_smoke/races_warm.json')); \
+		s = open('/tmp/_tpumt_lint_smoke/races_warm.stats').read(); \
+		f, a, h = map(int, re.search( \
+			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
+		codes = {x['code'] for x in warm['findings']}; \
+		assert a == 0 and h == f > 0, s; \
+		assert warm == cold, 'warm TPM16xx findings must replay identically'; \
+		assert {'TPM1601', 'TPM1602', 'TPM1603'} <= codes, codes; \
+		print('lint-smoke races OK: TPM16xx recomputed from replayed concurrency facts, 0 files re-parsed')"
+	@echo "lint-smoke OK: cold populate, warm zero-reparse (concurrency facts replayed), touched file re-analyzes, salt bump invalidates exactly once"
 
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile

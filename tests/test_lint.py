@@ -41,7 +41,6 @@ FILE_FAMILIES = [
     ("TPM2", "tpm2"),
     ("TPM3", "tpm3"),
     ("TPM5", "tpm5"),
-    ("TPM6", "tpm6"),
     ("TPM7", "tpm7"),
     ("TPM8", "tpm8"),
     ("TPM10", "tpm10"),
@@ -50,20 +49,29 @@ FILE_FAMILIES = [
     ("TPM1102", "tpm1102"),
     ("TPM1301", "tpm1301"),
     ("TPM140", "tpm14"),
+    # ISSUE 13 demoted TPM601: the tpm6 single-file fixtures are now
+    # TPM1601 goldens (their Timer target resolves, so the lockset
+    # engine owns them); the TPM601 fallback keeps its own test below
+    ("TPM16", "tpm6"),
 ]
 
-#: (family prefix, fixture stem) for the ISSUE-10 whole-program
-#: families — mini package trees, because the findings are
-#: interprocedural by construction (helper in one file, hazard in
-#: another)
+#: (family prefix, fixture stem) for the whole-program families — mini
+#: package trees, because the findings are interprocedural by
+#: construction (helper in one file, hazard in another)
 TREE_FAMILIES = [
     ("TPM11", "tpm11"),
     ("TPM12", "tpm12"),
+    ("TPM16", "tpm16"),
 ]
 
 
 def codes_of(findings):
     return [f.code for f in findings]
+
+
+def counts_of(stats):
+    """The cache-relevant stats triple (``seconds``/``jobs`` vary)."""
+    return {k: stats[k] for k in ("files", "analyzed", "cache_hits")}
 
 
 @pytest.mark.parametrize("family,stem", FILE_FAMILIES)
@@ -1137,7 +1145,7 @@ def test_cli_list_rules_covers_every_family(capsys):
                  "TPM401", "TPM501", "TPM502", "TPM601", "TPM701",
                  "TPM801", "TPM802", "TPM900", "TPM1001", "TPM1101",
                  "TPM1102", "TPM1201", "TPM1301", "TPM1401",
-                 "TPM1402"):
+                 "TPM1402", "TPM1601", "TPM1602", "TPM1603"):
         assert code in out
     # table rows match the registry (README is hand-synced to this)
     assert len(rule_table()) >= 20
@@ -1204,19 +1212,19 @@ def test_cache_cold_warm_touch_cycle(tmp_path):
 
     s1: dict = {}
     f1 = lint_paths([str(proj)], cache_path=str(cache), stats=s1)
-    assert s1 == {"files": 2, "analyzed": 2, "cache_hits": 0}
+    assert counts_of(s1) == {"files": 2, "analyzed": 2, "cache_hits": 0}
     assert "TPM502" in codes_of(f1), f1
     assert cache.exists() and json.loads(cache.read_text())["entries"]
 
     s2: dict = {}
     f2 = lint_paths([str(proj)], cache_path=str(cache), stats=s2)
-    assert s2 == {"files": 2, "analyzed": 0, "cache_hits": 2}
+    assert counts_of(s2) == {"files": 2, "analyzed": 0, "cache_hits": 2}
     assert f2 == f1  # byte-identical findings, zero re-parsing
 
     clean.write_text(clean.read_text() + "\n# touched\n")
     s3: dict = {}
     f3 = lint_paths([str(proj)], cache_path=str(cache), stats=s3)
-    assert s3 == {"files": 2, "analyzed": 1, "cache_hits": 1}
+    assert counts_of(s3) == {"files": 2, "analyzed": 1, "cache_hits": 1}
     assert f3 == f1
 
 
@@ -1313,7 +1321,7 @@ def test_cache_evicts_deleted_paths_on_save(tmp_path):
     gone.unlink()
     s: dict = {}
     lint_paths([str(proj)], cache_path=str(cache), stats=s)
-    assert s == {"files": 1, "analyzed": 0, "cache_hits": 1}
+    assert counts_of(s) == {"files": 1, "analyzed": 0, "cache_hits": 1}
     entries = json.loads(cache.read_text())["entries"]
     assert set(entries) == {str(keep)}, entries
 
@@ -1334,10 +1342,10 @@ def test_cache_engine_salt_mismatch_invalidates_once(tmp_path):
     }))
     s1: dict = {}
     lint_paths([str(proj)], cache_path=str(cache), stats=s1)
-    assert s1 == {"files": 1, "analyzed": 1, "cache_hits": 0}
+    assert counts_of(s1) == {"files": 1, "analyzed": 1, "cache_hits": 0}
     s2: dict = {}
     lint_paths([str(proj)], cache_path=str(cache), stats=s2)
-    assert s2 == {"files": 1, "analyzed": 0, "cache_hits": 1}
+    assert counts_of(s2) == {"files": 1, "analyzed": 0, "cache_hits": 1}
 
 
 def test_records_generator_and_check_mode(tmp_path, capsys):
@@ -1418,6 +1426,389 @@ def test_cli_stats_and_no_cache(tmp_path, capsys):
     err = capsys.readouterr().err
     assert "files=1 analyzed=1 cache_hits=0" in err
     assert "cache=off" in err
+
+
+def test_tpm601_fallback_covers_unresolvable_bound_method(tmp_path):
+    """Code-review regression (ISSUE 13): a spawn target that CAPTURES
+    a ref but resolves to nothing at project scope (`obj.run` — untyped
+    receiver, blocklisted common method name) leaves the lockset engine
+    with no root, so the TPM601 fallback must still fire — resolution
+    is judged where the project can actually see, not at capture time."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self, path, obj):\n"
+        "        self._f = open(path, 'a')\n"
+        "        self._obj = obj\n"
+        "    def arm(self, obj):\n"
+        "        threading.Timer(1.0, obj.run).start()\n"
+        "    def record(self, line):\n"
+        "        self._f.write(line)\n"
+    )
+    assert codes_of(lint_paths([str(p)])) == ["TPM601"]
+
+
+def test_duplicate_qualname_defs_keep_their_own_lock_facts(tmp_path):
+    """Code-review regression (ISSUE 13): two same-qualname defs (the
+    try/except-ImportError and platform-variant idioms) must each keep
+    their OWN lock summary — an unlocked write in the first variant
+    races even when the second variant is locked."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self, path):\n"
+        "        self._f = open(path, 'a')\n"
+        "        self._lock = threading.Lock()\n"
+        "    if True:\n"
+        "        def emit(self, line):\n"
+        "            self._f.write(line)\n"
+        "    else:\n"
+        "        def emit(self, line):\n"
+        "            with self._lock:\n"
+        "                self._f.write(line)\n"
+        "    def arm(self):\n"
+        "        threading.Timer(1.0, self._dump).start()\n"
+        "    def _dump(self):\n"
+        "        with self._lock:\n"
+        "            self._f.write('fired')\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM1601" in codes_of(findings), findings
+    f = next(x for x in findings if x.code == "TPM1601")
+    assert f.line == 8, f  # the unlocked variant's write
+
+
+def test_module_level_lock_self_deadlock_convicts(tmp_path):
+    """Code-review regression (ISSUE 13): module-scope ``_LOCK =
+    threading.Lock()`` kinds must reach TPM1602 like class locks do —
+    a lock-held call into a helper re-acquiring the same module lock
+    is the same guaranteed self-deadlock; an RLock stays clean."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import threading\n"
+        "_LOCK = threading.Lock()\n"
+        "def outer(vals):\n"
+        "    with _LOCK:\n"
+        "        helper(vals)\n"
+        "def helper(vals):\n"
+        "    with _LOCK:\n"
+        "        vals.clear()\n"
+    )
+    assert "TPM1602" in codes_of(lint_paths([str(p)]))
+    p.write_text(
+        "import threading\n"
+        "_LOCK = threading.RLock()\n"
+        "def outer(vals):\n"
+        "    with _LOCK:\n"
+        "        helper(vals)\n"
+        "def helper(vals):\n"
+        "    with _LOCK:\n"
+        "        vals.clear()\n"
+    )
+    assert "TPM1602" not in codes_of(lint_paths([str(p)]))
+
+
+def test_deadlock_in_call_cycle_is_order_independent(tmp_path):
+    """Code-review regression (ISSUE 13): the transitive-acquire memo
+    must not cache a cycle-truncated result — a re-acquire deadlock
+    inside an a→b→a cycle convicts even when an unrelated lock-held
+    call forces the cycle to be explored from another entry first."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._lock2 = threading.Lock()\n"
+        "    def early(self):\n"
+        "        with self._lock2:\n"
+        "            self.a()\n"
+        "    def a(self):\n"
+        "        with self._lock:\n"
+        "            self.b()\n"
+        "    def b(self):\n"
+        "        self.a()\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM1602" in codes_of(findings), findings
+
+
+def test_with_wrapped_early_exit_still_convicts(tmp_path):
+    """Code-review regression (ISSUE 13): the new with-region CFG
+    blocks must not resurrect terminated flow — a rank-guarded early
+    return WRAPPED IN A `with` is still an exit edge, so TPM1102 keeps
+    convicting the deadlock shape PR 12 closed."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "def run(x, mesh, rank, span):\n"
+        "    if rank != 0:\n"
+        "        with span('skip'):\n"
+        "            return x\n"
+        "    return allreduce_sum(x, mesh)\n"
+    )
+    assert "TPM1102" in codes_of(lint_paths([str(p)]))
+
+
+def test_module_level_slot_install_is_not_a_rebind(tmp_path):
+    """Code-review regression (ISSUE 13): an import-time cross-module
+    slot assignment is a declaration-shaped initializer, not the
+    arm-time rebind TPM1603 judges — only the function-scope install
+    without a disarm convicts."""
+    pkg = tmp_path / "plane"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "slots.py").write_text(
+        "_SPAN_HOOK = None\n"
+        "def fire(op):\n"
+        "    h = _SPAN_HOOK\n"
+        "    return h and h(op)\n"
+    )
+    boot = pkg / "boot.py"
+    boot.write_text(
+        "from plane import slots\n"
+        "def default_hook(op):\n"
+        "    return op\n"
+        "slots._SPAN_HOOK = _install()\n"
+        "def _install():\n"
+        "    return default_hook\n"
+    )
+    assert "TPM1603" not in codes_of(lint_paths([str(tmp_path)]))
+    boot.write_text(
+        "from plane import slots\n"
+        "def arm():\n"
+        "    slots._SPAN_HOOK = _install()\n"
+        "def _install():\n"
+        "    def hook(op):\n"
+        "        return op\n"
+        "    return hook\n"
+    )
+    assert "TPM1603" in codes_of(lint_paths([str(tmp_path)]))
+
+
+def _copy_lint_tree(tmp_path):
+    """A tmp copy of the self-clean lint root set (tests/ excluded —
+    test modules are exempt from the contract families anyway), for
+    the seeded-mutant runs that must convict against the REAL tree."""
+    import shutil
+
+    roots = []
+    for name in ("tpu_mpi_tests", "tpu"):
+        shutil.copytree(REPO / name, tmp_path / name,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+        roots.append(str(tmp_path / name))
+    for name in ("bench.py", "__graft_entry__.py"):
+        shutil.copyfile(REPO / name, tmp_path / name)
+        roots.append(str(tmp_path / name))
+    return roots
+
+
+def test_seeded_race_mutant_jsonl_lock_stripped(tmp_path):
+    """Mutation gate (acceptance criterion): stripping ``with
+    self._jsonl_lock:`` from Reporter.jsonl makes the handle write a
+    disjoint-lockset race between the main thread and the live-plane
+    threads that reach jsonl through the sink escapes — convicted as
+    the run's SOLE finding, anchored in report.py."""
+    roots = _copy_lint_tree(tmp_path)
+    rp = tmp_path / "tpu_mpi_tests" / "instrument" / "report.py"
+    src = rp.read_text()
+    old = (
+        "        with self._jsonl_lock:\n"
+        "            if self._jsonl_file is None:\n"
+        '                self._jsonl_file = open(self.jsonl_path, "a")\n'
+        "            self._jsonl_file.write(line)\n"
+        "            self._jsonl_file.flush()\n"
+    )
+    new = (
+        "        if self._jsonl_file is None:\n"
+        '            self._jsonl_file = open(self.jsonl_path, "a")\n'
+        "        self._jsonl_file.write(line)\n"
+        "        self._jsonl_file.flush()\n"
+    )
+    assert old in src, "report.py jsonl lock shape changed — update me"
+    rp.write_text(src.replace(old, new))
+    findings = lint_paths(roots)
+    assert codes_of(findings) == ["TPM1601"], findings
+    f = findings[0]
+    assert f.path.endswith("report.py"), f
+    assert "_jsonl_file" in f.message, f
+
+
+def test_seeded_deadlock_mutant_lock_held_call(tmp_path):
+    """Mutation gate (acceptance criterion): inlining a call to the
+    lock-taking ``value`` helper INSIDE set_gauge's ``with self._lock:``
+    region re-acquires the non-reentrant registry lock — convicted as
+    the run's SOLE finding (TPM1602), anchored at the call. Run with
+    --jobs 2 so the parallel extraction path feeds the project pass
+    in-suite."""
+    roots = _copy_lint_tree(tmp_path)
+    mp = tmp_path / "tpu_mpi_tests" / "instrument" / "metrics.py"
+    src = mp.read_text()
+    old = (
+        "        with self._lock:\n"
+        '            s = self._get(name, "gauge", labels)\n'
+        "            if s is not None:\n"
+        "                s.value = v\n"
+    )
+    new = old + "            self.value(name, labels)\n"
+    assert old in src, "metrics.py set_gauge shape changed — update me"
+    mp.write_text(src.replace(old, new))
+    findings = lint_paths(roots, jobs=2)
+    assert codes_of(findings) == ["TPM1602"], findings
+    f = findings[0]
+    assert f.path.endswith("metrics.py"), f
+    assert "value" in f.message and "_lock" in f.message, f
+
+
+def test_tpm601_fallback_fires_only_without_resolved_roots(tmp_path):
+    """The demotion contract: the lexical TPM601 heuristic fires ONLY
+    where thread-entry discovery resolved nothing (a dynamic spawn
+    target) — a resolvable target hands the file to the TPM16xx engine
+    and TPM601 stands down."""
+    p = tmp_path / "dyn.py"
+    p.write_text(
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self, path, hooks):\n"
+        "        self._f = open(path, 'a')\n"
+        "        self._hooks = hooks\n"
+        "    def arm(self):\n"
+        "        threading.Timer(1.0, self._hooks[0]).start()\n"
+        "    def record(self, line):\n"
+        "        self._f.write(line)\n"
+    )
+    findings = lint_paths([str(p)])
+    assert codes_of(findings) == ["TPM601"], findings
+    # same file, but the Timer target now resolves: the lockset engine
+    # owns the file — TPM601 silent, the race convicted as TPM1601
+    p.write_text(
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self, path):\n"
+        "        self._f = open(path, 'a')\n"
+        "    def arm(self):\n"
+        "        threading.Timer(1.0, self._dump).start()\n"
+        "    def _dump(self):\n"
+        "        self._f.write('fired')\n"
+        "    def record(self, line):\n"
+        "        self._f.write(line)\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM601" not in codes_of(findings), findings
+    assert "TPM1601" in codes_of(findings), findings
+
+
+def test_race_inheritance_merges_locations(tmp_path):
+    """A subclass's ``self.phase`` store and the base's timer-thread
+    read are ONE abstract location (base-climbed) — the IdleAwareWatchdog
+    shape; unrelated same-named attrs on unrelated classes are not."""
+    p = tmp_path / "wd.py"
+    p.write_text(
+        "import threading\n"
+        "class Base:\n"
+        "    def __init__(self):\n"
+        "        self.phase = 'idle'\n"
+        "    def start(self):\n"
+        "        threading.Timer(1.0, self._fire).start()\n"
+        "    def _fire(self):\n"
+        "        print(self.phase)\n"
+        "class Sub(Base):\n"
+        "    def arm(self, phase):\n"
+        "        self.phase = phase\n"
+    )
+    findings = lint_paths([str(p)])
+    assert "TPM1601" in codes_of(findings), findings
+    f = next(x for x in findings if x.code == "TPM1601")
+    assert f.line == 11, f  # the subclass store (the write anchors)
+
+
+def test_hook_roots_are_not_mhp_with_main(tmp_path):
+    """Phase hooks fire ON the thread running the phase: a hook-only
+    root must not fabricate a race against main-thread code (the
+    PhaseProgress shape is single-threaded in reality)."""
+    p = tmp_path / "hooks.py"
+    p.write_text(
+        "from tpu_mpi_tests.instrument.timers import add_phase_hook\n"
+        "class Progress:\n"
+        "    def __init__(self):\n"
+        "        self._tot = {}\n"
+        "    def __call__(self, name, event):\n"
+        "        self._tot[name] = self._tot.get(name, 0) + 1\n"
+        "    def start(self):\n"
+        "        add_phase_hook(self)\n"
+        "    def stop(self):\n"
+        "        self._tot.clear()\n"
+    )
+    findings = lint_paths([str(p)])
+    assert not any(c.startswith("TPM16") for c in codes_of(findings)), \
+        findings
+
+
+def test_cache_replays_concurrency_facts(tmp_path):
+    """Acceptance criterion: warm-cache lint re-parses ZERO files with
+    the new facts schema, and the TPM16xx project findings recompute
+    identically from the REPLAYED threading-plane facts (spawns,
+    escapes, locksets all cross the JSON boundary)."""
+    import shutil
+
+    proj = tmp_path / "tree"
+    shutil.copytree(FIXTURES / "tpm16_bad", proj)
+    cache = tmp_path / "cache.json"
+    s1: dict = {}
+    f1 = lint_paths([str(proj)], cache_path=str(cache), stats=s1)
+    assert counts_of(s1)["analyzed"] == counts_of(s1)["files"] > 0
+    assert {"TPM1601", "TPM1602", "TPM1603"} <= set(codes_of(f1)), f1
+    s2: dict = {}
+    f2 = lint_paths([str(proj)], cache_path=str(cache), stats=s2)
+    assert s2["analyzed"] == 0 and s2["cache_hits"] == s2["files"]
+    assert f2 == f1
+
+
+def test_jobs_parallel_extraction_matches_sequential(tmp_path):
+    """--jobs N farms per-file analysis to worker processes; findings
+    are identical to the sequential run, and a warm-cache run stays
+    zero-reparse regardless of N."""
+    import shutil
+
+    proj = tmp_path / "tree"
+    shutil.copytree(FIXTURES / "tpm16_bad", proj)
+    seq = lint_paths([str(proj)], jobs=1)
+    par = lint_paths([str(proj)], jobs=2)
+    assert par == seq and par, par
+    cache = tmp_path / "cache.json"
+    lint_paths([str(proj)], cache_path=str(cache), jobs=2)
+    s: dict = {}
+    warm = lint_paths([str(proj)], cache_path=str(cache), jobs=3,
+                      stats=s)
+    assert s["analyzed"] == 0 and s["jobs"] == 3
+    assert warm == seq
+
+
+def test_cli_json_and_sarif_carry_tpm16(capsys):
+    """The output-format goldens extended with a TPM16xx finding
+    (satellite): --format json carries the race finding with its
+    anchor, and the SARIF rule table + results include the family."""
+    bad = str(FIXTURES / "tpm16_bad")
+    rc = cli.main(["--no-cache", "--format", "json", bad])
+    out = capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.out)
+    by_code = {f["code"]: f for f in doc["findings"]}
+    assert {"TPM1601", "TPM1602", "TPM1603"} <= set(by_code)
+    race = by_code["TPM1601"]
+    assert race["path"].endswith("recorder.py") and race["line"] == 20
+
+    rc = cli.main(["--no-cache", "--format", "sarif", bad])
+    out = capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.out)
+    driver = doc["runs"][0]["tool"]["driver"]
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert {"TPM1601", "TPM1602", "TPM1603", "TPM601"} <= set(rule_ids)
+    result_codes = {r["ruleId"] for r in doc["runs"][0]["results"]}
+    assert {"TPM1601", "TPM1602", "TPM1603"} <= result_codes
 
 
 def test_self_clean_gate():

@@ -22,6 +22,12 @@ This module builds a small, conservative CFG per function body:
 * **Branches** record, for every ``if``, the two path entry blocks and
   whether each side's straight-line flow *terminates* (cannot fall
   through to the join) — the "early exit" bit TPM1102 keys on.
+* **With regions** (ISSUE 13) record, for every ``with`` statement, the
+  set of blocks its body occupies. A ``with`` opens a fresh block and
+  closes into a fresh block, so region membership is whole-block — the
+  lockset layer (:mod:`tpu_mpi_tests.analysis.locks`) maps each
+  statement's held-lock set straight off the blocks that contain it,
+  nested regions unioning naturally.
 
 Approximations (documented in README "Static analysis"): exception
 edges are not modeled — ``except`` handler bodies fork from the block
@@ -71,11 +77,21 @@ class Branch:
 
 
 @dataclass
+class WithRegion:
+    """One ``with`` statement: its ``withitem`` context expressions and
+    the block indices its body occupies (nested compounds included)."""
+
+    node: ast.With | ast.AsyncWith
+    blocks: frozenset[int]
+
+
+@dataclass
 class CFG:
     entry: Block
     exit: Block
     blocks: list[Block] = field(default_factory=list)
     branches: list[Branch] = field(default_factory=list)
+    with_regions: list[WithRegion] = field(default_factory=list)
 
     def reachable(self, start: Block) -> list[Block]:
         """Blocks reachable from ``start`` (inclusive) following FORWARD
@@ -105,6 +121,7 @@ class _Builder:
         self.cur: Block | None = self._new()
         self.entry = self.cur
         self.branches: list[Branch] = []
+        self.with_regions: list[WithRegion] = []
         # innermost-first (header, after) targets for continue/break
         self.loops: list[tuple[Block, Block]] = []
 
@@ -136,10 +153,7 @@ class _Builder:
         elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
             self._loop(s)
         elif isinstance(s, (ast.With, ast.AsyncWith)):
-            cur = self._live()
-            for item in s.items:
-                cur.units.append(item.context_expr)
-            self.build_stmts(s.body)
+            self._with(s)
         elif isinstance(s, ast.Try) or (
             hasattr(ast, "TryStar") and isinstance(s, ast.TryStar)
         ):
@@ -171,6 +185,32 @@ class _Builder:
             self._live().units.append(s)
 
     # -- compound statements ------------------------------------------------
+
+    def _with(self, s: ast.With | ast.AsyncWith) -> None:
+        # the context expressions evaluate BEFORE the region is entered
+        # (a lock is not yet held while its name is being resolved)
+        cur = self._live()
+        for item in s.items:
+            cur.units.append(item.context_expr)
+        body_entry = self._new()
+        self._edge(cur, body_entry)
+        self.cur = body_entry
+        start = body_entry.idx
+        self.build_stmts(s.body)
+        # every block registered while the body was being built belongs
+        # to the region — nested compounds (branches, loops, inner
+        # withs) allocate theirs inside this window, so membership is
+        # closed under nesting by construction
+        region = frozenset(range(start, len(self.blocks)))
+        if self.cur is not None:
+            # a body that fell through continues after the with; a body
+            # that terminated (return/raise) must leave flow DEAD, or a
+            # with-wrapped early exit would read as falling through and
+            # the TPM1102/TPM1301 exit bits would miss it
+            after = self._new()
+            self._edge(self.cur, after)
+            self.cur = after
+        self.with_regions.append(WithRegion(node=s, blocks=region))
 
     def _if(self, s: ast.If) -> None:
         cond = self._live()
@@ -288,4 +328,4 @@ def build(node: ast.AST) -> CFG:
     if b.cur is not None:  # implicit return at the end of the body
         b._edge(b.cur, b.exit)
     return CFG(entry=b.entry, exit=b.exit, blocks=b.blocks,
-               branches=b.branches)
+               branches=b.branches, with_regions=b.with_regions)

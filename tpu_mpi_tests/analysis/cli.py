@@ -43,6 +43,14 @@ rule families (stable codes; see README "Static analysis" for the table):
                           (TPM1401) / kinds consumed but never emitted
                           (TPM1402); RECORDS.md is the generated
                           schema table (`make records`)
+  TPM16xx lockset races   may-happen-in-parallel lockset analysis over
+                          the threading plane: TPM1601 disjoint-lockset
+                          data race, TPM1602 non-reentrant-lock
+                          self-deadlock through the call graph, TPM1603
+                          hook-slot rebind without the arm/disarm
+                          idiom. TPM601 is its single-file fallback:
+                          it fires only where thread-entry discovery
+                          resolved nothing.
 
 suppress one finding on its line (unused suppressions are themselves
 findings):   x = jnp.asarray(2.0)  # tpumt: ignore[TPM301]
@@ -118,9 +126,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--no-cache", action="store_true",
                     help="disable the content-hash analysis cache for "
                     "this run")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parallelize per-file fact extraction over N "
+                    "worker processes (default 1; warm-cache runs "
+                    "re-parse zero files regardless of N)")
     ap.add_argument("--stats", action="store_true",
-                    help="print files/analyzed/cache-hit counts to "
-                    "stderr")
+                    help="print files/analyzed/cache-hit counts plus "
+                    "wall time and files/proc to stderr")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every registered code and exit")
     args = ap.parse_args(argv)
@@ -146,6 +158,8 @@ def main(argv: list[str] | None = None) -> int:
             )
 
             cache_path = default_cache_path()
+    if args.jobs < 1:
+        ap.error("--jobs must be >= 1")
     stats: dict = {}
     findings = lint_paths(
         args.paths,
@@ -154,12 +168,18 @@ def main(argv: list[str] | None = None) -> int:
         entry_modules=entry_modules,
         cache_path=cache_path,
         stats=stats,
+        jobs=args.jobs,
     )
     if args.stats:
+        analyzed = stats.get("analyzed", 0)
+        jobs = stats.get("jobs", 1)
+        per_proc = analyzed / jobs if jobs else analyzed
         print(
             f"tpumt-lint stats: files={stats.get('files', 0)} "
-            f"analyzed={stats.get('analyzed', 0)} "
+            f"analyzed={analyzed} "
             f"cache_hits={stats.get('cache_hits', 0)} "
+            f"seconds={stats.get('seconds', 0.0):.3f} "
+            f"jobs={jobs} files_per_proc={per_proc:.1f} "
             f"cache={cache_path or 'off'}",
             file=sys.stderr,
         )

@@ -27,6 +27,7 @@ import ast
 import hashlib
 import io
 import re
+import time
 import tokenize
 from dataclasses import dataclass
 from pathlib import Path
@@ -205,6 +206,20 @@ def is_device_call(ctx: "FileContext", call: ast.Call,
         return True
     origin = ctx.imports.origin(parts[0])
     return bool(origin and origin.startswith(DEVICE_ORIGINS))
+
+
+def own_nodes(root: ast.AST) -> Iterator[ast.AST]:
+    """In-order walk of ``root``'s subtree, skipping nested function and
+    lambda bodies — "own scope": what executes when this code object
+    runs, not what it merely defines. Shared by the facts extractor
+    (program.py) and the lockset layer (locks.py) — one definition, so
+    their scope semantics cannot diverge."""
+    for child in ast.iter_child_nodes(root):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        yield from own_nodes(child)
 
 
 def stmt_lists(tree: ast.AST) -> Iterator[list[ast.stmt]]:
@@ -466,6 +481,52 @@ def rule_table() -> list[tuple[str, str]]:
     return rows
 
 
+def analyze_file(path: str, source: str | None = None,
+                 digest: str | None = None) -> dict:
+    """One file's full file-scope analysis as a serializable dict —
+    the unit of work ``--jobs`` farms out to worker processes (and the
+    sequential path runs inline, passing the ``source``/``digest`` the
+    cache lookup already paid for). Shape:
+
+    ``{"path", "digest", "entry": {findings, facts, supps, malformed}}``
+    on success, or ``{"path", "error": [line, message]}`` when the file
+    cannot be read or parsed (the caller turns that into TPM902)."""
+    from tpu_mpi_tests.analysis.program import extract_facts
+
+    if source is None:
+        try:
+            source = Path(path).read_text()
+        except OSError as e:
+            return {"path": path, "unreadable": True,
+                    "error": [1, f"cannot parse: {e}"]}
+    if digest is None:
+        digest = hashlib.sha256(source.encode()).hexdigest()
+    try:
+        tree = ast.parse(source, filename=path)
+    except (SyntaxError, ValueError) as e:
+        line = getattr(e, "lineno", None) or 1
+        return {"path": path, "error": [line, f"cannot parse: {e}"]}
+    ctx = FileContext(path, source, tree)
+    findings: list[dict] = []
+    for rule in all_rules():
+        if rule.scope != "file":
+            continue
+        for line, col, code, msg in rule.check(ctx):
+            findings.append(Finding(path, line, col, code,
+                                    msg).as_dict())
+    facts = extract_facts(ctx)
+    supps, malformed = collect_suppressions(source)
+    return {
+        "path": path, "digest": digest,
+        "entry": {
+            "findings": findings,
+            "facts": facts,
+            "supps": [s.as_dict() for s in supps],
+            "malformed": malformed,
+        },
+    }
+
+
 def iter_files(paths: Iterable[str]) -> Iterator[Path]:
     seen: set[Path] = set()
     for p in paths:
@@ -485,6 +546,22 @@ def iter_files(paths: Iterable[str]) -> Iterator[Path]:
                 yield path
 
 
+def _run_pool(miss_paths: list[str], jobs: int) -> list[dict]:
+    """``analyze_file`` over a worker pool; degrades to sequential when
+    the platform cannot fork/spawn (the lint must never fail because
+    its parallelism did)."""
+    try:
+        import multiprocessing
+
+        with multiprocessing.Pool(jobs) as pool:
+            return pool.map(analyze_file, miss_paths)
+    except (ImportError, OSError, RuntimeError):
+        # RuntimeError: the spawn start method inside an unguarded
+        # __main__ (Windows/macOS library callers) refuses to
+        # bootstrap — degrade to sequential, never fail the lint
+        return [analyze_file(p) for p in miss_paths]
+
+
 def lint_paths(
     paths: Iterable[str],
     select: Iterable[str] | None = None,
@@ -492,6 +569,7 @@ def lint_paths(
     entry_modules: dict[str, str] | None = None,
     cache_path: str | None = None,
     stats: dict | None = None,
+    jobs: int = 1,
 ) -> list[Finding]:
     """Lint files/directories; returns sorted, suppression-filtered
     findings (unused/malformed suppressions included as findings).
@@ -500,10 +578,18 @@ def lint_paths(
     (:mod:`tpu_mpi_tests.analysis.lintcache`): unchanged files replay
     their cached file-scope findings + facts instead of re-parsing. The
     default (None) is uncached — library callers and tests stay
-    hermetic; the CLI opts in. ``stats``, when a dict, receives
-    ``files``/``analyzed``/``cache_hits`` counts."""
-    from tpu_mpi_tests.analysis.program import extract_facts
+    hermetic; the CLI opts in.
 
+    ``jobs`` parallelizes per-file analysis (parse + file rules + fact
+    extraction) over a ``multiprocessing`` pool — the facts were made
+    JSON-serializable for the cache, which is exactly what lets them
+    cross a process boundary. Cache hits are resolved in the parent
+    BEFORE dispatch, so a warm run re-parses zero files regardless of
+    ``jobs``; the project pass always runs in the parent.
+
+    ``stats``, when a dict, receives ``files``/``analyzed``/
+    ``cache_hits``/``seconds``/``jobs`` counts."""
+    t0 = time.monotonic()
     code_filter = CodeFilter(select, ignore)
     raw: set[Finding] = set()
     facts_list: list[dict] = []
@@ -529,58 +615,67 @@ def lint_paths(
             raw.add(Finding(str(p), 1, 0, "TPM902",
                             "not a python file"))
 
-    rules = all_rules()
-    file_rules = [r for r in rules if r.scope == "file"]
-
+    misses: list[str] = []
+    # cache-miss sources the lookup already read, reused by the
+    # sequential path (pool workers re-read — sending sources over the
+    # pipe would cost more than the read)
+    miss_src: dict[str, tuple[str, str]] = {}
     for f in iter_files(paths):
         path = str(f)
-        try:
-            source = f.read_text()
-        except OSError as e:
-            raw.add(Finding(path, 1, 0, "TPM902", f"cannot parse: {e}"))
-            continue
         n_files += 1
-        digest = hashlib.sha256(source.encode()).hexdigest()
-
-        entry = cache.get(path, digest) if cache else None
-        if entry is not None:
-            replay = replay_cache_entry(entry, path)
-            if replay is not None:
-                n_hits += 1
-                cached_findings, facts, supps, malformed = replay
-                raw.update(cached_findings)
-                facts_list.append(facts)
-                suppressions[path] = (supps, malformed)
+        if cache is not None:
+            try:
+                source = f.read_text()
+            except OSError as e:
+                n_files -= 1
+                raw.add(Finding(path, 1, 0, "TPM902",
+                                f"cannot parse: {e}"))
                 continue
+            digest = hashlib.sha256(source.encode()).hexdigest()
+            entry = cache.get(path, digest)
+            if entry is not None:
+                replay = replay_cache_entry(entry, path)
+                if replay is not None:
+                    n_hits += 1
+                    cached_findings, facts, supps, malformed = replay
+                    raw.update(cached_findings)
+                    facts_list.append(facts)
+                    suppressions[path] = (supps, malformed)
+                    continue
+            miss_src[path] = (source, digest)
+        misses.append(path)
 
-        try:
-            tree = ast.parse(source, filename=path)
-        except (SyntaxError, ValueError) as e:
-            line = getattr(e, "lineno", None) or 1
-            raw.add(Finding(path, line, 0, "TPM902",
-                            f"cannot parse: {e}"))
+    if jobs > 1 and len(misses) > 1:
+        results = _run_pool(misses, jobs)
+    else:
+        results = [analyze_file(p, *miss_src.get(p, (None, None)))
+                   for p in misses]
+
+    for res in results:
+        path = res["path"]
+        if "error" in res:
+            if res.get("unreadable"):
+                # match the cached path (and the pre-jobs engine):
+                # unreadable files never count toward `files`
+                n_files -= 1
+            line, msg = res["error"]
+            raw.add(Finding(path, int(line), 0, "TPM902", msg))
             continue
         n_analyzed += 1
-        ctx = FileContext(path, source, tree)
-        file_findings: list[Finding] = []
-        for rule in file_rules:
-            for line, col, code, msg in rule.check(ctx):
-                file_findings.append(Finding(ctx.path, line, col, code, msg))
-        facts = extract_facts(ctx)
-        supps, malformed = collect_suppressions(source)
-        raw.update(file_findings)
-        facts_list.append(facts)
-        suppressions[path] = (supps, malformed)
+        entry = res["entry"]
+        raw.update(
+            Finding(d["path"], int(d["line"]), int(d["col"]),
+                    d["code"], d["message"])
+            for d in entry["findings"]
+        )
+        facts_list.append(entry["facts"])
+        supps = [Suppression.from_dict(s) for s in entry["supps"]]
+        suppressions[path] = (supps, list(entry["malformed"]))
         if cache is not None:
-            cache.put(path, digest, {
-                "findings": [x.as_dict() for x in file_findings],
-                "facts": facts,
-                "supps": [s.as_dict() for s in supps],
-                "malformed": malformed,
-            })
+            cache.put(path, res["digest"], entry)
 
     proj = ProjectContext(facts_list, entry_modules or DEFAULT_ENTRY_MODULES)
-    for rule in rules:
+    for rule in all_rules():
         if rule.scope != "project":
             continue
         for path, line, col, code, msg in rule.check_project(proj):
@@ -621,6 +716,8 @@ def lint_paths(
         cache.save()
     if stats is not None:
         stats.update(files=n_files, analyzed=n_analyzed,
-                     cache_hits=n_hits)
+                     cache_hits=n_hits,
+                     seconds=round(time.monotonic() - t0, 3),
+                     jobs=jobs)
     findings.sort()
     return findings

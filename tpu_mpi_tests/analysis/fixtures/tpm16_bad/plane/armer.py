@@ -1,0 +1,16 @@
+"""TPM1603 bad: the slot is rebound to a live callable with no
+``= None`` disarm anywhere in this file — a reader sees the stale hook
+forever (the chaos layer's arm()/disarm() pairing is the sanctioned
+idiom)."""
+
+from plane import slots
+
+
+def install(tracer):
+    slots._TRACE_HOOK = _make(tracer)
+
+
+def _make(tracer):
+    def hook(op):
+        tracer.append(op)
+    return hook

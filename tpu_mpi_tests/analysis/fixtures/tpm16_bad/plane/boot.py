@@ -1,0 +1,13 @@
+"""The thread entry lives here, a file away from the hazard: the
+whole-program pass must see ``r.poll`` escape into the Timer and tag
+``Recorder.poll`` (and everything it calls) as a concurrent root."""
+
+import threading
+
+from plane.recorder import Recorder
+
+
+def launch(path):
+    r = Recorder(path)
+    threading.Timer(1.0, r.poll).start()
+    return r

@@ -1,0 +1,23 @@
+"""TPM1601 bad: ``record`` writes the handle under the lock, but the
+Timer thread (armed cross-file in ``boot.py``) reaches the same write
+through ``poll`` with NO lock — the caller-lockset intersection is
+empty, so the shared write is unprotected (the watchdog JSONL
+interleave shape, one helper down)."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self, path):
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+
+    def record(self, line):
+        with self._lock:
+            self._append(line)
+
+    def _append(self, line):
+        self._f.write(line + "\n")
+
+    def poll(self):
+        self._append("poll")

@@ -1,0 +1,21 @@
+"""TPM1602 bad: ``bump`` calls a helper while holding the
+non-reentrant lock, and the helper re-acquires it — guaranteed
+self-deadlock on the first call (the attach_metrics
+observe-inside-the-lock shape)."""
+
+import threading
+
+
+class Gauges:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals = {}
+
+    def bump(self, key):
+        with self._lock:
+            self._vals[key] = self._vals.get(key, 0) + 1
+            self._flush_locked()
+
+    def _flush_locked(self):
+        with self._lock:
+            self._vals.clear()

@@ -1,0 +1,11 @@
+"""The hook slot and its reader: ``fire`` snapshots the slot on the
+hot path, so whatever ``armer`` installs stays live until un-installed.
+"""
+
+_TRACE_HOOK = None
+
+
+def fire(op):
+    hook = _TRACE_HOOK
+    if hook is not None:
+        hook(op)

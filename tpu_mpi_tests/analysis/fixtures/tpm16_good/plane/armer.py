@@ -1,0 +1,18 @@
+"""TPM1603 good: the arm/disarm idiom — install() rebinds the slot,
+uninstall() puts ``None`` back, both in the same layer."""
+
+from plane import slots
+
+
+def install(tracer):
+    slots._TRACE_HOOK = _make(tracer)
+
+
+def uninstall():
+    slots._TRACE_HOOK = None
+
+
+def _make(tracer):
+    def hook(op):
+        tracer.append(op)
+    return hook

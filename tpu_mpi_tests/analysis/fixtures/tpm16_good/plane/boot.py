@@ -1,0 +1,12 @@
+"""Same thread entry as the bad tree — the fix is on the lock side,
+not the spawn side."""
+
+import threading
+
+from plane.recorder import Recorder
+
+
+def launch(path):
+    r = Recorder(path)
+    threading.Timer(1.0, r.poll).start()
+    return r

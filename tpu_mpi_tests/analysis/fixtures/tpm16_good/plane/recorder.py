@@ -1,0 +1,22 @@
+"""TPM1601 good: EVERY path into the shared write holds the lock —
+the Timer-side ``poll`` takes it too, so the caller-lockset
+intersection keeps the helper's write protected."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self, path):
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+
+    def record(self, line):
+        with self._lock:
+            self._append(line)
+
+    def _append(self, line):
+        self._f.write(line + "\n")
+
+    def poll(self):
+        with self._lock:
+            self._append("poll")

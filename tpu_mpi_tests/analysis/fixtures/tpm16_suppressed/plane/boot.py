@@ -1,0 +1,11 @@
+"""Same thread entry as the bad tree."""
+
+import threading
+
+from plane.recorder import Recorder
+
+
+def launch(path):
+    r = Recorder(path)
+    threading.Timer(1.0, r.poll).start()
+    return r

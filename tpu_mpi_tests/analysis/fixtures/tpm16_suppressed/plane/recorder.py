@@ -1,0 +1,21 @@
+"""TPM1601 suppressed: same shape as the bad tree, silenced with a
+why-comment — the stand-in for a sanctioned ordering argument."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self, path):
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+
+    def record(self, line):
+        with self._lock:
+            self._append(line)
+
+    def _append(self, line):
+        # pretend-benign: the timer is cancelled before record() runs
+        self._f.write(line + "\n")  # tpumt: ignore[TPM1601]
+
+    def poll(self):
+        self._append("poll")

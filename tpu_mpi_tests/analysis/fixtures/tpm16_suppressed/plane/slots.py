@@ -1,0 +1,9 @@
+"""The hook slot and its reader (identical to the bad tree's)."""
+
+_TRACE_HOOK = None
+
+
+def fire(op):
+    hook = _TRACE_HOOK
+    if hook is not None:
+        hook(op)

@@ -31,7 +31,12 @@ import os
 import tempfile
 from pathlib import Path
 
-CACHE_VERSION = 1
+#: bumped to 2 in ISSUE 13: the facts schema grew the threading-plane
+#: keys (``races`` + per-function ``locks``) — a version-1 cache would
+#: replay facts the project pass cannot judge. The engine salt would
+#: catch this too (the analysis sources changed), but the version is
+#: the explicit contract for the schema shape itself.
+CACHE_VERSION = 2
 
 
 def default_cache_path() -> str:

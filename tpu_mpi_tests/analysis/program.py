@@ -64,6 +64,7 @@ from tpu_mpi_tests.analysis.core import (
     device_callables,
     is_device_call,
     last_attr,
+    own_nodes as _own_nodes,
     stmt_lists,
     walk_calls,
 )
@@ -171,39 +172,37 @@ _MAX_DEPTH = 16  # tpumt: ignore[TPM701]
 # small walkers
 
 
-def _own_nodes(root: ast.AST) -> Iterator[ast.AST]:
-    """In-order walk of ``root``'s subtree, skipping nested function and
-    lambda bodies — "own scope": what executes when this code object
-    runs, not what it merely defines."""
-    for child in ast.iter_child_nodes(root):
-        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-            continue
-        yield child
-        yield from _own_nodes(child)
+def _walk_functions_cls(
+    tree: ast.Module,
+) -> list[tuple[str, ast.AST, str]]:
+    """``(qualname, node, enclosing_class_qual)`` for every def, in
+    document order — nested defs and methods get dotted qualnames
+    (``outer.inner``, ``Cls.meth``); the class qual is ``""`` for
+    plain/nested functions (the lockset layer needs to know which
+    ``self`` an access belongs to)."""
+    out: list[tuple[str, ast.AST, str]] = []
+
+    def visit(node: ast.AST, prefix: str, cls: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child, cls))
+                visit(child, q + ".", "")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.",
+                      f"{prefix}{child.name}")
+            else:
+                visit(child, prefix, cls)
+
+    visit(tree, "", "")
+    return out
 
 
 def _walk_functions(
     tree: ast.Module,
 ) -> list[tuple[str, ast.AST]]:
-    """``(qualname, node)`` for every def, in document order — nested
-    defs and methods get dotted qualnames (``outer.inner``,
-    ``Cls.meth``)."""
-    out: list[tuple[str, ast.AST]] = []
-
-    def visit(node: ast.AST, prefix: str) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                q = f"{prefix}{child.name}"
-                out.append((q, child))
-                visit(child, q + ".")
-            elif isinstance(child, ast.ClassDef):
-                visit(child, f"{prefix}{child.name}.")
-            else:
-                visit(child, prefix)
-
-    visit(tree, "")
-    return out
+    """``(qualname, node)`` for every def, in document order."""
+    return [(q, n) for q, n, _cls in _walk_functions_cls(tree)]
 
 
 def canon_target(ctx: FileContext, func: ast.AST) -> str | None:
@@ -1255,14 +1254,26 @@ def extract_facts(ctx: FileContext) -> dict:
     """The file's whole-program facts record — pure data, JSON-stable
     (cold extraction and a cache round-trip produce identical project
     findings)."""
+    from tpu_mpi_tests.analysis.locks import extract_race_facts
+
     local_device = device_callables(ctx)
     axis_bound, axis_uses = _axis_facts(ctx)
     rec_produced, rec_stamps = _record_producer_facts(ctx)
-    # one CFG per function, shared by the rank-branch and the
-    # record-consumer passes (they walk the same function list)
-    functions = _walk_functions(ctx.tree)
+    # one CFG per function, shared by the rank-branch, record-consumer,
+    # and lockset passes (they walk the same function list)
+    functions_cls = _walk_functions_cls(ctx.tree)
     graphs = {id(node): cfg_mod.build(node)
-              for _qual, node in functions}
+              for _qual, node, _cls in functions_cls}
+    races, fn_locks = extract_race_facts(
+        ctx, functions_cls, graphs,
+        resolve=lambda func: canon_target(ctx, func),
+    )
+    out_functions = []
+    for qual, node, _cls in functions_cls:
+        fn = _function_facts(ctx, qual, node, local_device,
+                             graphs[id(node)])
+        fn["locks"] = fn_locks.get(id(node), {})
+        out_functions.append(fn)
     return {
         "path": ctx.path,
         "module": ctx.module,
@@ -1274,11 +1285,8 @@ def extract_facts(ctx: FileContext) -> dict:
         "rec_produced": rec_produced,
         "rec_stamps": rec_stamps,
         "rec_consumed": _record_consumer_facts(ctx, graphs),
-        "functions": [
-            _function_facts(ctx, qual, node, local_device,
-                            graphs[id(node)])
-            for qual, node in functions
-        ],
+        "races": races,
+        "functions": out_functions,
     }
 
 

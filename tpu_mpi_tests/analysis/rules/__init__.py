@@ -6,8 +6,10 @@ comments stay meaningful across releases. File-scope rules see one
 parsed file; project-scope rules see the whole linted program as
 serialized facts (``analysis/program.py``) — the ISSUE-10 families
 (TPM11xx/TPM12xx), the interprocedural upgrades (TPM102/TPM502/
-TPM802), and the ISSUE-12 flow-sensitive families (TPM1102 early-exit
-divergence, TPM1301 broadcast-consistency, TPM14xx record-contract)
+TPM802), the ISSUE-12 flow-sensitive families (TPM1102 early-exit
+divergence, TPM1301 broadcast-consistency, TPM14xx record-contract),
+and the ISSUE-13 lockset concurrency layer (TPM16xx races/deadlocks/
+hook-slot rebinds, with TPM601 demoted to its single-file fallback)
 all live there.
 """
 
@@ -29,6 +31,7 @@ from tpu_mpi_tests.analysis.rules.early_exit_divergence import (
 )
 from tpu_mpi_tests.analysis.rules.concurrency import UnlockedSharedWrite
 from tpu_mpi_tests.analysis.rules.donation_safety import DonationSafety
+from tpu_mpi_tests.analysis.rules.races import LocksetRaces
 from tpu_mpi_tests.analysis.rules.import_hygiene import ImportHygiene
 from tpu_mpi_tests.analysis.rules.overlap_regions import (
     EscapedAsyncHandle,
@@ -56,6 +59,7 @@ ALL_RULES = [
     AxisConsistency(),
     AxisProgramConsistency(),
     UnlockedSharedWrite(),
+    LocksetRaces(),
     ScheduleConstants(),
     OverlapRegionSync(),
     EscapedAsyncHandle(),

@@ -1,131 +1,64 @@
-"""TPM6xx — cross-thread file-handle discipline.
+"""TPM6xx — cross-thread file-handle discipline (single-file fallback).
 
 The bug class: the watchdog fires from a ``threading.Timer`` thread and
 used to write its timeline record through the same JSONL handle the main
 thread's spans stream to; an interleaved ``json.dump`` (many small
 writes) corrupted both lines (fixed in PR 2 — ``Reporter.jsonl`` is now
-single-write under a lock). The rule: in any file that arms a
-``threading.Timer``/``Thread``, a ``.write()`` on a shared-looking
-handle (an attribute, or a name bound from ``open()``) must happen
-inside a ``with <lock>:`` block. ``sys.stdout``/``sys.stderr`` writes
-are exempt (line-buffered streams the hang-dump path deliberately
-uses).
+single-write under a lock).
+
+ISSUE 13 demoted this family: the flow- and lock-sensitive TPM16xx
+analysis (``rules/races.py``) owns every file whose thread entries it
+can resolve — there the lexical "a write without a lock in a file that
+arms a Timer" heuristic would double-report (or contradict) the
+lockset verdict. TPM601 now fires ONLY for files where thread-entry
+discovery resolved *nothing* (a dynamic spawn target like
+``Timer(s, callbacks[i])`` or an untyped/ambiguous bound method, no
+handler classes) — the whole-program engine is blind there, and the
+old heuristic is strictly better than silence. Resolution is judged at
+PROJECT scope with the same machinery the race rule uses (a captured
+``?meth:`` ref that no unique project method matches resolves to
+nothing), and test modules always keep the lexical rule: the lockset
+families exempt them, so the fallback is all the coverage they get.
+The lexical detection itself lives in
+:func:`tpu_mpi_tests.analysis.locks.lexical_tpm601` and is cached as a
+file fact, so warm runs replay it without re-parsing.
 """
 
 from __future__ import annotations
 
-import ast
 from typing import Iterator
 
-from tpu_mpi_tests.analysis.core import FileContext, attr_parts
-from tpu_mpi_tests.analysis.rules import _util
-
-THREAD_SPAWNS = {"threading.Timer", "threading.Thread"}
-LOCK_FACTORIES = {"threading.Lock", "threading.RLock"}
-EXEMPT_PARTS = {"stdout", "stderr", "stream", "sys"}
-
-
-def _dotted(node: ast.AST) -> str | None:
-    parts = attr_parts(node)
-    return ".".join(parts) if parts else None
+from tpu_mpi_tests.analysis.core import ProjectContext
 
 
 class UnlockedSharedWrite:
     name = "concurrency"
-    scope = "file"
+    scope = "project"
     codes = {
         "TPM601": "write() on a shared handle in a file that arms a "
-                  "threading.Timer/Thread, without holding a lock",
+                  "threading.Timer/Thread, without holding a lock "
+                  "(fallback: fires only where TPM16xx thread-entry "
+                  "discovery resolved nothing)",
     }
 
-    def check(self, ctx: FileContext) -> Iterator[tuple]:
-        spawns = False
-        locks: set[str] = set()
-        open_names: set[str] = set()
-        for n in ast.walk(ctx.tree):
-            if isinstance(n, ast.Call):
-                resolved = ctx.imports.resolve(n.func) or ""
-                if resolved in THREAD_SPAWNS:
-                    spawns = True
-            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
-                resolved = ctx.imports.resolve(n.value.func) or ""
-                for t in n.targets:
-                    name = _dotted(t)
-                    if not name:
-                        continue
-                    if resolved in LOCK_FACTORIES:
-                        locks.add(name)
-                    elif resolved in ("open", "io.open"):
-                        open_names.add(name)
-        if not spawns:
-            return
-        yield from self._walk(ctx, ctx.tree.body, locks, open_names,
-                              held=False)
+    def check_project(self, proj: ProjectContext) -> Iterator[tuple]:
+        from tpu_mpi_tests.analysis.rules.races import _Program
 
-    def _is_lockish(self, expr: ast.AST, locks: set[str]) -> bool:
-        name = _dotted(expr)
-        if not name:
-            return False
-        last = name.rsplit(".", 1)[-1].lower()
-        return name in locks or "lock" in last
-
-    def _walk(self, ctx, stmts, locks, open_names, held):
-        for stmt in stmts:
-            if isinstance(stmt, (ast.With, ast.AsyncWith)):
-                inner_held = held or any(
-                    self._is_lockish(item.context_expr, locks)
-                    for item in stmt.items
-                )
-                yield from self._walk(ctx, stmt.body, locks, open_names,
-                                      inner_held)
-                continue
-            # expressions directly in this statement (not nested bodies)
-            for call in self._own_calls(stmt):
-                yield from self._check_write(call, open_names, held)
-            for field in ("body", "orelse", "finalbody"):
-                sub = getattr(stmt, field, None)
-                if isinstance(sub, list):
-                    yield from self._walk(ctx, sub, locks, open_names,
-                                          held)
-            for h in getattr(stmt, "handlers", ()):
-                yield from self._walk(ctx, h.body, locks, open_names,
-                                      held)
-
-    @staticmethod
-    def _own_calls(stmt):
-        """Calls in the statement's header/expressions, excluding nested
-        statement bodies (those get their own lock context)."""
-        nested: set[int] = set()
-        for field in ("body", "orelse", "finalbody"):
-            for sub in getattr(stmt, field, None) or ():
-                for n in ast.walk(sub):
-                    nested.add(id(n))
-        for h in getattr(stmt, "handlers", ()):
-            for sub in h.body:
-                for n in ast.walk(sub):
-                    nested.add(id(n))
-        for n in ast.walk(stmt):
-            if isinstance(n, ast.Call) and id(n) not in nested:
-                yield n
-
-    def _check_write(self, call, open_names, held):
-        func = call.func
-        if not (isinstance(func, ast.Attribute) and func.attr == "write"):
-            return
-        recv = func.value
-        parts = attr_parts(recv)
-        if parts and (parts[0] == "sys"
-                      or any(p in EXEMPT_PARTS for p in parts)):
-            return
-        shared = isinstance(recv, ast.Attribute) or (
-            isinstance(recv, ast.Name) and recv.id in open_names
-        )
-        if shared and not held:
-            name = ".".join(parts) if parts else "<handle>"
-            yield (
-                call.lineno, call.col_offset, "TPM601",
-                f"'{name}.write()' in a module that arms a "
-                f"threading.Timer/Thread — concurrent writes interleave "
-                f"records (the watchdog JSONL bug class); serialize one "
-                f"write per record under `with <lock>:`",
-            )
+        prog = _Program(proj)
+        modeled: set[str] = set()
+        for ff in prog.files:
+            races = ff["races"]
+            ok = bool(races["handlers"])
+            if not ok:
+                for _kind, ref, _line in races["spawns"]:
+                    if ref and prog.resolve(ref, ff["module"]):
+                        ok = True
+                        break
+            if ok:
+                modeled.add(ff["path"])
+        for ff in proj.facts:
+            races = ff.get("races")
+            if not races or ff["path"] in modeled:
+                continue  # the lockset engine models this file
+            for line, col, msg in races.get("tpm601", ()):
+                yield (ff["path"], line, col, "TPM601", msg)

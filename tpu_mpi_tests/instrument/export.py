@@ -123,9 +123,11 @@ class MetricsExporter:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 # wfile is this connection's own socket handle — one
-                # handler instance per request, never shared across the
-                # exporter/heartbeat threads TPM601 guards against
-                self.wfile.write(body)  # tpumt: ignore[TPM601]
+                # handler instance per request, so the per-connection
+                # threads the race detector pairs here never share it
+                # (the ISSUE-13 sanctioned per-connection-wfile case;
+                # formerly the same suppression under lexical TPM601)
+                self.wfile.write(body)  # tpumt: ignore[TPM1601]
 
             def log_message(self, *args):  # scrapes must not spam stdout
                 pass
@@ -166,7 +168,10 @@ class Heartbeat:
 
     def _record(self, final: bool = False) -> dict:
         reg = self._registry
-        self._seq += 1
+        # GIL-atomic monotonic counter bump, and the only off-thread
+        # caller is stop(), which join()s the heartbeat thread BEFORE
+        # its final emit — ordered by happens-before, not by a lock
+        self._seq += 1  # tpumt: ignore[TPM1601]
         rec = {
             "kind": "health", "event": "heartbeat", "seq": self._seq,
             "t": reg.wall(),

@@ -215,7 +215,12 @@ class Reporter:
         the zero-new-call-sites contract of the live observability
         plane: whatever already flows to JSONL also updates the named
         series. A reporter without a registry pays one ``None`` check."""
-        self._metrics = registry
+        # attached during single-threaded reporter setup, BEFORE any
+        # live thread exists (heartbeat/memwatch start later in
+        # make_reporter/_arm_metrics); jsonl's unlocked read on a live
+        # thread sees either None or the final binding — never a torn
+        # value (attribute stores are atomic under the GIL)
+        self._metrics = registry  # tpumt: ignore[TPM1601]
 
     def attach_live(self, *stoppables):
         """Own live-plane components (heartbeat thread, metrics

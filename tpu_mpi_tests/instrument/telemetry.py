@@ -146,8 +146,15 @@ class Telemetry:
         self._counters: dict[str, list] = {}
 
     def enable(self, sink: Callable[[dict], None] | None = None) -> None:
-        self._sink = sink
-        self.enabled = True
+        # enable/disable run on the main thread while the watchdog's
+        # timer thread may be inside emit(); the races are deliberate
+        # best-effort teardown: attribute loads/stores are GIL-atomic,
+        # emit re-checks its snapshot, and a sink that disappears
+        # mid-emit is swallowed by emit's except — a lock here would
+        # put the hang-dump path behind a lock a wedged main thread
+        # might hold forever
+        self._sink = sink  # tpumt: ignore[TPM1601]
+        self.enabled = True  # tpumt: ignore[TPM1601]
 
     def disable(self) -> None:
         self.enabled = False

@@ -173,7 +173,11 @@ class IdleAwareWatchdog(Watchdog):
     def arm(self, phase: str | None = None) -> "IdleAwareWatchdog":
         """(Re)start the deadline for one active dispatch window."""
         if phase is not None:
-            self.phase = phase
+            # a firing timer reading phase mid-update can only mislabel
+            # its dump (a str rebind is GIL-atomic, never torn), and
+            # arm() cancels the old timer before starting the next —
+            # the label race is benign by design
+            self.phase = phase  # tpumt: ignore[TPM1601]
         self.cancel()
         return self.start()
 
