@@ -1,8 +1,8 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke overlap-smoke moe-smoke chaos-smoke live-smoke lint \
-	lint-smoke records records-check ci clean
+	serve-smoke overlap-smoke moe-smoke chaos-smoke live-smoke \
+	fleet-smoke lint lint-smoke records records-check ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -448,6 +448,125 @@ live-smoke:
 		/tmp/_tpumt_live.strag.jsonl --expect straggler:1
 	@echo "live-smoke OK: mid-run OpenMetrics + heartbeat trail + tpumt-top frame + online straggler conviction"
 
+# fleet-tuning smoke (README "Fleet tuning"): the ISSUE-14 closed loop,
+# end to end. Leg 1 — rank-0-swept, broadcast-applied multi-process
+# sweep: a REAL 2-process --tune daxpy run under the native launcher
+# must MEASURE (no multi-process skip note), with exactly one sweep
+# (rank 0 carries the per-candidate tune records; rank 1 none),
+# byte-identical tune_result records on both ranks, and one cache
+# writer; then `tpumt-tune pack` → `import` into a fresh cache → the
+# re-run is a pure tune_hit on BOTH ranks (the tune-once-ship-the-
+# schedule contract). Leg 2 — the online controller: a serve run whose
+# pre-seeded winner drifts (tpu/retune_demo.py) must latch tune_stale,
+# re-sweep between windows, emit a kind:"control" tune_swap, and pull
+# the post-swap SLO windows back inside the band — all asserted from
+# the JSONL — with tpumt-doctor exonerating the answered latch and the
+# CONTROL table rendering. Leg 3 — the same drift WITHOUT --retune
+# (live plane armed, controller off) must convict stale_schedule:0.
+fleet-smoke:
+	rm -f /tmp/_tpumt_fleet*
+	$(MAKE) -C native tpumt_run
+	env JAX_PLATFORMS=cpu ./native/tpumt_run -n 2 \
+		-o /tmp/_tpumt_fleet.rank -- \
+		python -m tpu_mpi_tests.workloads.daxpy --fake-devices 1 \
+		--n 262144 --iters 24 --tune \
+		--tune-cache /tmp/_tpumt_fleet.cache.json \
+		--jsonl /tmp/_tpumt_fleet.r1.jsonl
+	python -c "import json; \
+		recs = {r: [json.loads(l) for l in \
+			open(f'/tmp/_tpumt_fleet.r1.p{r}.jsonl')] for r in (0, 1)}; \
+		kinds = {r: [x.get('kind') for x in recs[r]] for r in (0, 1)}; \
+		assert kinds[0].count('tune') == 3, kinds[0]; \
+		assert kinds[1].count('tune') == 0, kinds[1]; \
+		res = {r: [x for x in recs[r] \
+			if x.get('kind') == 'tune_result'] for r in (0, 1)}; \
+		assert len(res[0]) == 1 and len(res[1]) == 1, res; \
+		assert all('note' not in x for x in res[0] + res[1]), \
+			'sweep must MEASURE, not skip'; \
+		strip = lambda x: {k: v for k, v in x.items() if k != 'rank'}; \
+		assert json.dumps(strip(res[0][0]), sort_keys=True) == \
+			json.dumps(strip(res[1][0]), sort_keys=True), res; \
+		cache = json.load(open('/tmp/_tpumt_fleet.cache.json')); \
+		assert len(cache['entries']) == 2, cache; \
+		print('fleet-smoke sweep OK: rank-0 swept, both ranks applied', \
+			res[0][0]['value'])"
+	python -m tpu_mpi_tests.tune.pack pack \
+		--cache /tmp/_tpumt_fleet.cache.json \
+		-o /tmp/_tpumt_fleet.pack.json
+	python -m tpu_mpi_tests.tune.pack import /tmp/_tpumt_fleet.pack.json \
+		--cache /tmp/_tpumt_fleet.fresh.json
+	env JAX_PLATFORMS=cpu ./native/tpumt_run -n 2 \
+		-o /tmp/_tpumt_fleet.r2rank -- \
+		python -m tpu_mpi_tests.workloads.daxpy --fake-devices 1 \
+		--n 262144 --iters 24 --tune \
+		--tune-cache /tmp/_tpumt_fleet.fresh.json \
+		--jsonl /tmp/_tpumt_fleet.r2.jsonl
+	python -c "import json; \
+		kinds = {r: [json.loads(l).get('kind') for l in \
+			open(f'/tmp/_tpumt_fleet.r2.p{r}.jsonl')] for r in (0, 1)}; \
+		assert all(kinds[r].count('tune_hit') == 1 and \
+			kinds[r].count('tune') == 0 and \
+			kinds[r].count('tune_result') == 0 for r in (0, 1)), kinds; \
+		print('fleet-smoke pack OK: import -> pure cache hits on both ranks')"
+	python -c "from tpu_mpi_tests.drivers._common import force_cpu_devices; \
+		force_cpu_devices(2); \
+		from tpu_mpi_tests.tune.cache import ScheduleCache; \
+		from tpu_mpi_tests.tune.fingerprint import device_fingerprint; \
+		c = ScheduleCache.load('/tmp/_tpumt_fleet.serve.cache.json'); \
+		c.store('daxpy/chunk', device_fingerprint(), 1); c.save()"
+	env JAX_PLATFORMS=cpu python -m tpu.retune_demo --drift-after=8 \
+		--fake-devices 2 --duration 6 --arrival closed --concurrency 1 \
+		--seed 5 --report-interval 1 --workloads daxpy:4096:float32 \
+		--telemetry --retune --batch-deadline 30 \
+		--tune-cache /tmp/_tpumt_fleet.serve.cache.json \
+		--jsonl /tmp/_tpumt_fleet.serve.jsonl
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_fleet.serve.jsonl')]; \
+		stale = [r for r in recs if r.get('kind') == 'health' \
+			and r.get('event') == 'tune_stale']; \
+		assert len(stale) == 1 and \
+			stale[0]['op'] == 'serve:daxpy:4096:float32', stale; \
+		sweeps = [r for r in recs if r.get('kind') == 'tune' \
+			and r.get('knob') == 'daxpy/chunk']; \
+		assert len(sweeps) == 3, sweeps; \
+		swap = [r for r in recs if r.get('kind') == 'control']; \
+		assert len(swap) == 1 and swap[0]['event'] == 'tune_swap', swap; \
+		s = swap[0]; \
+		assert s['old'] == 1 and s['new'] in (8, 32), s; \
+		assert s['resweep_s'] > 0 and s['sag_pct'] > 15, s; \
+		wins = [(r['t_end'], r['p50_ms']) for r in recs \
+			if r.get('kind') == 'serve' and r.get('event') == 'window']; \
+		pre = [p for t, p in wins if t <= s['t']]; \
+		post = [p for t, p in wins if t > s['t']]; \
+		assert pre and max(pre) > 20, (pre, 'induced sag must show'); \
+		assert len(post) >= 3 and all(p < 10 for p in post), \
+			(post, 'post-swap windows must be back inside the band'); \
+		print('fleet-smoke retune OK: stale -> resweep -> swap', \
+			s['old'], '->', s['new'], '-> p50', max(pre), '->', max(post))"
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_fleet.serve.jsonl | grep -q '^DOCTOR OK'
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_fleet.serve.jsonl > /tmp/_tpumt_fleet.report.txt
+	grep -q '^CONTROL tune_swap daxpy:4096:float32:' \
+		/tmp/_tpumt_fleet.report.txt
+	rm -f /tmp/_tpumt_fleet.serve.cache.json
+	python -c "from tpu_mpi_tests.drivers._common import force_cpu_devices; \
+		force_cpu_devices(2); \
+		from tpu_mpi_tests.tune.cache import ScheduleCache; \
+		from tpu_mpi_tests.tune.fingerprint import device_fingerprint; \
+		c = ScheduleCache.load('/tmp/_tpumt_fleet.serve.cache.json'); \
+		c.store('daxpy/chunk', device_fingerprint(), 1); c.save()"
+	env JAX_PLATFORMS=cpu python -m tpu.retune_demo --drift-after=8 \
+		--fake-devices 2 --duration 4 --arrival closed --concurrency 1 \
+		--seed 5 --report-interval 1 --workloads daxpy:4096:float32 \
+		--telemetry --metrics-port 0 \
+		--tune-cache /tmp/_tpumt_fleet.serve.cache.json \
+		--jsonl /tmp/_tpumt_fleet.noctl.jsonl > /dev/null
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_fleet.noctl.jsonl --expect stale_schedule:0
+	@echo "fleet-smoke OK: rank-0 fleet sweep + pack round-trip + closed-loop retune + stale_schedule conviction"
+
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused
 # suppressions are findings too, so stale ignores also fail here. The
@@ -563,10 +682,13 @@ lint-smoke:
 # observability smoke, the serving-pipeline smoke, the overlap-engine
 # smoke, the workload-spec pillar smoke, the chaos-verified diagnosis
 # smoke, the live-observability smoke (OpenMetrics endpoint + online
-# doctor), the lint self-clean gate, the lint-cache incrementality +
-# engine-salt smoke, and the RECORDS.md staleness gate
+# doctor), the fleet-tuning smoke (rank-0 2-process sweep + pack
+# round-trip + closed-loop retune), the lint self-clean gate, the
+# lint-cache incrementality + engine-salt smoke, and the RECORDS.md
+# staleness gate
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke \
-	moe-smoke chaos-smoke live-smoke lint lint-smoke records-check
+	moe-smoke chaos-smoke live-smoke fleet-smoke lint lint-smoke \
+	records-check
 
 clean:
 	$(MAKE) -C native clean

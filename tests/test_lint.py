@@ -1605,6 +1605,29 @@ def _copy_lint_tree(tmp_path):
     return roots
 
 
+def test_seeded_fleet_mutant_winner_broadcast_dropped(tmp_path):
+    """Mutation gate (ISSUE 14 acceptance): stripping the winner
+    broadcast from the fleet sweep — rank 0 keeps its locally-built
+    result record, every other rank's ``result`` stays the None
+    placeholder — is exactly the rank-divergence TPM1301 was built for,
+    convicted as the run's SOLE finding, anchored at the unbroadcast
+    read in sweep.py. The SHIPPED code routes the value through
+    ``fleet.bcast`` (a curated broadcast-class call) and lints clean —
+    the dogfood half of the contract is ``make lint`` / the self-clean
+    gate."""
+    roots = _copy_lint_tree(tmp_path)
+    sp = tmp_path / "tpu_mpi_tests" / "tune" / "sweep.py"
+    src = sp.read_text()
+    old = '    result = fleet.bcast(result, f"{knob}:result")\n'
+    assert old in src, "fleet sweep broadcast shape changed — update me"
+    sp.write_text(src.replace(old, ""))
+    findings = lint_paths(roots)
+    assert codes_of(findings) == ["TPM1301"], findings
+    f = findings[0]
+    assert f.path.endswith("sweep.py"), f
+    assert "result" in f.message, f
+
+
 def test_seeded_race_mutant_jsonl_lock_stripped(tmp_path):
     """Mutation gate (acceptance criterion): stripping ``with
     self._jsonl_lock:`` from Reporter.jsonl makes the handle write a

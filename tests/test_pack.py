@@ -1,0 +1,246 @@
+"""Portable schedule packs (ISSUE 14 tentpole b): pack → import →
+pure-hit resolution, merge conflict/provenance semantics, corrupted-pack
+degradation, the ``--tune-pack`` driver preload, and the ``tpumt-tune``
+no-jax login-node golden."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_mpi_tests.tune import pack as tp
+from tpu_mpi_tests.tune import registry as tr
+from tpu_mpi_tests.tune.cache import ScheduleCache
+from tpu_mpi_tests.tune.fingerprint import device_fingerprint, fingerprint
+from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry(monkeypatch):
+    monkeypatch.delenv("TPU_MPI_TUNE_CACHE", raising=False)
+    tr.deconfigure()
+    yield
+    tr.deconfigure()
+
+
+def _warm_cache(tmp_path, name="warm.json"):
+    """A cache with two swept-looking entries (full + device slots)."""
+    c = ScheduleCache.load(str(tmp_path / name))
+    c.store("demo/packed", fingerprint(dtype="float32", n=4096), 32,
+            seconds=0.5)
+    c.store("demo/packed", device_fingerprint(), 32, seconds=0.5)
+    c.save()
+    return str(tmp_path / name)
+
+
+# ------------------------------------------------------------- round trip
+
+
+def test_pack_import_fresh_cache_is_pure_hits(tmp_path):
+    """The fleet contract end to end in-process: pack a warmed cache,
+    import into a FRESH cache, and every resolution is a pure tune_hit
+    — zero sweeps, zero measurements."""
+    warm = _warm_cache(tmp_path)
+    pack_file = tmp_path / "sched.pack.json"
+    assert tp.main(["pack", "--cache", warm,
+                    "-o", str(pack_file)]) == 0
+    fresh = tmp_path / "fresh.json"
+    assert tp.main(["import", str(pack_file),
+                    "--cache", str(fresh)]) == 0
+
+    tr.configure(cache_path=str(fresh), enabled=True)
+    records = []
+    out = ensure_tuned(
+        "demo/packed", lambda c: pytest.fail("pure hit: no sweep"),
+        candidates=(1, 32), emit=records.append,
+        dtype="float32", n=4096,
+    )
+    assert out == 32
+    assert [r["kind"] for r in records] == ["tune_hit"]
+
+
+def test_pack_carries_provenance(tmp_path):
+    warm = _warm_cache(tmp_path)
+    pack_file = tmp_path / "p.json"
+    tp.main(["pack", "--cache", warm, "-o", str(pack_file)])
+    doc = json.loads(pack_file.read_text())
+    assert doc["kind"] == "tpumt-tune-pack" and doc["version"] == 1
+    prov = doc["provenance"]
+    assert prov["entries"] == 2
+    assert prov["knobs"] == ["demo/packed"]
+    # device identity read back out of the fingerprints the sweeps
+    # stored under — platform/device/world/procs all present
+    assert prov["devices"] and prov["platforms"]
+    assert prov["worlds"] and prov["procs"]
+    assert "engine" in doc
+
+
+def test_pack_missing_cache_is_an_error(tmp_path, capsys):
+    assert tp.main(["pack", "--cache", str(tmp_path / "nope.json"),
+                    "-o", str(tmp_path / "o.json")]) == 2
+
+
+# ------------------------------------------------------------------ merge
+
+
+def _mini_pack(path, key, value, t):
+    doc = tp.make_pack({
+        key: {"value": value, "seconds": 0.1,
+              "knob": key.split("|")[0],
+              "fingerprint": key.split("|")[1], "t": t},
+    })
+    Path(path).write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_merge_newer_measurement_wins_and_reports(tmp_path, capsys):
+    a = _mini_pack(tmp_path / "a.json", "k|fp", "old-winner", 100.0)
+    b = _mini_pack(tmp_path / "b.json", "k|fp", "new-winner", 200.0)
+    out = tmp_path / "m.json"
+    assert tp.main(["merge", a, b, "-o", str(out)]) == 0
+    printed = capsys.readouterr().out
+    assert "CONFLICT k|fp" in printed
+    assert "newer measurement wins" in printed
+    doc = json.loads(out.read_text())
+    assert doc["entries"]["k|fp"]["value"] == "new-winner"
+    # order-independent: the newer stamp wins from either side
+    out2 = tmp_path / "m2.json"
+    assert tp.main(["merge", b, a, "-o", str(out2)]) == 0
+    assert json.loads(out2.read_text())["entries"]["k|fp"]["value"] \
+        == "new-winner"
+
+
+def test_merge_disjoint_and_identical_keys_are_not_conflicts(
+        tmp_path, capsys):
+    a = _mini_pack(tmp_path / "a.json", "k1|fp", 1, 100.0)
+    b = _mini_pack(tmp_path / "b.json", "k2|fp", 2, 50.0)
+    out = tmp_path / "m.json"
+    assert tp.main(["merge", a, b, "-o", str(out)]) == 0
+    assert "CONFLICT" not in capsys.readouterr().out
+    assert len(json.loads(out.read_text())["entries"]) == 2
+
+
+def test_import_dry_run_writes_nothing(tmp_path, capsys):
+    warm = _warm_cache(tmp_path)
+    pack_file = tmp_path / "p.json"
+    tp.main(["pack", "--cache", warm, "-o", str(pack_file)])
+    fresh = tmp_path / "fresh.json"
+    assert tp.main(["import", str(pack_file), "--cache", str(fresh),
+                    "--dry-run"]) == 0
+    printed = capsys.readouterr().out
+    assert "would write" in printed and "ADD" in printed
+    assert not fresh.exists()
+
+
+# ------------------------------------------------------------ degradation
+
+
+@pytest.mark.parametrize("content", [
+    "not json{{{",
+    '{"version": 99, "kind": "tpumt-tune-pack", "entries": {}}',
+    '{"version": 1, "kind": "something-else", "entries": {}}',
+    '{"version": 1, "kind": "tpumt-tune-pack", "entries": "nope"}',
+])
+def test_corrupted_pack_degrades_to_empty(tmp_path, content):
+    p = tmp_path / "bad.json"
+    p.write_text(content)
+    assert tp.load_pack(str(p))["entries"] == {}
+
+
+def test_tune_pack_flag_preloads_and_degrades(tmp_path, capsys):
+    """The --tune-pack driver path: setup_tuning absorbs a pack into
+    the in-memory cache (resolutions then hit), and a corrupted pack
+    degrades to the local cache/priors with a NOTE, never a crash."""
+    import argparse
+
+    from tpu_mpi_tests.drivers._common import setup_tuning
+
+    warm = _warm_cache(tmp_path)
+    pack_file = tmp_path / "p.json"
+    tp.main(["pack", "--cache", warm, "-o", str(pack_file)])
+    capsys.readouterr()
+
+    args = argparse.Namespace(
+        tune=False, tune_cache=str(tmp_path / "local.json"),
+        tune_pack=str(pack_file), tune_budget=None,
+    )
+    setup_tuning(args)
+    assert "preloaded" in capsys.readouterr().out
+    assert tr.lookup("demo/packed", dtype="float32", n=4096) == 32
+    # in-memory only: the local cache file was not created by preload
+    assert not (tmp_path / "local.json").exists()
+
+    tr.deconfigure()
+    bad = tmp_path / "bad.json"
+    bad.write_text("corrupt{{{")
+    args.tune_pack = str(bad)
+    setup_tuning(args)
+    assert "empty or unreadable" in capsys.readouterr().out
+    assert tr.lookup("demo/packed", dtype="float32", n=4096) is None
+
+
+def test_absorb_newer_wins_against_local_entries(tmp_path):
+    cache = ScheduleCache.load(str(tmp_path / "c.json"))
+    cache.entries["k|fp"] = {"value": "local", "t": 200.0,
+                             "knob": "k", "fingerprint": "fp"}
+    doc = tp.make_pack({
+        "k|fp": {"value": "packed", "t": 100.0, "knob": "k",
+                 "fingerprint": "fp"},
+        "k2|fp": {"value": "new", "t": 100.0, "knob": "k2",
+                  "fingerprint": "fp"},
+    })
+    adopted = tp.absorb(cache, doc)
+    assert cache.entries["k|fp"]["value"] == "local"  # newer local kept
+    assert cache.entries["k2|fp"]["value"] == "new"
+    assert adopted == 1
+
+
+# ------------------------------------------------------------ entry point
+
+
+def test_tpumt_tune_runs_without_jax(tmp_path):
+    """The tpumt-tune console script must pack/merge/import in a
+    process where ``import jax`` raises — the login-node contract of
+    the sibling CLIs (packs are built and shipped from build hosts)."""
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps({
+        "version": 1,
+        "entries": {"demo/k|device=v5e;platform=tpu": {
+            "value": 7, "seconds": 0.1, "knob": "demo/k",
+            "fingerprint": "device=v5e;platform=tpu", "t": 100.0}},
+    }))
+    code = (
+        "import sys\n"
+        "class Block:\n"
+        "    def find_spec(self, name, path=None, target=None):\n"
+        "        if name == 'jax' or name.startswith('jax.'):\n"
+        "            raise ImportError('jax blocked: login-node sim')\n"
+        "sys.meta_path.insert(0, Block())\n"
+        "from tpu_mpi_tests.tune import pack\n"
+        "try:\n"
+        "    pack.main(['--help'])\n"
+        "except SystemExit as e:\n"
+        "    assert (e.code or 0) == 0, e.code\n"
+        f"cache = {str(cache)!r}\n"
+        f"out = {str(tmp_path / 'p.json')!r}\n"
+        f"fresh = {str(tmp_path / 'fresh.json')!r}\n"
+        "assert pack.main(['pack', '--cache', cache, '-o', out]) == 0\n"
+        "assert pack.main(['merge', out, out, '-o', out + '.m']) == 0\n"
+        "assert pack.main(['import', out, '--cache', fresh]) == 0\n"
+        "import json\n"
+        "doc = json.load(open(fresh))\n"
+        "assert doc['entries'], doc\n"
+        "print('TUNE PACK NOJAX OK')\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "TUNE PACK NOJAX OK" in r.stdout
+    pyproject = (REPO / "pyproject.toml").read_text()
+    assert 'tpumt-tune = "tpu_mpi_tests.tune.pack:main"' in pyproject

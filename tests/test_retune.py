@@ -1,0 +1,422 @@
+"""The online re-tune controller (ISSUE 14 tentpole c): tune_stale →
+bounded between-windows re-sweep → hot swap through registry.resolve →
+kind:"control" tune_swap records — plus the CONTROL table, the trace
+marker, and the doctor's stale_schedule verdict over the same records."""
+
+import json
+
+import pytest
+
+from tpu_mpi_tests.instrument.metrics import (
+    STALE_SAMPLES,
+    MetricsRegistry,
+)
+from tpu_mpi_tests.tune import registry as tr
+from tpu_mpi_tests.tune.controller import TuneController
+
+
+@pytest.fixture(autouse=True)
+def _isolated_registry(monkeypatch):
+    monkeypatch.delenv("TPU_MPI_TUNE_CACHE", raising=False)
+    tr.deconfigure()
+    yield
+    tr.deconfigure()
+
+
+def _span(op, gbps):
+    return {"kind": "span", "op": op, "nbytes": 1 << 20,
+            "seconds": 0.01, "gbps": gbps}
+
+
+def _latch_stale(reg, op, base=10.0, sagged=1.0):
+    """Drive the registry's tune_stale watch to a latch: a tuned knob
+    goes live, the op baselines at ``base`` GB/s, then a full rolling
+    window sags to ``sagged``."""
+    reg.observe({"kind": "tune_hit", "knob": "demo/knob", "value": 1})
+    for _ in range(STALE_SAMPLES):
+        reg.observe(_span(op, base))
+    for _ in range(STALE_SAMPLES):
+        reg.observe(_span(op, sagged))
+
+
+def _teed_registry(records):
+    """A registry whose health sink mirrors the Reporter wiring: the
+    fired record lands in the JSONL (``records``) AND tees back through
+    observe — which is what delivers it to health listeners (the
+    controller's latch)."""
+    reg = MetricsRegistry()
+    reg.set_health_sink(
+        lambda rec: (records.append(rec), reg.observe(rec)))
+    return reg
+
+
+class _FakeHandlers:
+    """A rebuildable serve handler whose speed is keyed on the resolved
+    candidate — the degraded-winner shape the controller exists for."""
+
+    def __init__(self, knob, timing, default):
+        self.knob = knob
+        self.timing = dict(timing)
+        self.default = default
+        self.built = []
+
+    def build(self, value=None):
+        eff = value if value is not None else tr.resolve(
+            self.knob, prior=self.default)
+        self.built.append(eff)
+        cost = self.timing[eff]
+
+        def step(k: int):
+            import time
+
+            time.sleep(cost * k)
+
+        step.tune_info = {
+            "knob": self.knob,
+            "ctx": {},
+            "candidates": tuple(self.timing),
+            "rebuild": self.build,
+        }
+        return step
+
+
+def test_controller_closes_the_loop(tmp_path):
+    """stale latch → re-sweep (real sweep engine, winner persisted) →
+    hot swap via registry.resolve → control record → latch reset."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    records = []
+    reg = _teed_registry(records)
+    fake = _FakeHandlers("demo/knob", {"slow": 0.005, "fast": 0.0},
+                         default="slow")
+    handlers = {"daxpy:64:float32": fake.build()}
+    ctl = TuneController(reg, handlers, sink=records.append,
+                         line=lambda s: None, budget_s=30.0)
+
+    op = "serve:daxpy:64:float32"
+    _latch_stale(reg, op)
+    stale = [r for r in records if r.get("kind") == "health"
+             and r.get("event") == "tune_stale"]
+    assert len(stale) == 1 and stale[0]["op"] == op
+
+    old_step = handlers["daxpy:64:float32"]
+    assert ctl.window_boundary(1000.0) == 1
+    # the re-sweep ran through the REAL sweep engine: candidate records
+    # plus a tune_result, winner measured not guessed
+    kinds = [r["kind"] for r in records]
+    assert kinds.count("tune") == 2 and "tune_result" in kinds
+    swap = [r for r in records if r.get("kind") == "control"][0]
+    assert swap["event"] == "tune_swap"
+    assert swap["class"] == "daxpy:64:float32"
+    assert swap["knob"] == "demo/knob"
+    assert swap["old"] == "slow" and swap["new"] == "fast"
+    assert swap["op"] == op and swap["t"] == 1000.0
+    assert swap["resweep_s"] > 0
+    assert isinstance(swap["sag_pct"], (int, float))
+    # hot-swapped THROUGH registry.resolve: the new handler re-resolved
+    # and picked up the persisted winner
+    assert handlers["daxpy:64:float32"] is not old_step
+    assert fake.built[-1] == "fast"
+    assert tr.resolve("demo/knob", prior="slow") == "fast"
+    # the stale latch was reset: the op re-baselines on the new
+    # schedule and can fire again after another full sag cycle
+    _latch_stale(reg, op, base=8.0, sagged=1.0)
+    assert [r for r in records if r.get("event") == "tune_stale"][1:]
+
+
+def test_controller_ignores_classes_without_tune_info(tmp_path):
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    records = []
+    reg = _teed_registry(records)
+
+    def bare_step(k):
+        return None
+
+    handlers = {"daxpy:64:float32": bare_step}
+    ctl = TuneController(reg, handlers, sink=records.append,
+                         line=lambda s: None)
+    _latch_stale(reg, "serve:daxpy:64:float32")
+    assert ctl.window_boundary(1.0) == 0
+    assert [r for r in records if r.get("kind") == "control"] == []
+    assert handlers["daxpy:64:float32"] is bare_step
+
+
+def test_controller_ignores_non_serve_ops(tmp_path):
+    """A stale op inside a handler (halo_exchange) has no handler to
+    rebuild: the controller degrades to a no-op, never an error."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    reg = _teed_registry([])
+    fake = _FakeHandlers("demo/knob", {"a": 0.0}, default="a")
+    handlers = {"daxpy:64:float32": fake.build()}
+    ctl = TuneController(reg, handlers, sink=lambda r: None,
+                         line=lambda s: None)
+    _latch_stale(reg, "halo_exchange")
+    assert ctl.window_boundary(1.0) == 0
+
+
+def test_controller_survives_failing_rebuild(tmp_path):
+    """A re-tune that blows up mid-sweep must not kill serving: the old
+    handler stays installed and the error surfaces as a line."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    lines = []
+    reg = _teed_registry([])
+
+    def exploding_rebuild(value=None):
+        raise RuntimeError("compile blew up")
+
+    def step(k):
+        return None
+
+    step.tune_info = {"knob": "demo/knob", "ctx": {},
+                      "candidates": ("a", "b"),
+                      "rebuild": exploding_rebuild}
+    handlers = {"daxpy:64:float32": step}
+    ctl = TuneController(reg, handlers, sink=lambda r: None,
+                         line=lines.append)
+    op = "serve:daxpy:64:float32"
+    _latch_stale(reg, op)
+    assert ctl.window_boundary(1.0) == 0
+    assert handlers["daxpy:64:float32"] is step
+    errors = [ln for ln in lines if "RETUNE ERROR" in ln]
+    assert len(errors) == 1
+    # the one-shot stale latch must not be abandoned on a transient
+    # failure: later boundaries RETRY (bounded), then the watch is
+    # re-baselined so a sustained sag can latch again
+    assert ctl.window_boundary(2.0) == 0
+    assert ctl.window_boundary(3.0) == 0
+    errors = [ln for ln in lines if "RETUNE ERROR" in ln]
+    assert len(errors) == 3  # initial + RETUNE_RETRIES
+    assert ctl.window_boundary(4.0) == 0
+    assert len([ln for ln in lines if "RETUNE ERROR" in ln]) == 3
+    # retries spent → counter cleared AND the op's watch reset: a
+    # fresh sag re-latches and gets the FULL retry budget again
+    _latch_stale(reg, op, base=5.0, sagged=0.5)
+    for t in (5.0, 6.0, 7.0):
+        assert ctl.window_boundary(t) == 0
+    assert len([ln for ln in lines if "RETUNE ERROR" in ln]) == 6
+    assert ctl.window_boundary(8.0) == 0
+    assert len([ln for ln in lines if "RETUNE ERROR" in ln]) == 6
+
+
+def test_serve_loop_calls_controller_between_windows():
+    """The loop consults the controller at window boundaries only —
+    the quarantine-probe point, never mid-batch."""
+    from tpu_mpi_tests.serve.arrival import OpenLoopPoisson
+    from tpu_mpi_tests.serve.loop import ServeLoop
+    from tpu_mpi_tests.serve.workloads import parse_workload_table
+
+    calls = []
+
+    class StubController:
+        def window_boundary(self, t_wall):
+            calls.append(t_wall)
+            return 0
+
+    classes = parse_workload_table("daxpy:64:float32")
+    t = {"now": 0.0}
+
+    def clock():
+        return t["now"]
+
+    def sleep(dt):
+        t["now"] += max(dt, 1e-3)
+
+    loop = ServeLoop(
+        classes, {"daxpy:64:float32": lambda n: None},
+        OpenLoopPoisson(5.0, seed=1),
+        duration_s=10.0, window_s=2.0, seed=1,
+        controller=StubController(),
+        clock=clock, wall=clock, sleep=sleep,
+    )
+    loop.run()
+    assert len(calls) >= 3  # one per elapsed window boundary
+
+
+def test_metrics_reset_stale_rebaselines(tmp_path):
+    """reset_stale forgets baseline AND latch: after a swap the op can
+    latch again from fresh post-swap readings."""
+    fired = []
+    reg = MetricsRegistry(health_sink=fired.append)
+    op = "serve:x"
+    _latch_stale(reg, op)
+    assert len(fired) == 1
+    # latched: more sag does not re-fire
+    for _ in range(STALE_SAMPLES):
+        reg.observe(_span(op, 0.5))
+    assert len(fired) == 1
+    reg.reset_stale(op)
+    _latch_stale(reg, op, base=5.0, sagged=0.5)
+    assert len(fired) == 2
+
+
+# ------------------------------------------------------------- surfacing
+
+
+def test_report_control_table(tmp_path, capsys):
+    from tpu_mpi_tests.instrument.aggregate import main as report_main
+
+    f = tmp_path / "run.jsonl"
+    recs = [
+        {"kind": "control", "event": "tune_swap",
+         "class": "daxpy:64:float32", "knob": "daxpy/chunk",
+         "op": "serve:daxpy:64:float32", "signal": "gbps",
+         "sag_pct": 41.5, "old": 1, "new": 32, "resweep_s": 0.25,
+         "t": 100.0, "rank": 0},
+        {"kind": "control", "event": "tune_swap",
+         "class": "daxpy:64:float32", "knob": "daxpy/chunk",
+         "op": "serve:daxpy:64:float32", "signal": "gbps",
+         "sag_pct": 20.5, "old": 32, "new": 8, "resweep_s": 0.75,
+         "t": 200.0, "rank": 0},
+    ]
+    f.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    assert report_main([str(f)]) == 0
+    out = capsys.readouterr().out
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("CONTROL")][0]
+    assert "tune_swap daxpy:64:float32" in line
+    assert "knob=daxpy/chunk" in line and "n=2" in line
+    assert "old=1" in line and "new=8" in line
+    assert "sag=31.0%" in line  # mean of the two swaps
+    assert "resweep=1s" in line
+
+    from tpu_mpi_tests.instrument.aggregate import summarize
+
+    s = summarize([str(f)])
+    json.dumps(s)  # --json path stays serializable
+    row = s["control"]["daxpy:64:float32|daxpy/chunk"]
+    assert row["swaps"] == 2 and row["old"] == 1 and row["new"] == 8
+
+
+def test_trace_places_control_marker(tmp_path):
+    from tpu_mpi_tests.instrument.timeline import chrome_trace
+
+    f = tmp_path / "run.jsonl"
+    recs = [
+        {"kind": "manifest", "process_index": 0, "process_count": 1},
+        {"kind": "span", "op": "serve:daxpy:64:float32",
+         "seconds": 0.01, "t_start": 100.0, "t_end": 100.01},
+        {"kind": "control", "event": "tune_swap",
+         "class": "daxpy:64:float32", "knob": "daxpy/chunk",
+         "op": "serve:daxpy:64:float32", "signal": "gbps",
+         "sag_pct": 40.0, "old": 1, "new": 32, "resweep_s": 0.5,
+         "t": 101.0, "rank": 0},
+    ]
+    f.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    doc = chrome_trace([str(f)])
+    marks = [e for e in doc["traceEvents"]
+             if e.get("cat") == "control"]
+    assert len(marks) == 1, doc["traceEvents"]
+    assert "tune_swap" in marks[0]["name"]
+    assert marks[0]["args"]["old"] == 1 and marks[0]["args"]["new"] == 32
+
+
+# ------------------------------------------------------ doctor verdicts
+
+
+def _doctor_stream(tmp_path, recs, name="run.jsonl"):
+    f = tmp_path / name
+    f.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(f)
+
+
+def _stale_rec(t=100.0, op="serve:daxpy:64:float32"):
+    return {"kind": "health", "event": "tune_stale", "op": op,
+            "signal": "gbps", "baseline": 10.0, "rolling": 1.0,
+            "sag_pct": 90.0, "threshold_pct": 15.0, "n": 8,
+            "knobs": ["daxpy/chunk"], "t": t, "rank": 0}
+
+
+def _closing(t):
+    return [{"kind": "span", "op": "x", "seconds": 0.01, "world": 1,
+             "t_start": t, "t_end": t + 0.01},
+            {"kind": "telemetry_summary", "op": "x", "rank": 0,
+             "t": t + 1.0}]
+
+
+def test_doctor_convicts_unanswered_stale_schedule(tmp_path):
+    from tpu_mpi_tests.instrument.diagnose import diagnose_files
+
+    f = _doctor_stream(
+        tmp_path,
+        [{"kind": "manifest", "process_index": 0, "process_count": 1}]
+        + [_stale_rec(t=100.0)] + _closing(200.0),
+    )
+    findings = diagnose_files([f])
+    assert [x["class"] for x in findings] == ["stale_schedule"]
+    x = findings[0]
+    assert x["rank"] == 0 and x["last_op"] == "serve:daxpy:64:float32"
+    assert "no tune_swap followed" in x["detail"]
+    assert x["t"] == 100.0
+
+
+def test_doctor_exonerates_answered_stale(tmp_path):
+    """A tune_swap after the latch is the loop CLOSING — the doctor
+    must not convict exactly the runs the controller saves."""
+    from tpu_mpi_tests.instrument.diagnose import diagnose_files
+
+    swap = {"kind": "control", "event": "tune_swap",
+            "class": "daxpy:64:float32", "knob": "daxpy/chunk",
+            "op": "serve:daxpy:64:float32", "signal": "gbps",
+            "sag_pct": 90.0, "old": 1, "new": 32, "resweep_s": 0.5,
+            "t": 105.0, "rank": 0}
+    f = _doctor_stream(
+        tmp_path,
+        [{"kind": "manifest", "process_index": 0, "process_count": 1},
+         _stale_rec(t=100.0), swap] + _closing(200.0),
+    )
+    assert diagnose_files([f]) == []
+
+
+def test_doctor_relatch_after_swap_still_convicts(tmp_path):
+    """Latest latch wins in the digest: the --retune controller re-arms
+    the watch after a swap, so an op can latch AGAIN — the old swap
+    must not exonerate the new, unanswered latch."""
+    from tpu_mpi_tests.instrument.diagnose import diagnose_files
+
+    swap = {"kind": "control", "event": "tune_swap",
+            "class": "daxpy:64:float32", "knob": "daxpy/chunk",
+            "op": "serve:daxpy:64:float32", "signal": "gbps",
+            "sag_pct": 90.0, "old": 1, "new": 32, "resweep_s": 0.5,
+            "t": 15.0, "rank": 0}
+    f = _doctor_stream(
+        tmp_path,
+        [{"kind": "manifest", "process_index": 0, "process_count": 1},
+         _stale_rec(t=10.0), swap, _stale_rec(t=50.0)]
+        + _closing(100.0),
+    )
+    findings = diagnose_files([f])
+    assert [x["class"] for x in findings] == ["stale_schedule"]
+    assert findings[0]["t"] == 50.0  # anchored at the NEW latch
+
+
+def test_doctor_stale_grace_on_live_stream(tmp_path):
+    """Mid-follow (followed=True), a latch fresher than the grace
+    window stays unconvicted — the controller only acts at the next
+    window boundary; the post-mortem pass convicts every unanswered
+    latch regardless of freshness (the run ended, no swap can come)."""
+    from tpu_mpi_tests.instrument.diagnose import diagnose_files
+
+    # a mid-run stream: the stale latch landed 1 s before the last
+    # record — inside the grace while followed, convicted post-mortem
+    f = _doctor_stream(
+        tmp_path,
+        [{"kind": "manifest", "process_index": 0, "process_count": 1},
+         {"kind": "span", "op": "x", "seconds": 0.01, "world": 1,
+          "t_start": 99.0, "t_end": 99.01},
+         _stale_rec(t=100.0),
+         {"kind": "span", "op": "x", "seconds": 0.01, "world": 1,
+          "t_start": 101.0, "t_end": 101.01}],
+    )
+    assert diagnose_files([f], followed=True) == []
+    assert [x["class"] for x in diagnose_files([f])] \
+        == ["stale_schedule"]
+    # a latch older than the grace convicts even mid-follow
+    f2 = _doctor_stream(
+        tmp_path,
+        [{"kind": "manifest", "process_index": 0, "process_count": 1},
+         _stale_rec(t=100.0),
+         {"kind": "span", "op": "x", "seconds": 0.01, "world": 1,
+          "t_start": 120.0, "t_end": 120.01}],
+        name="run2.jsonl",
+    )
+    assert [x["class"] for x in diagnose_files([f2], followed=True)] \
+        == ["stale_schedule"]

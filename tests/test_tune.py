@@ -370,10 +370,40 @@ def test_context_sensitive_knobs_ignore_device_slot(tmp_path):
     assert _resolve_k_tile(None, False, dtype="bfloat16", lq=64) == 512
 
 
-def test_sweep_refuses_to_measure_multiprocess(tmp_path, monkeypatch):
-    """Per-rank budget cutoffs / winners would diverge across ranks
-    mid-collective: a multi-process sweep must not measure — it records
-    the skip and resolves cached > prior."""
+def test_fleet_sweep_rank0_measures_and_persists(tmp_path, monkeypatch):
+    """ISSUE 14 tentpole a: a multi-process sweep MEASURES. On rank 0
+    the fleet path runs every candidate, records them, picks the
+    argmin, persists it, and emits NO multi-process skip note. (With a
+    single-process jax the broadcast is the identity, which is exactly
+    rank 0's view of the protocol.)"""
+    import importlib
+
+    sweep_mod = importlib.import_module("tpu_mpi_tests.tune.sweep")
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    monkeypatch.setattr(sweep_mod, "_process_count", lambda: 2)
+    timing = {"slow": 1.0, "fast": 0.25}
+    records = []
+    winner = sweep(
+        "demo/fleet0", lambda c: timing[c],
+        candidates=("slow", "fast"), emit=records.append,
+        dtype="float32",
+    )
+    assert winner == "fast"
+    kinds = [r["kind"] for r in records]
+    assert kinds == ["tune", "tune", "tune_result"]
+    assert all("note" not in r for r in records), records
+    assert records[-1]["value"] == "fast"
+    assert records[-1]["measured"] == 2
+    cache = ScheduleCache.load(str(tmp_path / "t.json"))
+    assert cache.lookup("demo/fleet0",
+                        fingerprint(dtype="float32")) == "fast"
+    assert cache.lookup("demo/fleet0", device_fingerprint()) == "fast"
+
+
+def test_fleet_sweep_rank0_budget_cutoff_is_broadcast(tmp_path,
+                                                     monkeypatch):
+    """Rank 0's clock decides the budget stop; the skipped candidates
+    are reported exactly like the single-process sweep's."""
     import importlib
 
     sweep_mod = importlib.import_module("tpu_mpi_tests.tune.sweep")
@@ -381,16 +411,189 @@ def test_sweep_refuses_to_measure_multiprocess(tmp_path, monkeypatch):
     monkeypatch.setattr(sweep_mod, "_process_count", lambda: 2)
     records = []
     winner = sweep(
+        "demo/fleetb", lambda c: 1.0,
+        candidates=("prior", "x", "y"), budget_s=0.0,
+        emit=records.append,
+    )
+    assert winner == "prior"
+    skipped = [r for r in records if r.get("skipped") == "budget"]
+    assert {r["candidate"] for r in skipped} == {"x", "y"}
+    assert records[-1]["skipped"] == 2
+
+
+def test_fleet_sweep_nonzero_rank_applies_broadcast_winner(
+        tmp_path, monkeypatch):
+    """A non-zero rank measures every candidate (the collectives need
+    it present) but emits ONLY the broadcast tune_result — rank 0's
+    record verbatim — and never writes the cache: exactly one sweep,
+    one writer, byte-identical resolved schedules."""
+    from tpu_mpi_tests.tune import fleet
+
+    sweep_mod = __import__("tpu_mpi_tests.tune.sweep",
+                           fromlist=["sweep"])
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    monkeypatch.setattr(sweep_mod, "_process_count", lambda: 2)
+    monkeypatch.setattr(fleet, "process_count", lambda: 2)
+    monkeypatch.setattr(fleet, "process_index", lambda: 1)
+    monkeypatch.setattr(
+        fleet, "_device_bcast",
+        lambda payload: (_ for _ in ()).throw(RuntimeError("no mp cpu")),
+    )
+    # rank 0's decision stream, served FIFO by a fake coordination
+    # client (key content does not matter: the SPMD call order does)
+    import json as _json
+
+    rank0 = [
+        {"knob": "demo/fleet1", "n": 2},   # open handshake
+        True,                               # go candidate 0
+        True,                               # go candidate 1
+        {"kind": "tune_result", "knob": "demo/fleet1", "value": "b",
+         "seconds": 0.125, "measured": 2, "skipped": 0,
+         "fingerprint": "fp-from-rank0"},
+    ]
+    payloads = [_json.dumps(v) for v in rank0]
+
+    class FakeClient:
+        def blocking_key_value_get(self, key, timeout_ms):
+            return payloads.pop(0)
+
+        def key_value_set(self, key, value):  # pragma: no cover
+            raise AssertionError("rank 1 must never set decisions")
+
+    monkeypatch.setattr(fleet, "_kv_client", lambda: FakeClient())
+    fleet._reset_transport_for_tests()
+    measured = []
+    records = []
+    winner = sweep_mod.sweep(
+        "demo/fleet1",
+        lambda c: measured.append(c) or {"a": 0.5, "b": 0.125}[c],
+        candidates=("a", "b"), emit=records.append,
+    )
+    fleet._reset_transport_for_tests()
+    assert winner == "b"
+    assert measured == ["a", "b"]  # every rank runs every candidate
+    assert [r["kind"] for r in records] == ["tune_result"]
+    assert records[0]["fingerprint"] == "fp-from-rank0"  # verbatim
+    # one writer: rank 1 persisted nothing
+    assert ScheduleCache.load(str(tmp_path / "t.json")).entries == {}
+
+
+def test_fleet_sweep_without_transport_keeps_skip_contract(
+        tmp_path, monkeypatch):
+    """A fleet with no broadcast path degrades to the PR-4 contract on
+    every rank: record the skip, resolve cached > prior."""
+    from tpu_mpi_tests.tune import fleet
+
+    sweep_mod = __import__("tpu_mpi_tests.tune.sweep",
+                           fromlist=["sweep"])
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    monkeypatch.setattr(sweep_mod, "_process_count", lambda: 2)
+    monkeypatch.setattr(fleet, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        fleet, "_device_bcast",
+        lambda payload: (_ for _ in ()).throw(RuntimeError("no mp cpu")),
+    )
+    monkeypatch.setattr(fleet, "_kv_client", lambda: None)
+    fleet._reset_transport_for_tests()
+    records = []
+    winner = sweep_mod.sweep(
         "demo/mp", lambda c: pytest.fail("must not measure"),
         candidates=("p", "q"), emit=records.append,
     )
+    fleet._reset_transport_for_tests()
     assert winner == "p"
     assert [r["kind"] for r in records] == ["tune_result"]
-    assert "multi-process" in records[0]["note"]
+    assert "no fleet broadcast transport" in records[0]["note"]
     # a warmed cache still serves its winner
     tr.configured_cache().store("demo/mp", device_fingerprint(), "q")
-    assert sweep("demo/mp", lambda c: 0.0, candidates=("p", "q"),
-                 emit=records.append) == "q"
+    assert sweep_mod.sweep("demo/mp", lambda c: 0.0,
+                           candidates=("p", "q"),
+                           emit=records.append) == "q"
+
+
+def test_ensure_tuned_hit_decision_is_rank0s(tmp_path, monkeypatch):
+    """Per-host caches can diverge (rank 0 is the only writer): the
+    hit-vs-sweep decision must be rank 0's, broadcast — a non-zero rank
+    whose LOCAL cache misses must still take the hit path when rank 0
+    hit, or a subset of ranks would enter the collective sweep
+    handshake alone and hang the pod."""
+    import json as _json
+
+    from tpu_mpi_tests.tune import fleet
+
+    sweep_mod = __import__("tpu_mpi_tests.tune.sweep",
+                           fromlist=["ensure_tuned"])
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True)
+    monkeypatch.setattr(sweep_mod, "_process_count", lambda: 2)
+    monkeypatch.setattr(fleet, "process_count", lambda: 2)
+    monkeypatch.setattr(fleet, "process_index", lambda: 1)
+    monkeypatch.setattr(
+        fleet, "_device_bcast",
+        lambda payload: (_ for _ in ()).throw(RuntimeError("no mp cpu")),
+    )
+    payloads = [_json.dumps({"hit": True, "value": "rank0-winner"})]
+
+    class FakeClient:
+        def blocking_key_value_get(self, key, timeout_ms):
+            return payloads.pop(0)
+
+    monkeypatch.setattr(fleet, "_kv_client", lambda: FakeClient())
+    fleet._reset_transport_for_tests()
+    records = []
+    out = sweep_mod.ensure_tuned(
+        "demo/fleeth",
+        lambda c: pytest.fail("rank 0 hit: no rank may sweep"),
+        candidates=("a", "b"), emit=records.append,
+    )
+    fleet._reset_transport_for_tests()
+    assert out == "rank0-winner"  # not this rank's (empty) cache view
+    assert [r["kind"] for r in records] == ["tune_hit"]
+
+
+def test_cache_read_only_never_writes(tmp_path):
+    """The single-writer contract's mechanism: a read-only cache's
+    save() is a no-op (non-zero fleet ranks get one from configure)."""
+    path = tmp_path / "tune.json"
+    c = ScheduleCache.load(str(path))
+    c.read_only = True
+    c.store("knob/x", "fp", 7)
+    c.save()
+    assert not path.exists()
+    assert c.lookup("knob/x", "fp") == 7  # in-memory view still serves
+
+
+def test_configure_marks_nonzero_rank_read_only(tmp_path, monkeypatch):
+    """registry.configure is where non-zero ranks lose write access: a
+    2-process run produces ONE cache writer."""
+    monkeypatch.setattr(tr, "_nonzero_rank", lambda: True)
+    cache = tr.configure(cache_path=str(tmp_path / "t.json"))
+    assert cache.read_only
+    cache.store("knob/x", "fp", 1)
+    cache.save()
+    assert not (tmp_path / "t.json").exists()
+    monkeypatch.setattr(tr, "_nonzero_rank", lambda: False)
+    cache = tr.configure(cache_path=str(tmp_path / "t.json"))
+    assert not cache.read_only
+
+
+def test_mark_fleet_rank_applies_after_bootstrap(tmp_path, monkeypatch):
+    """The real driver ordering: setup_tuning configures BEFORE
+    bootstrap initializes jax.distributed — so at configure time every
+    rank looks like a writer. mark_fleet_rank (called by make_reporter,
+    which runs after bootstrap) applies the marking once the rank is
+    actually known."""
+    monkeypatch.setattr(tr, "_nonzero_rank", lambda: False)
+    cache = tr.configure(cache_path=str(tmp_path / "t.json"))
+    assert not cache.read_only  # pre-bootstrap: rank unknown
+    monkeypatch.setattr(tr, "_nonzero_rank", lambda: True)
+    tr.mark_fleet_rank()
+    assert cache.read_only
+    cache.store("knob/x", "fp", 1)
+    cache.save()
+    assert not (tmp_path / "t.json").exists()
+    # unconfigured registry: a harmless no-op
+    tr.deconfigure()
+    tr.mark_fleet_rank()
 
 
 def test_full_fingerprint_beats_device_slot(tmp_path):

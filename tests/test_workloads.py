@@ -201,6 +201,148 @@ class TestDecodeSpec:
                                                   "float32")
         step(2)
 
+    def test_rows_resolve_cached_variant_per_payload(self, capsys,
+                                                     tmp_path):
+        """ISSUE-14 satellite: the µs/op pillar consumes the SAME
+        ``coll_variant/*`` schedules collbench sweeps — per payload
+        size, cached > prior — and a malformed cache value degrades to
+        the XLA prior instead of crashing the row."""
+        from tpu_mpi_tests.tune import registry as tr
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+        from tpu_mpi_tests.workloads import decode
+
+        out = tmp_path / "dec.jsonl"
+        try:
+            tr.configure(cache_path=str(tmp_path / "t.json"))
+            # batch=1 x heads=8 x f32 = 32 B per shard on world=8: a
+            # cached rdma winner is below the ring kernel's lane floor
+            # at this payload — the consult must be VISIBLE (the NOTE
+            # proves the lookup engaged) and degrade to the XLA tier
+            tr.configured_cache().store(
+                "coll_variant/allreduce",
+                fingerprint(dtype="float32", bytes=32, world=8),
+                "rdma",
+            )
+            tr.configured_cache().save()
+            rc = decode.main([
+                "--batches", "1", "--heads", "8", "--n-iter", "20",
+                "--colls", "allreduce",
+                "--tune-cache", str(tmp_path / "t.json"),
+                "--jsonl", str(out),
+            ])
+        finally:
+            tr.deconfigure()
+        text = capsys.readouterr().out
+        assert rc == 0
+        assert "cached rdma variant infeasible" in text
+        recs = [json.loads(line) for line in
+                out.read_text().splitlines()]
+        dec = [r for r in recs if r.get("kind") == "decode"]
+        assert len(dec) == 1
+        assert dec[0]["variant"] == "xla"
+
+    def test_malformed_cached_variant_degrades_to_prior(self, capsys,
+                                                        tmp_path):
+        from tpu_mpi_tests.tune import registry as tr
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+        from tpu_mpi_tests.workloads import decode
+
+        out = tmp_path / "dec.jsonl"
+        try:
+            tr.configure(cache_path=str(tmp_path / "t.json"))
+            tr.configured_cache().store(
+                "coll_variant/allreduce",
+                fingerprint(dtype="float32", bytes=32, world=8),
+                "garbage",
+            )
+            tr.configured_cache().save()
+            rc = decode.main([
+                "--batches", "1", "--heads", "8", "--n-iter", "20",
+                "--colls", "allreduce",
+                "--tune-cache", str(tmp_path / "t.json"),
+                "--jsonl", str(out),
+            ])
+        finally:
+            tr.deconfigure()
+        capsys.readouterr()
+        assert rc == 0
+        recs = [json.loads(line) for line in
+                out.read_text().splitlines()]
+        dec = [r for r in recs if r.get("kind") == "decode"]
+        assert len(dec) == 1 and dec[0]["variant"] == "xla"
+
+    def test_serve_handler_carries_tune_info(self, mesh8):
+        """The --retune contract: the decode handler exposes its knob,
+        context, candidates, and a rebuild that honors an explicit
+        variant (the controller's re-sweep measure path)."""
+        step = _common.workload_factory("decode")(mesh8, (4, 8),
+                                                  "float32")
+        info = step.tune_info
+        assert info["knob"] == "coll_variant/allreduce"
+        assert info["candidates"] == ("xla", "rdma")
+        assert info["ctx"]["world"] == 8
+        rebuilt = info["rebuild"]("xla")
+        rebuilt(2)  # a working, warmed handler
+        assert rebuilt.tune_info["knob"] == "coll_variant/allreduce"
+
+
+class TestDaxpyChunkSchedule:
+    """The ``daxpy/chunk`` knob (ISSUE 14): chunking is a dispatch-count
+    schedule, never a numerics change — and the default resolution is
+    the prior (1), byte-identical to the pre-knob loop."""
+
+    def test_chunked_result_is_bitwise_identical(self, capsys,
+                                                 tmp_path):
+        from tpu_mpi_tests.tune import registry as tr
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+        from tpu_mpi_tests.workloads import daxpy
+
+        rc = daxpy.main(["--n", "512", "--dtype", "float64",
+                         "--iters", "5"])
+        base = capsys.readouterr().out
+        assert rc == 0
+        try:
+            cache = tr.configure(cache_path=str(tmp_path / "t.json"))
+            cache.store("daxpy/chunk",
+                        fingerprint(n=512, dtype="float64"), 4)
+            cache.save()
+            rc = daxpy.main(["--n", "512", "--dtype", "float64",
+                             "--iters", "5",
+                             "--tune-cache", str(tmp_path / "t.json")])
+        finally:
+            tr.deconfigure()
+        chunked = capsys.readouterr().out
+        assert rc == 0  # the per-element + checksum gates passed
+        # same SUM, same line shapes (TIME values differ — timing)
+        sum_of = lambda t: [ln for ln in t.splitlines()  # noqa: E731
+                            if "SUM =" in ln]
+        assert sum_of(chunked) == sum_of(base)
+
+    def test_malformed_chunk_degrades_to_prior(self, capsys, tmp_path):
+        from tpu_mpi_tests.tune import registry as tr
+        from tpu_mpi_tests.tune.fingerprint import fingerprint
+        from tpu_mpi_tests.workloads import daxpy
+
+        try:
+            cache = tr.configure(cache_path=str(tmp_path / "t.json"))
+            cache.store("daxpy/chunk",
+                        fingerprint(n=512, dtype="float64"), "bogus")
+            cache.save()
+            rc = daxpy.main(["--n", "512", "--dtype", "float64",
+                             "--iters", "3",
+                             "--tune-cache", str(tmp_path / "t.json")])
+        finally:
+            tr.deconfigure()
+        capsys.readouterr()
+        assert rc == 0
+
+    def test_space_declared_with_prior_one(self):
+        from tpu_mpi_tests.tune import registry as tr
+
+        sp = tr.space("daxpy/chunk")
+        assert sp.prior == 1
+        assert sp.candidates[0] == 1
+
 
 class TestEmbeddingSpec:
     def test_one_shot_driver_end_to_end(self, capsys, tmp_path):

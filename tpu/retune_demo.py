@@ -1,0 +1,80 @@
+"""Induced-drift serving demo: the closed tuning loop in one run.
+
+Replaces the daxpy serve handler with a synthetic one whose service
+time is keyed on the RESOLVED ``daxpy/chunk`` schedule: the pre-seeded
+winner (chunk=1, warmed into ``--tune-cache`` before launch) silently
+degrades after ``--drift-after`` batches — the "conditions drifted
+under a tuned schedule" scenario fleet tuning exists for — while every
+other candidate stays fast. Everything downstream is the REAL stack:
+the metrics tee latches ``tune_stale`` when the class's achieved GB/s
+sags below the winner's own baseline, and with ``--retune`` the serve
+loop's controller re-sweeps between windows, hot-swaps the handler, and
+the SLO windows recover; without it the run limps to the end and
+``tpumt-doctor`` convicts ``stale_schedule``.
+
+Used by ``make fleet-smoke`` (both leg shapes) and runnable by hand::
+
+    python -m tpu.retune_demo [--drift-after=N] <tpumt-serve args...>
+
+Every argument after the optional ``--drift-after=N`` is passed to
+``tpumt-serve`` verbatim.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    drift_after = 40
+    if argv and argv[0].startswith("--drift-after="):
+        drift_after = int(argv.pop(0).split("=", 1)[1])
+    slow_s = 0.03   # the drifted winner's per-batch service time
+    fast_s = 0.001  # every healthy candidate
+
+    from tpu_mpi_tests.drivers import _common
+    from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+    calls = {"n": 0}
+
+    def drifting_daxpy_factory(mesh, shape, dtype):
+        """The registry contract, synthetically timed: step(k) blocks
+        (sleeps) for a duration keyed on the resolved chunk schedule,
+        and carries the tune_info recipe the --retune controller
+        rebuilds through."""
+
+        def build(value=None):
+            # explicit > cached > prior, through the real resolver: the
+            # cached hit is what arms the metrics plane's stale watch
+            # (a tune_hit record flows through the tee)
+            eff = int(ensure_tuned(
+                "daxpy/chunk", lambda c: 0.0, explicit=value,
+            ))
+
+            def step(k: int):
+                calls["n"] += 1
+                drifted = eff == 1 and calls["n"] > drift_after
+                time.sleep(slow_s if drifted else fast_s)
+
+            step.tune_info = {
+                "knob": "daxpy/chunk",
+                "ctx": {},
+                "candidates": (1, 8, 32),
+                "rebuild": build,
+            }
+            return step
+
+        return build()
+
+    # registered FIRST: register_workload is setdefault, so the spec's
+    # own factory never displaces the drifting twin in this process
+    _common.register_workload("daxpy", drifting_daxpy_factory)
+    from tpu_mpi_tests.drivers import serve
+
+    return serve.main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ DEFAULT_ENTRY_MODULES = {
     "tpu_mpi_tests.instrument.live": "tpumt-top",
     "tpu_mpi_tests.analysis.cli": "tpumt-lint",
     "tpu_mpi_tests.analysis.records": "tpumt-records",
+    "tpu_mpi_tests.tune.pack": "tpumt-tune",
     # the rule modules load lazily at lint time (all_rules()), which the
     # static reachability walk cannot see — root them explicitly so an
     # eager jax import in a rule module is still caught

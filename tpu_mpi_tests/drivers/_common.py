@@ -181,6 +181,17 @@ def base_parser(description: str) -> argparse.ArgumentParser:
         "priors",
     )
     p.add_argument(
+        "--tune-pack",
+        default=None,
+        metavar="PACK",
+        help="preload a portable schedule pack (tpumt-tune pack/merge "
+        "— README 'Fleet tuning') into the in-memory cache before any "
+        "knob resolves: a fleet of identical topologies tunes once and "
+        "ships the artifact with the deployment; fingerprints still "
+        "gate which entries apply, and a corrupted pack degrades to "
+        "empty (priors) rather than failing the run",
+    )
+    p.add_argument(
         "--tune-budget",
         type=float,
         default=60.0,
@@ -406,13 +417,21 @@ def _attach_tune_sink(rep) -> None:
     winners/hits get a stable ``TUNE`` stdout line."""
     from tpu_mpi_tests.tune import registry as tr
 
+    # single-writer contract: setup_tuning configured the cache BEFORE
+    # bootstrap initialized jax.distributed, so the non-zero-rank
+    # read-only marking must be applied now that the rank is known
+    tr.mark_fleet_rank()
     if tr.configured_cache() is None:
         return
 
     import json as _json
 
     def emit(rec):
-        rep.jsonl({**rec, "rank": rep.rank})
+        # stamp the TRUE process index, not rep.rank: meshless specs
+        # (daxpy) pass rank=0 to make_reporter in every process, and a
+        # fleet sweep's per-rank tune records exist precisely to show
+        # which rank measured and which applied the broadcast winner
+        rep.jsonl({**rec, "rank": rep.proc_index})
         kind = rec.get("kind")
         if kind == "tune_result":
             sec = rec.get("seconds")
@@ -496,22 +515,38 @@ def setup_tuning(args) -> None:
     ``make_reporter`` re-configures with the reporter's JSONL sink).
 
     The cache loads when the run asked for tuning (``--tune`` /
-    ``--tune-cache``) or when the default cache file already exists —
-    so a warmed machine benefits without flags, while a pristine
-    machine (no cache, no ``--tune``) resolves every schedule from the
-    shipped priors, byte-identical to the pre-autotuner behavior."""
+    ``--tune-cache`` / ``--tune-pack``) or when the default cache file
+    already exists — so a warmed machine benefits without flags, while
+    a pristine machine (no cache, no ``--tune``) resolves every
+    schedule from the shipped priors, byte-identical to the
+    pre-autotuner behavior. A ``--tune-pack`` artifact is absorbed into
+    the in-memory cache (newer-measurement-wins against local entries)
+    so every later resolution sees the shipped schedules."""
     from tpu_mpi_tests.tune import cache as tc
     from tpu_mpi_tests.tune import registry as tr
 
     path = getattr(args, "tune_cache", None) or tc.default_cache_path()
-    wants = getattr(args, "tune", False) or getattr(args, "tune_cache", None)
+    pack_path = getattr(args, "tune_pack", None)
+    wants = (getattr(args, "tune", False)
+             or getattr(args, "tune_cache", None) or pack_path)
     if not wants and not os.path.exists(path):
         return
-    tr.configure(
+    cache = tr.configure(
         cache_path=path,
         enabled=getattr(args, "tune", False),
         budget_s=getattr(args, "tune_budget", None),
     )
+    if pack_path:
+        from tpu_mpi_tests.tune import pack as tp
+
+        doc = tp.load_pack(pack_path)
+        if not doc["entries"]:
+            print(f"NOTE --tune-pack {pack_path}: empty or unreadable "
+                  f"pack; resolving from the local cache/priors")
+        else:
+            n = tp.absorb(cache, doc)
+            print(f"TUNE PACK {pack_path}: {n} of "
+                  f"{len(doc['entries'])} schedule entries preloaded")
 
 
 def jnp_dtype(args):

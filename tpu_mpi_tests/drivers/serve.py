@@ -62,6 +62,17 @@ def run(args) -> int:
 
     rep = _common.make_reporter(args, rank=topo.process_index, size=world)
     with rep:
+        if args.retune and rep.metrics is None:
+            # --retune without --metrics-port: attach a sink-only
+            # registry NOW, before the handlers warm — their tune_hit
+            # records are what arm the stale watch, so the tee must be
+            # live before the first resolution flows (no exporter, no
+            # heartbeat threads: just the record tee)
+            from tpu_mpi_tests.instrument.metrics import MetricsRegistry
+
+            rep.attach_metrics(MetricsRegistry(
+                health_sink=lambda rec: rep.jsonl(
+                    {**rec, "rank": rep.proc_index})))
         if args.arrival == "poisson":
             load = f"rate={args.rate:g}/s"
         else:
@@ -112,6 +123,27 @@ def run(args) -> int:
             watchdog=wd,
             quarantine_after=args.quarantine_after,
         )
+        if args.retune:
+            # the closed loop: tune_stale (metrics tee, attached above
+            # before the handlers warmed) → bounded between-windows
+            # re-sweep → hot swap via registry.resolve → kind:"control"
+            # tune_swap records (tune/controller.py). The stale watch
+            # reads span GB/s, so telemetry must be on. Bound to the
+            # LOOP's handler dict (the loop copies the caller's) so a
+            # hot swap lands in the dict batches actually dispatch from.
+            from tpu_mpi_tests.tune.controller import TuneController
+
+            if not args.telemetry:
+                rep.line("NOTE --retune needs --telemetry (tune_stale "
+                         "watches span GB/s); the controller will "
+                         "never fire")
+            loop.controller = TuneController(
+                rep.metrics, loop.handlers,
+                sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}),
+                line=rep.line,
+                budget_s=args.batch_deadline or args.tune_budget,
+                watchdog=wd,
+            )
         summaries = loop.run()
 
         rc = 0
@@ -230,6 +262,17 @@ def main(argv=None) -> int:
         "lands in the SLO table instead of the whole run exiting 1 "
         "(closed-loop note: requests shed during quarantine thin the "
         "client population like any shed). Default: off",
+    )
+    p.add_argument(
+        "--retune", action="store_true",
+        help="online closed-loop tuning: when a class's achieved GB/s "
+        "sags below its tuned winner's baseline (the tune_stale health "
+        "latch — README 'Live observability'), run a bounded re-sweep "
+        "of that class's knob between SLO windows and hot-swap the "
+        "schedule, emitting kind:'control' tune_swap records "
+        "(README 'Fleet tuning'). Needs --telemetry; the re-sweep "
+        "budget is --batch-deadline (else --tune-budget). Classes "
+        "without a tune_info recipe are never re-tuned",
     )
     p.add_argument(
         "--batch-deadline", type=float, default=None, metavar="S",

@@ -55,7 +55,12 @@ into:
   latency-bound regime where GB/s tables are blind;
 * WORKLOAD rows (``kind: "workload"`` records — the spec runner's
   stable bench row, ``workloads/runner.py``): one headline metric per
-  workload spec, regression direction carried by the record itself.
+  workload spec, regression direction carried by the record itself;
+* a CONTROL table (``kind: "control"`` records from the serve loop's
+  online re-tune controller — ``tune/controller.py``, README "Fleet
+  tuning"): per re-tuned class, how many ``tune_swap``s fired, the
+  old/new winner, the sag that triggered each, and the re-sweep
+  seconds — the controller's actions made auditable post-mortem.
 
 ``--diff A B`` compares two runs instead: two JSONL sets (per-phase /
 per-op / memory metrics) or two bench JSON files (``bench.py`` output or
@@ -190,6 +195,7 @@ def summarize(
     route: dict[str, dict] = {}
     decode: dict[str, dict] = {}
     workload: dict[str, dict] = {}
+    control: dict[str, dict] = {}
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -386,6 +392,27 @@ def summarize(
                     wl["unit"] = rec["unit"]
                 if rec.get("higher_better") is not None:
                     wl["higher_better"] = bool(rec["higher_better"])
+            elif kind == "control":
+                key = (f"{rec.get('class', '?')}|"
+                       f"{rec.get('knob', '?')}")
+                c = control.setdefault(
+                    key, {"class": rec.get("class"),
+                          "knob": rec.get("knob"),
+                          "event": rec.get("event"),
+                          "swaps": 0, "old": None, "new": None,
+                          "signal": None, "sag_pct": [],
+                          "resweep_s": 0.0},
+                )
+                c["swaps"] += 1
+                if c["old"] is None:
+                    c["old"] = rec.get("old")
+                c["new"] = rec.get("new")
+                if rec.get("signal") is not None:
+                    c["signal"] = rec.get("signal")
+                if isinstance(rec.get("sag_pct"), (int, float)):
+                    c["sag_pct"].append(float(rec["sag_pct"]))
+                if isinstance(rec.get("resweep_s"), (int, float)):
+                    c["resweep_s"] += float(rec["resweep_s"])
             elif kind == "serve":
                 sv = serve.setdefault(
                     rec.get("class", "?"),
@@ -460,6 +487,14 @@ def summarize(
         },
         "overlap": {op: _overlap_row(overlap[op])
                     for op in sorted(overlap)},
+        "control": {
+            key: {**{f: c[f] for f in ("class", "knob", "event",
+                                       "swaps", "old", "new",
+                                       "signal", "resweep_s")},
+                  "sag_pct": (sum(c["sag_pct"]) / len(c["sag_pct"])
+                              if c["sag_pct"] else None)}
+            for key, c in sorted(control.items())
+        },
         "bench": {
             key: {"value": sum(vals) / len(vals),
                   "band": _noise_band(vals), "n": len(vals)}
@@ -783,6 +818,18 @@ def _print_text(summary: dict, skew_threshold: float,
         print(
             f"BENCH {key}: value={b['value']:.6g} n={b['n']} "
             f"band=±{b['band'] * 100:.2f}%"
+        )
+
+    for _key, c in summary.get("control", {}).items():
+        sag = ("-" if c.get("sag_pct") is None
+               else format(c["sag_pct"], ".1f") + "%")
+        print(
+            f"CONTROL {c.get('event', '?')} {c.get('class', '?')}: "
+            f"knob={c.get('knob')} n={c['swaps']} "
+            f"old={json.dumps(c.get('old'))} "
+            f"new={json.dumps(c.get('new'))} sag={sag} "
+            f"signal={c.get('signal') or '-'} "
+            f"resweep={c.get('resweep_s', 0.0):.3g}s"
         )
 
     for name, t in summary.get("tuning", {}).items():

@@ -33,6 +33,12 @@ Failure classes and the signals that convict them:
   load: the queue bound is doing the dropping, not the handlers.
   Classes under quarantine (serve ``--quarantine-after`` graceful
   degradation, a designed isolation with its own records) are exempt.
+* ``stale_schedule`` — a ``kind:"health" event:"tune_stale"`` latch
+  (the metrics plane's achieved-GB/s sag watermark, README "Live
+  observability") that no ``kind:"control" event:"tune_swap"``
+  answered: the run kept serving a tuned schedule its own telemetry
+  says has gone stale. A swap for the same op exonerates — the re-tune
+  controller (``--retune``) acting IS the closed loop working.
 
 The doctor convicts from the ORGANIC telemetry only: ``kind: "chaos"``
 injection-audit records are deliberately ignored, so the chaos-smoke
@@ -57,7 +63,7 @@ _INF = float("inf")
 #: the classes a finding can carry (the chaos smoke maps injected
 #: faults onto them via tpu_mpi_tests.chaos.spec.FINDING_FOR)
 FINDING_CLASSES = ("missing_rank", "straggler", "wedge", "oom",
-                   "shed_storm")
+                   "shed_storm", "stale_schedule")
 
 #: conviction thresholds — deliberately stricter than tpumt-report's
 #: reporting bands (1.5x skew): the report flags for a human to read,
@@ -70,6 +76,10 @@ DEFAULTS = {
     "ramp_ratio": 3.0,       # census-only oom growth factor
     "limit_frac": 0.5,       # oom: fraction of hbm_bytes_limit crossed
     "shed_min": 10,          # serve sheds before a storm verdict
+    "stale_grace_s": 5.0,    # seconds a tune_stale may wait for its
+                             # tune_swap before stale_schedule convicts
+                             # (mid-follow the controller needs a
+                             # window boundary to act)
 }
 
 
@@ -158,6 +168,11 @@ class _Stream:
         self.serve_windows: dict[str, deque] = {}
         self.serve_settled: dict[str, dict] = {}
         self.serve_first_shed: dict[str, list] = {}
+        # stale-schedule digest: the FIRST tune_stale latch per op (the
+        # registry latches once per op, so first == only, but a rerun
+        # segment could repeat) and the latest tune_swap answer per op
+        self.stale_ops: dict[str, tuple[int, dict, float]] = {}
+        self.swap_t: dict[str, float] = {}
         for ln, rec in (records or []):
             self.add(ln, rec)
 
@@ -251,6 +266,22 @@ class _Stream:
                     self.phase_last_t[name] = t
         elif kind == "telemetry_summary":
             self._has_summary = True
+        elif kind == "health":
+            if rec.get("event") == "tune_stale" and rec.get("op"):
+                # LATEST latch wins: the --retune controller re-arms the
+                # watch after a swap, so an op can latch again — keeping
+                # the first latch would let the old swap exonerate the
+                # new, unanswered one
+                self.stale_ops[str(rec["op"])] = (
+                    ln, rec, t if t is not None else 0.0
+                )
+        elif kind == "control":
+            if rec.get("event") == "tune_swap" and rec.get("op"):
+                op = str(rec["op"])
+                self.swap_t[op] = max(
+                    self.swap_t.get(op, -_INF),
+                    t if t is not None else _INF,
+                )
         elif kind == "serve":
             cls = rec.get("class")
             event = rec.get("event")
@@ -706,6 +737,48 @@ def _shed_storm_findings(streams: list[_Stream], opts) -> list[dict]:
     return out
 
 
+def _stale_schedule_findings(streams: list[_Stream], opts,
+                             followed: bool = False) -> list[dict]:
+    """A latched ``tune_stale`` with no ``tune_swap`` answer: the run's
+    own telemetry said the tuned schedule sagged below its baseline and
+    nothing re-tuned it. A swap at-or-after the latch exonerates (the
+    ``--retune`` controller closing the loop is the healthy outcome —
+    the doctor must not convict exactly the runs the controller saves).
+    Mid-follow a latch fresher than ``stale_grace_s`` stays unconvicted
+    — the controller only acts at the next window boundary — while the
+    post-mortem pass convicts every unanswered latch: the run ended, no
+    swap can come. One finding per rank, naming the first unanswered
+    op."""
+    out = []
+    for s in streams:
+        unanswered = []
+        for op, (ln, rec, t) in sorted(s.stale_ops.items()):
+            if s.swap_t.get(op, -_INF) >= t:
+                continue  # the controller answered: loop closed
+            if (followed and s.last_t is not None
+                    and s.last_t - t < opts["stale_grace_s"]):
+                continue  # too fresh to judge live: a swap may come
+            unanswered.append((op, ln, rec, t))
+        if not unanswered:
+            continue
+        op, ln, rec, t = unanswered[0]
+        sag = rec.get("sag_pct")
+        signal = rec.get("signal")
+        out.append(_finding(
+            "stale_schedule", s.rank, 0.75,
+            f"op {op!r} sagged {sag}% below its tuned baseline "
+            f"(signal={signal}, knobs={rec.get('knobs')}) and no "
+            f"tune_swap followed — the run kept serving a schedule its "
+            f"own telemetry convicted; re-sweep (--tune / serve "
+            f"--retune) or ship a fresher --tune-pack"
+            + (f"; {len(unanswered) - 1} more op(s) stale"
+               if len(unanswered) > 1 else ""),
+            [s.ref(ln, rec)],
+            last_op=op, phase=None, t=t,
+        ))
+    return out
+
+
 def diagnose_streams(streams: list[_Stream], ctx: dict | None = None,
                      followed: bool = False, **overrides) -> list[dict]:
     """Apply every rule; findings sorted most-confident first.
@@ -757,6 +830,11 @@ def diagnose_streams(streams: list[_Stream], ctx: dict | None = None,
     )
     findings.extend(
         f for f in _shed_storm_findings(streams, opts)
+        if f["rank"] not in dead_ranks
+    )
+    findings.extend(
+        f for f in _stale_schedule_findings(streams, opts,
+                                            followed=followed)
         if f["rank"] not in dead_ranks
     )
     findings.sort(key=lambda f: (-f["confidence"], f["class"],

@@ -143,6 +143,14 @@ class _TuneStaleWatch:
             if knob and knob not in self._knobs:
                 self._knobs.append(str(knob))
 
+    def reset(self, op: str) -> None:
+        """Forget an op's baseline AND its latch: the re-tune controller
+        calls this after a ``tune_swap`` so the op re-baselines on the
+        NEW schedule's first readings — recovery becomes measurable and
+        a future sag of the new winner can fire again."""
+        with self._lock:
+            self._ops.pop(op, None)
+
     def span(self, op: str, gbps, roofline_frac) -> None:
         with self._lock:
             if not self._knobs:
@@ -210,11 +218,23 @@ class MetricsRegistry:
         #: recent kind:"health" records (observed or self-fired) for the
         #: dashboard's HEALTH section — bounded by construction
         self.health_events: deque = deque(maxlen=16)
+        #: synchronous subscribers to non-heartbeat health events (the
+        #: serve-loop re-tune controller latches tune_stale through
+        #: this); registered during single-threaded setup, called on the
+        #: observing thread, exceptions swallowed like every tee path
+        self._health_listeners: list = []
         self.started_wall = wall()
         self._stale = _TuneStaleWatch(self)
 
     def set_health_sink(self, sink: Callable[[dict], None] | None) -> None:
         self._health_sink = sink
+
+    def add_health_listener(self, cb: Callable[[dict], None]) -> None:
+        self._health_listeners.append(cb)
+
+    def reset_stale(self, op: str) -> None:
+        """Re-baseline an op's tune_stale watch (post-swap)."""
+        self._stale.reset(op)
 
     # -- series primitives -------------------------------------------------
 
@@ -435,6 +455,15 @@ class MetricsRegistry:
                  (("event", str(rec.get("event", "?"))),))
         if rec.get("event") != "heartbeat":
             self.health_events.append(dict(rec))
+            for cb in self._health_listeners:
+                try:
+                    cb(rec)
+                except Exception:
+                    pass
+
+    def _on_control(self, rec: dict) -> None:
+        self.inc("tpumt_control_events",
+                 (("event", str(rec.get("event", "?"))),))
 
     def _on_tune_hit(self, rec: dict) -> None:
         self.inc("tpumt_tune_resolutions",

@@ -222,6 +222,12 @@ class Reporter:
         # value (attribute stores are atomic under the GIL)
         self._metrics = registry  # tpumt: ignore[TPM1601]
 
+    @property
+    def metrics(self):
+        """The attached live MetricsRegistry, or None — the re-tune
+        controller wires its tune_stale subscription through this."""
+        return self._metrics
+
     def attach_live(self, *stoppables):
         """Own live-plane components (heartbeat thread, metrics
         exporter, phase-progress hook): closing the reporter calls

@@ -224,6 +224,22 @@ def _collect(streams):
                     float(rec["t"]) - offset, "p",
                     args_from(rec, ("deadline_s",)),
                 ))
+            elif kind == "control":
+                # the re-tune controller acting (tune/controller.py):
+                # a process-scoped marker at the hot-swap instant, so
+                # the timeline shows the schedule change between the
+                # sagging windows and the recovered ones
+                if rec.get("t") is None:
+                    unplaced += 1
+                    continue
+                instants.append((
+                    rank, TID_COMM,
+                    f"CONTROL {rec.get('event', '?')} "
+                    f"{rec.get('class') or rec.get('knob', '?')}",
+                    "control", float(rec["t"]) - offset, "p",
+                    args_from(rec, ("knob", "op", "old", "new",
+                                    "sag_pct", "signal", "resweep_s")),
+                ))
             elif kind == "compile":
                 if rec.get("t_start") is None:
                     unplaced += 1

@@ -145,6 +145,7 @@ class ServeLoop:
         sink: Callable[[dict], None] | None = None,
         watchdog=None,
         quarantine_after: int | None = None,
+        controller=None,
         clock: Callable[[], float] = time.monotonic,
         wall: Callable[[], float] = time.time,
         sleep: Callable[[float], None] = time.sleep,
@@ -169,6 +170,12 @@ class ServeLoop:
         # (None = off, the pre-quarantine behavior)
         self.quarantine_after = (int(quarantine_after)
                                  if quarantine_after else None)
+        # online re-tuning (tune/controller.py, --retune): consulted at
+        # window boundaries only — between batches, like the quarantine
+        # probes — so a bounded re-sweep is quarantine-style degraded
+        # service (arrivals queue through it), never a mid-batch stall.
+        # None = off, byte-identical to the pre-controller loop.
+        self.controller = controller
         self._quarantined: dict[str, float] = {}  # key -> wall t of entry
         self._clock = clock
         self._wall = wall
@@ -375,6 +382,8 @@ class ServeLoop:
                         admit(now, synthetic=True)
                 if self._quarantined:
                     self._probe_quarantined(w_end)
+                if self.controller is not None:
+                    self.controller.window_boundary(w_end)
 
             if queue:
                 batch, queue = coalesce(queue, self.max_batch)

@@ -24,6 +24,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 from pathlib import Path
 from typing import Any
 
@@ -53,11 +54,20 @@ def _key(knob: str, fingerprint: str) -> str:
 class ScheduleCache:
     """In-memory view of one cache file. ``load`` never raises on bad
     content; ``save`` is atomic and merge-on-write (a concurrent sweep
-    of a DIFFERENT knob on the same file loses nothing)."""
+    of a DIFFERENT knob on the same file loses nothing).
+
+    ``read_only`` makes :meth:`save` a no-op: the multi-process
+    single-writer contract (ISSUE 14). Every rank of a fleet run loads
+    and resolves from the shared file, but only rank 0 may write it —
+    N ranks' merge-on-write saves interleaving on one shared homedir is
+    exactly the race the atomic rename cannot fix (each rename is
+    atomic; the read-merge-write sequences still clobber each other).
+    ``tune.registry.configure`` marks non-zero ranks read-only."""
 
     def __init__(self, path: str):
         self.path = str(path)
         self.entries: dict[str, dict[str, Any]] = {}
+        self.read_only = False
         self._lock = threading.Lock()
 
     @classmethod
@@ -101,6 +111,10 @@ class ScheduleCache:
             "seconds": seconds,
             "knob": knob,
             "fingerprint": fingerprint,
+            # measurement time: what `tpumt-tune merge`'s
+            # newer-measurement-wins rule arbitrates conflicts with
+            # (pre-timestamp entries read as oldest)
+            "t": time.time(),
             **extra,
         }
         with self._lock:
@@ -108,7 +122,10 @@ class ScheduleCache:
 
     def save(self) -> None:
         """Atomic write, merged over the file's current content so
-        concurrent writers of disjoint keys compose."""
+        concurrent writers of disjoint keys compose. A ``read_only``
+        cache (non-zero ranks of a fleet run) never writes."""
+        if self.read_only:
+            return
         with self._lock:
             merged = self._read_entries(self.path)
             merged.update(self.entries)

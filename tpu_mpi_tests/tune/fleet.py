@@ -1,0 +1,175 @@
+"""Fleet broadcast transport: replicate rank-0 decisions to every process.
+
+The rank-0-swept, broadcast-applied sweep protocol (ISSUE 14 tentpole a)
+needs exactly one primitive: a small JSON-serializable value computed on
+process 0 delivered verbatim to every process, at a point every process
+reaches together. :func:`bcast` is that primitive, and the ONLY sanctioned
+shape for consuming it is the TPM1301 shape the broadcast-consistency
+rule was built to police::
+
+    if jax.process_index() == 0:
+        decision = ...          # only rank 0 computed the real value
+    else:
+        decision = None         # placeholder, not a value
+    decision = bcast(decision, tag)   # now identical on every rank
+
+(The helper is deliberately named ``bcast`` — one of the curated
+``BROADCAST_CALLS`` the analyzer recognizes as a replication point — so
+the shipped protocol lints clean while a mutant that drops the broadcast
+is convicted; ``tests/test_lint.py`` seeds exactly that mutant.)
+
+Two transports, probed once per process:
+
+* **device** — ``multihost_utils.broadcast_one_to_all`` over a
+  fixed-size length-prefixed ``uint8`` buffer: the documented jax
+  multihost path, used on real TPU pods.
+* **kv** — the ``jax.distributed`` coordination-service key-value store
+  (the same service ``jax.distributed.initialize`` stands up for every
+  multi-process run): rank 0 ``key_value_set``s the payload under a
+  sequence-numbered key, every other rank blocks on
+  ``blocking_key_value_get``. This is the fallback where the backend has
+  no cross-process device collectives (this repo's CI image: the CPU
+  backend raises ``Multiprocess computations aren't implemented``), and
+  it is what ``make fleet-smoke`` exercises.
+
+Key sequencing relies on the SPMD contract the sweep protocol already
+guarantees: every process calls :func:`bcast` the same number of times
+in the same order, so the per-process counters agree and keys collide
+never. A process where neither transport exists raises
+:class:`FleetUnavailable` — callers degrade to the PR-4 skip contract
+(record the skip, resolve cached > prior) instead of diverging.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import struct
+
+#: fixed device-broadcast buffer: 4-byte little-endian length prefix +
+#: payload. Schedule values are ints/strings/flat dicts by the cache
+#: contract — a decision that does not fit here is a bug, not a payload.
+MAX_PAYLOAD = 4096
+
+#: how long a non-zero rank waits on a rank-0 KV decision before giving
+#: up (seconds; ``TPU_MPI_FLEET_TIMEOUT_S`` overrides). Generous by
+#: design: the ranks measure the same candidates at the same time, so
+#: the wait is bounded by cross-rank measurement skew, not sweep length.
+KV_TIMEOUT_S = 600.0
+
+_SEQ = itertools.count()
+_TRANSPORT: str | None = None  # "device" | "kv", decided at first use
+
+
+class FleetUnavailable(RuntimeError):
+    """No broadcast transport exists in this process: device collectives
+    unavailable AND no coordination-service client. Callers fall back to
+    the single-process-era skip contract."""
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _encode(obj) -> str:
+    return json.dumps(obj)
+
+
+def _device_bcast(payload: str) -> str:
+    """``broadcast_one_to_all`` of a length-prefixed uint8 buffer. Every
+    process passes the same-shape buffer (receivers' contents are
+    ignored), so the call is SPMD-symmetric by construction."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    data = payload.encode("utf-8")
+    if len(data) > MAX_PAYLOAD - 4:
+        raise ValueError(
+            f"fleet broadcast payload of {len(data)} bytes exceeds "
+            f"{MAX_PAYLOAD - 4} (schedule decisions are tiny by the "
+            f"cache contract)"
+        )
+    buf = np.zeros(MAX_PAYLOAD, np.uint8)
+    buf[:4] = np.frombuffer(struct.pack("<I", len(data)), np.uint8)
+    buf[4:4 + len(data)] = np.frombuffer(data, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf), np.uint8)
+    n = struct.unpack("<I", out[:4].tobytes())[0]
+    return out[4:4 + n].tobytes().decode("utf-8")
+
+
+def _kv_client():
+    """The jax.distributed coordination-service client, or None. Reads
+    process-global distributed state only — never initializes a
+    backend."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+def _kv_bcast(payload: str, key: str) -> str:
+    client = _kv_client()
+    if client is None:
+        raise FleetUnavailable(
+            "no fleet broadcast transport: device collectives are "
+            "unavailable on this backend and the jax.distributed "
+            "coordination client is not initialized"
+        )
+    timeout_ms = int(
+        float(os.environ.get("TPU_MPI_FLEET_TIMEOUT_S", KV_TIMEOUT_S))
+        * 1000
+    )
+    if process_index() == 0:
+        client.key_value_set(key, payload)
+        return payload
+    return client.blocking_key_value_get(key, timeout_ms)
+
+
+def bcast(obj, tag: str = ""):
+    """Replicate rank 0's JSON-serializable ``obj`` to every process.
+
+    Single-process: identity (after a JSON round-trip on neither path —
+    the value is returned as-is). Multi-process: the device transport is
+    tried once; a backend without cross-process collectives permanently
+    falls back to the coordination-service KV store. A transport that
+    worked once is never silently switched mid-run — a failure after
+    that propagates, because half a fleet changing transports is a
+    divergence, not a degradation.
+
+    Every process MUST call this the same number of times in the same
+    order (the sweep protocol guarantees it); the shared sequence
+    counter is what keys the KV path."""
+    global _TRANSPORT
+    if process_count() <= 1:
+        return obj
+    seq = next(_SEQ)
+    payload = _encode(obj)
+    if _TRANSPORT in (None, "device"):
+        try:
+            out = _device_bcast(payload)
+            _TRANSPORT = "device"
+            return json.loads(out)
+        except ValueError:
+            raise  # oversized payload: a bug on every transport
+        except Exception:
+            if _TRANSPORT == "device":
+                raise  # worked before: do not silently switch mid-run
+    out = _kv_bcast(payload, f"tpumt/tune/{tag}/{seq}")
+    _TRANSPORT = "kv"
+    return json.loads(out)
+
+
+def _reset_transport_for_tests() -> None:
+    global _TRANSPORT
+    _TRANSPORT = None

@@ -98,3 +98,14 @@ COLL_DISPATCH_DEPTH = 1
 # both knobs resolve with device_fallback=False (payload/shape keyed).
 MOE_COMBINE = "alltoall"
 EMBED_LOOKUP = "take"
+
+# Host-dispatch chunking for the daxpy pillar (ISSUE 14): how many
+# kernel applications one dispatch chains device-side (a fori_loop of
+# identical applications — bitwise the same result, since each
+# iteration recomputes from the same operands). 1 = the reference's
+# dispatch-per-iteration semantics, byte-identical stdout; bigger
+# chunks amortize the per-dispatch fixed cost the decode pillar
+# measures in µs/op. Deliberately a LOCAL-compute knob: it is the
+# fleet-sweep smoke's measurable candidate on backends whose
+# cross-process device collectives don't exist (make fleet-smoke).
+DAXPY_CHUNK = 1

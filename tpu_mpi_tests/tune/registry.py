@@ -90,6 +90,7 @@ def _import_knob_owners() -> None:
     import tpu_mpi_tests.comm.moe  # noqa: F401
     import tpu_mpi_tests.comm.ring  # noqa: F401
     import tpu_mpi_tests.drivers.collbench  # noqa: F401
+    import tpu_mpi_tests.workloads.daxpy  # noqa: F401
 
 
 class _State:
@@ -118,13 +119,50 @@ def configure(
     (:func:`~tpu_mpi_tests.tune.sweep.ensure_tuned`); lookups of an
     existing cache work regardless, which is how ``bench.py`` consults a
     warmed cache without any flag. ``emit`` is the default JSONL sink
-    for sweep records (a driver passes its Reporter's)."""
+    for sweep records (a driver passes its Reporter's).
+
+    Multi-process runs get ONE cache writer: non-zero ranks load and
+    resolve like any other, but their cache is marked read-only so no
+    code path (a fleet sweep, bench's on-miss sweep, the serve-loop
+    re-tune controller) can ever interleave a merge-on-write save with
+    rank 0's on a shared homedir — the winner every rank applies
+    arrives by broadcast, not through the file."""
     with _STATE_LOCK:
         _STATE.cache = ScheduleCache.load(cache_path or default_cache_path())
+        _STATE.cache.read_only = _nonzero_rank()
         _STATE.enabled = bool(enabled)
         _STATE.budget_s = budget_s
         _STATE.emit = emit
         return _STATE.cache
+
+
+def _nonzero_rank() -> bool:
+    """True on the non-writer ranks of a multi-process run. Reads the
+    jax.distributed process-global state only (set by
+    ``jax.distributed.initialize`` / ``comm.mesh.bootstrap``) — never
+    initializes a backend, and answers False wherever jax itself is
+    absent, so stdlib/login-node callers are untouched."""
+    try:
+        from jax._src import distributed
+
+        st = distributed.global_state
+        return bool(st.num_processes and st.num_processes > 1
+                    and st.process_id)
+    except Exception:
+        return False
+
+
+def mark_fleet_rank() -> None:
+    """Re-evaluate the single-writer marking. Drivers call
+    :func:`configure` from ``setup_platform`` BEFORE
+    ``comm.mesh.bootstrap`` initializes jax.distributed, so the
+    configure-time check sees an uninitialized state and every rank
+    looks like a writer; ``drivers/_common.make_reporter`` (which runs
+    after bootstrap on every driver path) calls this to apply the
+    marking once the process-global rank is actually known."""
+    with _STATE_LOCK:
+        if _STATE.cache is not None and _nonzero_rank():
+            _STATE.cache.read_only = True
 
 
 def deconfigure() -> None:

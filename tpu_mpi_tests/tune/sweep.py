@@ -26,6 +26,23 @@ JSONL records (rendered by ``tpumt-report``'s tuning table):
 * ``{"kind": "tune_hit", knob, value, fingerprint}`` — a resolution
   served from the cache with no sweep (what ``make tune-smoke`` asserts
   on its second invocation).
+
+Multi-process runs measure too (ISSUE 14): every rank runs every
+candidate — the candidates dispatch collectives, so all ranks must be
+present — but ONLY rank 0's timer decides. The per-candidate
+continue/stop (the budget cutoff) and the final winner are replicated
+to every rank through :func:`tpu_mpi_tests.tune.fleet.bcast` before any
+rank acts on them, so the executed candidate sequence and the applied
+schedule are identical on every rank BY CONSTRUCTION — the TPM1301
+broadcast-consistency shape, dogfooded (a mutant that drops the winner
+broadcast is a lint finding; ``tests/test_lint.py`` seeds it). The
+winner is stored by rank 0 alone (the cache has ONE writer — see
+:meth:`~tpu_mpi_tests.tune.cache.ScheduleCache.save`), per-candidate
+``tune`` records are rank-0-only ("exactly one sweep"), and the
+``tune_result`` record every rank emits is built once on rank 0 and
+broadcast, so the per-rank JSONL streams carry byte-identical resolved
+schedules. A fleet without any broadcast transport keeps the PR-4
+contract: record the skip, resolve cached > prior.
 """
 
 from __future__ import annotations
@@ -91,15 +108,12 @@ def sweep(
     the device-only fingerprint, so context-free resolution sites still
     benefit from a sweep run with full context.
 
-    Single-process only: candidate measurements dispatch collectives,
-    and every per-rank decision in a sweep — the wall-clock budget
-    cutoff, an errored candidate, the winner itself — is local, so two
-    processes could execute different candidate programs and hang the
-    pod on a collective only a subset of ranks entered. A multi-process
-    run therefore never measures: it records the skip and resolves
-    cached > prior (warm the cache in a single-process run on one host
-    of the same topology, or point every process at one shared
-    ``--tune-cache`` file)."""
+    Multi-process runs take the rank-0-swept, broadcast-applied path
+    (:func:`_fleet_sweep` — see the module docstring): every per-rank
+    decision that used to make pod sweeps unsafe (budget cutoff, winner
+    choice) is made once on rank 0 and broadcast before any rank acts
+    on it. Single-process behavior is byte-identical to the PR-4
+    engine."""
     if candidates is None:
         candidates = registry.space(knob).candidates
     candidates = list(candidates)
@@ -109,17 +123,9 @@ def sweep(
     fp = fingerprint(**ctx)
 
     if _process_count() > 1:
-        fallback = registry.lookup(knob, **ctx)
-        if fallback is None:
-            fallback = candidates[0]
-        emit({"kind": "tune_result", "knob": knob, "value": fallback,
-              "seconds": None, "measured": 0,
-              "skipped": len(candidates), "fingerprint": fp,
-              "note": "sweep skipped: multi-process run (per-rank "
-                      "budget/winner decisions would diverge across "
-                      "ranks mid-collective); warm the cache "
-                      "single-process"})
-        return fallback
+        return _fleet_sweep(
+            knob, measure, candidates, budget_s, emit, persist, ctx, fp
+        )
 
     t_begin = time.perf_counter()
     best = None
@@ -175,6 +181,118 @@ def sweep(
     return best
 
 
+def _fleet_sweep(knob, measure, candidates, budget_s, emit, persist,
+                 ctx, fp):
+    """The rank-0-swept, broadcast-applied multi-process sweep.
+
+    Every rank measures every candidate (the candidate programs dispatch
+    collectives — all ranks must enter them together), but only rank 0's
+    clock and timer have authority: the per-candidate go/stop decision
+    and the final winner record are computed on rank 0 and replicated
+    through :func:`~tpu_mpi_tests.tune.fleet.bcast` before any rank acts
+    on them, so budget cutoffs and applied schedules are identical on
+    every rank by construction. Per-candidate ``tune`` records and the
+    cache write are rank-0-only; the broadcast ``tune_result`` is
+    emitted by every rank (identical content — the per-rank JSONL
+    streams agree byte for byte on the resolved schedule)."""
+    from tpu_mpi_tests.tune import fleet
+
+    try:
+        # the opening handshake doubles as the transport probe: a fleet
+        # with no broadcast path degrades to the PR-4 skip contract on
+        # every rank symmetrically, instead of diverging mid-sweep
+        fleet.bcast({"knob": knob, "n": len(candidates)}, f"{knob}:open")
+    except fleet.FleetUnavailable as e:
+        fallback = registry.lookup(knob, **ctx)
+        if fallback is None:
+            fallback = candidates[0]
+        emit({"kind": "tune_result", "knob": knob, "value": fallback,
+              "seconds": None, "measured": 0,
+              "skipped": len(candidates), "fingerprint": fp,
+              "note": f"sweep skipped: multi-process run with no fleet "
+                      f"broadcast transport ({e}); warm the cache "
+                      f"single-process or ship a --tune-pack"})
+        return fallback
+
+    rank = fleet.process_index()
+    t_begin = time.perf_counter()
+    best = None
+    best_sec = float("inf")
+    measured = 0
+    skipped = 0
+    for i, cand in enumerate(candidates):
+        # rank 0's clock is the ONLY budget authority; every rank
+        # applies the broadcast decision, so the executed candidate
+        # sequence cannot diverge (the prior, candidate 0, is always
+        # measured — same contract as the single-process sweep)
+        if rank == 0:
+            go = bool(
+                i == 0
+                or budget_s is None
+                or time.perf_counter() - t_begin < budget_s
+            )
+        else:
+            go = None
+        go = fleet.bcast(go, f"{knob}:go{i}")
+        if not go:
+            skipped = len(candidates) - i
+            if rank == 0:
+                for c in candidates[i:]:
+                    emit({"kind": "tune", "knob": knob, "candidate": c,
+                          "skipped": "budget", "fingerprint": fp})
+            break
+        err = None
+        sec = float("nan")
+        with comm_span(f"tune:{knob}", candidate=cand):
+            try:
+                sec = float(measure(cand))
+            except Exception as e:  # infeasible candidate, not fatal
+                err = f"{type(e).__name__}: {e}"
+        if rank == 0:
+            rec = {"kind": "tune", "knob": knob, "candidate": cand,
+                   "seconds": None if sec != sec else sec,
+                   "fingerprint": fp}
+            if err is not None:
+                rec["error"] = err
+            emit(rec)
+            if sec == sec:
+                measured += 1
+                if sec < best_sec:
+                    best, best_sec = cand, sec
+
+    # rank 0 builds the COMPLETE winner record and broadcasts it; every
+    # rank emits the broadcast copy and applies its value — the TPM1301
+    # shape this protocol exists for (and the seeded-mutant gate strips)
+    if rank == 0:
+        if best is None:
+            result = {"kind": "tune_result", "knob": knob,
+                      "value": candidates[0], "seconds": None,
+                      "measured": 0, "skipped": skipped,
+                      "fingerprint": fp, "note": "no valid measurement"}
+        else:
+            result = {"kind": "tune_result", "knob": knob, "value": best,
+                      "seconds": best_sec, "measured": measured,
+                      "skipped": skipped, "fingerprint": fp}
+    else:
+        result = None
+    result = fleet.bcast(result, f"{knob}:result")
+    emit(result)
+
+    if rank == 0 and persist and result.get("note") is None:
+        # single cache writer: non-zero ranks never touch the file (the
+        # cache itself is read-only there — belt and braces), so the
+        # merge-on-write save cannot race itself across a shared homedir
+        cache = registry.configured_cache()
+        if cache is not None:
+            cache.store(knob, fp, result["value"],
+                        seconds=result["seconds"])
+            if ctx:
+                cache.store(knob, device_fingerprint(), result["value"],
+                            seconds=result["seconds"])
+            cache.save()
+    return result["value"]
+
+
 def ensure_tuned(
     knob: str,
     measure: Callable[[object], float],
@@ -191,10 +309,31 @@ def ensure_tuned(
     ``tune_hit`` record) > sweep-on-miss when ``--tune`` armed the
     registry > prior. Returns the schedule to run.
     ``device_fallback=False`` for context-sensitive knobs (see
-    :func:`~tpu_mpi_tests.tune.registry.lookup`)."""
+    :func:`~tpu_mpi_tests.tune.registry.lookup`).
+
+    Multi-process runs make the hit-vs-sweep decision on RANK 0's cache
+    and broadcast it: per-host caches can diverge (rank 0 is the only
+    writer, so a fleet without a shared cache file or a ``--tune-pack``
+    holds the winner on rank 0 alone), and a subset of ranks entering
+    the collective sweep handshake while the rest took the hit path
+    would hang the pod. With no broadcast transport the decision stays
+    local — the pre-fleet behavior, where a divergent cache could
+    diverge schedules but never deadlock."""
     if explicit is not None:
         return explicit
     cached = registry.lookup(knob, device_fallback=device_fallback, **ctx)
+    if _process_count() > 1:
+        from tpu_mpi_tests.tune import fleet
+
+        try:
+            if fleet.process_index() == 0:
+                decision = {"hit": cached is not None, "value": cached}
+            else:
+                decision = None
+            decision = fleet.bcast(decision, f"{knob}:resolve")
+            cached = decision["value"] if decision["hit"] else None
+        except fleet.FleetUnavailable:
+            pass  # no transport: local resolution, skip-record sweeps
     if cached is not None:
         (emit or registry.default_emit() or (lambda rec: None))(
             {"kind": "tune_hit", "knob": knob, "value": cached,

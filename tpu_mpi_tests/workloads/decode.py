@@ -42,6 +42,53 @@ DECODE_LINE_RE = (
 )
 
 
+def _effective_coll(coll, mesh, axis_name, world, n, dtype, dtype_name,
+                    shard_bytes, line, explicit=None):
+    """``(effective _loop_fn name, variant)`` for one payload size:
+    the ``coll_variant/<base>`` schedule collbench declares and sweeps,
+    resolved explicit > cached > prior (``device_fallback=False`` —
+    payload-size-sensitive, like collbench's own resolution). A cached
+    ``rdma`` winner below the ring kernel's lane-alignment floor at
+    THIS payload degrades to the XLA tier with a visible NOTE (``line``
+    is the printer — the one-shot path passes ``rep.line``, the serve
+    factory ``print``), and a malformed cache value degrades to the
+    prior. Collectives without a ring twin resolve to themselves
+    (variant None)."""
+    from tpu_mpi_tests.tune import registry as tr
+
+    if coll not in ("allgather", "allreduce"):
+        return coll, None
+    variant = tr.resolve(
+        f"coll_variant/{coll}", explicit=explicit, device_fallback=False,
+        dtype=dtype_name, bytes=shard_bytes, world=world,
+    )
+    if variant not in ("xla", "rdma"):
+        variant = "xla"  # malformed cache value degrades to the prior
+    if variant == "rdma":
+        import jax
+
+        from tpu_mpi_tests.drivers.collbench import _loop_fn
+
+        fn = _loop_fn(mesh, axis_name, f"{coll}_rdma", world)
+        try:
+            jax.eval_shape(
+                fn, jax.ShapeDtypeStruct((n * world,), dtype), 1
+            )
+        except Exception as e:
+            if explicit == "rdma":
+                # an explicitly requested candidate (a re-sweep's
+                # measure) must ERROR so the sweep records it as
+                # infeasible, never silently measure the other tier
+                raise
+            if line is not None:
+                line(f"NOTE decode {coll}: cached rdma variant "
+                     f"infeasible at {shard_bytes} B ({e}); "
+                     f"using xla")
+            return coll, "xla"
+        return f"{coll}_rdma", "rdma"
+    return coll, "xla"
+
+
 class DecodeSpec(WorkloadSpec):
     name = "decode"
     title = __doc__
@@ -114,10 +161,19 @@ class DecodeSpec(WorkloadSpec):
         itemsize = jnp.dtype(dtype).itemsize
         with ctx.phase("decode_sweep"):
             for coll in state["colls"]:
-                run_fn = _loop_fn(mesh, axis_name, coll, world)
                 for batch in state["batches"]:
                     n = batch * args.heads  # elements per shard
                     shard_bytes = n * itemsize
+                    # the µs/op pillar consumes the SAME tuned variant
+                    # schedules collbench sweeps: per payload size,
+                    # cached > prior (never swept here) — the decode
+                    # path must not hardcode the XLA lowering while the
+                    # cache says the ring twin wins at this size
+                    eff, variant = _effective_coll(
+                        coll, mesh, axis_name, world, n,
+                        dtype, args.dtype, shard_bytes, ctx.rep.line,
+                    )
+                    run_fn = _loop_fn(mesh, axis_name, eff, world)
                     x = shard_1d(jnp.ones((n * world,), dtype), mesh,
                                  axis_name)
                     costs.compile_probe(
@@ -135,7 +191,7 @@ class DecodeSpec(WorkloadSpec):
                         "batch": batch, "heads": args.heads,
                         "shard_bytes": shard_bytes, "us_per_op": us,
                         "world": world, "dtype": args.dtype,
-                        "n_iter": args.n_iter,
+                        "n_iter": args.n_iter, "variant": variant,
                     }
                     state["rows"].append(row)
                     ctx.rep.line(
@@ -215,7 +271,14 @@ class DecodeSpec(WorkloadSpec):
         the latency-bound class mixed traffic stresses. Reuses the
         benchmark's own chained program (collbench ``_loop_fn``), which
         donates: a failed batch rebuilds the buffer so one transient
-        error cannot poison the class (the collbench handler's rule)."""
+        error cannot poison the class (the collbench handler's rule).
+
+        The allreduce variant resolves through the same
+        ``coll_variant/allreduce`` schedule the one-shot rows consume,
+        and the handler carries a ``tune_info`` recipe so the serve
+        loop's re-tune controller (``--retune``, tune/controller.py)
+        can re-sweep and hot-swap it when the class's achieved GB/s
+        goes stale."""
         import jax.numpy as jnp
 
         from tpu_mpi_tests.comm.collectives import shard_1d
@@ -229,22 +292,38 @@ class DecodeSpec(WorkloadSpec):
         world = mesh.devices.size
         axis_name = mesh.axis_names[0]
         dt = jnp.dtype(dtype)
-        run_fn = _loop_fn(mesh, axis_name, "allreduce", world)
+        shard_bytes = n * dt.itemsize
+        ctx = {"dtype": str(dtype), "bytes": shard_bytes,
+               "world": world}
 
         def init():
             return shard_1d(jnp.ones((n * world,), dt), mesh, axis_name)
 
-        state = {"x": init()}
+        def build(variant=None):
+            eff, _v = _effective_coll(
+                "allreduce", mesh, axis_name, world, n, dt, str(dtype),
+                shard_bytes, print, explicit=variant,
+            )
+            run_fn = _loop_fn(mesh, axis_name, eff, world)
+            state = {"x": init()}
 
-        def step(k: int):
-            try:
-                state["x"] = block(run_fn(state["x"], k))
-            except Exception:
-                state["x"] = init()
-                raise
+            def step(k: int):
+                try:
+                    state["x"] = block(run_fn(state["x"], k))
+                except Exception:
+                    state["x"] = init()
+                    raise
 
-        step(1)  # compile + warm before traffic opens
-        return step
+            step(1)  # compile + warm before traffic opens
+            step.tune_info = {
+                "knob": "coll_variant/allreduce",
+                "ctx": dict(ctx),
+                "candidates": ("xla", "rdma"),
+                "rebuild": build,
+            }
+            return step
+
+        return build()
 
 
 SPEC = register_spec(DecodeSpec())
