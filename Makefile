@@ -565,7 +565,50 @@ fleet-smoke:
 		--jsonl /tmp/_tpumt_fleet.noctl.jsonl > /dev/null
 	python -m tpu_mpi_tests.instrument.diagnose \
 		/tmp/_tpumt_fleet.noctl.jsonl --expect stale_schedule:0
-	@echo "fleet-smoke OK: rank-0 fleet sweep + pack round-trip + closed-loop retune + stale_schedule conviction"
+	# stencil/tier fleet leg (ISSUE 15): a REAL 2-process --tune sweep
+	# over the kernel-tier space through the rank-0-swept broadcast
+	# path. This backend cannot execute the tiers cross-process
+	# (collectives unsupported on multi-process CPU), so every
+	# candidate — the fused tier included — records a VISIBLE error on
+	# rank 0 (the honest-decline contract), and the assertion is the
+	# fleet invariant itself: per-candidate tune records are
+	# rank-0-only, while the broadcast-resolved tune_result (winner =
+	# the prior, unpersisted) is byte-identical on both ranks.
+	env JAX_PLATFORMS=cpu ./native/tpumt_run -n 2 \
+		-o /tmp/_tpumt_fleet.tierrank -- \
+		python -m tpu_mpi_tests.drivers.stencil2d --fake-devices 1 \
+		--n-local 16 --n-other 32 --dtype float32 \
+		--iterate-tier auto --iterate-only --iterate-iters 2 --tune \
+		--tune-cache /tmp/_tpumt_fleet.tier.cache.json \
+		--jsonl /tmp/_tpumt_fleet.tier.jsonl
+	python -c "import json; \
+		recs = {r: [json.loads(l) for l in \
+			open(f'/tmp/_tpumt_fleet.tier.p{r}.jsonl')] for r in (0, 1)}; \
+		tune = {r: [x for x in recs[r] if x.get('kind') == 'tune' \
+			and x.get('knob') == 'stencil/tier'] for r in (0, 1)}; \
+		assert {t['candidate'] for t in tune[0]} == \
+			{'blocks', 'rdma-chained', 'rdma-fused', 'xla'}, tune[0]; \
+		assert all('seconds' in t or 'error' in t for t in tune[0]); \
+		assert tune[1] == [], 'per-candidate records are rank-0-only'; \
+		res = {r: [x for x in recs[r] if x.get('kind') == 'tune_result' \
+			and x.get('knob') == 'stencil/tier'] for r in (0, 1)}; \
+		assert len(res[0]) == 1 and len(res[1]) == 1, res; \
+		strip = lambda x: {k: v for k, v in x.items() if k != 'rank'}; \
+		assert json.dumps(strip(res[0][0]), sort_keys=True) == \
+			json.dumps(strip(res[1][0]), sort_keys=True), res; \
+		print('fleet-smoke tier OK: broadcast-identical stencil/tier', \
+			'winner', res[0][0]['value'], 'on both ranks')"
+	# the fused tier's OVERLAP row: a single-process iterate-leg run
+	# emits the kernel-level seam-wait record and tpumt-report renders
+	# it attributed to the rdma-fused tier
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.stencil2d \
+		--fake-devices 2 --n-local 16 --n-other 32 --dtype float32 \
+		--iterate-tier rdma-fused --iterate-only --iterate-iters 2 \
+		--jsonl /tmp/_tpumt_fleet.tierov.jsonl > /dev/null
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_fleet.tierov.jsonl | \
+		grep -E '^OVERLAP stencil2d_fused_rdma: .*tier=rdma-fused'
+	@echo "fleet-smoke OK: rank-0 fleet sweep + pack round-trip + closed-loop retune + stale_schedule conviction + broadcast tier winners + fused OVERLAP row"
 
 # self-clean gate: the repo's own code must raise zero tpumt-lint
 # findings (stable TPMxxx codes — README "Static analysis"); unused

@@ -91,6 +91,37 @@ def _resolve_blocks(blocks_env: "str | None", dtype_name: str, *, n: int,
     ))
 
 
+def _resolve_tier(env_val: "str | None", dtype_name: str, *, n: int,
+                  world: int, platform: str) -> str:
+    """Kernel tier of the per-iteration pipeline (ISSUE 15): explicit
+    TPU_MPI_BENCH_TIER > cached winner > shipped prior ("blocks" — the
+    pre-ISSUE-15 schedule family, byte-identical untuned). The hand
+    tiers need the TPU backend; everywhere else the tier is declined to
+    "xla" (with a stderr NOTE when explicitly requested) — the schedule
+    string must never claim a tier that did not run."""
+    from tpu_mpi_tests.comm.halo import STENCIL_TIERS, resolve_stencil_tier
+
+    if env_val is not None and env_val not in STENCIL_TIERS:
+        raise SystemExit(
+            f"TPU_MPI_BENCH_TIER={env_val!r} unsupported "
+            f"({' | '.join(STENCIL_TIERS)})"
+        )
+    if platform != "tpu":
+        if env_val is not None and env_val != "xla":
+            import sys
+
+            print(
+                f"NOTE TPU_MPI_BENCH_TIER={env_val} not applicable "
+                f"(platform={platform}); running the xla tier",
+                file=sys.stderr,
+                flush=True,
+            )
+        return "xla"
+    return resolve_stencil_tier(
+        env_val, dtype=dtype_name, n=n, world=world
+    )
+
+
 def _resolve_overlap(env_val: "str | None", dtype_name: str, *, n: int,
                      world: int) -> int:
     """Halo pipeline depth for the bench schedule: explicit
@@ -105,41 +136,58 @@ def _resolve_overlap(env_val: "str | None", dtype_name: str, *, n: int,
 
 def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
                     topo, n_blocks: int, ov_depth: int = 1,
-                    report_declined: bool = False):
+                    tier: str = "blocks", report_declined: bool = False):
     """Build one per-iteration schedule:
-    ``(run, state, use_blocks, ov_eff)``.
+    ``(run, state, use_blocks, ov_eff, bench_dim, tier)``.
+
+    ``tier`` selects the kernel tier of the hot loop (ISSUE 15 —
+    resolved via the ``stencil/tier`` schedule space by the caller):
+
+    * ``"blocks"`` — the ppermute hand tier, parameterized by the
+      ``stencil/blocks`` knob: the resident-block schedule where it
+      applies (TPU, k>1, divisible shard), else the dim-1 single-buffer
+      kernel — the pre-ISSUE-15 schedule family, byte-identical.
+    * ``"rdma-chained"`` — the hand RDMA ring feeding the in-place
+      kernel as two chained launches (``iterate_pallas_fn(rdma=True)``).
+    * ``"rdma-fused"`` — the ONE-launch fused halo+stencil kernel
+      (in-kernel RDMA overlapped with interior compute,
+      ``iterate_fused_rdma_fn``) on the dim-0 streaming decomposition.
+    * ``"xla"`` — the XLA formulation (shallow ghosts, per-step
+      exchange); also the only tier off-TPU, where interpret-mode
+      pallas is far too slow.
 
     ``ov_depth >= 2`` selects the comm/compute-overlap step
-    (``halo.iterate_overlap_fn`` — edge ppermutes in flight while the
-    core kernel runs on old data) where it applies: TPU, the dim-1
-    single-buffer path, ``steps == 1`` (the overlap step carries
-    per-step-radius ghosts). Everywhere else the depth is declined to
-    1 with a stderr NOTE — the schedule string must never claim an
-    overlap that did not run.
-
-    The resident-block schedule (TPU, k>1): S separate buffers per shard
-    run the fast full-height dim-0 (sublane-tap) kernel; the inter-block
-    ghost refresh is a narrow in-chip band copy and, on a multi-device
-    mesh, the outermost ghost bands ride a ppermute ring over ICI
-    (round-3 generalization). Measured 3021 vs 2087 iter/s against the
-    single-buffer dim-1 kernel in the same contention window
-    (BASELINE.md)."""
+    (``halo.iterate_overlap_fn``) where it applies: TPU, the blocks
+    tier's dim-1 single-buffer path, ``steps == 1``. Any declined knob
+    prints a stderr NOTE and the returned ``tier``/``ov_eff`` name what
+    actually ran — the schedule string must never claim a schedule that
+    did not run."""
     import jax.numpy as jnp
     import numpy as np
 
     from tpu_mpi_tests.arrays.domain import Domain2D
     from tpu_mpi_tests.comm.collectives import shard_blocks
-    from tpu_mpi_tests.comm.halo import iterate_fused_fn, iterate_pallas_fn
+    from tpu_mpi_tests.comm.halo import (
+        iterate_fused_fn,
+        iterate_fused_rdma_fn,
+        iterate_pallas_fn,
+    )
     from tpu_mpi_tests.kernels.stencil import N_BND, analytic_pairs
 
     dtype = np.dtype(jnp.bfloat16) if dtype_name == "bfloat16" \
         else np.dtype(np.float32)
     eps = 1e-6
+    if topo.platform != "tpu":
+        # the hand tiers need the TPU backend (interpret-mode pallas is
+        # orders of magnitude off); the resolver already declines them,
+        # this guard keeps direct callers honest too
+        tier = "xla"
     use_blocks = (
-        topo.platform == "tpu" and steps > 1
+        tier == "blocks" and topo.platform == "tpu" and steps > 1
         and n_blocks >= 2 and (n // world) % n_blocks == 0
     )
-    if report_declined and n_blocks >= 2 and not use_blocks:
+    if report_declined and tier == "blocks" and n_blocks >= 2 \
+            and not use_blocks:
         # never silently mis-attribute a schedule: a requested block count
         # that fails the gate is reported (stderr — stdout stays the one
         # JSON line) and the JSON records the schedule that actually ran
@@ -152,7 +200,7 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
             file=sys.stderr,
             flush=True,
         )
-    bench_dim = 0 if use_blocks else 1
+    bench_dim = 0 if (use_blocks or tier == "rdma-fused") else 1
     d = Domain2D(
         n_local_deriv=n // world,
         n_global_other=n,
@@ -171,7 +219,7 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
     ov_eff = 1
     if (
         ov_depth >= 2 and topo.platform == "tpu" and steps == 1
-        and not use_blocks
+        and not use_blocks and tier == "blocks"
     ):
         ov_eff = 2
     elif ov_depth >= 2:
@@ -180,8 +228,8 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
         print(
             f"NOTE overlap depth {ov_depth} not applicable "
             f"(platform={topo.platform} steps={steps} "
-            f"blocks={n_blocks}); running the serialized schedule "
-            f"(_ov1)",
+            f"blocks={n_blocks} tier={tier}); running the serialized "
+            f"schedule (_ov1)",
             file=sys.stderr,
             flush=True,
         )
@@ -197,28 +245,54 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
             mesh=bench_mesh, axis_name=axis_name,
         )
         zg = split_blocks(zg, n_blocks, d.n_bnd, mesh=bench_mesh)
+    elif tier == "rdma-fused":
+        import jax
+
+        run = iterate_fused_rdma_fn(
+            mesh, axis_name, d.n_bnd, eps * d.scale, steps=steps
+        )
+        # the fused kernel's geometry checks (seam blocking, VMEM fit)
+        # fire at trace time, not at factory time — probe them NOW so an
+        # infeasible geometry raises inside the caller's degrade path
+        # instead of crashing the first timed call. The probe traces the
+        # compute-only twin (identical geometry path) so the watchdog
+        # flight recorder never sees a phantom fused-RDMA dispatch note
+        # for a program that never executes.
+        jax.eval_shape(
+            iterate_fused_rdma_fn(
+                mesh, axis_name, d.n_bnd, eps * d.scale, steps=steps,
+                local_only=True,
+            ),
+            zg, 1,
+        )
+    elif tier == "rdma-chained":
+        run = iterate_pallas_fn(
+            mesh, axis_name, d.n_bnd, eps * d.scale, steps=steps,
+            rdma=True,
+        )
     elif ov_eff >= 2:
         from tpu_mpi_tests.comm.halo import iterate_overlap_fn
 
         run = iterate_overlap_fn(
             mesh, axis_name, d.n_bnd, eps * d.scale, axis=bench_dim
         )
-    elif topo.platform == "tpu":
+    elif tier == "blocks":  # dim-1 single-buffer hand kernel (blocks=0)
         run = iterate_pallas_fn(
             mesh, axis_name, d.n_bnd, eps * d.scale, steps=steps
         )
-    else:  # CPU smoke path: interpret-mode pallas is far too slow
+    else:  # the XLA tier (and the only CPU path)
         run = iterate_fused_fn(mesh, axis_name, 1, 2, d.n_bnd, d.scale, eps)
-    return run, zg, use_blocks, ov_eff
+    return run, zg, use_blocks, ov_eff, bench_dim, tier
 
 
 def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
-             blocks_env: str | None, overlap_env: str | None = None):
+             blocks_env: str | None, overlap_env: str | None = None,
+             tier_env: str | None = None):
     """One dtype's full measurement: resolve the schedule (explicit env >
-    cached winner > prior; TPU_MPI_BENCH_TUNE=1 sweeps block-count
-    candidates on a cache miss first), chain-time it, median-of-samples.
-    Returns the JSON-ready dict (top-level field shapes; the caller
-    nests the secondary dtype's copy)."""
+    cached winner > prior; TPU_MPI_BENCH_TUNE=1 sweeps kernel-tier and
+    block-count candidates on a cache miss first), chain-time it,
+    median-of-samples. Returns the JSON-ready dict (top-level field
+    shapes; the caller nests the secondary dtype's copy)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -227,15 +301,61 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
 
     dtype = np.dtype(jnp.bfloat16) if dtype_name == "bfloat16" \
         else np.dtype(np.float32)
-    if topo.platform != "tpu":
-        steps = 1  # CPU smoke path uses the XLA iterate (shallow halos)
+
+    tier = _resolve_tier(tier_env, dtype_name, n=n, world=world,
+                         platform=topo.platform)
+    tier_miss = topo.platform == "tpu" and _tr.lookup(
+        "stencil/tier", device_fallback=False,
+        dtype=dtype_name, n=n, world=world,
+    ) is None
+    if tier_env is None and tier_miss and _tr.tuning_enabled():
+        # on-miss kernel-tier sweep (ISSUE 15): price the one-launch
+        # fused tier against blocks / chained RDMA / XLA — prior-first,
+        # a candidate whose gate declines RAISES so the record can never
+        # credit a tier with another tier's seconds
+        from tpu_mpi_tests.tune import priors as _priors
+        from tpu_mpi_tests.tune.sweep import sweep as _sweep
+
+        sp = _tr.space("stencil/tier")
+        cands = [_priors.STENCIL_TIER] + [
+            c for c in sp.candidates if c != _priors.STENCIL_TIER
+        ]
+        n_blocks_t = _resolve_blocks(blocks_env, dtype_name, n=n,
+                                     world=world)
+
+        def measure_tier(cand):
+            steps_c = 1 if cand == "xla" else steps
+            run_c, zg_c, _, _, _, tier_eff = _build_schedule(
+                dtype_name, n=n, steps=steps_c, world=world, mesh=mesh,
+                axis_name=axis_name, topo=topo, n_blocks=n_blocks_t,
+                tier=str(cand),
+            )
+            if tier_eff != cand:
+                raise ValueError(
+                    f"tier={cand} not applicable "
+                    f"(platform={topo.platform} steps={steps} n={n} "
+                    f"world={world})"
+                )
+            sec, zg_c = chain_rate(run_c, zg_c, n_short=5, n_long=55)
+            del zg_c
+            # normalize to per-TIMESTEP seconds: the xla candidate
+            # advances one timestep per call, the k-step tiers k
+            return sec / steps_c
+
+        tier = str(_sweep(
+            "stencil/tier", measure_tier, candidates=cands,
+            emit=_tune_emit, dtype=dtype_name, n=n, world=world,
+        ))
+    if tier == "xla":
+        steps = 1  # the XLA iterate runs shallow halos, 1 timestep/call
 
     n_blocks = _resolve_blocks(blocks_env, dtype_name, n=n, world=world)
     cache_miss = _tr.lookup(
         "stencil/blocks", device_fallback=False,
         dtype=dtype_name, n=n, world=world,
     ) is None
-    if blocks_env is None and cache_miss and _tr.tuning_enabled():
+    if blocks_env is None and cache_miss and _tr.tuning_enabled() \
+            and tier == "blocks":
         # on-miss only (a warmed cache entry IS the swept winner), and
         # prior-first: the budget-exempt first slot must measure THIS
         # dtype's shipped prior, never a value inherited elsewhere
@@ -249,7 +369,7 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         cands = [prior] + [c for c in sp.candidates if c != prior]
 
         def measure_blocks(cand):
-            run_c, zg_c, ub, _ = _build_schedule(
+            run_c, zg_c, ub, *_rest = _build_schedule(
                 dtype_name, n=n, steps=steps, world=world, mesh=mesh,
                 axis_name=axis_name, topo=topo, n_blocks=int(cand),
             )
@@ -269,12 +389,31 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         ))
 
     ov_depth = _resolve_overlap(overlap_env, dtype_name, n=n, world=world)
-    run, zg, use_blocks, ov_eff = _build_schedule(
-        dtype_name, n=n, steps=steps, world=world, mesh=mesh,
-        axis_name=axis_name, topo=topo, n_blocks=n_blocks,
-        ov_depth=ov_depth,
-        report_declined=blocks_env is not None,
-    )
+    try:
+        run, zg, use_blocks, ov_eff, bench_dim, tier = _build_schedule(
+            dtype_name, n=n, steps=steps, world=world, mesh=mesh,
+            axis_name=axis_name, topo=topo, n_blocks=n_blocks,
+            ov_depth=ov_depth, tier=tier,
+            report_declined=blocks_env is not None,
+        )
+    except ValueError as e:
+        # a cached/requested tier infeasible at THIS geometry (e.g. the
+        # fused tier's seam blocking) degrades to the prior tier with a
+        # visible NOTE — never a dead headline, never a mislabeled one
+        import sys
+
+        print(
+            f"NOTE tier {tier} infeasible at n={n} world={world} "
+            f"steps={steps} ({e}); running the blocks tier",
+            file=sys.stderr,
+            flush=True,
+        )
+        run, zg, use_blocks, ov_eff, bench_dim, tier = _build_schedule(
+            dtype_name, n=n, steps=steps, world=world, mesh=mesh,
+            axis_name=axis_name, topo=topo, n_blocks=n_blocks,
+            ov_depth=ov_depth, tier="blocks",
+            report_declined=blocks_env is not None,
+        )
 
     n_short = int(os.environ.get("TPU_MPI_BENCH_ITERS_SHORT", 100))
     # 2100 (2000-iteration delta ≈ 1.7 s device time) keeps the shared
@@ -337,15 +476,20 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         ],
         # which per-iteration schedule actually ran (the blocks gate
         # can decline a requested TPU_MPI_BENCH_BLOCKS, the overlap
-        # gate a requested depth) — the _ov<d> suffix attributes the
-        # row to a pipeline depth, not just a shape (ISSUE 7)
+        # gate a requested depth, the tier gate a requested tier) —
+        # the _ov<d> suffix attributes the row to a pipeline depth and
+        # the trailing token to the executing KERNEL TIER (ISSUE 15:
+        # blocks / rdma-chained / rdma-fused / xla), so BENCH_r* rounds
+        # are attributable to a tier, not just blocks/steps
         "schedule": (
             f"blocks{n_blocks}_dim0_world{world}_{dtype_name}"
-            f"_ov{ov_eff}"
+            f"_ov{ov_eff}_{tier}"
             if use_blocks
-            else f"dim1_world{world}_{dtype_name}_ov{ov_eff}"
+            else f"dim{bench_dim}_world{world}_{dtype_name}"
+                 f"_ov{ov_eff}_{tier}"
         ),
         "steps": steps,
+        "tier": tier,
     }
 
 
@@ -409,6 +553,7 @@ def main() -> None:
         axis_name=axis_name, topo=topo,
         blocks_env=os.environ.get("TPU_MPI_BENCH_BLOCKS"),
         overlap_env=os.environ.get("TPU_MPI_BENCH_OVERLAP"),
+        tier_env=os.environ.get("TPU_MPI_BENCH_TIER"),
     ))
 
     second = os.environ.get("TPU_MPI_BENCH_SECOND_DTYPE", "")

@@ -139,3 +139,91 @@ def test_rejects_bad_sizes(capsys):
         stencil2d.main(["--n-local", "3"])
     with pytest.raises(SystemExit):
         stencil2d.main(["--n-iter", "0"])
+
+
+def test_iterate_tier_leg_fused(capsys):
+    """ISSUE 15: the kernel-tier iterate leg under the fused tier — the
+    ITER line, the fused-vs-chained bitwise gate, the analytic eigen
+    err-norm gate, and the seam-wait OVERLAP record all fire."""
+    rc = stencil2d.main(
+        ["--n-local", "16", "--n-other", "32", "--dtype", "float32",
+         "--iterate-tier", "rdma-fused", "--iterate-only",
+         "--iterate-iters", "3"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ITER tier=rdma-fused" in out
+    assert "ITER BITWISE fused==chained" in out and "OK" in out
+    assert "ITER ERR rel=" in out
+    assert "OVERLAP stencil2d_fused_rdma overlap_frac=" in out
+    assert "TEST dim:" not in out  # --iterate-only skips the matrix
+
+
+def test_iterate_tier_leg_steps4_records_overlap(tmp_path, capsys):
+    """steps=4 deep-ghost leg; the overlap record lands in JSONL with
+    the fused tier named (the OVERLAP-table/provenance contract)."""
+    import json
+
+    jl = tmp_path / "iter.jsonl"
+    rc = stencil2d.main(
+        ["--n-local", "24", "--n-other", "32", "--dtype", "float32",
+         "--iterate-tier", "rdma-fused", "--iterate-steps", "4",
+         "--iterate-only", "--iterate-iters", "2", "--jsonl", str(jl)]
+    )
+    capsys.readouterr()
+    assert rc == 0
+    ovs = [
+        json.loads(line)
+        for line in jl.read_text().splitlines()
+        if json.loads(line).get("kind") == "overlap"
+    ]
+    assert len(ovs) == 1
+    ov = ovs[0]
+    assert ov["op"] == "stencil2d_fused_rdma"
+    assert ov["tier"] == "rdma-fused"
+    assert 0.0 <= ov["overlap_frac"] <= 1.0
+    assert ov["comm_s"] > 0 and ov["compute_s"] > 0
+    assert ov["drain_s"] >= 0
+
+
+def test_iterate_tier_leg_tune_sweep(tmp_path, capsys):
+    """--iterate-tier auto --tune sweeps stencil/tier through the PR-4
+    engine: every tier candidate measured (or visibly declined), the
+    winner persisted and applied."""
+    import json
+
+    from tpu_mpi_tests.tune import registry as tr
+
+    jl = tmp_path / "tune.jsonl"
+    try:
+        rc = stencil2d.main(
+            ["--n-local", "24", "--n-other", "32", "--dtype", "float32",
+             "--iterate-tier", "auto", "--iterate-only",
+             "--iterate-iters", "2", "--tune",
+             "--tune-cache", str(tmp_path / "cache.json"),
+             "--tune-budget", "600", "--jsonl", str(jl)]
+        )
+    finally:
+        tr.deconfigure()
+    out = capsys.readouterr().out
+    assert rc == 0
+    recs = [json.loads(line) for line in jl.read_text().splitlines()]
+    tune = [r for r in recs if r.get("kind") == "tune"
+            and r.get("knob") == "stencil/tier"]
+    # prior first, every candidate measured or visibly declined
+    assert tune and tune[0]["candidate"] == "blocks"
+    assert {t["candidate"] for t in tune} == {
+        "blocks", "rdma-chained", "rdma-fused", "xla"}
+    fused = [t for t in tune if t["candidate"] == "rdma-fused"][0]
+    assert fused.get("seconds") or fused.get("error")
+    results = [r for r in recs if r.get("kind") == "tune_result"
+               and r.get("knob") == "stencil/tier"]
+    assert len(results) == 1
+    assert f"ITER tier={results[0]['value']}" in out
+
+
+def test_iterate_only_requires_tier():
+    import pytest
+
+    with pytest.raises(SystemExit):
+        stencil2d.main(["--iterate-only"])

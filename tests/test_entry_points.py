@@ -57,7 +57,7 @@ def test_bench_prints_one_json_line_smoke():
     rec = json.loads(lines[-1])
     per_dtype = {"value", "unit", "vs_baseline",
                  "vs_f64_reference_roofline", "dtype", "samples",
-                 "schedule", "steps"}
+                 "schedule", "steps", "tier"}
     # round 5 (VERDICT r4 #3): one invocation carries BOTH dtypes — the
     # primary keeps the top-level headline fields, the secondary is a
     # same-shaped sub-object under its dtype name
@@ -74,6 +74,11 @@ def test_bench_prints_one_json_line_smoke():
     assert sub["dtype"] == "bfloat16"
     assert sub["value"] > 0
     assert sub["schedule"].startswith("dim1_")
+    # tier provenance (ISSUE 15): the schedule string and the JSON both
+    # name the EXECUTING kernel tier — xla is the only CPU tier
+    assert rec["tier"] == "xla" and sub["tier"] == "xla"
+    assert rec["schedule"].endswith("_xla")
+    assert sub["schedule"].endswith("_xla")
 
 
 def test_bench_second_dtype_disable():

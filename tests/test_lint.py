@@ -924,6 +924,20 @@ def test_unused_suppression_is_a_finding():
     assert "TPM101" in findings[0].message
 
 
+def test_fused_runner_factory_convicts_without_origin_resolution():
+    """ISSUE 15 satellite: the fused-tier runner factory is on the
+    compiled-fn-factory NAME list (analysis/core.FACTORY_NAMES,
+    alongside ``pick_kernel_tier``), so a perf_counter pair timing its
+    result convicts TPM101 even when the import graph cannot resolve
+    the call's origin (the fixture binds the module dynamically)."""
+    from tpu_mpi_tests.analysis.core import FACTORY_NAMES
+
+    assert {"pick_kernel_tier", "iterate_fused_rdma_fn"} <= FACTORY_NAMES
+    findings = lint_paths([str(FIXTURES / "tpm1_factory_bad.py")])
+    assert codes_of(findings) == ["TPM101"], findings
+    assert "run" in findings[0].message
+
+
 def test_malformed_suppression_is_a_finding(tmp_path):
     p = tmp_path / "mal.py"
     p.write_text("x = 1  # tpumt: ignore TPM101 (missing brackets)\n")

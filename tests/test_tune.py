@@ -693,3 +693,72 @@ def _import_bench():
     finally:
         sys.path.pop(0)
     return bench
+
+
+# ---------------------------------------------------- stencil/tier (ISSUE 15)
+
+
+def test_stencil_tier_space_and_prior_parity():
+    """The kernel-tier space is declared with the fused tier as a
+    sweepable candidate, prior first; an unconfigured registry resolves
+    the shipped "blocks" prior (pre-ISSUE-15 schedule, byte-identical)
+    and malformed cache values degrade to it."""
+    from tpu_mpi_tests.comm.halo import (
+        STENCIL_TIERS,
+        resolve_stencil_tier,
+    )
+
+    sp = tr.space("stencil/tier")
+    assert sp.prior == priors.STENCIL_TIER == "blocks"
+    assert "rdma-fused" in sp.candidates
+    assert set(sp.candidates) == set(STENCIL_TIERS)
+    assert tr.configured_cache() is None
+    assert resolve_stencil_tier(None, dtype="float32", n=8192,
+                                world=1) == "blocks"
+    # explicit wins
+    assert resolve_stencil_tier("rdma-fused", dtype="float32", n=8192,
+                                world=1) == "rdma-fused"
+
+
+def test_stencil_tier_cached_winner_and_malformed_degrade(tmp_path):
+    from tpu_mpi_tests.comm.halo import resolve_stencil_tier
+
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    cache = tr.configured_cache()
+    ctx = dict(dtype="float32", n=4096, world=2)
+    cache.store("stencil/tier", fingerprint(**ctx), "rdma-fused")
+    assert resolve_stencil_tier(None, **ctx) == "rdma-fused"
+    # a winner tuned at one context must not leak through the
+    # device-only slot (device_fallback=False)
+    assert resolve_stencil_tier(None, dtype="bfloat16", n=4096,
+                                world=2) == "blocks"
+    # malformed cache value -> prior, never a crash
+    cache.store("stencil/tier", fingerprint(**ctx), "warp-drive")
+    assert resolve_stencil_tier(None, **ctx) == "blocks"
+
+
+def test_stencil_tier_sweep_visible_degrade(tmp_path):
+    """The acceptance shape (ISSUE 15): the fused tier is MEASURED and
+    honestly declined when slower — its seconds land in the tune
+    records (a visible-degrade record), the faster tier wins and
+    persists."""
+    tr.configure(cache_path=str(tmp_path / "t.json"), enabled=True,
+                 budget_s=60.0)
+    timing = {"blocks": 0.2, "rdma-chained": 0.3, "rdma-fused": 0.5,
+              "xla": 0.9}
+    records = []
+    winner = sweep(
+        "stencil/tier", lambda cand: timing[cand],
+        emit=records.append, dtype="float32", n=8192, world=1,
+    )
+    assert winner == "blocks"
+    fused = [r for r in records
+             if r["kind"] == "tune" and r["candidate"] == "rdma-fused"]
+    assert len(fused) == 1 and fused[0]["seconds"] == 0.5
+    assert records[-1]["kind"] == "tune_result"
+    assert records[-1]["value"] == "blocks"
+    # and the persisted winner resolves at the same context
+    from tpu_mpi_tests.comm.halo import resolve_stencil_tier
+
+    assert resolve_stencil_tier(None, dtype="float32", n=8192,
+                                world=1) == "blocks"

@@ -128,6 +128,13 @@ DEVICE_ORIGINS = ("jax", "tpu_mpi_tests.kernels", "tpu_mpi_tests.comm")
 #: compiled-fn factories: halo iterate builders, pick_kernel_tier, ...)
 FACTORY_ORIGINS = DEVICE_ORIGINS + ("tpu_mpi_tests.drivers",)
 
+#: compiled-fn factories convicted BY NAME, independent of whether the
+#: import graph resolved their origin (aliased/dynamic imports):
+#: ``pick_kernel_tier``'s step and the ISSUE-15 fused-tier runner — a
+#: perf_counter pair timing either's result without a sync is a TPM1xx
+#: finding (fixture ``tpm1_factory_bad.py``)
+FACTORY_NAMES = {"pick_kernel_tier", "iterate_fused_rdma_fn"}
+
 
 def walk_calls(node: ast.AST) -> Iterator[ast.Call]:
     for n in ast.walk(node):
@@ -186,6 +193,7 @@ def device_callables(ctx: "FileContext") -> set[str]:
         elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
             resolved = ctx.imports.resolve(n.value.func) or ""
             if not (resolved.startswith(FACTORY_ORIGINS)
+                    or last_attr(n.value.func) in FACTORY_NAMES
                     or has_trace_entry(n.value.func)):
                 continue
             for t in n.targets:

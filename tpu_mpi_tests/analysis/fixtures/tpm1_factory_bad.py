@@ -1,0 +1,22 @@
+"""TPM101 bad: the clock pair times a compiled-fn-FACTORY result.
+
+The fused-tier runner (``iterate_fused_rdma_fn``, ISSUE 15) is a
+compiled-fn factory like ``pick_kernel_tier``: its return value
+dispatches async device work when called. The dynamic module handle
+defeats import-graph origin resolution, so conviction rests on the
+FACTORY_NAMES list (analysis/core.py) alone — the shape this fixture
+pins.
+"""
+
+import importlib
+import time
+
+H = importlib.import_module("tpu_mpi_tests.comm.halo")
+
+
+def timed_fused_iterate(mesh, z):
+    run = H.iterate_fused_rdma_fn(mesh, "shard", 2, 1e-2)
+    t0 = time.perf_counter()
+    out = run(z, 8)
+    seconds = time.perf_counter() - t0
+    return out, seconds

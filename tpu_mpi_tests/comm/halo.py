@@ -99,6 +99,41 @@ STENCIL_STEPS_SPACE = declare_space(
     describe="temporal-blocking depth (timesteps fused per HBM pass)",
 )
 
+#: the kernel tier of the headline stencil iterate (ISSUE 15): which
+#: per-iteration pipeline runs the exchange+update hot loop. "blocks" =
+#: the ppermute hand tier (parameterized by stencil/blocks: 0 = dim-1
+#: single buffer, S>=2 = resident blocks); "rdma-chained" = the
+#: hand-written RDMA ring feeding the in-place kernel as two chained
+#: launches (``iterate_pallas_fn(rdma=True)``); "rdma-fused" = the
+#: one-launch fused halo+stencil kernel (in-kernel RDMA overlapped with
+#: interior compute — :func:`iterate_fused_rdma_fn`); "xla" = the XLA
+#: formulation. Declared here because every tier's runner lives here.
+STENCIL_TIER_SPACE = declare_space(
+    "stencil/tier",
+    (_priors.STENCIL_TIER, "rdma-chained", "rdma-fused", "xla"),
+    describe="kernel tier of the stencil iterate hot loop: ppermute "
+             "blocks / chained RDMA / one-launch fused RDMA / XLA",
+)
+
+#: every value ``stencil/tier`` may resolve to (and the bench schedule
+#: string may name) — shared by the resolvers and their malformed-cache
+#: degrade paths
+STENCIL_TIERS = ("blocks", "rdma-chained", "rdma-fused", "xla")
+
+
+def resolve_stencil_tier(explicit=None, **ctx) -> str:
+    """The kernel tier the stencil iterate should run: explicit >
+    cached winner > shipped prior ("blocks" — the pre-ISSUE-15
+    schedule). Context-sensitive (``device_fallback=False``): a tier
+    won at one dtype/shape must not leak to another through the
+    device-only slot. Malformed cache values degrade to the prior."""
+    val = _tune_resolve(
+        "stencil/tier", explicit=explicit, prior=_priors.STENCIL_TIER,
+        device_fallback=False, **ctx,
+    )
+    return val if val in STENCIL_TIERS else _priors.STENCIL_TIER
+
+
 #: the halo pipeline depth (ISSUE 7 tentpole a): 1 = today's serialized
 #: exchange-then-update schedule (the prior, so untuned resolution is
 #: byte-identical to the pre-overlap era); 2 = double-buffered — the
@@ -744,6 +779,159 @@ def iterate_pallas_fn(
         return run(z, n_iter)
 
     return run_attributed
+
+
+@functools.lru_cache(maxsize=None)
+def iterate_fused_rdma_fn(
+    mesh: Mesh,
+    axis_name: str,
+    n_bnd: int,
+    scale_eps: float,
+    axis: int = 0,
+    interpret: bool | None = None,
+    steps: int = 1,
+    periodic: bool = False,
+    tile_rows: int | None = None,
+    local_only: bool = False,
+    unsafe_no_seam_wait: bool = False,
+):
+    """The ONE-launch fused tier (ISSUE 15): like
+    :func:`iterate_pallas_fn(rdma=True) <iterate_pallas_fn>` but each
+    iteration is a single ``pl.pallas_call``
+    (:func:`~tpu_mpi_tests.kernels.pallas_kernels.stencil2d_fused_rdma_pallas`)
+    that starts the edge-band RDMA, streams the interior row blocks
+    while the DMA flies, then waits the recv semaphores and finishes the
+    seam blocks — the reference's fully-manual overlapped pipeline
+    (``mpi_stencil2d_sycl.cc``) in one device-side schedule, with no
+    ghost-byte HBM round-trip between an exchange kernel and a compute
+    kernel.
+
+    Dim-0 (row-streaming) decomposition only — the fused schedule IS a
+    row-block stream. ``steps=k`` deep-ghost temporal blocking is
+    preserved (``n_bnd = k · radius``, exchanged once per k timesteps).
+    A 1-shard non-periodic ring degenerates to the pure compute pass
+    (``local_only`` — no barrier, no sends); interiors are
+    bitwise-identical to the chained tier (tests/test_pallas.py).
+
+    ``local_only=True`` forces the compute-only twin on ANY ring — the
+    host-bracketed baseline :func:`fused_overlap_record` prices the
+    seam wait against (its ghosts are treated as fixed bands, so its
+    VALUES are only meaningful on a genuinely 1-shard ring; as a timing
+    baseline the schedule is what matters). ``unsafe_no_seam_wait``
+    forwards the race-detector negative control."""
+    from tpu_mpi_tests.kernels.pallas_kernels import (
+        stencil2d_fused_rdma_pallas,
+    )
+    from tpu_mpi_tests.kernels.stencil import N_BND as RADIUS
+    from tpu_mpi_tests.utils import TpuMtError
+
+    if axis != 0:
+        raise TpuMtError(
+            "iterate_fused_rdma_fn: the fused tier streams row blocks — "
+            "dim-0 decomposition only (decompose the other way or use "
+            "iterate_pallas_fn)"
+        )
+    if n_bnd != steps * RADIUS:
+        raise TpuMtError(
+            f"iterate_fused_rdma_fn: ghost width n_bnd={n_bnd} must equal "
+            f"steps({steps}) x stencil radius({RADIUS}) — deep halos "
+            f"carry one radius per fused timestep"
+        )
+
+    world = mesh.shape[axis_name]
+    pure_compute = local_only or (world == 1 and not periodic)
+    spec = (axis_name, None)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def run(z, n_iter):
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(*spec), P()),
+            out_specs=P(*spec),
+            check_vma=False,
+        )
+        def go(z, n):
+            if periodic:
+                phys_kw = {"phys_static": (0, 0)}
+            elif world == 1:
+                phys_kw = {"phys_static": (1, 1)}
+            else:
+                idx = lax.axis_index(axis_name)
+                phys_kw = {
+                    "phys": jnp.stack(
+                        [
+                            (idx == 0).astype(jnp.int32),
+                            (idx == world - 1).astype(jnp.int32),
+                        ]
+                    )
+                }
+
+            def body(_, zz):
+                return stencil2d_fused_rdma_pallas(
+                    zz,
+                    scale_eps,
+                    axis_name=axis_name,
+                    steps=steps,
+                    periodic=periodic,
+                    interpret=interpret,
+                    tile_rows=tile_rows,
+                    local_only=pure_compute,
+                    unsafe_no_seam_wait=unsafe_no_seam_wait,
+                    **phys_kw,
+                )
+
+            return lax.fori_loop(0, n[0], body, z)
+
+        return go(z, jnp.asarray([n_iter], jnp.int32))
+
+    if pure_compute:
+        return run
+
+    def run_attributed(z, n_iter):
+        # a wedged DMA semaphore / neighborhood barrier in the fused
+        # ring is a silent hang; record the dispatch so the watchdog can
+        # attribute it (parity with the other RDMA tiers)
+        from tpu_mpi_tests.instrument.watchdog import note_comm_op
+
+        note_comm_op(
+            f"iterate_fused_rdma_fn(n_bnd={n_bnd}, periodic={periodic}, "
+            f"steps={steps}, world={world}, n_iter={n_iter})"
+        )
+        return run(z, n_iter)
+
+    return run_attributed
+
+
+def fused_overlap_record(op: str, *, steps: int, fused_s: float,
+                         compute_s: float, world: int, **extra) -> dict:
+    """The fused tier's kernel-level ``kind: "overlap"`` record (ISSUE
+    15): ``fused_s`` is the host-bracketed per-window wall time of the
+    one-launch fused runner, ``compute_s`` that of its compute-only twin
+    (``iterate_fused_rdma_fn(local_only=True)`` — same kernel, same
+    geometry, communication compiled out). Their difference is the
+    SEAM-WAIT cost: barrier + sends + recv waits + whatever ghost
+    arrival the interior stream failed to hide; ``overlap_frac`` =
+    1 − seam_wait/total, so a fully-hidden exchange reads ≈ 1 and a
+    serialized one reads the comm/total complement — feeding the
+    existing OVERLAP table and ``--diff`` frac gate. ``drain_s`` carries
+    the measured seam wait, mirroring the PR-7 convention (the genuinely
+    measured hiding signal)."""
+    seam_wait = max(0.0, float(fused_s) - float(compute_s))
+    frac = (1.0 - seam_wait / fused_s) if fused_s > 0 else 0.0
+    return {
+        "kind": "overlap",
+        "op": op,
+        "depth": 2,
+        "steps": steps,
+        "overlap_frac": frac,
+        "comm_s": float(fused_s),
+        "compute_s": float(compute_s),
+        "drain_s": seam_wait,
+        "world": world,
+        "tier": "rdma-fused",
+        **extra,
+    }
 
 
 def iterate_pallas_blocks_fn(

@@ -602,6 +602,21 @@ def parse_choice_list(spec: str, valid, what: str = "entries"):
     return names
 
 
+def resolve_kernel_auto(dtype: str, n: int, world: int, rep) -> str:
+    """Map the ``stencil/tier`` cache winner onto a driver's xla/pallas
+    update-body choice (``--kernel auto``, ISSUE 15): the "xla" tier
+    keeps the expression form, every hand tier maps to the in-place
+    pallas body. ONE copy of the policy for every tiered driver
+    (stencil2d, heat2d), with the resolution NOTE'd so the run's
+    provenance is visible (README "Kernel tiers")."""
+    from tpu_mpi_tests.comm.halo import resolve_stencil_tier
+
+    tier = resolve_stencil_tier(None, dtype=dtype, n=n, world=world)
+    kernel = "xla" if tier == "xla" else "pallas"
+    rep.line(f"NOTE --kernel auto -> {kernel} (stencil/tier {tier})")
+    return kernel
+
+
 def pick_kernel_tier(build, probe_args, kernel: str, rep, label: str = "step"):
     """Return ``(step, effective_kernel)`` for drivers with an XLA/pallas
     update-body choice. The pallas tier is probed at trace time (no

@@ -94,13 +94,21 @@ def run(args) -> int:
                 ] = blk.astype(dtype)
         zs = jax.device_put(zg_host, NamedSharding(mesh, P("x", "y")))
 
+        kernel_arg = args.kernel
+        if kernel_arg == "auto":
+            # heat has no RDMA exchange — the chained/fused tiers'
+            # exchange half deliberately does not transfer, only their
+            # pallas update body does (README "Kernel tiers")
+            kernel_arg = _common.resolve_kernel_auto(
+                args.dtype, nx, n_dev, rep
+            )
         step, kernel = _common.pick_kernel_tier(
             lambda k: heat_step2d_fn(
                 mesh, "x", "y", nb, float(cx), float(cy),
                 steps=args.halo_steps, kernel=k,
             ),
             (jax.ShapeDtypeStruct(zs.shape, zs.dtype), 1),
-            args.kernel,
+            kernel_arg,
             rep,
             label="heat2d_step",
         )
@@ -261,10 +269,12 @@ def main(argv=None) -> int:
         "interior-identical, gated by the same eigen check)",
     )
     p.add_argument(
-        "--kernel", choices=("xla", "pallas"), default="xla",
-        help="update-body tier: the XLA slice formulation or the in-place "
+        "--kernel", choices=("xla", "pallas", "auto"), default="xla",
+        help="update-body tier: the XLA slice formulation, the in-place "
         "row-streaming Pallas kernel (same recurrence update-for-update, "
-        "~2 HBM passes per fused call vs ~6 per step)",
+        "~2 HBM passes per fused call vs ~6 per step), or auto — the "
+        "stencil/tier schedule cache's winner mapped onto the two bodies "
+        "(README 'Kernel tiers'; --overlap still requires a literal xla)",
     )
     p.add_argument(
         "--overlap",

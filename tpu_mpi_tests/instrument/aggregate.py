@@ -318,10 +318,15 @@ def summarize(
                     rec.get("op", "?"),
                     {"depth": None, "frac": {}, "rate": {},
                      "rate_unit": None, "comm_s": 0.0, "compute_s": 0.0,
-                     "drain_s": 0.0, "steps": 0},
+                     "drain_s": 0.0, "steps": 0, "tier": None},
                 )
                 if rec.get("depth") is not None:
                     ov["depth"] = rec["depth"]
+                if rec.get("tier") is not None:
+                    # ISSUE 15: the fused tier's kernel-level records
+                    # name their tier; the row keeps it so OVERLAP
+                    # numbers stay attributable to a kernel schedule
+                    ov["tier"] = rec["tier"]
                 if isinstance(rec.get("overlap_frac"), (int, float)):
                     ov["frac"][rank] = float(rec["overlap_frac"])
                 for key, unit in (("it_per_s", "it/s"),
@@ -558,6 +563,7 @@ def _overlap_row(ov: dict) -> dict:
     rates = list(ov["rate"].values())
     return {
         "depth": ov["depth"],
+        "tier": ov.get("tier"),
         "ranks": max(len(fracs), len(rates), 1),
         "steps": ov["steps"],
         "overlap_frac": sum(fracs) / len(fracs) if fracs else 0.0,
@@ -801,12 +807,13 @@ def _print_text(summary: dict, skew_threshold: float,
         rate = ""
         if ov.get("rate") is not None:
             rate = f" {ov['rate']:.4g} {ov['rate_unit'] or 'it/s'}"
+        tier = f" tier={ov['tier']}" if ov.get("tier") else ""
         print(
             f"OVERLAP {op}: depth={ov['depth']} "
             f"frac={ov['overlap_frac']:.3f} "
             f"comm={ov['comm_s']:.6g}s compute={ov['compute_s']:.6g}s "
             f"drain={ov['drain_s']:.6g}s "
-            f"steps={ov['steps']} ranks={ov['ranks']}{rate}"
+            f"steps={ov['steps']} ranks={ov['ranks']}{tier}{rate}"
         )
     for name, ph in summary["phases"].items():
         if "overlap_frac" in ph:

@@ -912,6 +912,42 @@ def _iterate_kernel(
     out_ref[:] = z
 
 
+def _kstep_advance(window, *, masked, steps, K, R, abs0, se,
+                   phys_lo, phys_hi, phys_static):
+    """The k-step window advance shared by the row-streaming kernel and
+    the fused RDMA kernel — ONE implementation is what makes their
+    per-cell arithmetic (and therefore the fused-vs-chained bitwise
+    contract, ISSUE 15) structural rather than copy-paste-maintained.
+    ``masked`` blocks clamp the per-step update band to the absolute
+    span [dlo, dhi) (physical sides keep their fixed K band,
+    exchange-fed sides shrink by N_BND per step); mask-free blocks run
+    the raw maximal-span update."""
+    W = window.shape[0]
+    N = N_BND
+    for s in range(1, steps + 1):
+        lo = s * N
+        hi = W - s * N
+        if masked:
+            if phys_static is not None:
+                dlo = K if phys_lo else lo
+                dhi = R - (K if phys_hi else lo)
+            else:
+                dlo = jnp.where(phys_lo, K, lo)
+                dhi = jnp.where(phys_hi, R - K, R - lo)
+            window = _masked_step(window, lo, hi, 0, se, abs0, dlo, dhi)
+        else:
+            upd = _step5(window, lo, hi - lo, 0, se)
+            window = jnp.concatenate(
+                [
+                    jax.lax.slice_in_dim(window, 0, lo, axis=0),
+                    upd,
+                    jax.lax.slice_in_dim(window, hi, W, axis=0),
+                ],
+                axis=0,
+            )
+    return window
+
+
 def _iterate_stream0_kernel(z_ref, top_ref, bot_ref, scale_eps_ref, *rest,
                             steps, B, K, R, i_lo_mask, i_hi_mask,
                             phys_static):
@@ -937,34 +973,12 @@ def _iterate_stream0_kernel(z_ref, top_ref, bot_ref, scale_eps_ref, *rest,
     se = scale_eps_ref[0]
     i = pl.program_id(0)
     window = jnp.concatenate([top_ref[0], z_ref[:], bot_ref[0]], axis=0)
-    W = window.shape[0]  # B + 2K
-    N = N_BND
     abs0 = i * B - K  # absolute (ghosted) row index of window position 0
 
-    def advance(window, masked):
-        for s in range(1, steps + 1):
-            lo = s * N
-            hi = W - s * N
-            if masked:
-                if phys_static is not None:
-                    dlo = K if phys_lo else lo
-                    dhi = R - (K if phys_hi else lo)
-                else:
-                    dlo = jnp.where(phys_lo, K, lo)
-                    dhi = jnp.where(phys_hi, R - K, R - lo)
-                window = _masked_step(window, lo, hi, 0, se, abs0, dlo, dhi)
-            else:
-                upd = _step5(window, lo, hi - lo, 0, se)
-                window = jnp.concatenate(
-                    [
-                        jax.lax.slice_in_dim(window, 0, lo, axis=0),
-                        upd,
-                        jax.lax.slice_in_dim(window, hi, W, axis=0),
-                    ],
-                    axis=0,
-                )
-        return window
-
+    advance = functools.partial(
+        _kstep_advance, steps=steps, K=K, R=R, abs0=abs0, se=se,
+        phys_lo=phys_lo, phys_hi=phys_hi, phys_static=phys_static,
+    )
     needs_mask = (i < i_lo_mask) | (i >= i_hi_mask)
     window = jax.lax.cond(
         needs_mask,
@@ -1867,6 +1881,374 @@ def ring_halo_pallas(
     return jax.lax.dynamic_update_slice_in_dim(
         out, new_hi, size - n_bnd, axis=axis
     )
+
+
+def _patch_rows(window, start, rows, use):
+    """Replace ``window[start:start+len(rows)]`` with ``rows`` when the
+    scalar predicate ``use`` holds (traced or static) — the fused ring
+    kernel's ghost-band substitution, stitched with the same concat idiom
+    as ``_masked_step`` so the surviving cells' arithmetic is untouched."""
+    n = rows.shape[0]
+    seg = jax.lax.slice_in_dim(window, start, start + n, axis=0)
+    seg = jnp.where(use, rows.astype(window.dtype), seg)
+    W = window.shape[0]
+    return jnp.concatenate(
+        [
+            jax.lax.slice_in_dim(window, 0, start, axis=0),
+            seg,
+            jax.lax.slice_in_dim(window, start + n, W, axis=0),
+        ],
+        axis=0,
+    )
+
+
+def _fused_rdma_kernel(z_ref, top_ref, bot_ref, cur_lo_ref, cur_hi_ref,
+                       lo_edge_ref, hi_edge_ref, scale_eps_ref, *rest,
+                       axis_name, steps, B, K, R, nb, i_lo_mask, i_hi_mask,
+                       periodic, use_barrier, symmetric, phys_static,
+                       local_only, seam_wait):
+    """ONE-launch fused halo+stencil step (ISSUE 15 tentpole): in-kernel
+    RDMA of the edge bands overlapped with the interior k-step update.
+
+    Grid step ``i`` processes row block ``blk = (i + 1) % nb`` — the
+    permutation puts the two EDGE blocks (nb−1, then 0) last, so the
+    schedule is:
+
+    * step 0: neighborhood barrier, then ``make_async_remote_copy`` of
+      both interior edge bands to the ring neighbors (my hi edge → right
+      neighbor's lo ghost, my lo edge → left's hi ghost) — the
+      ``MPI_Irecv``/``Isend`` post of ``mpi_stencil2d_sycl.cc``'s manual
+      pipeline, issued before any compute;
+    * steps 0..nb−3: interior row blocks advance ``steps`` timesteps
+      from OLD data (windows assembled from the pre-sliced neighbor-edge
+      operands — cells touching no fresh ghost, the PR-7 CORE split
+      moved device-side) while the DMAs fly;
+    * step nb−2: wait on the recv semaphores (the seam point), copy the
+      landed ghost bands to VMEM, then finish the two seam blocks —
+      block nb−1 here, block 0 at step nb−1 — with their ghost rows
+      patched from the arrivals (``_patch_rows``) and the same masked
+      advance the streaming kernel uses, so fused interiors are
+      BITWISE-identical to the chained exchange→kernel path.
+
+    ``local_only=True`` compiles the communication out entirely (no
+    barrier, no sends, no waits, no patches): the pure compute pass a
+    1-shard non-periodic ring degenerates to, and the host-bracketed
+    baseline the seam-wait ``overlap_frac`` probe times against.
+
+    Non-receiving sides (non-periodic ring edges) keep their physical
+    ghosts: the patch predicate is ``~phys``, so the window's own (old,
+    physical) ghost rows survive — which also neutralizes the symmetric
+    bool-interpret mode's wrap-around arrivals, the same fix-up
+    ``ring_halo_pallas`` does outside the kernel.
+    """
+    if phys_static is None:
+        phys_ref = rest[0]
+        rest = rest[1:]
+        phys_lo = phys_ref[0] != 0
+        phys_hi = phys_ref[1] != 0
+    else:
+        phys_lo, phys_hi = bool(phys_static[0]), bool(phys_static[1])
+    (out_ref, new_lo_ref, new_hi_ref,
+     lo_scr, hi_scr, copy_sem, send_sem, recv_sem) = rest
+    del cur_lo_ref, cur_hi_ref  # alias donors; their data is in new_*
+    se = scale_eps_ref[0]
+    i = pl.program_id(0)
+    blk = jax.lax.rem(i + 1, jnp.int32(nb))
+    # the seam point: first edge block (nb−1) runs at grid step nb−2
+    # (nb == 1: the only block is both edges, everything at step 0)
+    wait_step = max(nb - 2, 0)
+
+    if not local_only:
+        n_dev = axis_size(axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        right = jax.lax.rem(idx + 1, jnp.int32(n_dev))
+        left = jax.lax.rem(idx - 1 + jnp.int32(n_dev), jnp.int32(n_dev))
+        # my hi interior edge → right neighbor's lo ghost (slot 0);
+        # my lo interior edge → left neighbor's hi ghost (slot 1)
+        rdma_hi = pltpu.make_async_remote_copy(
+            src_ref=hi_edge_ref,
+            dst_ref=new_lo_ref,
+            send_sem=send_sem.at[0],
+            recv_sem=recv_sem.at[0],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma_lo = pltpu.make_async_remote_copy(
+            src_ref=lo_edge_ref,
+            dst_ref=new_hi_ref,
+            send_sem=send_sem.at[1],
+            recv_sem=recv_sem.at[1],
+            device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        send_hi_ok = jnp.logical_or(bool(periodic), idx < n_dev - 1)
+        send_lo_ok = jnp.logical_or(bool(periodic), idx > 0)
+        first = i == 0
+
+        if use_barrier:
+            # both neighbors entered this call: their landing buffers are
+            # live and last call's reads are done (ring_halo_pallas's
+            # chained-iteration protection, unchanged)
+            @pl.when(first)
+            def _():
+                barrier = pltpu.get_barrier_semaphore()
+                pltpu.semaphore_signal(
+                    barrier, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                pltpu.semaphore_signal(
+                    barrier, inc=1, device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                pltpu.semaphore_wait(barrier, 2)
+
+        if symmetric:
+            # serializing bool interpreter: remote DMA is emulated with
+            # XLA collectives, so a conditional send is a conditional
+            # collective — send unconditionally and wait in place; the
+            # ~phys patch predicate below discards wrap-around arrivals
+            # on non-periodic edge ranks (ring_halo_pallas's fix-up,
+            # done in-window)
+            @pl.when(first)
+            def _():
+                rdma_hi.start()
+                rdma_lo.start()
+                rdma_hi.wait()
+                rdma_lo.wait()
+        else:
+            @pl.when(first & send_hi_ok)
+            def _():
+                rdma_hi.start()
+
+            @pl.when(first & send_lo_ok)
+            def _():
+                rdma_lo.start()
+
+            if seam_wait:
+                # recv waits mirror the neighbor's send predicates: my lo
+                # ghost lands iff I have a left neighbor, etc. — and they
+                # are the happens-before edge the vector-clock race test
+                # asserts (tests/test_ring_sync.py); ``seam_wait=False``
+                # (the unsafe negative control) removes exactly this edge
+                @pl.when((i == wait_step) & send_lo_ok)
+                def _():
+                    rdma_hi.wait_recv()  # left's hi edge → my lo ghost
+
+                @pl.when((i == wait_step) & send_hi_ok)
+                def _():
+                    rdma_lo.wait_recv()  # right's lo edge → my hi ghost
+
+            @pl.when((i == nb - 1) & send_hi_ok)
+            def _():
+                rdma_hi.wait_send()
+
+            @pl.when((i == nb - 1) & send_lo_ok)
+            def _():
+                rdma_lo.wait_send()
+
+        @pl.when(i == wait_step)
+        def _():
+            # landed ghost bands → VMEM for the seam windows (full-ref
+            # copies, so no tile-alignment constraint on K)
+            cp_lo = pltpu.make_async_copy(new_lo_ref, lo_scr,
+                                          copy_sem.at[0])
+            cp_hi = pltpu.make_async_copy(new_hi_ref, hi_scr,
+                                          copy_sem.at[1])
+            cp_lo.start()
+            cp_hi.start()
+            cp_lo.wait()
+            cp_hi.wait()
+
+    window = jnp.concatenate([top_ref[0], z_ref[:], bot_ref[0]], axis=0)
+    if not local_only:
+        # edge blocks read the ARRIVED ghosts; physical sides keep the
+        # window's own (old) ghost rows — which is also what neutralizes
+        # the symmetric-mode wrap-around arrivals
+        use_lo = jnp.logical_and(blk == 0, jnp.logical_not(phys_lo))
+        use_hi = jnp.logical_and(blk == jnp.int32(nb - 1),
+                                 jnp.logical_not(phys_hi))
+        window = _patch_rows(window, K, lo_scr[:], use_lo)
+        window = _patch_rows(window, B, hi_scr[:], use_hi)
+
+    abs0 = blk * B - K  # absolute (ghosted) row of window position 0
+
+    # the SHARED k-step advance (_kstep_advance — one implementation
+    # with the streaming kernel is what makes the fused-vs-chained
+    # interiors bitwise-identical by construction)
+    advance = functools.partial(
+        _kstep_advance, steps=steps, K=K, R=R, abs0=abs0, se=se,
+        phys_lo=phys_lo, phys_hi=phys_hi, phys_static=phys_static,
+    )
+    needs_mask = (blk < i_lo_mask) | (blk >= i_hi_mask)
+    window = jax.lax.cond(
+        needs_mask,
+        functools.partial(advance, masked=True),
+        functools.partial(advance, masked=False),
+        window,
+    )
+    out_ref[:] = jax.lax.slice_in_dim(window, K, K + B, axis=0)
+
+
+def stencil2d_fused_rdma_pallas(
+    z,
+    scale_eps,
+    *,
+    axis_name: str,
+    steps: int = 1,
+    periodic: bool = False,
+    phys=None,
+    phys_static: "tuple[int, int] | None" = None,
+    collective_id: int = 12,
+    interpret: bool | None = None,
+    tile_rows: int | None = None,
+    local_only: bool = False,
+    unsafe_no_seam_wait: bool = False,
+):
+    """One-launch fused halo-exchange + k-step stencil update along dim 0
+    (ISSUE 15): a single ``pl.pallas_call`` kicks off the RDMA of both
+    edge bands, streams the interior row blocks while the DMA is in
+    flight, then waits on the recv semaphores and finishes the seam
+    blocks — see :func:`_fused_rdma_kernel` for the device schedule.
+    Call *inside* ``shard_map`` over ``axis_name``; semantics (deep
+    ghosts, ``phys``/``phys_static`` flags, shape preservation, input
+    aliasing) match ``ring_halo_pallas`` + ``stencil2d_iterate_pallas``
+    chained, with interiors bitwise-identical to that chain (tested).
+
+    Like ``ring_halo_pallas``, the pack/unpack stays alignment-free: XLA
+    pre-slices the four edge/ghost bands (full-ref RDMA only), and the
+    compute operand streams through BLOCKED specs (no manual sliced DMA).
+    Row blocks must divide the ghosted height and hold the full seam
+    (``B >= 2K`` — a non-edge block's window must never reach a ghost
+    band, or it would read stale values mid-stream); domains whose width
+    exceeds the VMEM budget raise the same "VMEM budget" ValueError as
+    the other streaming kernels.
+
+    ``local_only=True`` (or a 1-shard non-periodic ring, which the
+    runner lowers to it) compiles every communication op out — the pure
+    compute pass, and the baseline the seam-wait probe times against.
+    ``unsafe_no_seam_wait`` removes the recv waits (the seam-read /
+    ghost-arrival synchronization edge) for the race-detector negative
+    control only."""
+    if z.ndim != 2:
+        raise ValueError("stencil2d_fused_rdma_pallas: 2-D shards only")
+    interp = _auto_interpret(interpret)
+    serial = _serial_interpret(interp)
+    R, Wn = z.shape
+    K = steps * N_BND
+    if R <= 2 * K:
+        raise ValueError(
+            f"height {R} too small for {steps}-step ghost width {2 * K}"
+        )
+    itemsize = jnp.dtype(z.dtype).itemsize
+    sub = max(8, 8 * 4 // itemsize)
+    bf16_temps = (_BF16_TEMPS_ITER_STREAM
+                  if jnp.dtype(z.dtype) == jnp.bfloat16
+                  else _BF16_TEMPS_DEFAULT)
+    B = _fit_block_rows(Wn, K, itemsize, sub, bf16_temps)
+    # the two (K, W) ghost-landing scratch buffers live alongside the
+    # streaming window — charge them against the same budget
+    scr_bytes = 2 * K * Wn * itemsize
+    while B > sub and _stream_live_bytes(B, K, Wn, itemsize,
+                                         bf16_temps) + scr_bytes > \
+            _VMEM_BUDGET_CAL:
+        B = max(sub, (B // 2) // sub * sub)
+    if _stream_live_bytes(B, K, Wn, itemsize, bf16_temps) + scr_bytes > \
+            _VMEM_BUDGET_CAL:
+        raise ValueError(
+            f"stencil2d_fused_rdma_pallas: width {Wn} exceeds the VMEM "
+            f"budget even at {B}-row blocks; use the XLA tier"
+        )
+    if tile_rows is not None:
+        _validate_tile_rows(tile_rows, sub)
+        B = min(B, tile_rows)
+    # blocks must tile the ghosted height exactly (the edge blocks' ghost
+    # rows sit at static window offsets) and hold a FULL seam: B >= 2K
+    # keeps every non-edge block's window out of the ghost bands — the
+    # core/seam split is per-block, so a window that straddled a ghost
+    # band from an interior block would read stale values mid-stream
+    B = _fit_divisor(R, B)
+    if B < 2 * K:
+        raise ValueError(
+            f"stencil2d_fused_rdma_pallas: no row blocking of height {R} "
+            f"holds the {2 * K}-row seam (largest fitting divisor {B}); "
+            f"pad the domain or use another tier"
+        )
+    nb = R // B
+    # per-block static masking classification (stream0's): block b is
+    # mask-free iff its window stays inside the worst-case update bands
+    i_lo_mask = -(-(2 * K - N_BND) // B)
+    i_hi_mask = (R - B - 2 * K + N_BND) // B + 1
+    top, bot = _row_block_edges(z, B, K, nb)
+    cur_lo = jax.lax.slice_in_dim(z, 0, K, axis=0)
+    cur_hi = jax.lax.slice_in_dim(z, R - K, R, axis=0)
+    lo_edge = jax.lax.slice_in_dim(z, K, 2 * K, axis=0)
+    hi_edge = jax.lax.slice_in_dim(z, R - 2 * K, R - K, axis=0)
+    se = jnp.asarray(scale_eps, z.dtype).reshape(1)
+    if phys is None and phys_static is None:
+        phys_static = (0, 0)  # both sides exchange-fed
+
+    def blkmap(i):
+        return (jax.lax.rem(i + 1, jnp.int32(nb)), 0)
+
+    in_specs = [
+        pl.BlockSpec((B, Wn), blkmap, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, K, Wn), lambda i: (*blkmap(i), 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, K, Wn), lambda i: (*blkmap(i), 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    operands = [z, top, bot, cur_lo, cur_hi, lo_edge, hi_edge, se]
+    if phys_static is None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(jnp.asarray(phys, jnp.int32).reshape(2))
+    edge_struct = jax.ShapeDtypeStruct((K, Wn), z.dtype)
+    out, _, _ = pl.pallas_call(
+        functools.partial(
+            _fused_rdma_kernel,
+            axis_name=axis_name,
+            steps=steps,
+            B=B,
+            K=K,
+            R=R,
+            nb=nb,
+            i_lo_mask=i_lo_mask,
+            i_hi_mask=i_hi_mask,
+            periodic=periodic,
+            use_barrier=not serial and not local_only,
+            symmetric=serial,
+            phys_static=phys_static,
+            local_only=local_only,
+            seam_wait=not unsafe_no_seam_wait,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((R, Wn), z.dtype),
+            edge_struct,
+            edge_struct,
+        ),
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=(
+            pl.BlockSpec((B, Wn), blkmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((K, Wn), z.dtype),
+            pltpu.VMEM((K, Wn), z.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={0: 0, 3: 1, 4: 2},
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interp,
+    )(*operands)
+    return out
 
 
 def _ring_allgather_kernel(x_ref, out_ref, copy_sem, send_sem, recv_sem,

@@ -55,6 +55,17 @@ STREAM_SKIP_TILE = 0
 BENCH_BLOCKS = {"float32": 2, "bfloat16": 0}
 BENCH_STEPS = 4
 
+# Kernel-tier prior for the headline stencil iterate (ISSUE 15): the
+# ppermute hand tier ("blocks", parameterized by stencil/blocks — 0 is
+# the dim-1 single buffer, S>=2 the resident-block schedule) is the
+# measured-best shipped schedule for both official dtypes; the sweep
+# candidates price the chained-RDMA ring ("rdma-chained"), the
+# one-launch fused halo+stencil kernel ("rdma-fused"), and the XLA
+# formulation ("xla") against it. bench.py / the stencil2d iterate leg
+# resolve through the cache with this prior, so an untuned run keeps
+# the pre-ISSUE-15 schedule byte-identically.
+STENCIL_TIER = "blocks"
+
 # Halo exchange schedule prior: DIRECT (plain ppermute on edge slices,
 # XLA packs as needed) is the measured-best default on every topology
 # benchmarked so far; DEVICE_STAGED and the hand-written PALLAS_RDMA
