@@ -1,8 +1,8 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke overlap-smoke moe-smoke chaos-smoke live-smoke \
-	fleet-smoke lint lint-smoke records records-check ci clean
+	serve-smoke replay-smoke overlap-smoke moe-smoke chaos-smoke \
+	live-smoke fleet-smoke lint lint-smoke records records-check ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -177,6 +177,119 @@ serve-smoke:
 	grep -q ' REGRESSION' /tmp/_tpumt_serve_smoke.baddiff.txt
 	@echo "serve-smoke OK: SLO table + request spans + diff gate"
 
+# request-lifecycle + traffic record/replay smoke (README "Latency
+# anatomy & traffic replay"): (a) record a 2-fake-device Poisson run —
+# the traffic artifact lands with a fingerprint, the run logs a
+# kind:"traffic" record, the SLO table renders the qd99/svc99
+# decomposition columns with real values, and the trace carries req
+# exemplar spans on the per-rank "requests" thread; (b) replay the
+# artifact twice — both replays report the artifact's own fingerprint,
+# reproduce identical per-class arrival counts, and their cross-replay
+# --diff prints the fingerprints-match line under the serve-smoke rc
+# contract (real clocks jitter sub-ms service times; byte-identical
+# arrival determinism is pinned with a fake clock in
+# tests/test_replay.py); (c) a degraded copy of a replay still trips
+# the gate (rc 1); (d) traffic recorded under a different seed refuses
+# to diff (rc 2, DIFF ERROR) unless --allow-traffic-mismatch.
+replay-smoke:
+	rm -f /tmp/_tpumt_replay*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 --duration 4 --arrival poisson --rate 30 \
+		--seed 7 --report-interval 1 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--telemetry --record /tmp/_tpumt_replay.traffic.json \
+		--jsonl /tmp/_tpumt_replay.rec.jsonl \
+		--trace-out /tmp/_tpumt_replay.trace.json \
+		| tee /tmp/_tpumt_replay.rec.out
+	grep -q '^SERVE TRAFFIC recorded: ' /tmp/_tpumt_replay.rec.out
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 --replay /tmp/_tpumt_replay.traffic.json \
+		--seed 7 --report-interval 1 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--jsonl /tmp/_tpumt_replay.r1.jsonl \
+		| tee /tmp/_tpumt_replay.r1.out
+	grep -q '^SERVE TRAFFIC replayed: ' /tmp/_tpumt_replay.r1.out
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 --replay /tmp/_tpumt_replay.traffic.json \
+		--seed 7 --report-interval 1 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--jsonl /tmp/_tpumt_replay.r2.jsonl
+	python -c "import json; \
+		art = json.load(open('/tmp/_tpumt_replay.traffic.json')); \
+		runs = [[json.loads(l) for l in open(p)] for p in \
+			('/tmp/_tpumt_replay.rec.jsonl', \
+			 '/tmp/_tpumt_replay.r1.jsonl', \
+			 '/tmp/_tpumt_replay.r2.jsonl')]; \
+		tr = [[r for r in recs if r.get('kind') == 'traffic'][-1] \
+			for recs in runs]; \
+		assert all(t['fingerprint'] == art['fingerprint'] \
+			for t in tr), tr; \
+		assert [t['event'] for t in tr] == \
+			['record', 'replay', 'replay'], tr; \
+		ns = [sorted((r['class'], r['requests']) for r in recs \
+			if r.get('kind') == 'serve' \
+			and r.get('event') == 'summary') for recs in runs]; \
+		assert ns[1] == ns[2], (ns[1], ns[2]); \
+		print('replay-smoke fingerprint OK:', art['fingerprint'], \
+			'replayed classes:', ns[1])"
+	python -c "import json; \
+		d = json.load(open('/tmp/_tpumt_replay.trace.json')); \
+		q = [e for e in d['traceEvents'] \
+			if e.get('cat') == 'req_queue']; \
+		s = [e for e in d['traceEvents'] \
+			if e.get('cat') == 'req_service']; \
+		m = [e for e in d['traceEvents'] if e.get('ph') == 'M' \
+			and e.get('args', {}).get('name') == 'requests']; \
+		assert q and s and m, (len(q), len(s), len(m)); \
+		print('replay-smoke trace OK:', len(q), 'queue spans,', \
+			len(s), 'service spans')"
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_replay.rec.jsonl > /tmp/_tpumt_replay.report.txt
+	grep -Eq '^SLO daxpy:4096:float32: .*qd99=[0-9.]+ms svc99=[0-9.]+ms' \
+		/tmp/_tpumt_replay.report.txt
+	grep -q '^TRAFFIC record: fingerprint=' /tmp/_tpumt_replay.report.txt
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_replay.r1.jsonl /tmp/_tpumt_replay.r2.jsonl \
+		> /tmp/_tpumt_replay.diff.txt; rc=$$?; \
+	if grep -q ' REGRESSION' /tmp/_tpumt_replay.diff.txt; \
+		then test $$rc -eq 1; else test $$rc -eq 0; fi
+	grep -q '^DIFF traffic fingerprints match' /tmp/_tpumt_replay.diff.txt
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_replay.r1.jsonl')]; \
+		f = open('/tmp/_tpumt_replay.bad.jsonl', 'w'); \
+		[f.write(json.dumps({**r, **({k: r[k] * 10 for k in \
+			('p50_ms', 'p95_ms', 'p99_ms', 'qd_p99_ms', \
+			'svc_p99_ms') if k in r}), \
+			**({'achieved_hz': r['achieved_hz'] / 10} \
+			if 'achieved_hz' in r else {})}) + chr(10)) \
+			for r in recs if r.get('kind') in ('serve', 'traffic')]; \
+		f.close()"
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_replay.r1.jsonl /tmp/_tpumt_replay.bad.jsonl \
+		> /tmp/_tpumt_replay.baddiff.txt; test $$? -eq 1
+	grep -q ' REGRESSION' /tmp/_tpumt_replay.baddiff.txt
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 --duration 4 --arrival poisson --rate 30 \
+		--seed 8 --report-interval 1 \
+		--workloads daxpy:4096:float32:3,allreduce:1024:float32:1 \
+		--record /tmp/_tpumt_replay.trafficB.json \
+		--jsonl /tmp/_tpumt_replay.b.jsonl
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_replay.r1.jsonl /tmp/_tpumt_replay.b.jsonl \
+		> /tmp/_tpumt_replay.mm.txt 2>&1; test $$? -eq 2
+	grep -q 'DIFF ERROR traffic fingerprints differ' \
+		/tmp/_tpumt_replay.mm.txt
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		--allow-traffic-mismatch \
+		/tmp/_tpumt_replay.r1.jsonl /tmp/_tpumt_replay.b.jsonl \
+		> /tmp/_tpumt_replay.mmok.txt; rc=$$?; \
+	if grep -q ' REGRESSION' /tmp/_tpumt_replay.mmok.txt; \
+		then test $$rc -eq 1; else test $$rc -eq 0; fi
+	grep -q '^DIFF NOTE traffic fingerprints differ' \
+		/tmp/_tpumt_replay.mmok.txt
+	@echo "replay-smoke OK: record/replay fingerprint gate + latency anatomy columns + req spans"
+
 # overlap-engine smoke (README "Overlap engine"): a 2-fake-device
 # stencil1d pipeline run at depth 2 must (a) record kind:"overlap" with
 # overlap_frac > 0, pass the bitwise seam gate (driver rc 0), and place
@@ -291,7 +404,11 @@ moe-smoke:
 # every fault class — kill, straggler, wedge, OOM ramp, serve flood —
 # and assert tpumt-doctor convicts the right CLASS and the right RANK
 # from the organic telemetry alone (--expect = exactly-one-finding
-# contract), while a clean run yields zero findings. Multi-rank legs
+# contract), while a clean run yields zero findings. The flood runs
+# twice: bounded queue → shed_storm (the verdict once load drops), and
+# unbounded queue → queue_ramp (the early warning BEFORE any shed) —
+# the ramp run is recorded, replayed without chaos armed, and the
+# ONLINE doctor (--follow) convicts the replayed storm mid-run. Multi-rank legs
 # run real separate processes under the native launcher with a
 # local-compute workload (this image's CPU backend has no
 # cross-process collectives — the multiproc test family documents
@@ -356,6 +473,35 @@ chaos-smoke:
 		--jsonl /tmp/_tpumt_chaos.flood.jsonl; test $$? -eq 1
 	python -m tpu_mpi_tests.instrument.diagnose \
 		/tmp/_tpumt_chaos.flood.jsonl --expect shed_storm:0
+	env JAX_PLATFORMS=cpu TPU_MPI_CHAOS="flood:burst=4000:after=1" \
+		python -m tpu_mpi_tests.drivers.serve --fake-devices 2 \
+		--duration 4 --arrival poisson --rate 20 --seed 7 \
+		--report-interval 0.5 --max-queue 100000 --max-batch 2 \
+		--workloads daxpy:1048576:float32 \
+		--record /tmp/_tpumt_chaos.ramp.traffic.json \
+		--jsonl /tmp/_tpumt_chaos.ramp.jsonl
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.ramp.jsonl --expect queue_ramp:0
+	( env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.serve \
+		--fake-devices 2 \
+		--replay /tmp/_tpumt_chaos.ramp.traffic.json \
+		--seed 7 --report-interval 0.5 --max-queue 100000 \
+		--max-batch 2 --workloads daxpy:1048576:float32 \
+		--jsonl /tmp/_tpumt_chaos.ramp2.jsonl \
+		> /tmp/_tpumt_chaos.ramp2.out 2>&1 ) & pid=$$!; \
+	sleep 1; python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_chaos.ramp2.jsonl --follow --timeout 120 \
+		--expect queue_ramp:0 | tee /tmp/_tpumt_chaos.ramp2.doc; \
+	rc=$${PIPESTATUS[0]}; wait $$pid; test $$rc -eq 0
+	grep -q '(live, ' /tmp/_tpumt_chaos.ramp2.doc
+	python -c "import json; \
+		sm = [json.loads(l) for l in \
+			open('/tmp/_tpumt_chaos.ramp2.jsonl')]; \
+		sm = [r for r in sm if r.get('kind') == 'serve' \
+			and r.get('event') == 'summary']; \
+		assert sm and all(r['shed'] == 0 for r in sm), sm; \
+		print('queue_ramp convicted with zero sheds: the ramp is', \
+			'the warning before the storm')"
 	python -m tpu_mpi_tests.instrument.aggregate \
 		/tmp/_tpumt_chaos.kill.jsonl > /tmp/_tpumt_chaos.report.txt
 	grep -q '^DIAGNOSIS missing_rank: rank=1' /tmp/_tpumt_chaos.report.txt
@@ -367,7 +513,7 @@ chaos-smoke:
 			if e.get('cat') == 'finding']; \
 		assert f and f[0]['pid'] == 1, f; \
 		print('chaos-smoke trace FINDING marker OK')"
-	@echo "chaos-smoke OK: 5 fault classes convicted (class+rank), clean run silent"
+	@echo "chaos-smoke OK: 6 fault classes convicted (class+rank), clean run silent"
 
 # live-observability smoke (README "Live observability"): (a) a serve
 # run armed with --metrics-port must expose well-formed OpenMetrics at
@@ -729,9 +875,9 @@ lint-smoke:
 # round-trip + closed-loop retune), the lint self-clean gate, the
 # lint-cache incrementality + engine-salt smoke, and the RECORDS.md
 # staleness gate
-ci: verify trace-smoke tune-smoke mem-smoke serve-smoke overlap-smoke \
-	moe-smoke chaos-smoke live-smoke fleet-smoke lint lint-smoke \
-	records-check
+ci: verify trace-smoke tune-smoke mem-smoke serve-smoke replay-smoke \
+	overlap-smoke moe-smoke chaos-smoke live-smoke fleet-smoke lint \
+	lint-smoke records-check
 
 clean:
 	$(MAKE) -C native clean

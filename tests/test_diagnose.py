@@ -281,6 +281,117 @@ class TestRules:
         (f,) = diagnose.diagnose_files(_files(tmp_path))
         assert f["class"] == "shed_storm"
 
+    def test_queue_ramp_convicted_before_any_shed(self, tmp_path):
+        """The PR-16 early warning: a rising queue-delay share of the
+        e2e p99 with a standing backlog convicts queue_ramp from the
+        window decomposition alone — zero sheds anywhere."""
+        recs = [_manifest(0, n=1)]
+        for i, (qd, p99, depth) in enumerate(
+                [(2.0, 10.0, 3), (6.0, 10.0, 12),
+                 (8.0, 10.0, 30), (9.5, 10.0, 60)]):
+            recs.append({
+                "kind": "serve", "event": "window", "class": "c:1:f32",
+                "t_start": 100.0 + i, "t_end": 101.0 + i,
+                "arrivals": 100, "requests": 100, "shed": 0,
+                "errors": 0, "queue_max": depth + 5,
+                "queue_depth": depth, "p99_ms": p99, "qd_p99_ms": qd,
+                "rank": 0,
+            })
+        recs += [_summary_marker(0), _mem(110.0, 1, event="final")]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "queue_ramp" and f["rank"] == 0
+        assert f["last_op"] == "c:1:f32"
+        assert "backlog" in f["detail"]
+
+    def test_queue_ramp_requires_share_depth_and_sustain(self, tmp_path):
+        """No conviction when any leg of the rule is missing: a
+        service-dominated tail (low qd share), a draining queue (depth
+        under the floor), or a falling share (not sustained)."""
+        def windows(rows):
+            recs = [_manifest(0, n=1)]
+            for i, (qd, p99, depth) in enumerate(rows):
+                recs.append({
+                    "kind": "serve", "event": "window",
+                    "class": "c:1:f32", "t_start": 100.0 + i,
+                    "t_end": 101.0 + i, "arrivals": 100,
+                    "requests": 100, "shed": 0, "errors": 0,
+                    "queue_max": 99, "queue_depth": depth,
+                    "p99_ms": p99, "qd_p99_ms": qd, "rank": 0,
+                })
+            recs += [_summary_marker(0), _mem(110.0, 1, event="final")]
+            _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+            return diagnose.diagnose_files(_files(tmp_path))
+
+        # service-dominated: share never reaches the floor
+        assert windows([(2.0, 10.0, 50)] * 4) == []
+        # queue drains: final depth under the floor in every 3-run
+        assert windows([(9.0, 10.0, 4)] * 4) == []
+        # share collapsing, not sustained
+        assert windows([(9.0, 10.0, 50), (5.0, 10.0, 50),
+                        (2.0, 10.0, 50), (1.0, 10.0, 50)]) == []
+        # fewer windows than the rule needs
+        assert windows([(9.0, 10.0, 50)] * 2) == []
+
+    def test_queue_ramp_convicts_a_drained_storm_post_mortem(
+            self, tmp_path):
+        """The scan is over EVERY consecutive window run, not just the
+        stream tail: a flood that fully drained by run end (the serve
+        loop always drains before summarizing) still convicts over the
+        windows where it was ramping — so --follow's mid-run conviction
+        and the post-mortem doctor agree on the same file."""
+        ramp = [(5.0, 10.0, 40), (8.0, 10.0, 30), (9.5, 10.0, 20)]
+        drained = [(1.0, 10.0, 0), (0.5, 10.0, 0)]
+        recs = [_manifest(0, n=1)]
+        for i, (qd, p99, depth) in enumerate(ramp + drained):
+            recs.append({
+                "kind": "serve", "event": "window", "class": "c:1:f32",
+                "t_start": 100.0 + i, "t_end": 101.0 + i,
+                "arrivals": 100, "requests": 100, "shed": 0,
+                "errors": 0, "queue_max": 99, "queue_depth": depth,
+                "p99_ms": p99, "qd_p99_ms": qd, "rank": 0,
+            })
+        recs += [_summary_marker(0), _mem(110.0, 1, event="final")]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "queue_ramp"
+
+    def test_queue_ramp_suppressed_by_shed_storm(self, tmp_path):
+        """Once the queue bound is actually dropping load the storm is
+        the verdict; the ramp (its own prelude) must not double-convict
+        the rank."""
+        recs = [_manifest(0, n=1)]
+        for i in range(4):
+            recs.append({
+                "kind": "serve", "event": "window", "class": "c:1:f32",
+                "t_start": 100.0 + i, "t_end": 101.0 + i,
+                "arrivals": 100, "requests": 30, "shed": 60 + i * 10,
+                "errors": 0, "queue_max": 32, "queue_depth": 32,
+                "p99_ms": 10.0, "qd_p99_ms": 9.5, "rank": 0,
+            })
+        recs += [_summary_marker(0), _mem(110.0, 1, event="final")]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        (f,) = diagnose.diagnose_files(_files(tmp_path))
+        assert f["class"] == "shed_storm"
+
+    def test_queue_ramp_ignores_pre_decomposition_streams(
+            self, tmp_path):
+        """Window records from builds before the qd/svc decomposition
+        carry no qd_p99_ms: the rule must stay silent, never guess a
+        share from partial fields."""
+        recs = [_manifest(0, n=1)]
+        for i in range(4):
+            recs.append({
+                "kind": "serve", "event": "window", "class": "c:1:f32",
+                "t_start": 100.0 + i, "t_end": 101.0 + i,
+                "arrivals": 100, "requests": 100, "shed": 0,
+                "errors": 0, "queue_max": 99, "queue_depth": 50,
+                "p99_ms": 10.0, "rank": 0,
+            })
+        recs += [_summary_marker(0), _mem(110.0, 1, event="final")]
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        assert diagnose.diagnose_files(_files(tmp_path)) == []
+
     def test_small_shed_not_a_storm(self, tmp_path):
         recs = [_manifest(0, n=1), {
             "kind": "serve", "event": "window", "class": "c",

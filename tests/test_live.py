@@ -186,6 +186,7 @@ class TestDashboard:
              "class": "daxpy:4096:float32", "arrivals": 10,
              "requests": 9, "errors": 0, "shed": 1, "queue_depth": 2,
              "p50_ms": 1.2, "p95_ms": 2.5, "p99_ms": 4.0,
+             "qd_p99_ms": 3.1, "svc_p99_ms": 0.9,
              "offered_hz": 10.0, "achieved_hz": 9.0, "t_end": 105.0},
             {"kind": "span", "op": "halo_exchange", "nbytes": 1 << 20,
              "world": 2, "seconds": 0.01, "gbps": 0.105, "t_end": 105.5},
@@ -205,6 +206,9 @@ class TestDashboard:
         dash = self._fed()
         frame = live.render(dash, ["out.p0.jsonl"])
         assert "SLO" in frame and "daxpy:4096:float32" in frame
+        # the latency-anatomy columns render live (dashes pre-PR-16)
+        assert "qd99" in frame and "svc99" in frame
+        assert "3.1" in frame and "0.9" in frame
         assert "OPS" in frame and "halo_exchange" in frame
         assert "MEM" in frame and "3.0MiB" in frame
         assert "OVLP" in frame and "frac=0.910" in frame

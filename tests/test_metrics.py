@@ -79,6 +79,22 @@ class TestRegistry:
         assert reg.value("tpumt_serve_queue_depth", L) == 3
         assert reg.value("tpumt_serve_p99_ms", L) == 3.0
 
+    def test_serve_window_latency_decomposition_gauges(self):
+        """The PR-16 latency anatomy rides the same tee: standing
+        per-class queue-delay and service p99 gauges, absent (not fake
+        zero) for pre-decomposition windows."""
+        reg = MetricsRegistry()
+        reg.observe({"kind": "serve", "event": "window", "class": "c1",
+                     "p99_ms": 3.0, "qd_p99_ms": 2.5,
+                     "svc_p99_ms": 0.5})
+        L = (("class", "c1"),)
+        assert reg.value("tpumt_serve_queue_delay_p99_ms", L) == 2.5
+        assert reg.value("tpumt_serve_service_p99_ms", L) == 0.5
+        reg2 = MetricsRegistry()
+        reg2.observe({"kind": "serve", "event": "window",
+                      "class": "c1", "p99_ms": 3.0})
+        assert reg2.value("tpumt_serve_queue_delay_p99_ms", L) is None
+
     def test_serve_window_queue_depth_falls_back_to_queue_max(self):
         reg = MetricsRegistry()
         reg.observe({"kind": "serve", "event": "window", "class": "c1",

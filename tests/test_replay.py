@@ -1,0 +1,232 @@
+"""Traffic record/replay (serve/replay.py + tpumt-serve --record/--replay).
+
+The artifact layer (fingerprint, save/load validation, ReplayArrivals)
+is pure stdlib and tested directly; the determinism contract — two
+replays of one artifact are byte-identical — is pinned at the loop
+level under a fake clock, because on real clocks sub-millisecond CPU
+service times jitter (replay-smoke applies the serve-smoke rc contract
+for exactly that reason; here the invariant holds exactly).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_mpi_tests.serve.arrival import OpenLoopPoisson
+from tpu_mpi_tests.serve.loop import ServeLoop
+from tpu_mpi_tests.serve.replay import (
+    TRAFFIC_FORMAT,
+    TRAFFIC_VERSION,
+    ReplayArrivals,
+    TrafficFormatError,
+    TrafficRecorder,
+    load_traffic,
+    save_traffic,
+    traffic_fingerprint,
+)
+from tpu_mpi_tests.serve.workloads import parse_workload_table
+
+
+EVENTS = [(0.0, "a:1:f32"), (0.25, "b:2:f32"), (0.25, "a:1:f32"),
+          (1.5, "a:1:f32")]
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_stable_and_sensitive():
+    fp = traffic_fingerprint(EVENTS, 2.0)
+    assert fp == traffic_fingerprint(list(EVENTS), 2.0)
+    # every component of the identity moves it: a time, a key, the
+    # count, the duration
+    assert fp != traffic_fingerprint(
+        [(0.001, "a:1:f32")] + EVENTS[1:], 2.0)
+    assert fp != traffic_fingerprint(
+        [(0.0, "b:2:f32")] + EVENTS[1:], 2.0)
+    assert fp != traffic_fingerprint(EVENTS[:-1], 2.0)
+    assert fp != traffic_fingerprint(EVENTS, 3.0)
+
+
+def test_fingerprint_robust_to_float_json_roundtrip():
+    """Identity survives a JSON round-trip (the artifact is JSON): the
+    microsecond rounding absorbs sub-us float noise, while a full
+    microsecond of drift is a different schedule."""
+    jittered = [(t + 4e-8, k) for t, k in EVENTS]
+    assert traffic_fingerprint(EVENTS, 2.0) \
+        == traffic_fingerprint(jittered, 2.0)
+    shifted = [(EVENTS[0][0] + 1e-6, EVENTS[0][1])] + EVENTS[1:]
+    assert traffic_fingerprint(EVENTS, 2.0) \
+        != traffic_fingerprint(shifted, 2.0)
+
+
+# ---------------------------------------------------------------------------
+# recorder + artifact save/load
+# ---------------------------------------------------------------------------
+
+
+def _artifact(events=EVENTS, duration=2.0):
+    rec = TrafficRecorder(arrival="poisson", load="test")
+    for t, k in events:
+        rec.add(t, k)
+    return rec.finalize(duration)
+
+
+def test_recorder_roundtrip(tmp_path):
+    art = _artifact()
+    assert art["format"] == TRAFFIC_FORMAT
+    assert art["version"] == TRAFFIC_VERSION
+    assert art["count"] == 4 and art["duration_s"] == 2.0
+    assert art["classes"] == {"a:1:f32": 3, "b:2:f32": 1}
+    assert art["fingerprint"] == traffic_fingerprint(EVENTS, 2.0)
+    p = tmp_path / "t.json"
+    save_traffic(str(p), art)
+    assert load_traffic(str(p)) == json.loads(p.read_text())
+    assert load_traffic(str(p))["fingerprint"] == art["fingerprint"]
+
+
+def test_load_refuses_bad_artifacts(tmp_path):
+    """Every defect class raises TrafficFormatError (the driver's
+    NOTE + exit 2 path), never a crash or a silent partial replay."""
+    p = tmp_path / "t.json"
+
+    def refused(doc):
+        p.write_text(doc if isinstance(doc, str) else json.dumps(doc))
+        with pytest.raises(TrafficFormatError):
+            load_traffic(str(p))
+
+    with pytest.raises(TrafficFormatError):
+        load_traffic(str(tmp_path / "missing.json"))
+    refused("{not json")
+    refused({"format": "something-else", "version": 1})
+    art = _artifact()
+    refused({**art, "version": TRAFFIC_VERSION + 1})
+    refused({**art, "events": [[0.0], [1.0, "k"]]})
+    refused({**art, "events": [["x", "k"]]})
+    refused({**art, "count": art["count"] + 1})
+    refused({**art, "events": [[1.0, "a:1:f32"], [0.5, "a:1:f32"]],
+             "count": 2})
+    # a tampered stream fails the fingerprint self-check
+    tampered = {**art,
+                "events": [[t, "b:2:f32"] for t, _ in art["events"]]}
+    refused(tampered)
+
+
+# ---------------------------------------------------------------------------
+# ReplayArrivals semantics
+# ---------------------------------------------------------------------------
+
+
+def test_replay_arrivals_schedule_and_classes():
+    r = ReplayArrivals(_artifact())
+    assert r.take_due(100.0) == []  # not started yet
+    r.start(10.0)
+    assert r.next_event() == 10.0
+    assert r.take_due(10.3) == [10.0, 10.25, 10.25]
+    assert [r.draw_class() for _ in range(3)] \
+        == ["a:1:f32", "b:2:f32", "a:1:f32"]
+    # limit is an absolute cutoff, same as OpenLoopPoisson
+    assert r.take_due(100.0, limit=11.0) == []
+    assert r.next_event() == 11.5
+    assert r.take_due(100.0) == [11.5]
+    assert r.draw_class() == "a:1:f32"
+    # exhausted: no more events, no more keys
+    assert r.next_event() is None and r.take_due(100.0) == []
+    assert r.draw_class() is None
+    r.on_complete(3, 12.0)  # no-op: replay is open-loop by construction
+    assert r.next_event() is None
+    # start() rewinds both cursors
+    r.start(0.0)
+    assert r.take_due(0.0) == [0.0] and r.draw_class() == "a:1:f32"
+
+
+# ---------------------------------------------------------------------------
+# loop-level determinism under a fake clock
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def _loop_run(arrival, recorder=None, duration=6.0, service_s=0.001):
+    clk = FakeClock()
+    classes = parse_workload_table(
+        "daxpy:128:float32:3,allreduce:64:float32:1")
+    records = []
+
+    def handler(n):
+        clk.t += service_s * n
+
+    loop = ServeLoop(
+        classes, {c.key: handler for c in classes}, arrival,
+        duration_s=duration, max_batch=8, window_s=2.0, seed=5,
+        sink=records.append, recorder=recorder,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    summaries = loop.run()
+    return records, summaries
+
+
+def test_record_then_replay_reproduces_traffic_exactly():
+    """The tentpole determinism contract, exact under a fake clock:
+    record a Poisson run, replay it twice — the two replays emit
+    byte-identical record streams, and re-recording DURING a replay
+    reproduces the original artifact fingerprint (round-trip
+    identity)."""
+    rec = TrafficRecorder(arrival="poisson", load="test")
+    _, rec_sum = _loop_run(OpenLoopPoisson(40.0, seed=5), recorder=rec)
+    art = rec.finalize(6.0)
+    assert art["count"] == sum(s["arrivals"] for s in rec_sum)
+
+    rerec = TrafficRecorder(arrival="replay", load="test")
+    r1, s1 = _loop_run(ReplayArrivals(art), recorder=rerec)
+    r2, s2 = _loop_run(ReplayArrivals(art))
+    assert json.dumps(r1) == json.dumps(r2)  # byte-identical streams
+    # the replay serves the recorded load class-for-class
+    assert {s["class"]: s["arrivals"] for s in s1} == art["classes"]
+    # replay -> re-record round-trips to the same traffic identity
+    assert rerec.finalize(6.0)["fingerprint"] == art["fingerprint"]
+
+
+def test_two_replays_diff_clean_and_recorded_run_comparable(tmp_path):
+    """tpumt-report --diff between two fake-clock replays exits 0 with
+    the fingerprints-match line; a degraded copy of one still trips the
+    gate (the mismatch refusal lives in test_report_cli)."""
+    from tpu_mpi_tests.instrument import aggregate
+
+    rec = TrafficRecorder(arrival="poisson", load="test")
+    _loop_run(OpenLoopPoisson(40.0, seed=5), recorder=rec)
+    art = rec.finalize(6.0)
+
+    def run_file(name, degrade=1.0):
+        records, _ = _loop_run(ReplayArrivals(art))
+        recs = [{"kind": "manifest", "process_index": 0,
+                 "process_count": 1},
+                {"kind": "traffic", "event": "replay",
+                 "fingerprint": art["fingerprint"],
+                 "count": art["count"], "duration_s": 6.0, "rank": 0}]
+        for r in records:
+            if degrade != 1.0 and r.get("kind") == "serve":
+                r = {**r, **{k: r[k] * degrade for k in
+                             ("p50_ms", "p95_ms", "p99_ms",
+                              "qd_p99_ms", "svc_p99_ms") if k in r}}
+            recs.append({**r, "rank": 0})
+        p = tmp_path / name
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        return str(p)
+
+    a, b = run_file("a.jsonl"), run_file("b.jsonl")
+    assert aggregate.main(["--diff", a, b]) == 0
+    bad = run_file("bad.jsonl", degrade=10.0)
+    assert aggregate.main(["--diff", a, bad]) == 1

@@ -547,10 +547,12 @@ def test_slo_table_summary_and_text(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     (line,) = [ln for ln in out.splitlines() if ln.startswith("SLO ")]
+    # a pre-decomposition stream (no qd_/svc_ fields) renders dashes in
+    # the qd99/svc99 columns — absent data, never fake zeros
     assert line == (
         "SLO daxpy:4096:float32: ranks=2 offered=64.33/s "
         "achieved=38/s n=190 err=1 shed=2 p50=2.5ms p95=5ms "
-        "p99=10ms qmax=5 windows=6"
+        "p99=10ms qd99=-ms svc99=-ms qmax=5 windows=6"
     )
 
 
@@ -646,6 +648,89 @@ def test_diff_serve_percentile_regression(tmp_path, capsys):
     rc = aggregate.main(["--diff", str(a), str(c)])
     out = capsys.readouterr().out
     assert rc == 0 and "DIFF OK within noise" in out
+
+
+def _traffic_record(fp, event="replay", count=100):
+    return {"kind": "traffic", "event": event, "fingerprint": fp,
+            "count": count, "duration_s": 3.0, "rank": 0,
+            "path": "t.json"}
+
+
+def test_diff_refuses_differing_traffic_fingerprints(tmp_path, capsys):
+    """Two serve runs that saw DIFFERENT recorded traffic are not a
+    comparison: --diff refuses with exit 2 and a DIFF ERROR before any
+    metric is judged; --allow-traffic-mismatch downgrades the refusal
+    to a NOTE and the metric gate proceeds."""
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_jsonl(a, [_traffic_record("aaaa111122223333"),
+                     *_serve_records(2.0, 4.0, 8.0)])
+    _write_jsonl(b, [_traffic_record("bbbb444455556666"),
+                     *_serve_records(2.0, 4.0, 8.0)])
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    cap = capsys.readouterr()
+    assert rc == 2
+    assert "DIFF ERROR traffic fingerprints differ" in cap.err
+    assert "aaaa111122223333" in cap.err and "bbbb444455556666" in cap.err
+    # identical metrics, so once allowed the diff itself is clean
+    rc = aggregate.main(["--diff", "--allow-traffic-mismatch",
+                         str(a), str(b)])
+    cap = capsys.readouterr()
+    assert rc == 0
+    assert "DIFF NOTE traffic fingerprints differ" in cap.out
+    # ... but --allow does not mask a real regression
+    _write_jsonl(b, [_traffic_record("bbbb444455556666"),
+                     *_serve_records(2.0, 4.0, 40.0)])
+    rc = aggregate.main(["--diff", "--allow-traffic-mismatch",
+                         str(a), str(b)])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_diff_matching_traffic_fingerprints_announced(tmp_path, capsys):
+    """Matching fingerprints print the match line — the visible signal
+    that this diff compared the SAME traffic, not two draws."""
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_jsonl(a, [_traffic_record("cafe000011112222", event="record"),
+                     *_serve_records(2.0, 4.0, 8.0)])
+    _write_jsonl(b, [_traffic_record("cafe000011112222"),
+                     *_serve_records(2.0, 4.0, 8.0)])
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DIFF traffic fingerprints match (cafe000011112222)" in out
+
+
+def test_diff_one_sided_fingerprint_notes_not_refuses(tmp_path, capsys):
+    """A pre-PR-16 baseline carries no fingerprint: the diff proceeds
+    (refusing would orphan every old baseline) but says out loud that
+    identical load cannot be verified."""
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_jsonl(a, [_traffic_record("cafe000011112222"),
+                     *_serve_records(2.0, 4.0, 8.0)])
+    _write_jsonl(b, _serve_records(2.0, 4.0, 8.0))
+    rc = aggregate.main(["--diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DIFF NOTE only" in out and "traffic fingerprint" in out
+
+
+def test_report_renders_traffic_line(tmp_path, capsys):
+    """The text report surfaces the run's traffic identity next to the
+    SLO table it qualifies."""
+    p = tmp_path / "s.jsonl"
+    _write_jsonl(p, [_traffic_record("cafe000011112222"),
+                     *_serve_records(2.0, 4.0, 8.0)])
+    rc = aggregate.main([str(p)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    (line,) = [ln for ln in out.splitlines()
+               if ln.startswith("TRAFFIC ")]
+    assert line.startswith(
+        "TRAFFIC replay: fingerprint=cafe000011112222 count=100 "
+        "duration=3s")
 
 
 def test_diff_serve_total_stall_flags(tmp_path, capsys):

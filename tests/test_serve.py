@@ -268,7 +268,10 @@ def test_loop_record_count_independent_of_request_count():
 
 def test_loop_summary_accounting_and_percentiles():
     records, summaries = _run_loop(rate=50.0, duration=10.0)
-    assert {r["event"] for r in records} == {"window", "summary"}
+    assert {r["event"] for r in records
+            if r["kind"] == "serve"} == {"window", "summary"}
+    # the only other stream is the bounded kind:"req" exemplars
+    assert {r["kind"] for r in records} <= {"serve", "req"}
     for s in summaries:
         assert s["kind"] == "serve" and s["event"] == "summary"
         assert s["requests"] == s["arrivals"]  # everything served
@@ -486,6 +489,175 @@ def test_loop_sheds_beyond_max_queue():
     assert summary["queue_max"] <= 20
 
 
+def test_latency_decomposition_reconciles_with_e2e():
+    """The PR-16 latency anatomy: every completion's e2e is recorded as
+    queue-delay + service EXACTLY, so the histogram means (the one
+    readout that is not bucket-quantized) reconcile to float precision,
+    and the bucketed percentiles reconcile within one log-bucket of
+    readout tolerance. Windows and summaries both carry the qd_/svc_
+    decomposition fields."""
+    records, summaries = _run_loop(rate=50.0, duration=10.0)
+    tol = 10 ** (1 / 24)  # one histogram bucket (24 per decade)
+    for s in summaries:
+        if not s["requests"]:
+            continue
+        assert s["qd_mean_ms"] + s["svc_mean_ms"] \
+            == pytest.approx(s["mean_ms"])
+        # components never exceed the whole (pointwise qd <= e2e and
+        # svc <= e2e survive the percentile readout up to bucketing)
+        assert s["qd_p99_ms"] <= s["p99_ms"] * tol
+        assert s["svc_p99_ms"] <= s["p99_ms"] * tol
+        # ... and the whole never exceeds the sum of the parts
+        assert s["p99_ms"] <= (s["qd_p99_ms"] + s["svc_p99_ms"]) * tol
+    windows = [r for r in records
+               if r.get("event") == "window" and r["requests"]]
+    assert windows
+    assert all("qd_p99_ms" in r and "svc_p99_ms" in r for r in windows)
+
+
+def test_req_exemplars_bounded_and_coherent():
+    """The rate-capped request sampler: an overloaded run sheds
+    thousands of requests but emits at most REQ_EXEMPLAR_CAP shed
+    exemplars plus ONE p99-worst completion per class-window, each
+    carrying a self-consistent lifecycle (arrival <= dispatch <= done,
+    queue + service == e2e)."""
+    from tpu_mpi_tests.serve.loop import REQ_EXEMPLAR_CAP
+
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+    records = []
+
+    def slow(n):
+        clk.t += 0.02 * n
+
+    loop = ServeLoop(
+        classes, {classes[0].key: slow},
+        OpenLoopPoisson(200.0, seed=1),
+        duration_s=10.0, window_s=2.0, max_queue=10, max_batch=1,
+        sink=records.append,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    windows = [r for r in records if r.get("event") == "window"]
+    reqs = [r for r in records if r["kind"] == "req"]
+    sheds = [r for r in reqs if r["event"] == "shed"]
+    completes = [r for r in reqs if r["event"] == "complete"]
+    assert summary["shed"] > REQ_EXEMPLAR_CAP * len(windows)
+    assert sheds and completes
+    assert len(sheds) <= REQ_EXEMPLAR_CAP * len(windows)
+    assert len(completes) <= len(windows)
+    for r in completes:
+        assert r["sampled"] == "p99_worst"
+        assert r["t_arrival"] <= r["t_dispatch"] <= r["t_done"]
+        assert r["queue_ms"] + r["service_ms"] \
+            == pytest.approx(r["e2e_ms"])
+    for r in sheds:
+        assert r["sampled"] == "shed"
+        assert r["queue_ms"] >= 0
+        assert r["t_done"] >= r["t_arrival"]
+
+
+def test_req_error_exemplars_capped():
+    """Failed batches surface as bounded error exemplars: at most
+    REQ_EXEMPLAR_CAP per class-window, stamped with the dispatch
+    lifecycle of the failed batch."""
+    from tpu_mpi_tests.serve.loop import REQ_EXEMPLAR_CAP
+
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+    records = []
+
+    def bad(n):
+        clk.t += 0.001
+        raise RuntimeError("device fell over")
+
+    loop = ServeLoop(
+        classes, {classes[0].key: bad},
+        OpenLoopPoisson(50.0, seed=0),
+        duration_s=5.0, window_s=2.0, sink=records.append,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    windows = [r for r in records if r.get("event") == "window"]
+    errs = [r for r in records
+            if r["kind"] == "req" and r["event"] == "error"]
+    assert summary["errors"] > REQ_EXEMPLAR_CAP * len(windows)
+    assert errs
+    assert len(errs) <= REQ_EXEMPLAR_CAP * len(windows)
+    for r in errs:
+        assert r["sampled"] == "error"
+        assert r["t_arrival"] <= r["t_dispatch"] <= r["t_done"]
+        assert r["requests"] >= 1
+
+
+def test_shed_wait_accounted_in_records():
+    """Shed requests get terminal accounting, not silent disappearance:
+    windows that shed carry the accumulated queue time of their shed
+    requests (mean + max), windows that did not shed carry neither
+    field (absent, never fake zeros)."""
+    clk = FakeClock()
+    classes = parse_workload_table("daxpy:128:float32")
+    records = []
+
+    def slow(n):
+        clk.t += 0.02 * n
+
+    loop = ServeLoop(
+        classes, {classes[0].key: slow},
+        OpenLoopPoisson(200.0, seed=1),
+        duration_s=10.0, window_s=2.0, max_queue=10, max_batch=1,
+        sink=records.append,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    (summary,) = loop.run()
+    assert summary["shed"] > 0
+    assert summary["shed_wait_ms_max"] >= summary["shed_wait_ms_mean"] >= 0
+    for r in (r for r in records if r.get("event") == "window"):
+        if r["shed"]:
+            assert r["shed_wait_ms_max"] >= r["shed_wait_ms_mean"] >= 0
+        else:
+            assert "shed_wait_ms_mean" not in r
+            assert "shed_wait_ms_max" not in r
+
+
+def test_quarantine_drops_leave_terminal_records():
+    """Requests already queued when their class is quarantined are
+    dropped WITH a terminal story: their waited time joins the class's
+    shed-wait accounting and a bounded number surface as
+    sampled="quarantine_drop" exemplars."""
+    clk = FakeClock()
+    classes = parse_workload_table(
+        "daxpy:128:float32:1,allreduce:64:float32:1"
+    )
+    records = []
+
+    def dead(n):
+        # slow failures: arrivals pile up behind the dying batches, so
+        # a backlog exists at the moment quarantine triggers
+        clk.t += 0.1
+        raise RuntimeError("mesh lost")
+
+    def healthy(n):
+        clk.t += 0.001 * n
+
+    loop = ServeLoop(
+        classes, {classes[0].key: dead, classes[1].key: healthy},
+        OpenLoopPoisson(50.0, seed=0),
+        duration_s=8.0, window_s=2.0, max_queue=64, max_batch=1,
+        sink=records.append, quarantine_after=3,
+        clock=clk.clock, wall=clk.clock, sleep=clk.sleep,
+    )
+    summaries = {s["class"]: s for s in loop.run()}
+    drops = [r for r in records if r["kind"] == "req"
+             and r.get("sampled") == "quarantine_drop"]
+    assert drops
+    assert all(r["event"] == "shed"
+               and r["class"] == classes[0].key
+               and r["t_done"] >= r["t_arrival"]
+               and r["queue_ms"] >= 0 for r in drops)
+    assert summaries[classes[0].key]["shed_wait_ms_max"] >= 0
+
+
 def test_loop_saturation_visible_in_summary():
     """A saturated-but-not-shedding run must still read as saturated:
     offered is the rate over the TRAFFIC window, not diluted by the
@@ -608,6 +780,89 @@ def test_serve_driver_end_to_end(serve_env, capsys):
     assert rc == 0
     assert any(ln.startswith("SLO daxpy:4096:float32:")
                for ln in rep.splitlines())
+
+
+def test_serve_driver_record_replay_roundtrip(serve_env, capsys):
+    """tpumt-serve --record then --replay end to end: the artifact
+    lands fingerprinted, the replay banner + TRAFFIC line carry the
+    same fingerprint, the replay manifest is self-describing about the
+    traffic that drove it, and the replayed run serves the recorded
+    per-class load exactly."""
+    from tpu_mpi_tests.drivers import serve as drv
+    from tpu_mpi_tests.serve.replay import load_traffic
+
+    art_path = serve_env / "traffic.json"
+    jl_rec = serve_env / "rec.jsonl"
+    base = [
+        "--duration", "1.5", "--arrival", "poisson", "--rate", "40",
+        "--seed", "3", "--report-interval", "0.5",
+        "--workloads", "daxpy:4096:float32:3,allreduce:512:float32:1",
+    ]
+    rc = drv.main([*base, "--record", str(art_path),
+                   "--jsonl", str(jl_rec)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "SERVE TRAFFIC recorded:" in out
+    art = load_traffic(str(art_path))  # validates the fingerprint
+
+    jl_rep = serve_env / "rep.jsonl"
+    rc = drv.main([*base, "--replay", str(art_path),
+                   "--jsonl", str(jl_rep)])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert f"fingerprint={art['fingerprint']}" in out
+    assert "SERVE TRAFFIC replayed:" in out
+    recs = [json.loads(ln) for ln in jl_rep.read_text().splitlines()]
+    assert recs[0]["kind"] == "manifest"
+    assert recs[0]["traffic_fingerprint"] == art["fingerprint"]
+    served = {r["class"]: r["arrivals"] for r in recs
+              if r.get("kind") == "serve"
+              and r.get("event") == "summary"}
+    assert served == art["classes"]
+
+
+def test_serve_driver_refuses_untrustworthy_replay(serve_env, capsys,
+                                                   tmp_path):
+    """Every refused-artifact path is a NOTE + exit 2, never a crash:
+    corrupt JSON, a version this build does not speak, and traffic
+    naming classes absent from --workloads."""
+    from tpu_mpi_tests.drivers import serve as drv
+    from tpu_mpi_tests.serve.replay import TrafficRecorder, save_traffic
+
+    base = ["--workloads", "daxpy:4096:float32", "--duration", "1"]
+    art_path = tmp_path / "t.json"
+
+    art_path.write_text("{definitely not json")
+    rc = drv.main([*base, "--replay", str(art_path)])
+    out = capsys.readouterr().out
+    assert rc == 2 and "NOTE traffic artifact refused" in out
+
+    rec = TrafficRecorder(arrival="poisson")
+    rec.add(0.0, "daxpy:4096:float32")
+    art = rec.finalize(1.0)
+    save_traffic(str(art_path), {**art, "version": art["version"] + 1})
+    rc = drv.main([*base, "--replay", str(art_path)])
+    out = capsys.readouterr().out
+    assert rc == 2 and "NOTE traffic artifact refused" in out
+    assert "version" in out
+
+    rec = TrafficRecorder(arrival="poisson")
+    rec.add(0.0, "stencil1d:8192:float32")
+    save_traffic(str(art_path), rec.finalize(1.0))
+    rc = drv.main([*base, "--replay", str(art_path)])
+    out = capsys.readouterr().out
+    assert rc == 2 and "absent from --workloads" in out
+
+
+def test_serve_driver_record_replay_mutually_exclusive(capsys):
+    """Replaying a recording while re-recording it would fork the
+    traffic identity: argparse rejects the combination outright."""
+    from tpu_mpi_tests.drivers import serve as drv
+
+    with pytest.raises(SystemExit):
+        drv.main(["--record", "a.json", "--replay", "b.json",
+                  "--workloads", "daxpy:4096:float32"])
+    assert "mutually exclusive" in capsys.readouterr().err
 
 
 def test_serve_driver_quarantine_exits_clean(serve_env, capsys,

@@ -473,6 +473,41 @@ class TestMemCountersAndCompileTrack:
         assert meta[(0, timeline.TID_COMPILE)] == "compile"
         assert (1, timeline.TID_COMPILE) not in meta
 
+    def test_req_exemplar_track(self, tmp_path):
+        """kind:"req" lifecycle exemplars render as queue + service
+        spans on the per-rank "requests" thread: queue from arrival to
+        dispatch, service from dispatch to done; a shed exemplar (no
+        dispatch) is all queue; the thread is named only on ranks that
+        carry exemplars."""
+        _write_jsonl(tmp_path / "run.p0.jsonl", [
+            {"kind": "manifest", "process_index": 0,
+             "process_count": 1},
+            {"kind": "req", "event": "complete", "class": "c:1:f32",
+             "sampled": "p99_worst", "t_arrival": 100.0,
+             "t_dispatch": 100.4, "t_done": 100.5, "queue_ms": 400.0,
+             "service_ms": 100.0, "e2e_ms": 500.0, "rank": 0},
+            {"kind": "req", "event": "shed", "class": "c:1:f32",
+             "sampled": "shed", "t_arrival": 101.0, "t_done": 101.2,
+             "queue_ms": 200.0, "rank": 0},
+        ])
+        doc = timeline.chrome_trace([str(tmp_path / "run.p0.jsonl")])
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        q = [e for e in evs if e.get("cat") == "req_queue"]
+        s = [e for e in evs if e.get("cat") == "req_service"]
+        assert len(q) == 2 and len(s) == 1
+        assert all(e["tid"] == timeline.TID_REQ for e in q + s)
+        done = {e["name"]: e for e in q}
+        assert done["queue complete c:1:f32"]["dur"] \
+            == pytest.approx(0.4e6)
+        # the shed exemplar queues until its terminal drop
+        assert done["queue shed c:1:f32"]["dur"] == pytest.approx(0.2e6)
+        assert s[0]["dur"] == pytest.approx(0.1e6)
+        assert s[0]["args"]["sampled"] == "p99_worst"
+        meta = {(m["pid"], m["tid"]): m["args"]["name"]
+                for m in doc["traceEvents"]
+                if m["ph"] == "M" and m["name"] == "thread_name"}
+        assert meta[(0, timeline.TID_REQ)] == "requests"
+
     def test_counters_count_as_placed_events(self, mem_run, tmp_path):
         out = tmp_path / "t.json"
         n = timeline.write_trace(mem_run, str(out))

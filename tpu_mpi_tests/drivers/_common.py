@@ -248,7 +248,8 @@ def run_guarded(run, args) -> int:
         return run(args)
 
 
-def make_reporter(args, rank: int = 0, size: int = 1):
+def make_reporter(args, rank: int = 0, size: int = 1,
+                  manifest_extra: dict | None = None):
     """Build the driver's Reporter with the full observability wiring —
     one call so every driver gets it without per-driver plumbing:
 
@@ -295,7 +296,11 @@ def make_reporter(args, rank: int = 0, size: int = 1):
             run_manifest,
         )
 
-        m = run_manifest()
+        # manifest_extra: driver-known run identity (e.g. the serve
+        # driver's replay traffic fingerprint) folded into the
+        # kind:"manifest" record — the manifest schema is open by
+        # design (run_manifest merges **extra)
+        m = run_manifest(**(manifest_extra or {}))
         rep.jsonl(m)
         if rep.jsonl_path:
             cs = clock_sync_record()

@@ -60,7 +60,44 @@ def run(args) -> int:
         print(f"ERROR {e}")
         return 2
 
-    rep = _common.make_reporter(args, rank=topo.process_index, size=world)
+    # --replay: load + validate the traffic artifact BEFORE any mesh or
+    # reporter work — a refused artifact is a visible NOTE + exit 2
+    # (never a crash, never a silent partial replay), and an accepted
+    # one stamps its fingerprint into the run manifest so the JSONL is
+    # self-describing about what traffic drove it
+    replay_artifact = None
+    manifest_extra = None
+    if args.replay:
+        from tpu_mpi_tests.serve.replay import (
+            TrafficFormatError,
+            load_traffic,
+        )
+
+        try:
+            replay_artifact = load_traffic(args.replay)
+        except TrafficFormatError as e:
+            print(f"NOTE traffic artifact refused: {e}")
+            return 2
+        unknown = sorted(set(replay_artifact.get("classes") or ())
+                         - {c.key for c in classes})
+        if unknown:
+            print(f"NOTE replay traffic names workload classes absent "
+                  f"from --workloads: {', '.join(unknown)} (re-run "
+                  f"with the recording's workload table)")
+            return 2
+        if args.duration != replay_artifact["duration_s"]:
+            print(f"NOTE --replay pins --duration to the artifact's "
+                  f"{replay_artifact['duration_s']:g}s (byte-identical "
+                  f"replay needs the recorded horizon)")
+        args.duration = float(replay_artifact["duration_s"])
+        manifest_extra = {
+            "traffic_fingerprint": replay_artifact["fingerprint"],
+            "traffic_count": replay_artifact["count"],
+            "traffic_path": args.replay,
+        }
+
+    rep = _common.make_reporter(args, rank=topo.process_index,
+                                size=world, manifest_extra=manifest_extra)
     with rep:
         if args.retune and rep.metrics is None:
             # --retune without --metrics-port: attach a sink-only
@@ -73,12 +110,18 @@ def run(args) -> int:
             rep.attach_metrics(MetricsRegistry(
                 health_sink=lambda rec: rep.jsonl(
                     {**rec, "rank": rep.proc_index})))
-        if args.arrival == "poisson":
+        if replay_artifact is not None:
+            load = (f"replay={args.replay} "
+                    f"fingerprint={replay_artifact['fingerprint']}")
+            arrival_name = "replay"
+        elif args.arrival == "poisson":
             load = f"rate={args.rate:g}/s"
+            arrival_name = args.arrival
         else:
             load = f"concurrency={args.concurrency}"
+            arrival_name = args.arrival
         rep.banner(
-            f"serve: arrival={args.arrival} {load} "
+            f"serve: arrival={arrival_name} {load} "
             f"duration={args.duration:g}s world={world} "
             f"max_batch={args.max_batch} seed={args.seed} "
             f"classes={','.join(c.key for c in classes)}"
@@ -106,10 +149,19 @@ def run(args) -> int:
         rep.banner(f"serve: {len(handlers)} handlers warmed, "
                    f"opening traffic")
 
-        if args.arrival == "poisson":
+        if replay_artifact is not None:
+            from tpu_mpi_tests.serve.replay import ReplayArrivals
+
+            arrival = ReplayArrivals(replay_artifact)
+        elif args.arrival == "poisson":
             arrival = OpenLoopPoisson(args.rate, seed=args.seed)
         else:
             arrival = ClosedLoop(args.concurrency)
+        recorder = None
+        if args.record:
+            from tpu_mpi_tests.serve.replay import TrafficRecorder
+
+            recorder = TrafficRecorder(arrival=args.arrival, load=load)
         wd = (IdleAwareWatchdog(args.batch_deadline, "serve")
               if args.batch_deadline else None)
         loop = ServeLoop(
@@ -122,6 +174,7 @@ def run(args) -> int:
             sink=lambda rec: rep.jsonl({**rec, "rank": rep.rank}),
             watchdog=wd,
             quarantine_after=args.quarantine_after,
+            recorder=recorder,
         )
         if args.retune:
             # the closed loop: tune_stale (metrics tee, attached above
@@ -145,6 +198,41 @@ def run(args) -> int:
                 watchdog=wd,
             )
         summaries = loop.run()
+
+        if recorder is not None:
+            from tpu_mpi_tests.serve.replay import save_traffic
+
+            artifact = recorder.finalize(args.duration)
+            save_traffic(args.record, artifact)
+            rep.jsonl({
+                "kind": "traffic", "event": "record", "rank": rep.rank,
+                "path": args.record,
+                "fingerprint": artifact["fingerprint"],
+                "count": artifact["count"],
+                "duration_s": artifact["duration_s"],
+                "classes": artifact["classes"],
+                "version": artifact["version"],
+            })
+            rep.line(
+                f"SERVE TRAFFIC recorded: path={args.record} "
+                f"fingerprint={artifact['fingerprint']} "
+                f"count={artifact['count']}"
+            )
+        if replay_artifact is not None:
+            rep.jsonl({
+                "kind": "traffic", "event": "replay", "rank": rep.rank,
+                "path": args.replay,
+                "fingerprint": replay_artifact["fingerprint"],
+                "count": replay_artifact["count"],
+                "duration_s": replay_artifact["duration_s"],
+                "classes": replay_artifact["classes"],
+                "version": replay_artifact["version"],
+            })
+            rep.line(
+                f"SERVE TRAFFIC replayed: path={args.replay} "
+                f"fingerprint={replay_artifact['fingerprint']} "
+                f"count={replay_artifact['count']}"
+            )
 
         rc = 0
         for rec in summaries:
@@ -275,6 +363,24 @@ def main(argv=None) -> int:
         "without a tune_info recipe are never re-tuned",
     )
     p.add_argument(
+        "--record", default=None, metavar="PATH",
+        help="capture this run's offered traffic (arrival times + class "
+        "keys, chaos injections included) as a versioned portable "
+        "artifact with a traffic fingerprint; replay it with --replay "
+        "for identical-traffic A/B runs (README 'Latency anatomy & "
+        "traffic replay')",
+    )
+    p.add_argument(
+        "--replay", default=None, metavar="PATH",
+        help="drive the loop with a recorded traffic artifact instead "
+        "of a synthetic arrival process: the recorded (time, class) "
+        "stream is reproduced byte-identically, --duration is pinned "
+        "to the recording's horizon, and the traffic fingerprint lands "
+        "in the manifest so tpumt-report --diff can refuse cross-"
+        "traffic comparisons; corrupt or version-mismatched artifacts "
+        "are refused with a NOTE (exit 2)",
+    )
+    p.add_argument(
         "--batch-deadline", type=float, default=None, metavar="S",
         help="idle-aware watchdog: hard-exit if one BATCH exceeds S "
         "seconds (armed only around active dispatch — idle gaps "
@@ -296,6 +402,10 @@ def main(argv=None) -> int:
         p.error("--max-queue must be >= 1")
     if args.quarantine_after is not None and args.quarantine_after < 1:
         p.error("--quarantine-after must be >= 1 (omit to disable)")
+    if args.record and args.replay:
+        p.error("--record and --replay are mutually exclusive (replaying "
+                "a recording while re-recording it would fork the "
+                "traffic identity)")
     if args.batch_deadline is not None and args.batch_deadline <= 0:
         # a negative Timer fires immediately: the first batch would die
         # with a bogus "hung collective" diagnosis

@@ -196,6 +196,7 @@ def summarize(
     decode: dict[str, dict] = {}
     workload: dict[str, dict] = {}
     control: dict[str, dict] = {}
+    traffic: dict | None = None
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -418,6 +419,18 @@ def summarize(
                     c["sag_pct"].append(float(rec["sag_pct"]))
                 if isinstance(rec.get("resweep_s"), (int, float)):
                     c["resweep_s"] += float(rec["resweep_s"])
+            elif kind == "traffic":
+                # the run's traffic identity (serve --record/--replay):
+                # one per run — last wins, which is also correct for
+                # append-mode reruns
+                traffic = {
+                    "event": rec.get("event"),
+                    "fingerprint": rec.get("fingerprint"),
+                    "count": rec.get("count"),
+                    "duration_s": rec.get("duration_s"),
+                    "classes": rec.get("classes"),
+                    "path": rec.get("path"),
+                }
             elif kind == "serve":
                 sv = serve.setdefault(
                     rec.get("class", "?"),
@@ -477,6 +490,7 @@ def summarize(
         "compile": {},
         "vmem": {name: vmem[name] for name in sorted(vmem)},
         "serve": {cls: _serve_row(serve[cls]) for cls in sorted(serve)},
+        "traffic": traffic,
         "route": {op: _route_row(route[op]) for op in sorted(route)},
         "decode": {
             key: {"us_per_op": sum(d["us"]) / len(d["us"]),
@@ -605,8 +619,13 @@ def _route_row(rt: dict) -> dict:
     }
 
 
-#: the serve metrics whose cross-window spread becomes a --diff band
-_SERVE_METRICS = ("p50_ms", "p95_ms", "p99_ms", "mean_ms", "achieved_hz")
+#: the serve latency metrics (worst-rank maxima in the SLO row; the
+#: qd_/svc_ pair is the PR-16 decomposition — queue delay + service
+#: ≈ e2e) and, with achieved_hz appended, the metrics whose
+#: cross-window spread becomes a --diff band
+_SERVE_LAT_METRICS = ("p50_ms", "p95_ms", "p99_ms", "mean_ms",
+                      "qd_p99_ms", "svc_p99_ms")
+_SERVE_METRICS = _SERVE_LAT_METRICS + ("achieved_hz",)
 
 
 def _serve_row(sv: dict) -> dict:
@@ -645,7 +664,7 @@ def _serve_row(sv: dict) -> dict:
                 agg[key] = bound if cur is None else fn(cur, bound)
         agg["queue_max"] = max(agg["queue_max"],
                                int(w.get("queue_max") or 0))
-        for k in _SERVE_METRICS[:-1]:
+        for k in _SERVE_LAT_METRICS:
             if isinstance(w.get(k), (int, float)):
                 agg[k] = max(agg.get(k) or 0.0, float(w[k]))
     for agg in synth.values():
@@ -676,7 +695,7 @@ def _serve_row(sv: dict) -> dict:
         )
     for k in ("offered_hz", "achieved_hz"):
         row[k] = sum(float(r.get(k) or 0.0) for r in rows)
-    for k in ("p50_ms", "p95_ms", "p99_ms", "mean_ms"):
+    for k in _SERVE_LAT_METRICS:
         vals = [float(r[k]) for r in rows
                 if isinstance(r.get(k), (int, float))]
         if vals:
@@ -777,8 +796,21 @@ def _print_text(summary: dict, skew_threshold: float,
             f"achieved={sv['achieved_hz']:.4g}/s "
             f"n={sv['requests']} err={sv['errors']} shed={sv['shed']} "
             f"p50={ms('p50_ms')}ms p95={ms('p95_ms')}ms "
-            f"p99={ms('p99_ms')}ms qmax={sv['queue_max']} "
+            f"p99={ms('p99_ms')}ms qd99={ms('qd_p99_ms')}ms "
+            f"svc99={ms('svc_p99_ms')}ms qmax={sv['queue_max']} "
             f"windows={sv['windows']}{quar}"
+        )
+
+    tf = summary.get("traffic")
+    if tf:
+        dur = tf.get("duration_s")
+        dur_s = (format(dur, ".4g")
+                 if isinstance(dur, (int, float)) else "?")
+        print(
+            f"TRAFFIC {tf.get('event', '?')}: "
+            f"fingerprint={tf.get('fingerprint')} "
+            f"count={tf.get('count')} duration={dur_s}s "
+            f"path={tf.get('path')}"
         )
 
     for op, rt in summary.get("route", {}).items():
@@ -1044,7 +1076,11 @@ def _metrics_from_summary(s: dict) -> dict[str, dict]:
     # before its tail flags (same contract as the bench samples)
     for cls, sv in s.get("serve", {}).items():
         bands = sv.get("bands") or {}
-        for met in ("p50_ms", "p95_ms", "p99_ms"):
+        # the qd_/svc_ decomposition series gate alongside the e2e
+        # percentiles: a queue-side regression can't hide inside a
+        # flat e2e p99 (service got faster, queueing got worse)
+        for met in ("p50_ms", "p95_ms", "p99_ms",
+                    "qd_p99_ms", "svc_p99_ms"):
             v = sv.get(met)
             if isinstance(v, (int, float)):
                 out[f"serve:{cls}:{met}"] = {
@@ -1118,24 +1154,56 @@ def _metrics_from_summary(s: dict) -> dict[str, dict]:
 
 def _side_metrics(
     path: str,
-) -> tuple[str, dict[str, dict], dict | None]:
+) -> tuple[str, dict[str, dict], dict | None, dict | None]:
     bench = _load_bench_doc(path)
     if bench is not None:
-        return "bench", _bench_metrics(bench), None
+        return "bench", _bench_metrics(bench), None, None
     files = [f for f in expand_rank_files([path]) if Path(f).exists()]
     s = summarize(files)
-    return "jsonl", _metrics_from_summary(s), s.get("rank_set")
+    return ("jsonl", _metrics_from_summary(s), s.get("rank_set"),
+            s.get("traffic"))
 
 
-def diff_main(path_a: str, path_b: str, threshold: float = 0.05) -> int:
+def diff_main(path_a: str, path_b: str, threshold: float = 0.05,
+              allow_traffic_mismatch: bool = False) -> int:
     """Compare two runs per metric. A change is flagged only beyond the
     noise band — the larger of either side's cross-sample/cross-rank
     band and the ``--diff-threshold`` floor. Returns 1 when any flagged
     change is a *regression* (slower / less bandwidth / more memory),
     0 otherwise; 2 when the baseline is a partial-rank run (a crashed
-    rank must not silently shrink the noise band a gate trusts)."""
-    kind_a, a, ranks_a = _side_metrics(path_a)
-    kind_b, b, ranks_b = _side_metrics(path_b)
+    rank must not silently shrink the noise band a gate trusts) or the
+    two serve runs carry different traffic fingerprints (an SLO diff
+    across different traffic is not a comparison — record once, replay
+    twice; ``--allow-traffic-mismatch`` downgrades this to a NOTE)."""
+    kind_a, a, ranks_a, traffic_a = _side_metrics(path_a)
+    kind_b, b, ranks_b, traffic_b = _side_metrics(path_b)
+    fp_a = (traffic_a or {}).get("fingerprint")
+    fp_b = (traffic_b or {}).get("fingerprint")
+    if fp_a and fp_b and fp_a != fp_b:
+        if not allow_traffic_mismatch:
+            print(
+                f"DIFF ERROR traffic fingerprints differ: A={fp_a} "
+                f"B={fp_b} — these serve runs saw different request "
+                f"streams, so their SLO deltas conflate the change "
+                f"under test with the load change; replay one recorded "
+                f"artifact on both sides (tpumt-serve --record/"
+                f"--replay) or pass --allow-traffic-mismatch to "
+                f"compare anyway",
+                file=sys.stderr,
+            )
+            return 2
+        print(f"DIFF NOTE traffic fingerprints differ (A={fp_a} "
+              f"B={fp_b}); comparing anyway (--allow-traffic-mismatch)")
+    elif (fp_a or fp_b) and not (fp_a and fp_b):
+        # one side recorded/replayed, the other ran synthetic traffic:
+        # not refusable (pre-PR-16 baselines have no fingerprint) but
+        # never silent
+        have, lack = (path_a, path_b) if fp_a else (path_b, path_a)
+        print(f"DIFF NOTE only {have} carries a traffic fingerprint; "
+              f"{lack} ran unrecorded traffic — the comparison cannot "
+              f"verify identical load")
+    elif fp_a and fp_b:
+        print(f"DIFF traffic fingerprints match ({fp_a})")
     if ranks_a and ranks_a.get("missing"):
         print(
             f"DIFF ERROR baseline {path_a} is a partial-rank run "
@@ -1253,6 +1321,14 @@ def main(argv: list[str] | None = None) -> int:
         help="minimum relative-change floor for --diff flags when the "
         "runs' own noise bands are tighter (default 0.05)",
     )
+    p.add_argument(
+        "--allow-traffic-mismatch",
+        action="store_true",
+        help="let --diff compare two serve runs whose traffic "
+        "fingerprints differ (normally refused with exit 2: an SLO "
+        "delta across different request streams conflates the change "
+        "under test with the load change — record once, replay twice)",
+    )
     args = p.parse_args(argv)
 
     if args.diff:
@@ -1267,8 +1343,11 @@ def main(argv: list[str] | None = None) -> int:
             ):
                 print(f"tpumt-report: cannot open {f}", file=sys.stderr)
                 return 1
-        return diff_main(args.files[0], args.files[1],
-                         threshold=args.diff_threshold)
+        return diff_main(
+            args.files[0], args.files[1],
+            threshold=args.diff_threshold,
+            allow_traffic_mismatch=args.allow_traffic_mismatch,
+        )
 
     files = [f for f in expand_rank_files(args.files) if Path(f).exists()]
     if not files:

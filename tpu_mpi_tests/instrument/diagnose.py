@@ -63,7 +63,7 @@ _INF = float("inf")
 #: the classes a finding can carry (the chaos smoke maps injected
 #: faults onto them via tpu_mpi_tests.chaos.spec.FINDING_FOR)
 FINDING_CLASSES = ("missing_rank", "straggler", "wedge", "oom",
-                   "shed_storm", "stale_schedule")
+                   "shed_storm", "stale_schedule", "queue_ramp")
 
 #: conviction thresholds — deliberately stricter than tpumt-report's
 #: reporting bands (1.5x skew): the report flags for a human to read,
@@ -80,6 +80,14 @@ DEFAULTS = {
                              # tune_swap before stale_schedule convicts
                              # (mid-follow the controller needs a
                              # window boundary to act)
+    "ramp_windows": 3,       # consecutive windows a queue ramp must
+                             # sustain before queue_ramp convicts
+    "qd_share_min": 0.5,     # queue-delay share of e2e p99 the final
+                             # window must reach (past it the tail is
+                             # queueing, not service)
+    "ramp_depth_min": 8,     # standing queue_depth the final window
+                             # must carry — a drained queue is not a
+                             # ramp no matter what the shares say
 }
 
 
@@ -737,6 +745,74 @@ def _shed_storm_findings(streams: list[_Stream], opts) -> list[dict]:
     return out
 
 
+def _queue_ramp_findings(streams: list[_Stream], opts) -> list[dict]:
+    """Saturation as an EARLY WARNING: the queue-delay share of the
+    e2e p99 (``qd_p99_ms / p99_ms``, the PR-16 decomposition) held or
+    rose across ``ramp_windows`` consecutive windows, ended the run at
+    or above ``qd_share_min``, and the run's last window still carried
+    a standing backlog of at least ``ramp_depth_min`` — the tail is
+    queueing, the queue is not draining, and the shed cliff is where
+    that trajectory ends. Scans every consecutive window run (not just
+    the stream tail): a flood that eventually drains still convicts
+    post-mortem over the windows where it was ramping, so --follow's
+    mid-run conviction and the offline doctor agree on the same
+    records for free. Suppressed wherever shed_storm already convicted
+    the rank: the storm is the verdict once load is actually dropping,
+    the ramp is the warning before. One finding per rank, naming the
+    class with the worst qualifying share."""
+    out = []
+    need = int(opts["ramp_windows"])
+    for s in streams:
+        worst = None
+        for cls, dq in s.serve_windows.items():
+            wins = [
+                (ln, r) for ln, r in dq
+                if not ((q := s.quar_t.get(r.get("class"))) is not None
+                        and (_rec_t(r) or 0) >= q)
+            ]
+            if len(wins) < need:
+                continue
+            for i in range(len(wins) - need + 1):
+                run = wins[i:i + need]
+                shares = []
+                for _ln, r in run:
+                    qd, e2e = r.get("qd_p99_ms"), r.get("p99_ms")
+                    if not (isinstance(qd, (int, float))
+                            and isinstance(e2e, (int, float))
+                            and e2e > 0):
+                        shares = None  # pre-decomposition records in
+                        break          # this run: no verdict from them
+                    shares.append(min(qd / e2e, 1.0))
+                if not shares:
+                    continue
+                depth = run[-1][1].get("queue_depth")
+                if not isinstance(depth, (int, float)):
+                    depth = 0
+                sustained = all(b >= a - 0.05
+                                for a, b in zip(shares, shares[1:]))
+                if not (sustained and shares[-1] >= opts["qd_share_min"]
+                        and depth >= opts["ramp_depth_min"]):
+                    continue
+                if worst is None or shares[-1] > worst[0]:
+                    worst = (shares[-1], shares[0], cls, depth, run)
+        if worst is None:
+            continue
+        share_end, share_start, cls, depth, tail = worst
+        out.append(_finding(
+            "queue_ramp", s.rank, 0.7,
+            f"class {cls!r}: queue-delay share of the e2e p99 held at "
+            f"{share_start * 100:.0f}%→{share_end * 100:.0f}% across "
+            f"{len(tail)} windows with a standing backlog of {depth} "
+            f"still queued — the tail is queueing, not service, and "
+            f"the queue is not draining; sheds follow if the offered "
+            f"load holds (raise capacity, lower --rate, or let "
+            f"--max-queue shed earlier)",
+            [s.ref(*tail[0]), s.ref(*tail[-1])],
+            last_op=cls, phase="serve", t=_rec_t(tail[-1][1]),
+        ))
+    return out
+
+
 def _stale_schedule_findings(streams: list[_Stream], opts,
                              followed: bool = False) -> list[dict]:
     """A latched ``tune_stale`` with no ``tune_swap`` answer: the run's
@@ -828,9 +904,19 @@ def diagnose_streams(streams: list[_Stream], ctx: dict | None = None,
         f for f in _straggler_findings(streams, opts, alive=alive)
         if f["rank"] not in dead_ranks
     )
-    findings.extend(
+    storm_findings = [
         f for f in _shed_storm_findings(streams, opts)
         if f["rank"] not in dead_ranks
+    ]
+    findings.extend(storm_findings)
+    storm_ranks = {f["rank"] for f in storm_findings}
+    findings.extend(
+        # ramp suppressed where the storm already convicted: the storm
+        # is the verdict once load is dropping, the ramp the forecast
+        # before — double-convicting one saturation event would break
+        # every --expect exactly-one-finding contract
+        f for f in _queue_ramp_findings(streams, opts)
+        if f["rank"] not in dead_ranks | storm_ranks
     )
     findings.extend(
         f for f in _stale_schedule_findings(streams, opts,

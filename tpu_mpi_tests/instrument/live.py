@@ -308,9 +308,13 @@ def render(dash: Dashboard, files: list[str]) -> str:
         lines.append("BEAT  " + " | ".join(parts))
 
     if dash.slo:
+        # qd99/svc99: the PR-16 latency decomposition — queue delay vs
+        # service share of the tail, live (a climbing qd99 under a flat
+        # svc99 is saturation building before the shed cliff)
         lines.append(
             f"SLO   {'class':28s} {'off/s':>8s} {'ach/s':>8s} "
             f"{'p50ms':>8s} {'p95ms':>8s} {'p99ms':>8s} "
+            f"{'qd99':>8s} {'svc99':>8s} "
             f"{'err':>5s} {'shed':>5s} {'q':>4s}")
         for cls in sorted(dash.slo):
             w = dash.slo[cls]
@@ -318,6 +322,8 @@ def render(dash: Dashboard, files: list[str]) -> str:
                 f"      {cls:28s} {_fmt(w.get('offered_hz'))} "
                 f"{_fmt(w.get('achieved_hz'))} {_fmt(w.get('p50_ms'))} "
                 f"{_fmt(w.get('p95_ms'))} {_fmt(w.get('p99_ms'))} "
+                f"{_fmt(w.get('qd_p99_ms'))} "
+                f"{_fmt(w.get('svc_p99_ms'))} "
                 f"{_fmt(w.get('errors'), 5)} {_fmt(w.get('shed'), 5)} "
                 f"{_fmt(w.get('queue_depth', w.get('queue_max')), 4)}")
 

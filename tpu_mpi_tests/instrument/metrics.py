@@ -389,6 +389,17 @@ class MetricsRegistry:
                 v = rec.get(field)
                 if isinstance(v, (int, float)):
                     self.set_gauge(f"tpumt_serve_{field}", L, v)
+            # the latency decomposition as standing gauges: queue-delay
+            # and service p99 per class, live on the OpenMetrics
+            # endpoint — the saturation early warning (queue-delay
+            # share climbing toward the SLO bound) without waiting for
+            # the post-mortem table
+            for field, name in (
+                    ("qd_p99_ms", "tpumt_serve_queue_delay_p99_ms"),
+                    ("svc_p99_ms", "tpumt_serve_service_p99_ms")):
+                v = rec.get(field)
+                if isinstance(v, (int, float)):
+                    self.set_gauge(name, L, v)
         elif event == "quarantine":
             self.inc("tpumt_serve_quarantines", L)
 

@@ -50,6 +50,7 @@ from tpu_mpi_tests.instrument.aggregate import (
 TID_COMM = 0
 TID_PHASE = 1
 TID_COMPILE = 2
+TID_REQ = 3
 
 _US = 1e6  # trace-event ts/dur unit is microseconds
 
@@ -254,6 +255,38 @@ def _collect(streams):
                                     "temp_bytes", "output_bytes",
                                     "fingerprint")),
                 ))
+            elif kind == "req":
+                # request lifecycle exemplars (serve loop sampler): the
+                # window's p99-worst completion plus shed/error
+                # terminals, rendered as a queue span (arrival ->
+                # dispatch, or -> death for sheds) and a service span
+                # (dispatch -> done) on the owning rank's requests
+                # track — per-request latency anatomy on the timeline
+                t_arr = rec.get("t_arrival")
+                if t_arr is None:
+                    unplaced += 1
+                    continue
+                t_arr = float(t_arr) - offset
+                t_disp = rec.get("t_dispatch")
+                t_done = rec.get("t_done")
+                label = f"{rec.get('event', '?')} {rec.get('class', '?')}"
+                q_end = float(t_disp if t_disp is not None
+                              else (t_done if t_done is not None
+                                    else rec["t_arrival"])) - offset
+                spans.append((
+                    rank, TID_REQ, f"queue {label}", "req_queue",
+                    t_arr, max(q_end - t_arr, 0.0),
+                    args_from(rec, ("sampled", "queue_ms", "e2e_ms")),
+                ))
+                if t_disp is not None and t_done is not None:
+                    start = float(t_disp) - offset
+                    end = float(t_done) - offset
+                    spans.append((
+                        rank, TID_REQ, f"service {label}", "req_service",
+                        start, max(end - start, 0.0),
+                        args_from(rec, ("sampled", "service_ms",
+                                        "e2e_ms", "requests")),
+                    ))
             elif kind == "mem":
                 if rec.get("t") is None:
                     unplaced += 1
@@ -314,6 +347,7 @@ def chrome_trace(
     t0 = min(starts) if starts else 0.0
 
     compile_ranks = {s[0] for s in spans if s[1] == TID_COMPILE}
+    req_ranks = {s[0] for s in spans if s[1] == TID_REQ}
     events = []
     for rank in sorted({r for r, _, _ in streams}):
         events.append({"ph": "M", "name": "process_name", "pid": rank,
@@ -326,6 +360,10 @@ def chrome_trace(
             events.append({"ph": "M", "name": "thread_name", "pid": rank,
                            "tid": TID_COMPILE,
                            "args": {"name": "compile"}})
+        if rank in req_ranks:
+            events.append({"ph": "M", "name": "thread_name", "pid": rank,
+                           "tid": TID_REQ,
+                           "args": {"name": "requests"}})
     for rank, tid, name, cat, start, dur, args in sorted(
         spans, key=lambda s: s[4]
     ):

@@ -76,24 +76,61 @@ class Request:
         self.synthetic = synthetic
 
 
-class _ClassStats:
-    """Per-class accumulators, total + current-window. Fixed size: two
-    histograms and a handful of counters, regardless of request count."""
+#: per-(class, window) exemplar budget for shed and error terminal
+#: records — the sampler's rate cap (plus exactly one p99-worst
+#: completion), so the ``kind:"req"`` stream stays bounded per window
+#: no matter how hard a storm sheds
+REQ_EXEMPLAR_CAP = 2
 
-    __slots__ = ("hist", "win_hist", "requests", "errors", "shed",
-                 "batches", "arrivals", "queue_max", "win_requests",
-                 "win_errors", "win_shed", "win_batches", "win_arrivals",
-                 "win_queue_max", "consec_errors", "quarantines",
+
+class _ClassStats:
+    """Per-class accumulators, total + current-window. Fixed size: six
+    histograms (e2e + queue-delay + service, total and window), a
+    bounded exemplar set, and a handful of counters, regardless of
+    request count."""
+
+    __slots__ = ("hist", "win_hist", "qd_hist", "svc_hist",
+                 "win_qd_hist", "win_svc_hist", "requests", "errors",
+                 "shed", "batches", "arrivals", "queue_max",
+                 "win_requests", "win_errors", "win_shed", "win_batches",
+                 "win_arrivals", "win_queue_max", "shed_wait_s",
+                 "shed_wait_max_s", "win_shed_wait_s",
+                 "win_shed_wait_max_s", "win_worst", "win_shed_ex",
+                 "win_err_ex", "consec_errors", "quarantines",
                  "quarantine_s", "streak_errors", "quar_errors",
                  "quar_shed")
 
     def __init__(self):
         self.hist = LatencyHistogram()
         self.win_hist = LatencyHistogram()
+        # the latency DECOMPOSITION: e2e = queue delay (arrival ->
+        # dispatch) + service (dispatch -> completion), recorded from
+        # the same three stamps so qd + svc == e2e per request exactly
+        # and the percentile readouts reconcile within bucket tolerance
+        self.qd_hist = LatencyHistogram()
+        self.svc_hist = LatencyHistogram()
+        self.win_qd_hist = LatencyHistogram()
+        self.win_svc_hist = LatencyHistogram()
         self.requests = self.errors = self.shed = 0
         self.batches = self.arrivals = self.queue_max = 0
         self.win_requests = self.win_errors = self.win_shed = 0
         self.win_batches = self.win_arrivals = self.win_queue_max = 0
+        # terminal accounting for requests that never complete: the
+        # queue time a shed request had accumulated when dropped
+        # (admission sheds + quarantine backlog drops) — kept OUT of
+        # qd_hist so the qd+svc≈e2e reconciliation stays a completions-
+        # only identity, but first-class in the window record
+        self.shed_wait_s = 0.0
+        self.shed_wait_max_s = 0.0
+        self.win_shed_wait_s = 0.0
+        self.win_shed_wait_max_s = 0.0
+        # the bounded per-window request exemplars: the p99-worst
+        # completed request (one), plus up to REQ_EXEMPLAR_CAP shed and
+        # error terminals — ready-to-sink dicts, wall-stamped at
+        # capture time
+        self.win_worst: dict | None = None
+        self.win_shed_ex: list[dict] = []
+        self.win_err_ex: list[dict] = []
         # graceful degradation bookkeeping: consecutive failed batches
         # (reset on any success), completed quarantine episodes, and
         # total seconds the class spent quarantined
@@ -116,8 +153,22 @@ class _ClassStats:
 
     def reset_window(self) -> None:
         self.win_hist.reset()
+        self.win_qd_hist.reset()
+        self.win_svc_hist.reset()
         self.win_requests = self.win_errors = self.win_shed = 0
         self.win_batches = self.win_arrivals = self.win_queue_max = 0
+        self.win_shed_wait_s = 0.0
+        self.win_shed_wait_max_s = 0.0
+        self.win_worst = None
+        self.win_shed_ex = []
+        self.win_err_ex = []
+
+    def note_shed_wait(self, wait_s: float) -> None:
+        wait_s = max(wait_s, 0.0)
+        self.shed_wait_s += wait_s
+        self.shed_wait_max_s = max(self.shed_wait_max_s, wait_s)
+        self.win_shed_wait_s += wait_s
+        self.win_shed_wait_max_s = max(self.win_shed_wait_max_s, wait_s)
 
 
 class ServeLoop:
@@ -146,6 +197,7 @@ class ServeLoop:
         watchdog=None,
         quarantine_after: int | None = None,
         controller=None,
+        recorder=None,
         clock: Callable[[], float] = time.monotonic,
         wall: Callable[[], float] = time.time,
         sleep: Callable[[float], None] = time.sleep,
@@ -176,6 +228,11 @@ class ServeLoop:
         # service (arrivals queue through it), never a mid-batch stall.
         # None = off, byte-identical to the pre-controller loop.
         self.controller = controller
+        # traffic capture (serve/replay.py TrafficRecorder, --record):
+        # fed the OFFERED stream — every admission attempt, before the
+        # shed decision, chaos-flood injections included — so a replay
+        # re-offers exactly what this run saw, storms and all
+        self.recorder = recorder
         self._quarantined: dict[str, float] = {}  # key -> wall t of entry
         self._clock = clock
         self._wall = wall
@@ -202,12 +259,17 @@ class ServeLoop:
             arrivals, requests = st.win_arrivals, st.win_requests
             errors, shed = st.win_errors, st.win_shed
             batches, qmax = st.win_batches, st.win_queue_max
-            hist = st.win_hist
+            hist, qd_hist = st.win_hist, st.win_qd_hist
+            svc_hist = st.win_svc_hist
+            shed_wait_s = st.win_shed_wait_s
+            shed_wait_max_s = st.win_shed_wait_max_s
         else:
             arrivals, requests = st.arrivals, st.requests
             errors, shed = st.errors, st.shed
             batches, qmax = st.batches, st.queue_max
-            hist = st.hist
+            hist, qd_hist, svc_hist = st.hist, st.qd_hist, st.svc_hist
+            shed_wait_s = st.shed_wait_s
+            shed_wait_max_s = st.shed_wait_max_s
         rec = {
             "kind": "serve",
             "event": event,
@@ -227,7 +289,21 @@ class ServeLoop:
             "achieved_hz": requests / dur,
             "queue_max": qmax,
             **hist.percentiles_ms(),
+            # the decomposition columns: e2e ≈ qd + svc per percentile
+            # (exact per request; percentiles reconcile within the
+            # histogram's readout tolerance)
+            **{f"qd_{k}": v
+               for k, v in qd_hist.percentiles_ms().items()},
+            **{f"svc_{k}": v
+               for k, v in svc_hist.percentiles_ms().items()},
         }
+        if shed:
+            # queue time the shed/dropped requests had accumulated —
+            # the coordinated-omission blind spot, measured: a storm's
+            # victims carry their wait into the record instead of
+            # vanishing from every histogram
+            rec["shed_wait_ms_mean"] = shed_wait_s / shed * 1e3
+            rec["shed_wait_ms_max"] = shed_wait_max_s * 1e3
         if queue_depth is not None:
             # the STANDING backlog at emission time (queue_max is the
             # window's high-water mark): the live pressure signal the
@@ -247,11 +323,26 @@ class ServeLoop:
             self.sink(rec)
         return rec
 
+    def _emit_req_exemplars(self, st: _ClassStats) -> None:
+        """Flush the window's bounded request exemplars: the p99-worst
+        completion plus the capped shed/error terminals, captured as
+        ready-to-sink ``kind:"req"`` dicts. Called at every window
+        boundary just after the window record, so a trace reader sees
+        the exemplars inside the window they describe."""
+        if self.sink is None:
+            return
+        if st.win_worst is not None:
+            self.sink(st.win_worst)
+        for rec in st.win_shed_ex:
+            self.sink(rec)
+        for rec in st.win_err_ex:
+            self.sink(rec)
+
     # -- graceful degradation ----------------------------------------------
 
     def _enter_quarantine(self, cls: WorkloadClass, st: _ClassStats,
-                          t_wall: float, queue: list, waiting: dict
-                          ) -> None:
+                          t_wall: float, t_mono: float, queue: list,
+                          waiting: dict) -> None:
         """A handler class that stayed dead past ``quarantine_after``
         consecutive failed batches stops being served: its backlog is
         shed, future arrivals shed on admission, and the rest of the
@@ -269,6 +360,22 @@ class ServeLoop:
             st.win_shed += len(dropped)
             st.quar_shed += len(dropped)
             waiting[cls.key] = 0
+            # lifecycle terminals for the dropped backlog: each request
+            # dies with the queue time it had accumulated (satellite of
+            # the coordinated-omission fix — quarantine drops used to
+            # vanish without a latency trace)
+            for r in dropped:
+                wait_s = max(t_mono - r.arrival, 0.0)
+                st.note_shed_wait(wait_s)
+                if len(st.win_shed_ex) < REQ_EXEMPLAR_CAP:
+                    st.win_shed_ex.append({
+                        "kind": "req", "event": "shed",
+                        "class": cls.key,
+                        "sampled": "quarantine_drop",
+                        "t_arrival": t_wall - wait_s,
+                        "t_done": t_wall,
+                        "queue_ms": wait_s * 1e3,
+                    })
         if self.sink is not None:
             self.sink({
                 "kind": "serve", "event": "quarantine", "class": cls.key,
@@ -329,11 +436,24 @@ class ServeLoop:
         def wall_at(t_mono: float) -> float:
             return wall0 + (t_mono - t0)
 
+        # replay hook: a ReplayArrivals process carries the recorded
+        # class keys and hands them out in admission order, overriding
+        # the seeded mix drawer — two replays of one artifact admit the
+        # exact same (time, class) sequence
+        draw_recorded = getattr(self.arrival, "draw_class", None)
+
         def admit(t_arr: float, synthetic: bool = False) -> None:
             """One arrival: draw its class, then queue / shed it. A
             quarantined class sheds on arrival — the whole point is
             that its backlog cannot starve the healthy classes."""
-            cls = self.mix.draw()
+            cls = None
+            if draw_recorded is not None and not synthetic:
+                key = draw_recorded()
+                cls = self._by_key.get(key) if key is not None else None
+            if cls is None:
+                cls = self.mix.draw()
+            if self.recorder is not None:
+                self.recorder.add(t_arr - t0, cls.key)
             st = self.stats[cls.key]
             st.arrivals += 1
             st.win_arrivals += 1
@@ -347,6 +467,21 @@ class ServeLoop:
                 st.win_shed += 1
                 if cls.key in self._quarantined:
                     st.quar_shed += 1
+                # terminal lifecycle accounting: the request dies HERE
+                # with the queue time it accumulated between its
+                # scheduled arrival and the shed decision (a loop
+                # running behind schedule sheds late, and that lateness
+                # is real queue delay the victim experienced)
+                wait_s = max(now - t_arr, 0.0)
+                st.note_shed_wait(wait_s)
+                if len(st.win_shed_ex) < REQ_EXEMPLAR_CAP:
+                    st.win_shed_ex.append({
+                        "kind": "req", "event": "shed",
+                        "class": cls.key, "sampled": "shed",
+                        "t_arrival": wall_at(t_arr),
+                        "t_done": wall_at(now),
+                        "queue_ms": wait_s * 1e3,
+                    })
                 return
             queue.append(Request(cls, t_arr, synthetic))
             d = waiting.get(cls.key, 0) + 1
@@ -369,6 +504,7 @@ class ServeLoop:
                         self._emit("window", cls, st, window_wall,
                                    w_end, window=True,
                                    queue_depth=waiting.get(cls.key, 0))
+                        self._emit_req_exemplars(st)
                     st.reset_window()
                     # requests already waiting carry into the new
                     # window's depth — a backlog is not depth zero
@@ -392,6 +528,10 @@ class ServeLoop:
                 st = self.stats[cls.key]
                 if self.watchdog is not None:
                     self.watchdog.arm(f"serve:{cls.key}")
+                # the dispatch stamp: everything before it is queue
+                # delay (arrival -> coalesce -> here), everything after
+                # is service — e2e = qd + svc per request by identity
+                t_disp = clock()
                 failed = False
                 try:
                     with comm_span(
@@ -411,25 +551,62 @@ class ServeLoop:
                 done = clock()
                 st.batches += 1
                 st.win_batches += 1
+                svc = max(done - t_disp, 0.0)
                 if failed:
                     st.errors += len(batch)
                     st.win_errors += len(batch)
                     st.streak_errors += len(batch)
                     st.consec_errors += 1
+                    if len(st.win_err_ex) < REQ_EXEMPLAR_CAP:
+                        # one exemplar per failed batch, carrying the
+                        # oldest member's queue delay — enough to see
+                        # WHERE the failed request spent its life
+                        oldest = min(r.arrival for r in batch)
+                        st.win_err_ex.append({
+                            "kind": "req", "event": "error",
+                            "class": cls.key, "sampled": "error",
+                            "t_arrival": wall_at(oldest),
+                            "t_dispatch": wall_at(t_disp),
+                            "t_done": wall_at(done),
+                            "queue_ms": max(t_disp - oldest, 0.0) * 1e3,
+                            "service_ms": svc * 1e3,
+                            "requests": len(batch),
+                        })
                     if (self.quarantine_after
                             and st.consec_errors >= self.quarantine_after
                             and cls.key not in self._quarantined):
                         self._enter_quarantine(cls, st, wall_at(done),
-                                               queue, waiting)
+                                               done, queue, waiting)
                 else:
                     st.consec_errors = 0
                     st.streak_errors = 0
                     for req in batch:
-                        lat = done - req.arrival
+                        qd = max(t_disp - req.arrival, 0.0)
+                        lat = qd + svc
                         st.requests += 1
                         st.win_requests += 1
                         st.hist.record(lat)
                         st.win_hist.record(lat)
+                        st.qd_hist.record(qd)
+                        st.win_qd_hist.record(qd)
+                        st.svc_hist.record(svc)
+                        st.win_svc_hist.record(svc)
+                        worst = st.win_worst
+                        if (worst is None
+                                or lat * 1e3 > worst["e2e_ms"]):
+                            # the window's p99-worst completion — the
+                            # one request a trace reader always gets
+                            st.win_worst = {
+                                "kind": "req", "event": "complete",
+                                "class": cls.key,
+                                "sampled": "p99_worst",
+                                "t_arrival": wall_at(req.arrival),
+                                "t_dispatch": wall_at(t_disp),
+                                "t_done": wall_at(done),
+                                "queue_ms": qd * 1e3,
+                                "service_ms": svc * 1e3,
+                                "e2e_ms": lat * 1e3,
+                            }
                 # synthetic (chaos-flood) completions never re-arm the
                 # arrival process: a closed loop's population must
                 # return to exactly --concurrency once the burst drains
@@ -463,6 +640,7 @@ class ServeLoop:
                 self._emit("window", cls, st, window_wall, end_wall,
                            window=True,
                            queue_depth=waiting.get(cls.key, 0))
+                self._emit_req_exemplars(st)
             st.reset_window()
         return [
             self._emit("summary", self._by_key[key], st, wall0,
