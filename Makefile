@@ -2,7 +2,8 @@
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
 	serve-smoke replay-smoke overlap-smoke moe-smoke chaos-smoke \
-	live-smoke fleet-smoke lint lint-smoke records records-check ci clean
+	anatomy-smoke live-smoke fleet-smoke lint lint-smoke records \
+	records-check ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -515,6 +516,87 @@ chaos-smoke:
 		print('chaos-smoke trace FINDING marker OK')"
 	@echo "chaos-smoke OK: 6 fault classes convicted (class+rank), clean run silent"
 
+# communication-anatomy smoke (README "Communication anatomy"): over
+# two REAL native-launcher processes, (a) an injected per-op chaos
+# straggler must be convicted by the wait/wire decomposition — the
+# ANATOMY table charges >50% of the victim op's span time to wait with
+# the culprit rank alone atop the wait-share ranking, and tpumt-doctor
+# cites the per-call anatomy evidence (matched-seq wait attribution +
+# the culprit's worst late entry, file:line); (b) the Perfetto export
+# carries the wait/wire sub-spans and the rank-pair traffic counter
+# track; (c) the same command WITHOUT chaos stays near the honesty
+# floor (organic skew below clock-sync uncertainty is reported
+# unresolved, not fabricated); (d) --diff: a self-diff over anatomy:*
+# series is clean, and clean-vs-straggler exits 1 naming
+# anatomy:halo_exchange:wait_frac as the regressed series.
+anatomy-smoke:
+	rm -f /tmp/_tpumt_anat*
+	$(MAKE) -C native tpumt_run
+	env JAX_PLATFORMS=cpu \
+		TPU_MPI_CHAOS="straggler:rank=1:op=halo_exchange:delay_ms=80" \
+		./native/tpumt_run -n 2 -o /tmp/_tpumt_anat.strag.rank -- \
+		python -m tpu_mpi_tests.drivers.stencil1d --fake-devices 1 \
+		--n-global 65536 --dtype float64 --overlap 1 \
+		--overlap-iters 8 --telemetry \
+		--jsonl /tmp/_tpumt_anat.strag.jsonl
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_anat.strag.jsonl > /tmp/_tpumt_anat.report.txt
+	grep -q '^ANATOMY halo_exchange: ' /tmp/_tpumt_anat.report.txt
+	grep -q '^COMMGRAPH 0->1: bytes=' /tmp/_tpumt_anat.report.txt
+	grep -q '^COMMGRAPH 1->0: bytes=' /tmp/_tpumt_anat.report.txt
+	python -m tpu_mpi_tests.instrument.aggregate --json \
+		/tmp/_tpumt_anat.strag.jsonl > /tmp/_tpumt_anat.strag.sum.json
+	python -c "import json; \
+		a = json.load(open('/tmp/_tpumt_anat.strag.sum.json'))['anatomy']; \
+		op = a['ops']['halo_exchange']; \
+		assert op['wait_frac'] > 0.5, op; \
+		assert op['wait_share'][0][0] == 1, op['wait_share']; \
+		assert op['unmatched'] == 0, op; \
+		print('anatomy-smoke: straggler wait_frac', \
+			round(op['wait_frac'], 3), '-> culprit r1,', \
+			op['calls'], 'matched calls')"
+	python -m tpu_mpi_tests.instrument.diagnose \
+		/tmp/_tpumt_anat.strag.jsonl --expect straggler:1 \
+		> /tmp/_tpumt_anat.doc.txt
+	grep -q 'anatomy: rank 1 held' /tmp/_tpumt_anat.doc.txt
+	grep -q 'evidence: .*span halo_exchange seq=' /tmp/_tpumt_anat.doc.txt
+	python -m tpu_mpi_tests.instrument.timeline \
+		/tmp/_tpumt_anat.strag.jsonl -o /tmp/_tpumt_anat.trace.json
+	python -c "import json; \
+		d = json.load(open('/tmp/_tpumt_anat.trace.json')); \
+		w = [e for e in d['traceEvents'] \
+			if e.get('cat') == 'comm_wait']; \
+		t = [e for e in d['traceEvents'] \
+			if e.get('cat') == 'traffic']; \
+		assert w and t, (len(w), len(t)); \
+		print('anatomy-smoke trace:', len(w), 'wait sub-spans,', \
+			len(t), 'traffic counter samples')"
+	env JAX_PLATFORMS=cpu \
+		./native/tpumt_run -n 2 -o /tmp/_tpumt_anat.clean.rank -- \
+		python -m tpu_mpi_tests.drivers.stencil1d --fake-devices 1 \
+		--n-global 65536 --dtype float64 --overlap 1 \
+		--overlap-iters 8 --telemetry \
+		--jsonl /tmp/_tpumt_anat.clean.jsonl
+	python -m tpu_mpi_tests.instrument.aggregate --json \
+		/tmp/_tpumt_anat.clean.jsonl > /tmp/_tpumt_anat.clean.sum.json
+	python -c "import json; \
+		a = json.load(open('/tmp/_tpumt_anat.clean.sum.json'))['anatomy']; \
+		op = a['ops']['halo_exchange']; \
+		assert op['wait_frac'] < 0.25, op; \
+		print('anatomy-smoke: clean wait_frac', \
+			round(op['wait_frac'], 3), \
+			'(', op['unresolved'], 'of', op['calls'], \
+			'below the clock-sync floor -> unresolved )')"
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_anat.clean.jsonl /tmp/_tpumt_anat.clean.jsonl \
+		> /tmp/_tpumt_anat.selfdiff.txt
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_anat.clean.jsonl /tmp/_tpumt_anat.strag.jsonl \
+		> /tmp/_tpumt_anat.diff.txt; test $$? -eq 1
+	grep -q 'anatomy:halo_exchange:wait_frac.* REGRESSION' \
+		/tmp/_tpumt_anat.diff.txt
+	@echo "anatomy-smoke OK: wait/wire convicts the injected straggler, clean run holds the honesty floor, diff names the series"
+
 # live-observability smoke (README "Live observability"): (a) a serve
 # run armed with --metrics-port must expose well-formed OpenMetrics at
 # /metrics MID-RUN (curl'd while the loop serves) with nonzero serve
@@ -876,8 +958,8 @@ lint-smoke:
 # lint-cache incrementality + engine-salt smoke, and the RECORDS.md
 # staleness gate
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke replay-smoke \
-	overlap-smoke moe-smoke chaos-smoke live-smoke fleet-smoke lint \
-	lint-smoke records-check
+	overlap-smoke moe-smoke chaos-smoke anatomy-smoke live-smoke \
+	fleet-smoke lint lint-smoke records-check
 
 clean:
 	$(MAKE) -C native clean

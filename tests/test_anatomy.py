@@ -1,0 +1,370 @@
+"""Communication anatomy (instrument/anatomy.py): wait/wire
+decomposition over seq-matched collective spans, the clock-uncertainty
+honesty floor, the rank-pair traffic matrix, and each consumer surface
+(ANATOMY/COMMGRAPH report tables, --diff series, trace sub-spans and
+traffic counters, doctor evidence upgrade, tpumt-top WAIT column) —
+plus the pre-seq degrade every surface keys its legacy shape on.
+
+Fixtures are synthesized with KNOWN clock offsets so decompositions
+check as exact arithmetic, not tolerances: rank 1's raw clock runs
+0.5 s ahead and it enters every collective 0.2 s late on the corrected
+axis, so each matched call splits into wait=0.2 wire=0.1 per the early
+rank exactly.
+"""
+
+import json
+
+import pytest
+
+from tpu_mpi_tests.instrument import aggregate, anatomy, diagnose, timeline
+from tpu_mpi_tests.instrument.live import Dashboard, render
+
+
+def _write_jsonl(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _manifest(rank, n=2):
+    return {"kind": "manifest", "process_index": rank,
+            "process_count": n, "platform": "cpu",
+            "global_device_count": n, "device_kinds": ["cpu"],
+            "jax": "0.0-test", "argv": ["anatomy-test"]}
+
+
+def _sync(rank, offset, spread=0.0005):
+    return {"kind": "clock_sync", "rank": rank, "offset_s": offset,
+            "spread_s": spread, "method": "barrier_echo",
+            "run_sync_us": 1}
+
+
+def _span(op, seq, t0, t1, *, axis="x", world=2, nbytes=1 << 20,
+          **extra):
+    return {"kind": "span", "op": op, "axis": axis, "seq": seq,
+            "world": world, "nbytes": nbytes, "seconds": t1 - t0,
+            "t_start": t0, "t_end": t1, **extra}
+
+
+def _skewed_run(tmp_path, calls=4, spread=0.0005, drop_last_on_r1=False):
+    """Two ranks, rank 1 offset +0.5 raw, entering 0.2 s late every
+    call; each call: r0 [100+k, 100.3+k], r1 [100.2+k, 100.3+k] on the
+    corrected axis. unc = 2*spread."""
+    r0 = [_manifest(0), _sync(0, 0.0, spread)]
+    r1 = [_manifest(1), _sync(1, 0.5, spread)]
+    for k in range(calls):
+        r0.append(_span("allreduce", k, 100.0 + k, 100.3 + k))
+        if not (drop_last_on_r1 and k == calls - 1):
+            r1.append(_span("allreduce", k, 100.7 + k, 100.8 + k))
+    _write_jsonl(tmp_path / "run.p0.jsonl", r0)
+    _write_jsonl(tmp_path / "run.p1.jsonl", r1)
+    return [str(tmp_path / "run.p0.jsonl"),
+            str(tmp_path / "run.p1.jsonl")]
+
+
+class TestDecomposition:
+    def test_known_offsets_exact_split(self, tmp_path):
+        files = _skewed_run(tmp_path)
+        anat = anatomy.anatomize(timeline.rank_streams(files))
+        row = anat["ops"]["allreduce"]
+        # per call: r0 wait 0.2 wire 0.1, r1 wait 0 wire 0.1
+        assert row["calls"] == 4 and row["unmatched"] == 0
+        assert row["wait_s"] == pytest.approx(0.8)
+        assert row["wire_s"] == pytest.approx(0.8)
+        assert row["span_s"] == pytest.approx(1.6)
+        assert row["wait_frac"] == pytest.approx(0.5)
+        assert row["unresolved"] == 0
+        # the latest entrant holds ALL the wait: rank 1
+        assert row["wait_share"] == [(1, pytest.approx(1.0))]
+        # bytes priced per matched call across both ranks
+        assert row["bytes"] == 4 * 2 * (1 << 20)
+        assert row["pure_gbps"] == pytest.approx(
+            row["bytes"] / 0.8 / 1e9)
+        assert row["eff_gbps"] == pytest.approx(
+            row["bytes"] / 1.6 / 1e9)
+        assert anat["clock_unc_s"] == pytest.approx(0.001)
+
+    def test_unresolved_floor_never_fabricates(self, tmp_path):
+        # spread 0.15 each -> unc 0.3 > the true 0.2 skew: every
+        # per-rank wait reads unresolved, the split is refused
+        files = _skewed_run(tmp_path, spread=0.15)
+        row = anatomy.anatomize(
+            timeline.rank_streams(files))["ops"]["allreduce"]
+        assert row["unresolved"] == 4
+        assert row["wait_s"] == 0.0
+        assert row["wait_frac"] == 0.0
+        assert row["wait_share"] == []
+        # all span time reads as wire; the wire total clears the floor
+        # so pure GB/s still reports (now equal to effective)
+        assert row["wire_s"] == pytest.approx(row["span_s"])
+        assert row["pure_gbps"] == pytest.approx(row["eff_gbps"])
+
+    def test_missing_rank_call_counts_unmatched(self, tmp_path):
+        files = _skewed_run(tmp_path, drop_last_on_r1=True)
+        row = anatomy.anatomize(
+            timeline.rank_streams(files))["ops"]["allreduce"]
+        assert row["calls"] == 3
+        assert row["unmatched"] == 1  # r0's orphan seq 3
+        assert row["wait_s"] == pytest.approx(0.6)
+
+    def test_pre_seq_streams_anatomize_none(self, tmp_path):
+        recs0 = [_manifest(0), _sync(0, 0.0)]
+        recs1 = [_manifest(1), _sync(1, 0.5)]
+        for k in range(4):
+            for recs, t0 in ((recs0, 100.0 + k), (recs1, 100.7 + k)):
+                s = _span("allreduce", 0, t0, t0 + 0.1)
+                del s["seq"]
+                recs.append(s)
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs0)
+        _write_jsonl(tmp_path / "run.p1.jsonl", recs1)
+        files = [str(tmp_path / "run.p0.jsonl"),
+                 str(tmp_path / "run.p1.jsonl")]
+        assert anatomy.anatomize(timeline.rank_streams(files)) is None
+
+    def test_single_rank_spans_do_not_match(self, tmp_path):
+        _write_jsonl(tmp_path / "run.p0.jsonl", [
+            _manifest(0, n=1), _sync(0, 0.0),
+            _span("allreduce", 0, 100.0, 100.1),
+        ])
+        streams = timeline.rank_streams([str(tmp_path / "run.p0.jsonl")])
+        anat = anatomy.anatomize(streams)
+        assert anat is None or anat["ops"] == {}
+
+    def test_wait_wire_subspans_split_points(self, tmp_path):
+        files = _skewed_run(tmp_path, calls=2)
+        splits = anatomy.wait_wire_subspans(timeline.rank_streams(files))
+        assert splits == {
+            ("allreduce", "x", 0): pytest.approx(100.2),
+            ("allreduce", "x", 1): pytest.approx(101.2),
+        }
+
+    def test_critical_path_walks_backward_across_ranks(self, tmp_path):
+        files = _skewed_run(tmp_path, calls=2)
+        path = anatomy.critical_path(timeline.rank_streams(files))
+        assert path, "skewed run must yield a chain"
+        # oldest first; the chain ends at the globally last segment
+        assert path[-1]["t_start"] == max(s["t_start"] for s in path)
+        assert all(s["seconds"] > 0 for s in path)
+
+
+class TestTrafficMatrix:
+    def test_halo_partner_edges_symmetric_non_periodic(self, tmp_path):
+        per_edge = 4096
+        for rank in (0, 1):
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", [
+                _manifest(rank), _sync(rank, 0.0),
+                _span("halo_exchange", 0, 100.0, 100.1,
+                      partners=[-1, 1], periodic=False,
+                      partner_nbytes=per_edge),
+            ])
+        files = [str(tmp_path / f"run.p{r}.jsonl") for r in (0, 1)]
+        m = anatomy.traffic_matrix(timeline.rank_streams(files))
+        # out-of-range neighbors dropped at the edges; the kept pair
+        # of directed edges is symmetric
+        assert m == {(0, 1): {"halo_exchange": per_edge},
+                     (1, 0): {"halo_exchange": per_edge}}
+
+    def test_periodic_ring_wraps_modulo_world(self):
+        rec = _span("ring_attention", 0, 0.0, 1.0, world=4,
+                    partners=[1], periodic=True, partner_nbytes=300)
+        assert anatomy.partner_edges(rec, 3) == [(0, 300)]
+
+    def test_spans_without_partners_contribute_nothing(self):
+        assert anatomy.partner_edges(
+            _span("allreduce", 0, 0.0, 1.0), 0) == []
+
+
+class TestReportSurface:
+    def test_text_tables_and_json_key(self, tmp_path, capsys):
+        files = _skewed_run(tmp_path)
+        assert aggregate.main(files) == 0
+        out = capsys.readouterr().out
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("ANATOMY allreduce:"))
+        assert "wait_frac=0.500" in line
+        assert "wait_share r1=100%" in line
+        assert "unresolved=0" in line
+        assert "ANATOMY critpath:" in out
+        assert aggregate.main(files + ["--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["anatomy"]["ops"]["allreduce"]["calls"] == 4
+
+    def test_commgraph_rows(self, tmp_path, capsys):
+        for rank in (0, 1):
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", [
+                _manifest(rank), _sync(rank, 0.0),
+                _span("halo_exchange", 0, 100.0, 100.1,
+                      partners=[-1, 1], periodic=False,
+                      partner_nbytes=512),
+            ])
+        assert aggregate.main(
+            [str(tmp_path / f"run.p{r}.jsonl") for r in (0, 1)]) == 0
+        out = capsys.readouterr().out
+        assert "COMMGRAPH 0->1: bytes=512 halo_exchange=512" in out
+        assert "COMMGRAPH 1->0: bytes=512 halo_exchange=512" in out
+
+    def test_pre_seq_report_has_no_anatomy_surface(self, tmp_path,
+                                                   capsys):
+        """The legacy-shape gate: pre-seq files must produce a summary
+        WITHOUT the anatomy key and a report without the new tables."""
+        for rank in (0, 1):
+            recs = [_manifest(rank), _sync(rank, 0.0)]
+            for k in range(4):
+                s = _span("allreduce", 0, 100.0 + k, 100.1 + k)
+                del s["seq"]
+                recs.append(s)
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", recs)
+        files = [str(tmp_path / f"run.p{r}.jsonl") for r in (0, 1)]
+        assert aggregate.main(files + ["--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert "anatomy" not in s
+        assert aggregate.main(files) == 0
+        out = capsys.readouterr().out
+        assert "ANATOMY" not in out and "COMMGRAPH" not in out
+
+    def test_diff_series_flags_wait_regression(self, tmp_path, capsys):
+        files = _skewed_run(tmp_path)
+        s = aggregate.summarize(files)
+        m = aggregate._metrics_from_summary(s)
+        assert m["anatomy:allreduce:wait_frac"]["value"] == \
+            pytest.approx(0.5)
+        assert m["anatomy:allreduce:wait_frac"]["higher_better"] is False
+        assert m["anatomy:allreduce:pure_gbps"]["higher_better"] is True
+        # self-diff is clean (exit 0)...
+        base = str(tmp_path / "run.jsonl")
+        assert aggregate.main(["--diff", base, base]) == 0
+        capsys.readouterr()
+        # ...and a degraded copy (every call 3x more skewed) exits 1
+        # with the anatomy series named
+        worse = tmp_path / "worse"
+        worse.mkdir()
+        r0 = [_manifest(0), _sync(0, 0.0)]
+        r1 = [_manifest(1), _sync(1, 0.5)]
+        for k in range(4):
+            r0.append(_span("allreduce", k, 100.0 + k, 100.9 + k))
+            r1.append(_span("allreduce", k, 101.3 + k, 101.4 + k))
+        _write_jsonl(worse / "run.p0.jsonl", r0)
+        _write_jsonl(worse / "run.p1.jsonl", r1)
+        assert aggregate.main(
+            ["--diff", base, str(worse / "run.jsonl")]) == 1
+        out = capsys.readouterr().out
+        assert "anatomy:allreduce:wait_frac" in out
+
+
+class TestTraceSurface:
+    def test_wait_wire_subspans_rendered(self, tmp_path):
+        files = _skewed_run(tmp_path)
+        doc = timeline.chrome_trace(files)
+        waits = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "X" and e["name"] == "wait allreduce"]
+        wires = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "X" and e["name"] == "wire allreduce"]
+        # the early rank's 4 calls split; the late rank (last arriver)
+        # renders all-wire
+        assert len(waits) == 4
+        assert len(wires) == 8
+        assert all(e["cat"] == "comm_wait" for e in waits)
+        # each wait sub-span covers the known 0.2 s skew
+        for e in waits:
+            assert e["dur"] == pytest.approx(0.2e6, rel=1e-3)
+
+    def test_traffic_counter_track(self, tmp_path):
+        for rank in (0, 1):
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", [
+                _manifest(rank), _sync(rank, 0.0),
+                _span("halo_exchange", 0, 100.0, 100.1,
+                      partners=[-1, 1], periodic=False,
+                      partner_nbytes=256),
+                _span("halo_exchange", 1, 101.0, 101.1,
+                      partners=[-1, 1], periodic=False,
+                      partner_nbytes=256),
+            ])
+        doc = timeline.chrome_trace(
+            [str(tmp_path / f"run.p{r}.jsonl") for r in (0, 1)])
+        cnt = [e for e in doc["traceEvents"]
+               if e.get("ph") == "C" and e["name"] == "comm bytes sent"]
+        assert cnt and all(e["cat"] == "traffic" for e in cnt)
+        # cumulative: the second call doubles the edge byte count
+        last = max((e for e in cnt if e["pid"] == cnt[0]["pid"]),
+                   key=lambda e: e["ts"])
+        assert 512 in last["args"].values()
+
+    def test_pre_seq_trace_has_no_new_tracks(self, tmp_path):
+        recs = [_manifest(0), _sync(0, 0.0)]
+        s = _span("allreduce", 0, 100.0, 100.1)
+        del s["seq"]
+        recs.append(s)
+        _write_jsonl(tmp_path / "run.p0.jsonl", recs)
+        doc = timeline.chrome_trace([str(tmp_path / "run.p0.jsonl")])
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert "wait allreduce" not in names
+        assert "comm bytes sent" not in names
+
+
+class TestDoctorSurface:
+    def _streams(self, tmp_path, with_seq=True):
+        """Rank 1 enters every halo_exchange 0.49 s late: sync-honest
+        spans make rank 0 (the waiter) slow and rank 1 fast."""
+        r0 = [_manifest(0), _sync(0, 0.0, 0.001)]
+        r1 = [_manifest(1), _sync(1, 0.0, 0.001)]
+        for k in range(6):
+            a = _span("halo_exchange", k, 100.0 + k, 100.5 + k)
+            b = _span("halo_exchange", k, 100.49 + k, 100.5 + k)
+            if not with_seq:
+                del a["seq"], b["seq"]
+            r0.append(a)
+            r1.append(b)
+        for recs, rank in ((r0, 0), (r1, 1)):
+            recs += [{"kind": "mem", "event": "final", "t": 120.0,
+                      "live_bytes": 100},
+                     {"kind": "telemetry_summary", "op": "x",
+                      "rank": rank, "ops": 1, "bytes": 1,
+                      "seconds": 0.0}]
+        _write_jsonl(tmp_path / "run.p0.jsonl", r0)
+        _write_jsonl(tmp_path / "run.p1.jsonl", r1)
+        return [str(tmp_path / "run.p0.jsonl"),
+                str(tmp_path / "run.p1.jsonl")]
+
+    def test_seq_streams_upgrade_to_anatomy_evidence(self, tmp_path):
+        (f,) = diagnose.diagnose_files(self._streams(tmp_path))
+        assert f["class"] == "straggler" and f["rank"] == 1
+        assert f["confidence"] >= 0.75
+        assert "anatomy: rank 1 held 100% of the wait" in f["detail"]
+        assert any(ev.startswith("anatomy: 6 matched halo_exchange")
+                   for ev in f["evidence"])
+        # call-level ref: file:line of the culprit's worst entry
+        assert any("seq=" in ev and ".jsonl:" in ev
+                   for ev in f["evidence"])
+
+    def test_pre_seq_streams_keep_inversion_verdict(self, tmp_path):
+        files = self._streams(tmp_path, with_seq=False)
+        (f,) = diagnose.diagnose_files(files)
+        assert f["class"] == "straggler" and f["rank"] == 1
+        assert "invert" in f["detail"]
+        assert "anatomy" not in f["detail"]
+        assert f["evidence"] == []
+
+
+class TestLiveSurface:
+    def test_dashboard_wait_column(self, tmp_path):
+        files = _skewed_run(tmp_path)
+        dash = Dashboard()
+        for path in files:
+            for ln in open(path):
+                dash.feed(json.loads(ln), path)
+        frame = render(dash, files)
+        ops_hdr = next(ln for ln in frame.splitlines()
+                       if ln.startswith("OPS"))
+        assert "wait%" in ops_hdr
+        row = next(ln for ln in frame.splitlines()
+                   if "allreduce" in ln)
+        # cumulative wait_frac of the 4 matched calls: exactly 50%
+        assert "50.0" in row
+
+    def test_pre_seq_feed_renders_dash(self, tmp_path):
+        dash = Dashboard()
+        s = _span("allreduce", 0, 100.0, 100.1)
+        del s["seq"]
+        for rec in [_manifest(0), _sync(0, 0.0), s]:
+            dash.feed(rec, "p0")
+        row = next(ln for ln in render(dash, ["p0"]).splitlines()
+                   if "allreduce" in ln)
+        assert row.rstrip().endswith("-")

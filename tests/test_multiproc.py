@@ -237,7 +237,7 @@ def test_multiproc_4proc_stencil1d_and_ring(tpumt_run, tmp_path):
         @functools.partial(shard_map, mesh=mesh,
                            in_specs=spec, out_specs=spec)
         def probe(x):
-            n = lax.axis_size("dcn")
+            n = mesh.shape["dcn"]  # lax.axis_size needs jax >= 0.4.38
             # ring shift +1: rank r receives rank r-1's value — a
             # wrong-neighbor or wrong-direction permutation is exact-fail
             fwd = [(i, (i + 1) % n) for i in range(n)]

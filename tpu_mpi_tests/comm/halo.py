@@ -414,12 +414,24 @@ def halo_exchange(
     # slabs of the non-decomposed extent. Computed before the call — the
     # input is donated and its metadata may be gone afterwards.
     nbytes = halo_payload_bytes(zg, axis, world, n_bnd, periodic)
+    # rank-pair traffic metadata (instrument/anatomy.py COMMGRAPH): each
+    # rank sends one ghost band to each ring neighbor; ``partner_nbytes``
+    # is the per-edge payload (total / 2·pairs directed edges), so the
+    # reconstructed (src,dst) matrix sums back to ``nbytes`` and halo
+    # symmetry — bytes(r→r+1) == bytes(r+1→r) — holds by construction.
+    pairs = world if periodic else world - 1
+    partner_meta = (
+        {"partners": [-1, 1], "periodic": periodic,
+         "partner_nbytes": nbytes // (2 * pairs)}
+        if pairs > 0 else {}
+    )
     if staging is Staging.HOST_STAGED:
         return span_call(
             "halo_exchange_host",
             _host_staged_exchange,
             zg, mesh, axis_name, axis, n_bnd, periodic,
             nbytes=nbytes, axis_name=axis_name, world=world,
+            **partner_meta,
         )
     if staging is Staging.PALLAS_RDMA:
         # a wedged DMA semaphore / neighborhood barrier in the hand-written
@@ -439,6 +451,7 @@ def halo_exchange(
             ),
             zg,
             nbytes=nbytes, axis_name=axis_name, world=world,
+            **partner_meta,
         )
     fn = _exchange_fn(
         mesh,
@@ -453,14 +466,14 @@ def halo_exchange(
         return window.call(
             "halo_exchange", fn, zg,
             nbytes=nbytes, axis_name=axis_name, world=world,
-            staging=staging.value,
+            staging=staging.value, **partner_meta,
         )
     return span_call(
         "halo_exchange",
         fn,
         zg,
         nbytes=nbytes, axis_name=axis_name, world=world,
-        staging=staging.value,
+        staging=staging.value, **partner_meta,
     )
 
 

@@ -76,6 +76,15 @@ def bootstrap(
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
+    # CPU multi-process worlds need a cross-process collectives backend
+    # or every compiled collective fails with "Multiprocess computations
+    # aren't implemented on the CPU backend"; gloo ships in jaxlib and
+    # the knob is inert for TPU backends. Must be set before the first
+    # backend touch, which is why it lives here and not in the drivers.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jaxlib without the knob: previous behavior
     jax.distributed.initialize(
         coordinator_address=coordinator_address
         or os.environ.get("JAX_COORDINATOR_ADDRESS")

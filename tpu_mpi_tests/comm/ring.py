@@ -463,11 +463,17 @@ def ring_attention_fn(
         kv_bytes = int(getattr(k, "nbytes", 0)) + int(
             getattr(v, "nbytes", 0)
         )
+        # rank-pair traffic metadata: the K/V rotation is a ppermute by
+        # +1 on a periodic ring — each rank sends its (w−1 rotations of)
+        # kv block to exactly one neighbor, so the whole per-rank payload
+        # rides the single (r → r+1 mod w) edge
         return span_call(
             "ring_attention", attn, q, k, v,
             nbytes=(world - 1) * kv_bytes,
             axis_name=axis_name, world=world,
             flash=flash, causal=causal, stripe=stripe,
+            partners=[1], periodic=True,
+            partner_nbytes=(world - 1) * kv_bytes,
         )
 
     return attn_recorded

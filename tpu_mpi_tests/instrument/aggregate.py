@@ -553,6 +553,19 @@ def summarize(
             c, **_roofline_join(c, label, summary["ops"],
                                 summary["phases"])
         )
+    # communication anatomy (instrument/anatomy.py): wait/wire
+    # decomposition + rank-pair traffic matrix over the same files,
+    # aligned per run by the timeline merger. The key exists ONLY when
+    # the streams carry seq-stamped collective spans on 2+ ranks or
+    # partner metadata — pre-seq files keep the exact summary shape
+    # (and --json document) they always had. Lazy imports: timeline
+    # imports this module at its top level.
+    from tpu_mpi_tests.instrument.anatomy import anatomize
+    from tpu_mpi_tests.instrument.timeline import rank_streams
+
+    anatomy = anatomize(rank_streams(files, loaded=loaded))
+    if anatomy is not None:
+        summary["anatomy"] = anatomy
     return summary
 
 
@@ -780,6 +793,8 @@ def _print_text(summary: dict, skew_threshold: float,
             f"skew={op['skew']:.3g}{gb}"
         )
 
+    _print_anatomy(summary.get("anatomy"))
+
     for cls, sv in summary.get("serve", {}).items():
         def ms(key, sv=sv):
             v = sv.get(key)
@@ -924,6 +939,62 @@ def _print_text(summary: dict, skew_threshold: float,
         )
 
 
+def _print_anatomy(anat: dict | None) -> None:
+    """ANATOMY + COMMGRAPH tables (instrument/anatomy.py): silent when
+    the run carries no seq-stamped collective spans on 2+ ranks and no
+    partner metadata — pre-anatomy files keep their exact report shape.
+
+    Reading guide (README "Communication anatomy"): ``wait_frac`` is
+    the fraction of all ranks' in-collective seconds spent waiting for
+    the LAST arriver; the wait-share ranking names who that was
+    (sync-honest spans charge the wait to the early ranks — this table
+    un-inverts it). ``pure`` is bytes over wire time (what the fabric
+    sustained once everyone arrived), ``eff`` bytes over the whole span
+    (what the program felt); decompositions finer than the clock-sync
+    uncertainty (``unc``) are counted ``unresolved``, not split."""
+    if not anat:
+        return
+    for op in sorted(anat.get("ops", {})):
+        row = anat["ops"][op]
+        pure = ("-" if row.get("pure_gbps") is None
+                else format(row["pure_gbps"], ".4g"))
+        eff = ("-" if row.get("eff_gbps") is None
+               else format(row["eff_gbps"], ".4g"))
+        share = " ".join(
+            f"r{r}={frac * 100:.0f}%" for r, frac in row["wait_share"][:4]
+        )
+        print(
+            f"ANATOMY {op}: calls={row['calls']} "
+            f"ranks={len(row['ranks'])} "
+            f"wait_frac={row['wait_frac']:.3f} "
+            f"wait={row['wait_s']:.6g}s wire={row['wire_s']:.6g}s "
+            f"pure={pure}GB/s eff={eff}GB/s "
+            f"unresolved={row['unresolved']} "
+            f"unmatched={row['unmatched']} "
+            f"unc=±{anat['clock_unc_s'] * 1e3:.3g}ms"
+            + (f" wait_share {share}" if share else "")
+        )
+    path = anat.get("critical_path") or []
+    if path and anat.get("ops"):
+        total = sum(seg["seconds"] for seg in path)
+        shown = " -> ".join(
+            f"r{seg['rank']} {seg['kind']} {seg['name']} "
+            f"{seg['seconds']:.4g}s"
+            for seg in path[:6]
+        )
+        more = f" ... ({len(path) - 6} more)" if len(path) > 6 else ""
+        print(
+            f"ANATOMY critpath: {len(path)} segments "
+            f"{total:.6g}s: {shown}{more}"
+        )
+    for edge in sorted(anat.get("matrix", {})):
+        by_op = anat["matrix"][edge]
+        ops = " ".join(
+            f"{op}={by_op[op]}" for op in sorted(by_op) if op != "total"
+        )
+        print(f"COMMGRAPH {edge}: bytes={by_op['total']} {ops}".rstrip())
+
+
 def _print_memory(memory: dict) -> None:
     """MEMORY table: per-phase watermarks, run peak, top live buffers.
     Silent when the run recorded no ``mem`` records (no --memwatch) —
@@ -1062,6 +1133,24 @@ def _metrics_from_summary(s: dict) -> dict[str, dict]:
             out[f"op:{name}:gbps"] = {
                 "value": p50,
                 "band": (st["gbps_p90"] - st["gbps_p10"]) / (2 * p50),
+                "higher_better": True,
+            }
+    # communication-anatomy series (ISSUE 17): wait_frac gates lower-
+    # is-better (a change that makes ranks arrive more skewed is a
+    # regression even when the op's mean seconds hide it) and pure GB/s
+    # higher-is-better (the fabric's own rate, wait removed). Bands are
+    # each op's per-call spread. Absent entirely on pre-seq runs.
+    for op, row in ((s.get("anatomy") or {}).get("ops") or {}).items():
+        if isinstance(row.get("wait_frac"), (int, float)):
+            out[f"anatomy:{op}:wait_frac"] = {
+                "value": float(row["wait_frac"]),
+                "band": row.get("wait_frac_band", 0.0),
+                "higher_better": False,
+            }
+        if isinstance(row.get("pure_gbps"), (int, float)):
+            out[f"anatomy:{op}:pure_gbps"] = {
+                "value": float(row["pure_gbps"]),
+                "band": row.get("pure_gbps_band", 0.0),
                 "higher_better": True,
             }
     peak = (s.get("memory") or {}).get("peak") or {}

@@ -1,0 +1,328 @@
+"""Cross-rank communication anatomy: wait/wire decomposition, per-op
+skew attribution, and the rank-pair traffic matrix.
+
+The telemetry layer's collective spans are *sync-honest* — each rank's
+span covers its own entry to its own exit — which means a straggler's
+lateness is charged to the EARLY ranks (they sit in the collective
+waiting), exactly inverted from where the cause lives. The reference
+suite prints per-rank bandwidths for the same reason: one fused number
+cannot distinguish "the network is slow" from "a rank is late". This
+module splits the two, using facts the spine already records:
+
+* every comm span carries a per-(op, axis) monotone ``seq``
+  (``instrument/telemetry.py``), so the k-th allreduce on rank 0 is the
+  k-th on every sibling — the cross-rank alignment key;
+* every rank's clock offset to rank 0 (``clock_sync`` barrier-echo,
+  PR-2) puts all entries on one axis, and its sample ``spread_s`` bounds
+  how finely two ranks' entries can honestly be compared.
+
+For each matched call the decomposition is::
+
+    wait = latest_entry − own_entry     (sitting in the collective)
+    wire = own_end − latest_entry       (everyone arrived; data moving)
+
+and the *cause* of a call's total wait is the latest entrant. Rollups:
+per-op ``wait_frac`` (wait / span), the per-rank wait-share ranking
+("rank 2 caused 71% of allreduce wait"), *pure* GB/s (bytes over wire —
+what the fabric did) vs *effective* GB/s (bytes over span — what the
+program felt), the per-step critical path (the chain of slowest
+phase/op segments across ranks), and the rank-pair traffic matrix
+(bytes per directed (src, dst) edge from the halo/ppermute ``partners``
+span metadata).
+
+Honesty floor: a wait smaller than the measured clock-sync uncertainty
+(the worst two ranks' ``spread_s`` summed) is reported ``unresolved`` —
+counted, never decomposed — because the clocks cannot support the
+claim. Streams without ``seq`` (pre-anatomy JSONL) yield ``None`` from
+:func:`anatomize`, so every consumer keeps its legacy output
+byte-identical.
+
+Pure stdlib (no jax import): usable on a login node against files
+copied off the pod, like the sibling consumers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpu_mpi_tests.instrument.aggregate import _noise_band
+
+#: most critical-path segments kept in a rollup — the chain is a
+#: reading aid, not a database
+CRITPATH_MAX_SEGMENTS = 32
+
+
+def _eligible(rec: dict) -> bool:
+    """Spans the cross-rank match may align: sync-honest collective
+    spans (``seq`` stamped, 2+ participants, not an async dispatch
+    window — a drain-bounded window is not an arrival time)."""
+    return (
+        rec.get("kind") == "span"
+        and rec.get("seq") is not None
+        and int(rec.get("world") or 1) >= 2
+        and not rec.get("async")
+        and rec.get("t_start") is not None
+        and rec.get("t_end") is not None
+    )
+
+
+def clock_uncertainty(spreads: dict[int, float]) -> float:
+    """The floor under any cross-rank time comparison: the two worst
+    ranks' barrier-echo sample spreads summed (an entry-vs-entry delta
+    subtracts two offsets, each good to its own ``spread_s``)."""
+    worst = sorted((float(s) for s in spreads.values()), reverse=True)
+    return sum(worst[:2])
+
+
+def _clock_spreads(streams) -> dict[int, float]:
+    """Per-rank ``clock_sync`` sample spread (0.0 when a stream carries
+    none — old files compare uncorrected AND unbounded, which the
+    caller's floor then treats as perfectly synced; matching the
+    timeline merger's 0-offset degrade)."""
+    spreads: dict[int, float] = {}
+    for rank, _offset, records in streams:
+        spreads.setdefault(rank, 0.0)
+        for rec in records:
+            if rec.get("kind") == "clock_sync":
+                spreads[rank] = float(rec.get("spread_s") or 0.0)
+    return spreads
+
+
+def matched_calls(
+    streams,
+) -> dict[tuple[str, Any], dict[int, list[tuple[int, float, float]]]]:
+    """``{(op, axis): {rank: [(seq, t_entry, t_end)]}}`` over the
+    eligible spans, entry/end already shifted onto rank 0's clock.
+    The caller decides which seqs count as matched (present on every
+    participating rank)."""
+    table: dict[tuple[str, Any], dict[int, list]] = {}
+    for rank, offset, records in streams:
+        for rec in records:
+            if not _eligible(rec):
+                continue
+            key = (rec.get("op", "?"), rec.get("axis"))
+            table.setdefault(key, {}).setdefault(rank, []).append((
+                int(rec["seq"]),
+                float(rec["t_start"]) - offset,
+                float(rec["t_end"]) - offset,
+            ))
+    return table
+
+
+def partner_edges(rec: dict, rank: int) -> list[tuple[int, int]]:
+    """``[(dst, bytes)]`` sent by ``rank`` for one span record carrying
+    ``partners`` ring-offset metadata: ``partner_nbytes`` flows to each
+    ``(rank+d) % world`` on a periodic ring, out-of-range neighbors
+    dropped at the edges otherwise. Empty for spans without the
+    metadata — the shared edge enumeration for the traffic matrix and
+    the trace counter tracks."""
+    if rec.get("kind") != "span" or not rec.get("partners"):
+        return []
+    world = int(rec.get("world") or 1)
+    per_edge = int(rec.get("partner_nbytes") or 0)
+    if world < 2 or not per_edge:
+        return []
+    edges = []
+    for d in rec["partners"]:
+        dst = rank + int(d)
+        if rec.get("periodic"):
+            dst %= world
+        elif not (0 <= dst < world):
+            continue
+        edges.append((dst, per_edge))
+    return edges
+
+
+def traffic_matrix(streams) -> dict[tuple[int, int], dict[str, int]]:
+    """``{(src, dst): {op: bytes}}`` from the ``partners`` span
+    metadata (halo/ppermute wrappers — see :func:`partner_edges`).
+    Needs no seq matching (bytes are bytes); spans without partner
+    metadata simply contribute no edges."""
+    matrix: dict[tuple[int, int], dict[str, int]] = {}
+    for rank, _offset, records in streams:
+        for rec in records:
+            op = rec.get("op", "?")
+            for dst, nbytes in partner_edges(rec, rank):
+                edge = matrix.setdefault((rank, dst), {})
+                edge[op] = edge.get(op, 0) + nbytes
+    return matrix
+
+
+def critical_path(streams) -> list[dict]:
+    """The chain of slowest segments across ranks: starting from the
+    globally last-ending phase/op segment, repeatedly step to the
+    latest-ending segment that starts strictly before the current one —
+    the backward walk over "what was the run waiting on just before
+    this". Segments are placed phase windows and comm spans on the
+    offset-corrected axis; oldest first in the result."""
+    segs: list[tuple[float, float, int, str, str]] = []
+    for rank, offset, records in streams:
+        for rec in records:
+            kind = rec.get("kind")
+            if kind == "span" and rec.get("t_start") is not None:
+                name, cat = rec.get("op", "?"), "op"
+            elif (kind == "time" and rec.get("event") != "progress"
+                  and rec.get("t_start") is not None):
+                name, cat = rec.get("phase", "?"), "phase"
+            else:
+                continue
+            start = float(rec["t_start"]) - offset
+            end = float(rec.get("t_end") or rec["t_start"]) - offset
+            if end > start:
+                segs.append((start, end, rank, name, cat))
+    if not segs:
+        return []
+    chain: list[tuple[float, float, int, str, str]] = [
+        max(segs, key=lambda s: s[1])
+    ]
+    while len(chain) < CRITPATH_MAX_SEGMENTS:
+        cur_start = chain[-1][0]
+        prev = [s for s in segs if s[0] < cur_start]
+        if not prev:
+            break
+        chain.append(max(prev, key=lambda s: s[1]))
+    return [
+        {"rank": rank, "kind": cat, "name": name,
+         "t_start": start, "seconds": end - start}
+        for start, end, rank, name, cat in reversed(chain)
+    ]
+
+
+def anatomize(streams) -> dict | None:
+    """The full anatomy rollup over one run's aligned rank streams
+    (``timeline.rank_streams`` shape: ``[(rank, offset_s, records)]``).
+
+    Returns ``None`` when no op has seq-stamped collective spans on 2+
+    ranks AND no span carries partner metadata — the pre-anatomy
+    degrade gate every consumer keys its legacy byte-identity on."""
+    spreads = _clock_spreads(streams)
+    unc = clock_uncertainty(spreads)
+    table = matched_calls(streams)
+    ops: dict[str, dict] = {}
+    for (op, axis), per_rank in sorted(
+        table.items(), key=lambda kv: (kv[0][0], str(kv[0][1]))
+    ):
+        if len(per_rank) < 2:
+            continue
+        by_seq: dict[int, dict[int, tuple[float, float]]] = {}
+        for rank, calls in per_rank.items():
+            for seq, entry, end in calls:
+                # duplicate (append-mode double record): first wins
+                by_seq.setdefault(seq, {}).setdefault(rank, (entry, end))
+        ranks = set(per_rank)
+        row = ops.setdefault(op, {
+            "calls": 0, "unmatched": 0, "unresolved": 0,
+            "ranks": [],
+            "span_s": 0.0, "wait_s": 0.0, "wire_s": 0.0, "bytes": 0,
+            "wait_by_rank": {},
+            "_wait_fracs": [], "_pure_gbps": [],
+        })
+        row["ranks"] = sorted(set(row["ranks"]) | ranks)
+        for r in sorted(ranks):
+            row["wait_by_rank"].setdefault(r, 0.0)
+        nbytes_by_seq = _bytes_by_seq(streams, op, axis)
+        for seq in sorted(by_seq):
+            entries = by_seq[seq]
+            if set(entries) != ranks:
+                # a rank died (or its file is missing) before this call:
+                # no honest latest-entry exists — count, never fabricate
+                row["unmatched"] += len(entries)
+                continue
+            latest_entry = max(e for e, _ in entries.values())
+            culprit = max(entries, key=lambda r: entries[r][0])
+            span_s = sum(max(x - e, 0.0) for e, x in entries.values())
+            wait_s = wire_s = 0.0
+            for rank, (entry, end) in entries.items():
+                wait = latest_entry - entry
+                if 0.0 < wait < unc:
+                    # below the clock floor: the split is not supported
+                    # by the measurement — whole span reads as wire
+                    row["unresolved"] += 1
+                    wait = 0.0
+                wait_s += wait
+                wire_s += max((end - entry) - wait, 0.0)
+            row["calls"] += 1
+            row["span_s"] += span_s
+            row["wait_s"] += wait_s
+            row["wire_s"] += wire_s
+            row["wait_by_rank"][culprit] += wait_s
+            nb = nbytes_by_seq.get(seq, 0) * len(entries)
+            row["bytes"] += nb
+            if span_s > 0:
+                row["_wait_fracs"].append(wait_s / span_s)
+            if nb and wire_s > unc:
+                row["_pure_gbps"].append(nb / wire_s / 1e9)
+    for op, row in list(ops.items()):
+        if not row["calls"] and not row["unmatched"]:
+            del ops[op]
+            continue
+        row["wait_frac"] = (row["wait_s"] / row["span_s"]
+                            if row["span_s"] > 0 else 0.0)
+        row["eff_gbps"] = (row["bytes"] / row["span_s"] / 1e9
+                           if row["bytes"] and row["span_s"] > 0 else None)
+        # pure GB/s only when the wire residual clears the clock floor:
+        # an all-wait call's "wire rate" would be fabricated bandwidth
+        row["pure_gbps"] = (row["bytes"] / row["wire_s"] / 1e9
+                            if row["bytes"] and row["wire_s"] > unc
+                            else None)
+        total_wait = sum(row["wait_by_rank"].values())
+        row["wait_share"] = sorted(
+            ((r, w / total_wait) for r, w in row["wait_by_rank"].items()
+             if total_wait > 0 and w > 0),
+            key=lambda rw: -rw[1],
+        )
+        # per-call spreads become the --diff noise bands: a run whose
+        # wait_frac jitters call to call demands a bigger delta to flag
+        row["wait_frac_band"] = _noise_band(row.pop("_wait_fracs"))
+        row["pure_gbps_band"] = _noise_band(row.pop("_pure_gbps"))
+    matrix = traffic_matrix(streams)
+    if not ops and not matrix:
+        return None
+    return {
+        "clock_unc_s": unc,
+        "clock_spread_s": {str(r): s for r, s in sorted(spreads.items())},
+        "ops": ops,
+        "matrix": {
+            f"{src}->{dst}": dict(sorted(by_op.items()),
+                                  total=sum(by_op.values()))
+            for (src, dst), by_op in sorted(matrix.items())
+        },
+        "critical_path": critical_path(streams),
+    }
+
+
+def _bytes_by_seq(streams, op: str, axis) -> dict[int, int]:
+    """Per-seq payload bytes for one (op, axis) (any rank's record —
+    SPMD payloads match; per-call so a size sweep prices each call
+    right)."""
+    out: dict[int, int] = {}
+    for _rank, _offset, records in streams:
+        for rec in records:
+            if (_eligible(rec) and rec.get("op", "?") == op
+                    and rec.get("axis") == axis and rec.get("nbytes")):
+                out.setdefault(int(rec["seq"]), int(rec["nbytes"]))
+    return out
+
+
+def wait_wire_subspans(streams) -> dict[tuple[str, Any, int], float]:
+    """``{(op, axis, seq): latest_entry}`` for every fully matched call
+    whose latest entry clears the clock floor — the timeline renderer's
+    split points for wait/wire sub-spans (times on rank 0's clock)."""
+    spreads = _clock_spreads(streams)
+    unc = clock_uncertainty(spreads)
+    out: dict[tuple[str, Any, int], float] = {}
+    for (op, axis), per_rank in matched_calls(streams).items():
+        if len(per_rank) < 2:
+            continue
+        by_seq: dict[int, dict[int, float]] = {}
+        for rank, calls in per_rank.items():
+            for seq, entry, _end in calls:
+                by_seq.setdefault(seq, {}).setdefault(rank, entry)
+        ranks = set(per_rank)
+        for seq, entries in by_seq.items():
+            if set(entries) != ranks:
+                continue
+            latest = max(entries.values())
+            if latest - min(entries.values()) >= unc:
+                out[(op, axis, seq)] = latest
+    return out

@@ -109,6 +109,11 @@ RECENT_TS = 128
 #: cadence; older windows age out of the (bounded) online digest
 SHED_WINDOWS_KEPT = 512
 
+#: most recent seq-stamped collective calls kept per op for the
+#: straggler rule's per-call anatomy evidence (bounded like every
+#: digest; a run longer than this judges over the retained tail)
+ANAT_CALLS_KEPT = 512
+
 #: --follow floor on the no-files-yet wait (seconds): jax import alone
 #: can take tens of seconds before the first record, so the --idle
 #: default must not finalize an empty follow that early — but a file
@@ -165,6 +170,14 @@ class _Stream:
         self.phase_last_t: dict[str, float] = {}
         self.op_tot: dict[str, tuple[float, int]] = {}
         self.op_last_t: dict[str, float] = {}
+        # anatomy digest (instrument/anatomy.py semantics): recent
+        # seq-stamped collective calls per op — (seq, t_start, t_end,
+        # line) on this stream's OWN clock; the straggler judge
+        # subtracts clock_offset at match time. Empty on pre-seq
+        # streams, which keeps the legacy inversion verdict intact.
+        self.op_calls: dict[str, deque] = {}
+        self.clock_offset = 0.0
+        self.clock_spread = 0.0
         # shed-storm digest: a bounded deque of recent raw windows per
         # class (the exemption boundary can arrive AFTER the windows it
         # exempts, so filtering happens at judge time), windows evicted
@@ -212,6 +225,11 @@ class _Stream:
             v = rec.get("hbm_bytes_limit")
             if isinstance(v, (int, float)):
                 self.hbm_limit = int(v)
+        elif kind == "clock_sync":
+            # this rank's offset to rank 0 and the barrier-echo sample
+            # spread — the anatomy judge's alignment and honesty floor
+            self.clock_offset = float(rec.get("offset_s") or 0.0)
+            self.clock_spread = float(rec.get("spread_s") or 0.0)
         elif kind == "span":
             self._has_span = True
             self._last_op = rec.get("op") or rec.get("note")
@@ -229,6 +247,14 @@ class _Stream:
                 tot, cnt = self.op_tot.get(name, (0.0, 0))
                 self.op_tot[name] = (
                     tot + float(rec.get("seconds") or 0.0), cnt + 1)
+                if (rec.get("seq") is not None
+                        and rec.get("t_start") is not None):
+                    dq = self.op_calls.setdefault(
+                        name, deque(maxlen=ANAT_CALLS_KEPT))
+                    dq.append((
+                        int(rec["seq"]), float(rec["t_start"]),
+                        float(rec.get("t_end") or rec["t_start"]), ln,
+                    ))
         elif kind == "dispatch":
             self._last_op = rec.get("op") or rec.get("note")
             self.last_dispatch = (ln, rec, t)
@@ -579,20 +605,76 @@ def _death_finding(s: _Stream, streams: list[_Stream], opts,
     return None
 
 
+def _op_anatomy(alive: list[_Stream], name: str, opts) -> dict | None:
+    """Per-call wait attribution for one collective op across the
+    alive streams (instrument/anatomy.py semantics over the bounded
+    ``op_calls`` digest): match calls by ``seq``, align entries on the
+    clock offsets, charge each matched call's total wait to its latest
+    entrant, floor waits below the clock-sync uncertainty. None when
+    any stream lacks seq-stamped calls (pre-seq streams keep the
+    legacy inversion verdict), too few calls match, or every wait is
+    under the floor."""
+    per: dict[int, dict[int, tuple[float, float, int]]] = {}
+    for s in alive:
+        dq = s.op_calls.get(name)
+        if not dq:
+            return None
+        per[s.rank] = {
+            seq: (t0 - s.clock_offset, t1 - s.clock_offset, ln)
+            for seq, t0, t1, ln in dq
+        }
+    unc = sum(sorted((s.clock_spread for s in alive), reverse=True)[:2])
+    common = set.intersection(*(set(m) for m in per.values()))
+    if len(common) < opts["min_calls"]:
+        return None
+    wait_by_rank = {s.rank: 0.0 for s in alive}
+    worst_call: dict[int, tuple[float, int, int]] = {}
+    total_wait = 0.0
+    for seq in sorted(common):
+        entries = {r: per[r][seq] for r in per}
+        latest = max(e for e, _x, _ln in entries.values())
+        late_rank = max(entries, key=lambda r: entries[r][0])
+        wait = sum(
+            w for e, _x, _ln in entries.values()
+            if (w := latest - e) >= unc
+        )
+        if wait <= 0:
+            continue
+        wait_by_rank[late_rank] += wait
+        total_wait += wait
+        cur = worst_call.get(late_rank)
+        if cur is None or wait > cur[0]:
+            worst_call[late_rank] = (wait, seq, entries[late_rank][2])
+    if total_wait <= 0:
+        return None
+    culprit = max(wait_by_rank, key=wait_by_rank.get)
+    return {
+        "culprit": culprit,
+        "share": wait_by_rank[culprit] / total_wait,
+        "wait_s": wait_by_rank[culprit],
+        "matched": len(common),
+        "worst": worst_call[culprit],
+        "unc": unc,
+    }
+
+
 def _straggler_findings(streams: list[_Stream], opts,
                         alive: list[_Stream] | None = None) -> list[dict]:
     """Cross-rank skew over phases (slowest rank convicts) and
     collective ops (FASTEST rank convicts — sync-honest collective
     spans charge the wait to whoever arrived early, so the rank that
-    never waits is the one everyone waited for). ``alive`` overrides
-    the default not-died selection — follow mode passes the streams
-    that are not death-convicted, since mid-run EVERY stream is still
-    missing its close markers."""
+    never waits is the one everyone waited for; when the spans carry
+    ``seq`` the verdict upgrades to per-call anatomy — the rank
+    holding the matched-call wait-share convicts, with call-level
+    evidence refs). ``alive`` overrides the default not-died selection
+    — follow mode passes the streams that are not death-convicted,
+    since mid-run EVERY stream is still missing its close markers."""
     if alive is None:
         alive = [s for s in streams if not s.died]
     if len(alive) < 2:
         return []
     by_rank: dict = {}
+    by_stream = {s.rank: s for s in alive}
 
     def judge(table: dict, invert: bool, what: str, conf: float):
         for name, per_rank in table.items():
@@ -610,16 +692,40 @@ def _straggler_findings(streams: list[_Stream], opts,
             if skew <= opts["skew_threshold"] or margin <= opts["margin_s"]:
                 continue
             culprit = best if invert else worst
+            # anatomy upgrade (seq-stamped streams only): replace the
+            # inverted totals argument with direct per-call evidence —
+            # who the matched calls actually waited for
+            anat = _op_anatomy(alive, name, opts) if invert else None
+            evidence: list[str] = []
+            if anat is not None:
+                culprit = anat["culprit"]
+                conf = max(conf, 0.75)
+                w, seq, ln = anat["worst"]
+                cs = by_stream[culprit]
+                evidence = [
+                    f"anatomy: {anat['matched']} matched {name} calls "
+                    f"on {len(alive)} ranks; rank {culprit} held "
+                    f"{anat['share'] * 100:.0f}% of the wait "
+                    f"({anat['wait_s']:.3g}s, clock_unc="
+                    f"{anat['unc'] * 1e3:.3g}ms)",
+                    f"{cs.path}:{ln}: span {name} seq={seq} entered "
+                    f"{w * 1e3:.1f}ms after the first rank",
+                ]
             entry = by_rank.setdefault(
-                culprit, {"conf": conf, "items": [],
+                culprit, {"conf": conf, "items": [], "evidence": [],
                           "first": (what, name)})
             entry["conf"] = max(entry["conf"], conf)
+            entry["evidence"].extend(evidence)
             entry["items"].append(
                 f"{what} {name}: rank {worst} spent {secs[worst]:.3g}s "
                 f"vs rank {best}'s {secs[best]:.3g}s "
                 f"({skew:.2g}x)" + (
-                    " — collective spans invert: the fast rank is the "
-                    "late arriver" if invert else "")
+                    (f" — anatomy: rank {culprit} held "
+                     f"{anat['share'] * 100:.0f}% of the wait across "
+                     f"{anat['matched']} matched calls"
+                     if anat is not None else
+                     " — collective spans invert: the fast rank is the "
+                     "late arriver") if invert else "")
             )
 
     phases: dict = {}
@@ -634,7 +740,6 @@ def _straggler_findings(streams: list[_Stream], opts,
             ops.setdefault(name, {})[s.rank] = pair
     judge(ops, invert=True, what="collective", conf=0.6)
 
-    by_stream = {s.rank: s for s in alive}
     out = []
     for rank, entry in sorted(by_rank.items()):
         what, name = entry["first"]
@@ -650,7 +755,7 @@ def _straggler_findings(streams: list[_Stream], opts,
         out.append(_finding(
             "straggler", rank, entry["conf"],
             "; ".join(entry["items"]),
-            [],
+            entry["evidence"],
             # structured attribution, never mined back out of the
             # human-readable message: a phase skew names a phase, a
             # collective-span skew names the op

@@ -46,7 +46,7 @@ from contextlib import contextmanager
 from pathlib import Path
 
 from tpu_mpi_tests.instrument.aggregate import expand_rank_files
-from tpu_mpi_tests.instrument.metrics import MetricsRegistry
+from tpu_mpi_tests.instrument.metrics import CommWaitWatch, MetricsRegistry
 from tpu_mpi_tests.instrument.timeline import file_in_run
 
 #: stampless files older than this many seconds before the tailer
@@ -223,6 +223,7 @@ class Dashboard:
 
     def _reset(self) -> None:
         self.registry = MetricsRegistry()
+        self.comm_wait = CommWaitWatch(self.registry)
         self.manifest: dict = {}
         self.slo: dict[str, dict] = {}
         self.mem: dict = {}
@@ -231,6 +232,17 @@ class Dashboard:
         self.findings: deque = deque(maxlen=4)
         self.n_records = 0
         self.last_wall: float | None = None
+        # per-path rank / clock offset for the cross-rank wait match
+        # (the dashboard, unlike the in-process tee, knows which file
+        # is which rank — that is what makes live wait_frac possible)
+        self._path_rank: dict[str, int] = {}
+        self._path_offset: dict[str, float] = {}
+
+    def _rank_of(self, path: str) -> int:
+        if path not in self._path_rank:
+            # file-order fallback until the path's manifest arrives
+            self._path_rank[path] = len(self._path_rank)
+        return self._path_rank[path]
 
     def feed(self, rec: dict, path: str = "") -> None:
         kind = rec.get("kind")
@@ -255,6 +267,17 @@ class Dashboard:
         if kind == "manifest":
             if not self.manifest or rec.get("process_index") == 0:
                 self.manifest = rec
+            if isinstance(rec.get("process_index"), int):
+                self._path_rank[path] = rec["process_index"]
+            n = rec.get("process_count")
+            if isinstance(n, int) and n > self.comm_wait.expected:
+                self.comm_wait.expected = n
+        elif kind == "clock_sync":
+            self._path_offset[path] = float(rec.get("offset_s") or 0.0)
+            self.comm_wait.clock_sync(self._rank_of(path), rec)
+        elif kind == "span":
+            self.comm_wait.span(self._rank_of(path), rec,
+                                self._path_offset.get(path, 0.0))
         elif kind == "serve" and rec.get("event") == "window":
             self.slo[rec.get("class", "?")] = rec
         elif kind == "mem":
@@ -335,21 +358,27 @@ def render(dash: Dashboard, files: list[str]) -> str:
         gbps = _sample_map(snap, "tpumt_span_gbps_window", "op")
         lat = _sample_map(snap, "tpumt_span_latency_seconds", "op")
         roof = _sample_map(snap, "tpumt_roofline_frac", "op")
+        # wait% is the cross-rank anatomy decomposition, live: the
+        # share of each op's span time spent waiting for the latest
+        # entrant (CommWaitWatch; '-' until calls match across ranks)
+        wait = _sample_map(snap, "tpumt_comm_wait_frac", "op")
         lines.append(
             f"OPS   {'op':28s} {'ops':>8s} {'GB/s':>8s} "
-            f"{'p50ms':>8s} {'p99ms':>8s} {'roof%':>6s}")
+            f"{'p50ms':>8s} {'p99ms':>8s} {'roof%':>6s} {'wait%':>6s}")
         for op in sorted(ops):
             q = lat.get(op) or {}
             p50 = q.get("p50")
             p99 = q.get("p99")
             rf = roof.get(op)
+            wf = wait.get(op)
             g = gbps.get(op) or {}
             lines.append(
                 f"      {op:28s} {_fmt(int(ops[op]))} "
                 f"{_fmt(g.get('p50'))} "
                 f"{_fmt(p50 * 1e3 if p50 is not None else None)} "
                 f"{_fmt(p99 * 1e3 if p99 is not None else None)} "
-                f"{_fmt(rf * 100 if rf is not None else None, 6, 1)}")
+                f"{_fmt(rf * 100 if rf is not None else None, 6, 1)} "
+                f"{_fmt(wf * 100 if wf is not None else None, 6, 1)}")
 
     if dash.mem:
         parts = []

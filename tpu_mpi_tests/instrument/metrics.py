@@ -489,6 +489,72 @@ class MetricsRegistry:
         self._stale.tuned(rec.get("knob"))
 
 
+class CommWaitWatch:
+    """Cross-rank live wait_frac: the communication-anatomy match
+    (``instrument/anatomy.py`` semantics) run incrementally over the
+    multi-rank record stream ``tpumt-top`` already tails.
+
+    The in-process tee sees only its own rank's spans, so it cannot
+    decompose wait from wire; the dashboard sees every rank's file and
+    knows which file is which rank — it feeds seq-stamped collective
+    spans here with their rank and clock offset, and each call matched
+    across all expected ranks updates a cumulative per-op
+    ``tpumt_comm_wait_frac`` gauge on the registry (rendered as the
+    OPS table's WAIT column). Bounded: at most :data:`MAX_PENDING`
+    partially-matched calls are held; the oldest are dropped first (a
+    dead rank's unmatched calls must not grow the table). Waits below
+    the clock-sync uncertainty read as zero — the honesty floor."""
+
+    MAX_PENDING = 2048
+
+    def __init__(self, registry: MetricsRegistry):
+        self._reg = registry
+        self.expected = 0  # ranks per matched call (manifest count)
+        self._pending: dict[tuple, dict[int, tuple[float, float]]] = {}
+        self._spread: dict[int, float] = {}
+        self._tot: dict[str, list] = {}  # op -> [wait_s, span_s]
+
+    def clock_sync(self, rank: int, rec: dict) -> None:
+        self._spread[rank] = float(rec.get("spread_s") or 0.0)
+
+    def reset(self) -> None:
+        self._pending.clear()
+        self._spread.clear()
+        self._tot.clear()
+
+    def span(self, rank: int, rec: dict, offset: float) -> None:
+        if (rec.get("seq") is None or rec.get("async")
+                or int(rec.get("world") or 1) < 2
+                or rec.get("t_start") is None
+                or rec.get("t_end") is None
+                or self.expected < 2):
+            return
+        op = str(rec.get("op", "?"))
+        key = (op, rec.get("axis"), int(rec["seq"]))
+        entries = self._pending.setdefault(key, {})
+        entries.setdefault(rank, (float(rec["t_start"]) - offset,
+                                  float(rec["t_end"]) - offset))
+        if len(entries) < self.expected:
+            while len(self._pending) > self.MAX_PENDING:
+                self._pending.pop(next(iter(self._pending)))
+            return
+        del self._pending[key]
+        unc = sum(sorted(self._spread.values(), reverse=True)[:2])
+        latest = max(e for e, _x in entries.values())
+        wait_s = span_s = 0.0
+        for entry, end in entries.values():
+            span_s += max(end - entry, 0.0)
+            w = latest - entry
+            if w >= unc:
+                wait_s += w
+        tot = self._tot.setdefault(op, [0.0, 0.0])
+        tot[0] += wait_s
+        tot[1] += span_s
+        if tot[1] > 0:
+            self._reg.set_gauge("tpumt_comm_wait_frac", (("op", op),),
+                                tot[0] / tot[1])
+
+
 class PhaseProgress:
     """Streaming per-phase progress: a ``timers`` phase hook that keeps
     its own cumulative seconds/count per phase and emits throttled

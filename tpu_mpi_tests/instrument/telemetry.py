@@ -72,6 +72,7 @@ class CommEvent:
     t_end: float | None = None
     mono_start: float | None = None
     mono_end: float | None = None
+    seq: int | None = None
     meta: dict[str, Any] = field(default_factory=dict)
 
     def describe(self, now: float | None = None) -> str:
@@ -106,6 +107,12 @@ class CommEvent:
             "mono_start": self.mono_start,
             "mono_end": self.mono_end,
         }
+        if self.seq is not None:
+            # per-(op, axis) monotone call number: the k-th allreduce on
+            # rank 0 matches the k-th on every sibling, which is what the
+            # anatomy layer (instrument/anatomy.py) aligns on. Absent on
+            # dispatch notes and pre-seq streams — consumers degrade.
+            rec["seq"] = self.seq
         if self.meta:
             rec.update(self.meta)
         return rec
@@ -144,6 +151,11 @@ class Telemetry:
         self._lock = threading.Lock()
         # op -> [ops, bytes, seconds]
         self._counters: dict[str, list] = {}
+        # (op, axis) -> next call sequence number. Every rank runs the
+        # same SPMD program, so the same counter advanced at each span
+        # yields matching seq values across ranks — the anatomy layer's
+        # whole alignment key.
+        self._seq: dict[tuple[str, str | None], int] = {}
 
     def enable(self, sink: Callable[[dict], None] | None = None) -> None:
         # enable/disable run on the main thread while the watchdog's
@@ -163,7 +175,17 @@ class Telemetry:
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._seq.clear()
         self.flight.clear()
+
+    def next_seq(self, op: str, axis_name: str | None) -> int:
+        """Allocate the next per-(op, axis) call sequence number.
+        0-based; monotone for the registry's lifetime (until reset)."""
+        key = (op, axis_name)
+        with self._lock:
+            n = self._seq.get(key, 0)
+            self._seq[key] = n + 1
+        return n
 
     def record(self, event: CommEvent) -> None:
         """Record a completed span: counters + flight recorder + sink."""
@@ -337,6 +359,7 @@ def comm_span(
         # so a killed span never records — dead mid-collective
         chaos_hook(op, "enter")
     span = _Span()
+    seq = reg.next_seq(op, axis_name)
     t0_wall = time.time()
     t0 = time.perf_counter()
     try:
@@ -366,6 +389,7 @@ def comm_span(
                 t_end=t0_wall + dt,
                 mono_start=t0,
                 mono_end=t1,
+                seq=seq,
                 meta=meta,
             )
         )
@@ -396,7 +420,7 @@ class AsyncSpan:
 
     __slots__ = ("op", "nbytes", "axis_name", "world", "meta",
                  "t0_wall", "mono_start", "mono_end", "drain_s",
-                 "closed", "_armed")
+                 "closed", "_armed", "seq")
 
     def __init__(self, op: str, nbytes: int = 0,
                  axis_name: str | None = None, world: int = 1, **meta):
@@ -407,6 +431,11 @@ class AsyncSpan:
         self.meta = meta
         self.closed = False
         self._armed = _TELEMETRY.enabled and not _under_trace()
+        # seq at DISPATCH order, not drain order: drains can complete
+        # out of order under deep windows, but dispatch order is the
+        # SPMD-identical one the cross-rank match needs
+        self.seq = (_TELEMETRY.next_seq(op, axis_name)
+                    if self._armed else None)
         self.t0_wall = time.time()
         self.mono_start = time.perf_counter()
         self.mono_end = self.mono_start
@@ -446,6 +475,7 @@ class AsyncSpan:
                 t_end=self.t0_wall + dt,
                 mono_start=self.mono_start,
                 mono_end=self.mono_end,
+                seq=self.seq,
                 meta={"async": True, "drain_s": self.drain_s,
                       **self.meta},
             )

@@ -165,7 +165,18 @@ def _collect(streams):
     Timestamps are wall-clock seconds already shifted onto rank 0's
     clock (``t - offset``); records with no ``t_start``/``t`` cannot be
     placed and are only counted (pre-timeline JSONL compatibility)."""
+    from tpu_mpi_tests.instrument.anatomy import (partner_edges,
+                                                  wait_wire_subspans)
+
     spans, instants, counters, unplaced = [], [], [], 0
+    # cross-rank wait/wire split points per matched (op, axis, seq)
+    # call (instrument/anatomy.py): empty on pre-seq streams, so the
+    # legacy trace document is byte-identical
+    splits = wait_wire_subspans(streams)
+    # cumulative bytes sent per (src rank → dst rank) edge, sampled at
+    # each partner-annotated span's end — the traffic matrix as
+    # Perfetto counter tracks
+    sent: dict[int, dict[str, int]] = {}
 
     def args_from(rec, keys):
         return {k: rec[k] for k in keys if rec.get(k) is not None}
@@ -179,15 +190,46 @@ def _collect(streams):
                     continue
                 start = float(rec["t_start"]) - offset
                 end = float(rec.get("t_end") or rec["t_start"]) - offset
+                op = rec.get("op", "?")
                 spans.append((
-                    rank, TID_COMM, rec.get("op", "?"), "comm", start,
+                    rank, TID_COMM, op, "comm", start,
                     max(end - start, 0.0),
                     args_from(rec, ("nbytes", "gbps", "axis", "world",
                                     "seconds", "cost_bytes",
                                     "model_gbps", "roofline_frac",
                                     "async", "overlap_depth",
-                                    "dispatch_depth")),
+                                    "dispatch_depth", "seq")),
                 ))
+                # wait/wire sub-spans nested under the collective span
+                # (appended after the parent, so stable ts-sorting
+                # keeps parent-before-child for the nesting renderer):
+                # wait = own entry → last arriver, wire = the rest
+                split = (splits.get((op, rec.get("axis"), rec["seq"]))
+                         if rec.get("seq") is not None
+                         and not rec.get("async") else None)
+                if split is not None and end > start:
+                    sub_args = {"seq": rec["seq"]}
+                    if start < split < end:
+                        spans.append((rank, TID_COMM, f"wait {op}",
+                                      "comm_wait", start, split - start,
+                                      sub_args))
+                        spans.append((rank, TID_COMM, f"wire {op}",
+                                      "comm_wire", split, end - split,
+                                      sub_args))
+                    else:
+                        # this rank IS the last arriver (or the split
+                        # clamps outside its span): all wire
+                        spans.append((rank, TID_COMM, f"wire {op}",
+                                      "comm_wire", start, end - start,
+                                      sub_args))
+                edges = partner_edges(rec, rank)
+                if edges:
+                    cum = sent.setdefault(rank, {})
+                    for dst, nbytes in edges:
+                        key = f"to r{dst}"
+                        cum[key] = cum.get(key, 0) + nbytes
+                    counters.append((rank, "comm bytes sent", end,
+                                     dict(cum)))
             elif kind == "time":
                 if rec.get("event") == "progress":
                     # live cumulative snapshots (metrics plane): their
@@ -376,10 +418,12 @@ def chrome_trace(
         events.append({"ph": "i", "name": name, "cat": cat, "pid": rank,
                        "tid": tid, "ts": (t - t0) * _US, "s": scope,
                        "args": args})
-    # memory counter tracks ("C" events): one track per (rank, name),
-    # one series per device (or the census-only live-bytes series)
+    # counter tracks ("C" events): one track per (rank, name) — memory
+    # watermarks (one series per device, or the census-only live-bytes
+    # series) and the cumulative per-neighbor traffic-matrix bytes
     for rank, name, t, series in sorted(counters, key=lambda c: c[2]):
-        events.append({"ph": "C", "name": name, "cat": "mem", "pid": rank,
+        cat = "traffic" if name == "comm bytes sent" else "mem"
+        events.append({"ph": "C", "name": name, "cat": cat, "pid": rank,
                        "tid": 0, "ts": (t - t0) * _US, "args": series})
     return {
         "traceEvents": events,
