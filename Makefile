@@ -2,8 +2,8 @@
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
 	serve-smoke replay-smoke overlap-smoke moe-smoke chaos-smoke \
-	anatomy-smoke live-smoke fleet-smoke lint lint-smoke records \
-	records-check ci clean
+	anatomy-smoke live-smoke fleet-smoke lint lint-smoke \
+	protocol-smoke records records-check ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -948,6 +948,83 @@ lint-smoke:
 		print('lint-smoke races OK: TPM16xx recomputed from replayed concurrency facts, 0 files re-parsed')"
 	@echo "lint-smoke OK: cold populate, warm zero-reparse (concurrency facts replayed), touched file re-analyzes, salt bump invalidates exactly once"
 
+# collective-protocol smoke (README "Static analysis", ISSUE 18): the
+# whole-program schedule automaton, end to end. (a) Self-clean: the
+# repo's own composed schedule raises zero TPM17xx findings. (b)
+# Mutation gates against a copy of the REAL tree: rank-0-guarding the
+# fleet sweep's opening broadcast convicts TPM1701 as the run's SOLE
+# finding, and a rank-dependent halo trip count convicts TPM1702
+# (under --jobs 2, so the parallel extraction path feeds the protocol
+# pass). (c) Static↔runtime conformance: a fresh 2-process
+# native-launcher stencil run replays through the automaton clean
+# (--conform exit 0), and a truncated copy of rank 1's stream — the
+# wire-level mutant — is convicted TPM1705 (exit 1) citing the sibling
+# rank's next op; the two conformance runs share one cache, so the
+# second compiles its automaton from replayed per-file summaries with
+# ZERO files re-parsed (asserted via --stats).
+protocol-smoke:
+	rm -rf /tmp/_tpumt_proto; mkdir -p /tmp/_tpumt_proto/m1 /tmp/_tpumt_proto/m2 /tmp/_tpumt_proto/trunc
+	$(MAKE) -C native tpumt_run
+	python -m tpu_mpi_tests.analysis.cli --select TPM17 --no-cache \
+		tpu_mpi_tests tpu tests __graft_entry__.py bench.py
+	@echo "protocol-smoke self-clean OK: zero TPM17xx findings"
+	for m in m1 m2; do \
+		cp -r tpu_mpi_tests tpu /tmp/_tpumt_proto/$$m/; \
+		cp bench.py __graft_entry__.py /tmp/_tpumt_proto/$$m/; \
+	done
+	grep -q 'fleet.bcast({"knob": knob, "n": len(candidates)}, f"{knob}:open")' \
+		/tmp/_tpumt_proto/m1/tpu_mpi_tests/tune/sweep.py
+	sed -i 's/^\(        \)fleet\.bcast({"knob": knob, "n": len(candidates)}, f"{knob}:open")$$/\1if fleet.process_index() == 0:\n\1    fleet.bcast({"knob": knob, "n": len(candidates)}, f"{knob}:open")/' \
+		/tmp/_tpumt_proto/m1/tpu_mpi_tests/tune/sweep.py
+	python -m tpu_mpi_tests.analysis.cli --no-cache \
+		/tmp/_tpumt_proto/m1/tpu_mpi_tests /tmp/_tpumt_proto/m1/tpu \
+		/tmp/_tpumt_proto/m1/bench.py \
+		/tmp/_tpumt_proto/m1/__graft_entry__.py \
+		> /tmp/_tpumt_proto/m1.out; test $$? -eq 1
+	grep -q ' TPM1701 ' /tmp/_tpumt_proto/m1.out
+	test "$$(wc -l < /tmp/_tpumt_proto/m1.out)" -eq 1
+	@echo "protocol-smoke mutant OK: rank-guarded handshake -> sole TPM1701"
+	grep -q '^                    for _ in range(k):$$' \
+		/tmp/_tpumt_proto/m2/tpu_mpi_tests/workloads/stencil1d.py
+	sed -i 's/^\(                    \)for _ in range(k):$$/\1for _ in range(k - jax.process_index()):/' \
+		/tmp/_tpumt_proto/m2/tpu_mpi_tests/workloads/stencil1d.py
+	python -m tpu_mpi_tests.analysis.cli --no-cache --jobs 2 \
+		/tmp/_tpumt_proto/m2/tpu_mpi_tests /tmp/_tpumt_proto/m2/tpu \
+		/tmp/_tpumt_proto/m2/bench.py \
+		/tmp/_tpumt_proto/m2/__graft_entry__.py \
+		> /tmp/_tpumt_proto/m2.out; test $$? -eq 1
+	grep -q ' TPM1702 ' /tmp/_tpumt_proto/m2.out
+	test "$$(wc -l < /tmp/_tpumt_proto/m2.out)" -eq 1
+	@echo "protocol-smoke mutant OK: rank-dependent trip count -> sole TPM1702"
+	env JAX_PLATFORMS=cpu \
+		./native/tpumt_run -n 2 -o /tmp/_tpumt_proto/conf.rank -- \
+		python -m tpu_mpi_tests.drivers.stencil1d --fake-devices 1 \
+		--n-global 65536 --dtype float64 --overlap 1 \
+		--overlap-iters 8 --telemetry \
+		--jsonl /tmp/_tpumt_proto/conf.jsonl
+	python -m tpu_mpi_tests.analysis.cli --conform \
+		--cache /tmp/_tpumt_proto/cache.json \
+		/tmp/_tpumt_proto/conf.jsonl
+	@echo "protocol-smoke conform OK: fresh 2-process stream replays clean"
+	cp /tmp/_tpumt_proto/conf.p0.jsonl /tmp/_tpumt_proto/trunc/conf.p0.jsonl
+	head -n -5 /tmp/_tpumt_proto/conf.p1.jsonl \
+		> /tmp/_tpumt_proto/trunc/conf.p1.jsonl
+	python -m tpu_mpi_tests.analysis.cli --conform \
+		--cache /tmp/_tpumt_proto/cache.json --stats \
+		/tmp/_tpumt_proto/trunc/conf.jsonl \
+		> /tmp/_tpumt_proto/trunc.out \
+		2> /tmp/_tpumt_proto/warm.stats; test $$? -eq 1
+	grep -q ' TPM1705 ' /tmp/_tpumt_proto/trunc.out
+	grep -q 'sibling rank 0' /tmp/_tpumt_proto/trunc.out
+	test "$$(wc -l < /tmp/_tpumt_proto/trunc.out)" -eq 1
+	python -c "import re; s = open('/tmp/_tpumt_proto/warm.stats').read(); \
+		f, a, h = map(int, re.search( \
+			r'files=(\d+) analyzed=(\d+) cache_hits=(\d+)', s).groups()); \
+		assert a == 0 and h == f > 0, s; \
+		print('protocol-smoke warm OK: automaton recompiled from', h, \
+			'replayed summaries, 0 files re-parsed')"
+	@echo "protocol-smoke OK: self-clean + 2 source mutants + wire mutant convicted, conform clean on the real stream"
+
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
 # observability smoke, the serving-pipeline smoke, the overlap-engine
@@ -955,11 +1032,12 @@ lint-smoke:
 # smoke, the live-observability smoke (OpenMetrics endpoint + online
 # doctor), the fleet-tuning smoke (rank-0 2-process sweep + pack
 # round-trip + closed-loop retune), the lint self-clean gate, the
-# lint-cache incrementality + engine-salt smoke, and the RECORDS.md
-# staleness gate
+# lint-cache incrementality + engine-salt smoke, the collective-
+# protocol smoke (schedule-automaton mutation gates + static↔runtime
+# conformance), and the RECORDS.md staleness gate
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke replay-smoke \
 	overlap-smoke moe-smoke chaos-smoke anatomy-smoke live-smoke \
-	fleet-smoke lint lint-smoke records-check
+	fleet-smoke lint lint-smoke protocol-smoke records-check
 
 clean:
 	$(MAKE) -C native clean

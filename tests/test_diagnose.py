@@ -477,6 +477,110 @@ class TestCli:
         assert diagnose.main([str(tmp_path / "nope.jsonl")]) == 2
 
 
+class TestProtocolModel:
+    """``--protocol-model``: the schedule automaton replayed from
+    tpumt-lint's analysis cache upgrades missing_rank evidence with the
+    statically-expected next collective — and is byte-for-byte inert
+    without the flag or without a warm cache."""
+
+    def _warm_cache(self, tmp_path):
+        from tpu_mpi_tests.analysis.core import lint_paths
+
+        pkg = tmp_path / "duo"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text('"""pair-schedule tree."""\n')
+        (pkg / "pair.py").write_text(
+            "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+            "from tpu_mpi_tests.comm.collectives import reduce_scatter\n"
+            "from tpu_mpi_tests.instrument.telemetry import comm_span\n"
+            "\n"
+            "\n"
+            "def pair(x, mesh):\n"
+            '    with comm_span("allreduce", axis_name="ring"):\n'
+            "        x = allreduce_sum(x, mesh)\n"
+            '    with comm_span("reduce_scatter", axis_name="ring"):\n'
+            "        x = reduce_scatter(x, mesh)\n"
+            "    return x\n"
+        )
+        cache = str(tmp_path / "lintcache.json")
+        lint_paths([str(pkg)], cache_path=cache)
+        return cache
+
+    def _dead_after_allreduce(self, tmp_path):
+        # rank 0 completes the pair schedule and closes cleanly; rank 1
+        # emits only the allreduce span (seq-stamped) and goes silent.
+        surv = [_manifest(0),
+                dict(_span(0, "allreduce", 100.0), axis="ring", seq=0),
+                dict(_span(0, "reduce_scatter", 105.0), axis="ring",
+                     seq=0),
+                _mem(108.0, 900),
+                _mem(110.0, 1000, event="final"),
+                _summary_marker(0)]
+        _write_jsonl(tmp_path / "run.p0.jsonl", surv)
+        _write_jsonl(tmp_path / "run.p1.jsonl", [
+            _manifest(1),
+            dict(_span(1, "allreduce", 100.0), axis="ring", seq=0),
+        ])
+        return str(tmp_path / "run.jsonl")
+
+    def test_protocol_model_cites_expected_next_collective(
+            self, tmp_path, capsys):
+        cache = self._warm_cache(tmp_path)
+        base = self._dead_after_allreduce(tmp_path)
+        assert diagnose.main([base]) == 1
+        plain = capsys.readouterr().out
+        assert "FINDING missing_rank: rank=1" in plain
+        assert "protocol-model" not in plain
+
+        assert diagnose.main([base, "--protocol-model", cache]) == 1
+        out = capsys.readouterr().out
+        assert "protocol-model: after 1 matched span(s)" in out
+        assert "reduce_scatter" in out
+        assert "tpumt-lint analysis cache" in out
+        # strictly additive: dropping the one protocol-model line
+        # restores the flagless output exactly
+        kept = [ln for ln in out.splitlines()
+                if "protocol-model" not in ln]
+        assert kept == [ln for ln in plain.splitlines()
+                        if "protocol-model" not in ln]
+
+    def test_protocol_model_inert_on_cold_cache_or_preseq(
+            self, tmp_path, capsys):
+        cache = self._warm_cache(tmp_path)
+        base = self._dead_after_allreduce(tmp_path)
+        assert diagnose.main([base]) == 1
+        plain = capsys.readouterr().out
+
+        # absent cache file: flag present, nothing replayable
+        assert diagnose.main(
+            [base, "--protocol-model", str(tmp_path / "absent.json")]
+        ) == 1
+        assert capsys.readouterr().out == plain
+
+        # pre-seq stream (no PR-17 stamps): model declines, never
+        # convicts on guesswork
+        _write_jsonl(tmp_path / "run.p1.jsonl", [
+            _manifest(1),
+            dict(_span(1, "allreduce", 100.0), axis="ring"),
+        ])
+        assert diagnose.main([base]) == 1
+        plain2 = capsys.readouterr().out
+        assert diagnose.main([base, "--protocol-model", cache]) == 1
+        assert capsys.readouterr().out == plain2
+        assert "protocol-model" not in plain2
+
+    def test_protocol_model_json_evidence(self, tmp_path, capsys):
+        cache = self._warm_cache(tmp_path)
+        base = self._dead_after_allreduce(tmp_path)
+        assert diagnose.main(
+            [base, "--json", "--protocol-model", cache]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        (f,) = doc["findings"]
+        assert f["class"] == "missing_rank" and f["rank"] == 1
+        assert any(e.startswith("protocol-model:")
+                   for e in f["evidence"])
+
+
 class TestReportSurfacing:
     def test_diagnosis_line_in_report(self, tmp_path, capsys):
         _write_jsonl(tmp_path / "run.p0.jsonl",

@@ -62,6 +62,11 @@ TREE_FAMILIES = [
     ("TPM11", "tpm11"),
     ("TPM12", "tpm12"),
     ("TPM16", "tpm16"),
+    # ISSUE 18: the protocol verifier's composed-schedule shapes —
+    # TPM1701 (rank-guarded broadcast handshake), TPM1702
+    # (rank-dependent trip count), TPM1703 (swallowing handler), one
+    # file each, all through the cross-file wrappers in proto/comms.py
+    ("TPM17", "tpm17"),
 ]
 
 
@@ -1846,6 +1851,274 @@ def test_cli_json_and_sarif_carry_tpm16(capsys):
     assert {"TPM1601", "TPM1602", "TPM1603", "TPM601"} <= set(rule_ids)
     result_codes = {r["ruleId"] for r in doc["runs"][0]["results"]}
     assert {"TPM1601", "TPM1602", "TPM1603"} <= result_codes
+
+
+# --------------------------------------------------------------------------
+# ISSUE 18: the collective-protocol verifier (TPM17xx + --conform)
+
+
+def test_seeded_protocol_mutant_rank_guarded_handshake(tmp_path):
+    """Mutation gate (ISSUE 18 acceptance): rank-guarding the fleet
+    sweep's opening broadcast handshake is invisible to every prior
+    family — the handshake binds nothing (TPM1301 silent), ``bcast`` is
+    outside TPM1101's core alphabet, and no branch exits (TPM1102
+    silent) — yet rank 0's composed schedule gains a replication point
+    the other ranks never enter. Convicted as the run's SOLE finding,
+    TPM1701, anchored at the guard in sweep.py."""
+    roots = _copy_lint_tree(tmp_path)
+    sp = tmp_path / "tpu_mpi_tests" / "tune" / "sweep.py"
+    src = sp.read_text()
+    old = ('        fleet.bcast({"knob": knob, "n": len(candidates)}, '
+           'f"{knob}:open")\n')
+    assert old in src, "fleet sweep handshake shape changed — update me"
+    new = ('        if fleet.process_index() == 0:\n'
+           '            fleet.bcast({"knob": knob, "n": '
+           'len(candidates)}, f"{knob}:open")\n')
+    sp.write_text(src.replace(old, new))
+    findings = lint_paths(roots)
+    assert codes_of(findings) == ["TPM1701"], findings
+    f = findings[0]
+    assert f.path.endswith("sweep.py"), f
+    assert "broadcast" in f.message, f
+
+
+def test_seeded_protocol_mutant_rank_dependent_trip_count(tmp_path):
+    """Mutation gate (ISSUE 18 acceptance): making the serve step's
+    halo-batch trip count a function of the rank leaves every op and
+    every branch identical across ranks — only the iteration COUNT
+    diverges, the deadlock TPM1702 exists for. Sole finding, anchored
+    at the loop in stencil1d.py. Run with --jobs 2 so the parallel
+    extraction path feeds the protocol pass in-suite."""
+    roots = _copy_lint_tree(tmp_path)
+    st = tmp_path / "tpu_mpi_tests" / "workloads" / "stencil1d.py"
+    src = st.read_text()
+    old = "                    for _ in range(k):\n"
+    assert old in src, "serve step batch-loop shape changed — update me"
+    new = "                    for _ in range(k - jax.process_index()):\n"
+    st.write_text(src.replace(old, new))
+    findings = lint_paths(roots, jobs=2)
+    assert codes_of(findings) == ["TPM1702"], findings
+    f = findings[0]
+    assert f.path.endswith("stencil1d.py"), f
+    assert "trip count" in f.message, f
+
+
+def _write_pair_tree(tmp_path):
+    """A loop-free two-collective schedule: ``allreduce`` then
+    ``reduce_scatter``, exactly once each — the tightest automaton a
+    fabricated stream can violate (the real tree's dynamically-named
+    overlap spans deliberately widen its language)."""
+    pkg = tmp_path / "duo"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text('"""pair-schedule tree."""\n')
+    (pkg / "pair.py").write_text(
+        "from tpu_mpi_tests.comm.collectives import allreduce_sum\n"
+        "from tpu_mpi_tests.comm.collectives import reduce_scatter\n"
+        "from tpu_mpi_tests.instrument.telemetry import comm_span\n"
+        "\n"
+        "\n"
+        "def pair(x, mesh):\n"
+        '    with comm_span("allreduce", axis_name="ring"):\n'
+        "        x = allreduce_sum(x, mesh)\n"
+        '    with comm_span("reduce_scatter", axis_name="ring"):\n'
+        "        x = reduce_scatter(x, mesh)\n"
+        "    return x\n"
+    )
+    return str(tmp_path)
+
+
+def _write_stream(path, rank, events, seq=True):
+    """One rank's telemetry JSONL: a manifest then span records, with
+    (``seq=False``) or without the PR-17 per-(op, axis) stamps."""
+    recs = [{"kind": "manifest", "process_index": rank,
+             "process_count": 2}]
+    counters: dict = {}
+    for op, ax in events:
+        rec = {"kind": "span", "op": op, "axis": ax, "seconds": 0.001}
+        if seq:
+            key = (op, ax)
+            rec["seq"] = counters.get(key, 0)
+            counters[key] = rec["seq"] + 1
+        recs.append(rec)
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    return str(path)
+
+
+PAIR = [("allreduce", "ring"), ("reduce_scatter", "ring")]
+
+
+def test_conform_matching_streams_clean(tmp_path):
+    """Both ranks emit exactly the static schedule → no findings, no
+    notes."""
+    from tpu_mpi_tests.analysis.core import collect_project
+    from tpu_mpi_tests.analysis.protocol import conform_paths
+
+    tree = _write_pair_tree(tmp_path)
+    s0 = _write_stream(tmp_path / "s.p0.jsonl", 0, PAIR)
+    s1 = _write_stream(tmp_path / "s.p1.jsonl", 1, PAIR)
+    findings, notes = conform_paths([s0, s1], collect_project([tree]))
+    assert findings == [] and notes == [], (findings, notes)
+
+
+def test_conform_rank_set_expansion(tmp_path):
+    """Passing the base path finds the ``.p<i>`` siblings — the same
+    expansion the launcher/aggregator contract uses."""
+    from tpu_mpi_tests.analysis.core import collect_project
+    from tpu_mpi_tests.analysis.protocol import conform_paths
+
+    tree = _write_pair_tree(tmp_path)
+    _write_stream(tmp_path / "s.p0.jsonl", 0, PAIR)
+    _write_stream(tmp_path / "s.p1.jsonl", 1, PAIR + PAIR[:1])
+    findings, _notes = conform_paths([str(tmp_path / "s.jsonl")],
+                                     collect_project([tree]))
+    # rank 1's third event leaves the pair schedule → the expansion
+    # must have loaded BOTH rank files for the finding to exist
+    assert codes_of(findings) == ["TPM1704"], findings
+
+
+def test_conform_divergent_stream_tpm1704(tmp_path):
+    """A runtime sequence no static path generates: the second
+    ``allreduce`` has nowhere to go after the pair schedule's first —
+    cited with the longest matched prefix and the diverging event."""
+    from tpu_mpi_tests.analysis.core import collect_project
+    from tpu_mpi_tests.analysis.protocol import conform_paths
+
+    tree = _write_pair_tree(tmp_path)
+    s0 = _write_stream(tmp_path / "s.p0.jsonl", 0,
+                       [("allreduce", "ring"), ("allreduce", "ring")])
+    findings, _notes = conform_paths([s0], collect_project([tree]))
+    assert codes_of(findings) == ["TPM1704"], findings
+    f = findings[0]
+    assert f.path.endswith("s.p0.jsonl"), f
+    assert "after 1 matched event" in f.message, f
+    assert "op='allreduce'" in f.message, f
+    assert "reduce_scatter" in f.message, f  # the expected next op
+
+
+def test_conform_truncated_stream_tpm1705(tmp_path):
+    """Rank 1 stops one event short while its sibling emitted the
+    statically-mandatory next collective — the static twin of the
+    doctor's missing_rank, citing the automaton state and expected
+    op."""
+    from tpu_mpi_tests.analysis.core import collect_project
+    from tpu_mpi_tests.analysis.protocol import conform_paths
+
+    tree = _write_pair_tree(tmp_path)
+    s0 = _write_stream(tmp_path / "s.p0.jsonl", 0, PAIR)
+    s1 = _write_stream(tmp_path / "s.p1.jsonl", 1, PAIR[:1])
+    findings, _notes = conform_paths([s0, s1], collect_project([tree]))
+    assert codes_of(findings) == ["TPM1705"], findings
+    f = findings[0]
+    assert f.path.endswith("s.p1.jsonl"), f
+    assert "rank 1" in f.message and "sibling rank 0" in f.message, f
+    assert "op='reduce_scatter'" in f.message, f
+    assert "state" in f.message, f
+
+
+def test_conform_preseq_stream_notes_never_convicts(tmp_path):
+    """Acceptance criterion: pre-seq telemetry (no span carries the
+    PR-17 stamp) degrades to a visible NOTE — even when the sequence
+    would otherwise convict."""
+    from tpu_mpi_tests.analysis.core import collect_project
+    from tpu_mpi_tests.analysis.protocol import conform_paths
+
+    tree = _write_pair_tree(tmp_path)
+    s0 = _write_stream(tmp_path / "s.p0.jsonl", 0,
+                       [("allreduce", "ring"), ("allreduce", "ring")],
+                       seq=False)
+    findings, notes = conform_paths([s0], collect_project([tree]))
+    assert findings == [], findings
+    assert any("insufficient stamps" in n for n in notes), notes
+
+
+def test_conform_warm_cache_replays_automata(tmp_path):
+    """Satellite (ISSUE 18): conformance replays its automata from the
+    lint cache — the warm ``collect_project`` re-parses ZERO files
+    (asserted via stats) and convicts identically; ``--jobs 2`` parity
+    for the same pass."""
+    from tpu_mpi_tests.analysis.core import collect_project
+    from tpu_mpi_tests.analysis.protocol import conform_paths
+
+    tree = _write_pair_tree(tmp_path)
+    s0 = _write_stream(tmp_path / "s.p0.jsonl", 0, PAIR)
+    s1 = _write_stream(tmp_path / "s.p1.jsonl", 1, PAIR[:1])
+    cache = str(tmp_path / "cache.json")
+
+    cold: dict = {}
+    proj = collect_project([tree], cache_path=cache, stats=cold,
+                           jobs=2)
+    first, _ = conform_paths([s0, s1], proj)
+    assert cold["analyzed"] == cold["files"] > 0, cold
+
+    warm: dict = {}
+    proj2 = collect_project([tree], cache_path=cache, stats=warm)
+    again, _ = conform_paths([s0, s1], proj2)
+    assert warm["analyzed"] == 0, warm
+    assert warm["cache_hits"] == cold["files"], warm
+    assert codes_of(first) == codes_of(again) == ["TPM1705"]
+
+
+def test_protocol_pass_jobs_parity():
+    """The protocol project pass yields identical findings whether the
+    facts came from the sequential or the pooled extraction path."""
+    bad = str(FIXTURES / "tpm17_bad")
+    seq = lint_paths([bad])
+    par = lint_paths([bad], jobs=2)
+    assert par == seq and par, par
+
+
+def test_cli_conform_exit_codes(tmp_path, capsys):
+    """CLI contract: --conform exits 0 on a conformant stream set and 1
+    on the truncated copy, naming TPM1705; NOTEs go to stderr."""
+    tree = _write_pair_tree(tmp_path)
+    s0 = _write_stream(tmp_path / "s.p0.jsonl", 0, PAIR)
+    s1 = _write_stream(tmp_path / "s.p1.jsonl", 1, PAIR)
+    rc = cli.main(["--conform", s0, s1, "--conform-tree", tree,
+                   "--no-cache"])
+    assert rc == 0, capsys.readouterr()
+    capsys.readouterr()
+
+    _write_stream(tmp_path / "s.p1.jsonl", 1, PAIR[:1])
+    rc = cli.main(["--conform", s0, s1, "--conform-tree", tree,
+                   "--no-cache"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "TPM1705" in out.out
+
+    _write_stream(tmp_path / "s.p1.jsonl", 1, PAIR, seq=False)
+    rc = cli.main(["--conform", s1, "--conform-tree", tree,
+                   "--no-cache"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "insufficient stamps" in out.err
+
+
+def test_cli_json_and_sarif_carry_tpm17(capsys):
+    """The output-format goldens extended with the TPM17xx family:
+    --format json carries all three static findings with their anchors,
+    and the SARIF rule table lists the full family — the --conform-only
+    codes (TPM1704/1705) included, so CI hosts can render them."""
+    bad = str(FIXTURES / "tpm17_bad")
+    rc = cli.main(["--no-cache", "--format", "json", bad])
+    out = capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.out)
+    by_code = {f["code"]: f for f in doc["findings"]}
+    assert {"TPM1701", "TPM1702", "TPM1703"} <= set(by_code)
+    assert by_code["TPM1701"]["path"].endswith("driver.py")
+    assert by_code["TPM1702"]["path"].endswith("halo_loop.py")
+    assert by_code["TPM1703"]["path"].endswith("guard.py")
+
+    rc = cli.main(["--no-cache", "--format", "sarif", bad])
+    out = capsys.readouterr()
+    assert rc == 1
+    doc = json.loads(out.out)
+    driver = doc["runs"][0]["tool"]["driver"]
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert {"TPM1701", "TPM1702", "TPM1703",
+            "TPM1704", "TPM1705"} <= set(rule_ids)
+    result_codes = {r["ruleId"] for r in doc["runs"][0]["results"]}
+    assert {"TPM1701", "TPM1702", "TPM1703"} <= result_codes
 
 
 def test_self_clean_gate():

@@ -51,6 +51,18 @@ rule families (stable codes; see README "Static analysis" for the table):
                           idiom. TPM601 is its single-file fallback:
                           it fires only where thread-entry discovery
                           resolved nothing.
+  TPM17xx schedule-protocol  whole-program collective schedule
+                          automata: TPM1701 rank-divergent composed
+                          schedule (assembled across functions /
+                          broadcast wrappers / rank-returning
+                          helpers), TPM1702 rank-dependent loop bound
+                          enclosing a collective, TPM1703 collective
+                          under an exception path that skips its
+                          partner op; `--conform <jsonl...>` replays
+                          real seq-stamped telemetry against the
+                          automaton — TPM1704 stream no static path
+                          generates, TPM1705 rank stream ending with
+                          a mandatory collective un-emitted.
 
 suppress one finding on its line (unused suppressions are themselves
 findings):   x = jnp.asarray(2.0)  # tpumt: ignore[TPM301]
@@ -135,6 +147,15 @@ def main(argv: list[str] | None = None) -> int:
                     "wall time and files/proc to stderr")
     ap.add_argument("--list-rules", action="store_true",
                     help="print every registered code and exit")
+    ap.add_argument("--conform", action="store_true",
+                    help="conformance mode: PATHs are telemetry JSONL "
+                    "streams (`.p<i>` rank sets auto-expand), replayed "
+                    "against the schedule automaton compiled from "
+                    "--conform-tree; convicts TPM1704/TPM1705")
+    ap.add_argument("--conform-tree", metavar="DIR", default=None,
+                    help="source tree the schedule automaton is "
+                    "compiled from in --conform mode (default: the "
+                    "installed tpu_mpi_tests package)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -161,15 +182,37 @@ def main(argv: list[str] | None = None) -> int:
     if args.jobs < 1:
         ap.error("--jobs must be >= 1")
     stats: dict = {}
-    findings = lint_paths(
-        args.paths,
-        select=args.select,
-        ignore=args.ignore,
-        entry_modules=entry_modules,
-        cache_path=cache_path,
-        stats=stats,
-        jobs=args.jobs,
-    )
+    notes: list[str] = []
+    if args.conform:
+        from pathlib import Path
+
+        from tpu_mpi_tests.analysis import core as _core
+        from tpu_mpi_tests.analysis.core import collect_project
+        from tpu_mpi_tests.analysis.protocol import conform_paths
+
+        # the automaton is compiled from source, the stream from
+        # telemetry: PATHs here are JSONL files, not code
+        tree = args.conform_tree or str(Path(_core.__file__).parents[1])
+        proj = collect_project(
+            [tree],
+            entry_modules=entry_modules,
+            cache_path=cache_path,
+            stats=stats,
+            jobs=args.jobs,
+        )
+        findings, notes = conform_paths(args.paths, proj)
+    else:
+        findings = lint_paths(
+            args.paths,
+            select=args.select,
+            ignore=args.ignore,
+            entry_modules=entry_modules,
+            cache_path=cache_path,
+            stats=stats,
+            jobs=args.jobs,
+        )
+    for note in notes:
+        print(f"tpumt-lint: NOTE: {note}", file=sys.stderr)
     if args.stats:
         analyzed = stats.get("analyzed", 0)
         jobs = stats.get("jobs", 1)

@@ -571,45 +571,20 @@ def _run_pool(miss_paths: list[str], jobs: int) -> list[dict]:
         return [analyze_file(p) for p in miss_paths]
 
 
-def lint_paths(
-    paths: Iterable[str],
-    select: Iterable[str] | None = None,
-    ignore: Iterable[str] | None = None,
-    entry_modules: dict[str, str] | None = None,
-    cache_path: str | None = None,
-    stats: dict | None = None,
-    jobs: int = 1,
-) -> list[Finding]:
-    """Lint files/directories; returns sorted, suppression-filtered
-    findings (unused/malformed suppressions included as findings).
-
-    ``cache_path`` enables the content-hash analysis cache
-    (:mod:`tpu_mpi_tests.analysis.lintcache`): unchanged files replay
-    their cached file-scope findings + facts instead of re-parsing. The
-    default (None) is uncached — library callers and tests stay
-    hermetic; the CLI opts in.
-
-    ``jobs`` parallelizes per-file analysis (parse + file rules + fact
-    extraction) over a ``multiprocessing`` pool — the facts were made
-    JSON-serializable for the cache, which is exactly what lets them
-    cross a process boundary. Cache hits are resolved in the parent
-    BEFORE dispatch, so a warm run re-parses zero files regardless of
-    ``jobs``; the project pass always runs in the parent.
-
-    ``stats``, when a dict, receives ``files``/``analyzed``/
-    ``cache_hits``/``seconds``/``jobs`` counts."""
-    t0 = time.monotonic()
-    code_filter = CodeFilter(select, ignore)
+def _gather(
+    paths: Iterable[str], cache, jobs: int,
+) -> tuple[set, list[dict],
+           dict[str, tuple[list[Suppression], list[int]]], int, int, int]:
+    """The per-file phase shared by :func:`lint_paths` and
+    :func:`collect_project`: cache lookup, (possibly pooled) analysis
+    of the misses, cache write-back. Returns ``(raw_findings,
+    facts_list, suppressions, n_files, n_analyzed, n_hits)`` — the
+    caller decides whether to run rules over the facts or hand them
+    straight to the protocol layer."""
     raw: set[Finding] = set()
     facts_list: list[dict] = []
     suppressions: dict[str, tuple[list[Suppression], list[int]]] = {}
     n_files = n_analyzed = n_hits = 0
-
-    cache = None
-    if cache_path:
-        from tpu_mpi_tests.analysis.lintcache import LintCache
-
-        cache = LintCache(cache_path)
 
     # a missing or non-.py path is a broken gate, never a clean one: a
     # renamed directory in the `make lint` path list must fail loudly,
@@ -683,6 +658,48 @@ def lint_paths(
         if cache is not None:
             cache.put(path, res["digest"], entry)
 
+    return raw, facts_list, suppressions, n_files, n_analyzed, n_hits
+
+
+def lint_paths(
+    paths: Iterable[str],
+    select: Iterable[str] | None = None,
+    ignore: Iterable[str] | None = None,
+    entry_modules: dict[str, str] | None = None,
+    cache_path: str | None = None,
+    stats: dict | None = None,
+    jobs: int = 1,
+) -> list[Finding]:
+    """Lint files/directories; returns sorted, suppression-filtered
+    findings (unused/malformed suppressions included as findings).
+
+    ``cache_path`` enables the content-hash analysis cache
+    (:mod:`tpu_mpi_tests.analysis.lintcache`): unchanged files replay
+    their cached file-scope findings + facts instead of re-parsing. The
+    default (None) is uncached — library callers and tests stay
+    hermetic; the CLI opts in.
+
+    ``jobs`` parallelizes per-file analysis (parse + file rules + fact
+    extraction) over a ``multiprocessing`` pool — the facts were made
+    JSON-serializable for the cache, which is exactly what lets them
+    cross a process boundary. Cache hits are resolved in the parent
+    BEFORE dispatch, so a warm run re-parses zero files regardless of
+    ``jobs``; the project pass always runs in the parent.
+
+    ``stats``, when a dict, receives ``files``/``analyzed``/
+    ``cache_hits``/``seconds``/``jobs`` counts."""
+    t0 = time.monotonic()
+    code_filter = CodeFilter(select, ignore)
+
+    cache = None
+    if cache_path:
+        from tpu_mpi_tests.analysis.lintcache import LintCache
+
+        cache = LintCache(cache_path)
+
+    (raw, facts_list, suppressions,
+     n_files, n_analyzed, n_hits) = _gather(paths, cache, jobs)
+
     proj = ProjectContext(facts_list, entry_modules or DEFAULT_ENTRY_MODULES)
     for rule in all_rules():
         if rule.scope != "project":
@@ -730,3 +747,34 @@ def lint_paths(
                      jobs=jobs)
     findings.sort()
     return findings
+
+
+def collect_project(
+    paths: Iterable[str],
+    entry_modules: dict[str, str] | None = None,
+    cache_path: str | None = None,
+    stats: dict | None = None,
+    jobs: int = 1,
+) -> ProjectContext:
+    """The whole-program facts view WITHOUT running any rules — the
+    ``--conform`` entry point. Shares :func:`_gather` with
+    :func:`lint_paths`, so a warm cache replays every file's facts
+    (``analyzed == 0`` in ``stats``) and the conformance pass rebuilds
+    its schedule automata without re-parsing a single file."""
+    t0 = time.monotonic()
+    cache = None
+    if cache_path:
+        from tpu_mpi_tests.analysis.lintcache import LintCache
+
+        cache = LintCache(cache_path)
+    (_raw, facts_list, _supps,
+     n_files, n_analyzed, n_hits) = _gather(paths, cache, jobs)
+    if cache is not None:
+        cache.save()
+    if stats is not None:
+        stats.update(files=n_files, analyzed=n_analyzed,
+                     cache_hits=n_hits,
+                     seconds=round(time.monotonic() - t0, 3),
+                     jobs=jobs)
+    return ProjectContext(facts_list,
+                          entry_modules or DEFAULT_ENTRY_MODULES)

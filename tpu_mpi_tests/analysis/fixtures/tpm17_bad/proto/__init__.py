@@ -1,0 +1,3 @@
+"""TPM17xx bad tree: every file's branches look locally symmetric to
+the per-branch TPM11xx rules — the deadlocks only exist in the
+*composed* whole-program schedule the protocol verifier builds."""

@@ -1,0 +1,16 @@
+"""Wrappers: the collective, its telemetry emitter (the runtime
+alphabet the schedule automaton derives), and the broadcast-class
+handshake TPM1101's alphabet deliberately excludes."""
+
+from tpu_mpi_tests.comm.collectives import allreduce_sum
+from tpu_mpi_tests.instrument.telemetry import comm_span
+from tpu_mpi_tests.tune.fleet import bcast
+
+
+def global_sum(x, mesh):
+    with comm_span("allreduce", axis_name="shard"):
+        return allreduce_sum(x, mesh)
+
+
+def fanout(value, tag):
+    return bcast(value, tag)

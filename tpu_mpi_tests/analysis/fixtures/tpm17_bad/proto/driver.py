@@ -1,0 +1,14 @@
+"""TPM1701 bad: only rank 0 runs the broadcast handshake. Each branch
+is clean to TPM1101 (no core collective diverges) and to TPM1301 (the
+call binds nothing) — the hang is only visible in the composed
+schedule: rank 0's stream is [bcast], everyone else's is []."""
+
+from jax import process_index
+
+from proto.comms import fanout
+
+
+def open_sweep(value):
+    if process_index() == 0:
+        fanout(value, "sweep:open")
+    return value

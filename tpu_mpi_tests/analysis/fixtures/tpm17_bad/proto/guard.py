@@ -1,0 +1,14 @@
+"""TPM1703 bad: the collective is reachable under an exception path
+whose handler swallows and continues — the rank that catches skips the
+partner op the other ranks are blocking in."""
+
+from proto.comms import global_sum
+
+
+def reduce_or_skip(x, mesh):
+    out = x
+    try:
+        out = global_sum(x, mesh)
+    except Exception:
+        pass
+    return out

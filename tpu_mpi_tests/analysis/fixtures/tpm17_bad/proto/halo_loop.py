@@ -1,0 +1,14 @@
+"""TPM1702 bad: the trip count is a function of the rank, and the loop
+body dispatches a collective — every rank agrees on every op yet runs
+a different *count* of them, so some rank enters an iteration its
+partners never will (the divergent-loop deadlock)."""
+
+from jax import process_index
+
+from proto.comms import global_sum
+
+
+def drain(x, mesh, n):
+    for _ in range(n - process_index()):
+        x = global_sum(x, mesh)
+    return x

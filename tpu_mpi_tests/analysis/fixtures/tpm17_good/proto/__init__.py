@@ -1,0 +1,4 @@
+"""TPM17xx good tree: the same program shapes with the protocol
+discipline applied — every rank emits the identical composed schedule,
+rank branches carry no events, loop bounds are replicated, and the
+exception path re-raises instead of skipping its partner op."""

@@ -1,0 +1,11 @@
+"""TPM1703 good: the handler re-raises — the sanctioned abort shape.
+No rank quietly continues past a collective its partners entered."""
+
+from proto.comms import global_sum
+
+
+def reduce_or_skip(x, mesh):
+    try:
+        return global_sum(x, mesh)
+    except Exception:
+        raise

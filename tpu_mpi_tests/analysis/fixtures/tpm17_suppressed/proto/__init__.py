@@ -1,0 +1,3 @@
+"""TPM17xx suppressed tree: the bad shapes with sanctioned
+``# tpumt: ignore[...]`` why-comments — each must silence exactly its
+finding (an unused suppression is itself a TPM900 finding)."""

@@ -1,0 +1,13 @@
+"""TPM1703 suppressed: the swallowing handler, sanctioned with a
+why-comment (the raiser is environmental and symmetric on all ranks)."""
+
+from proto.comms import global_sum
+
+
+def reduce_or_skip(x, mesh):
+    out = x
+    try:  # tpumt: ignore[TPM1703] — raiser is symmetric (import error)
+        out = global_sum(x, mesh)
+    except Exception:
+        pass
+    return out

@@ -1,0 +1,12 @@
+"""TPM1702 suppressed: the rank-dependent trip count, sanctioned with
+a why-comment (a deliberately-staggered drain in a chaos test)."""
+
+from jax import process_index
+
+from proto.comms import global_sum
+
+
+def drain(x, mesh, n):
+    for _ in range(n - process_index()):  # tpumt: ignore[TPM1702] — chaos drain
+        x = global_sum(x, mesh)
+    return x

@@ -36,7 +36,12 @@ from pathlib import Path
 #: replay facts the project pass cannot judge. The engine salt would
 #: catch this too (the analysis sources changed), but the version is
 #: the explicit contract for the schema shape itself.
-CACHE_VERSION = 2
+#: Bumped to 3 in ISSUE 18: per-function ``proto`` event trees +
+#: ``rank_ret`` — the protocol layer (schedule automata, ``--conform``
+#: replay, the doctor's ``--protocol-model``) rebuilds its whole
+#: verdict from these cached facts, so a cache without them must read
+#: as cold, never as "no schedule".
+CACHE_VERSION = 3
 
 
 def default_cache_path() -> str:

@@ -163,6 +163,15 @@ BROADCAST_CALLS = {
     "process_allgather", "bcast",
 }
 
+#: telemetry span emitters (ISSUE 18): a call to one of these with a
+#: CONSTANT first argument is a statically-known runtime ``(op, axis)``
+#: event — the exact alphabet ``kind:"span"`` records carry — so the
+#: protocol layer derives its schedule automaton from the emitters
+#: themselves instead of guessing a wrapper→runtime-op table. A
+#: dynamic first argument (``self.op``, f-strings) is recorded with
+#: ``op=None``: a span whose name the static model cannot know.
+SPAN_EMITTERS = {"comm_span", "span_call", "async_span"}
+
 # summary-expansion recursion bound, not a device schedule knob — there
 # is nothing to tune and no topology it varies with
 _MAX_DEPTH = 16  # tpumt: ignore[TPM701]
@@ -437,6 +446,203 @@ def _unit_nodes(unit: ast.AST) -> Iterator[ast.AST]:
     yield from _own_nodes(unit)
 
 
+# ---------------------------------------------------------------------------
+# protocol facts (ISSUE 18): the structured event tree the schedule
+# automaton and the TPM17xx checks are compiled from
+
+
+def _const_str(node: ast.AST | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _taint_sources(ctx: FileContext, node: ast.AST) -> dict[str, str]:
+    """Local name → canonical call target it was assigned from — the
+    return-value taint channel (``mode = pick_mode()`` where
+    ``pick_mode`` turns out to be rank-returning assembles a
+    rank-divergent branch no lexical rank test reveals). A name EVER
+    rebound from a broadcast-class call is dropped entirely: the sweep's
+    ``go = fleet.bcast(go, ...)`` replication is exactly what makes the
+    value rank-invariant again."""
+    out: dict[str, str] = {}
+    killed: set[str] = set()
+    for n in _own_nodes(node):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            canon = canon_target(ctx, n.value.func)
+            last = last_attr(n.value.func) or ""
+            for t in n.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if last in BROADCAST_CALLS:
+                    killed.add(t.id)
+                elif canon:
+                    out[t.id] = canon
+    for name in killed:
+        out.pop(name, None)
+    return out
+
+
+def _test_taints(ctx: FileContext, expr: ast.AST,
+                 sources: dict[str, str]) -> list[str]:
+    """Canonical targets whose return value feeds this test: calls made
+    inside it plus the assigned-from targets of names it reads. Judged
+    rank-returning (or not) at project time, where the callee summaries
+    exist."""
+    canons: set[str] = set()
+    for n in _unit_nodes(expr):
+        if isinstance(n, ast.Call):
+            c = canon_target(ctx, n.func)
+            if c:
+                canons.add(c)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            c = sources.get(n.id)
+            if c:
+                canons.add(c)
+    return sorted(canons)[:8]
+
+
+def _returns_rank(node: ast.AST, aliases: set[str]) -> bool:
+    """Does the function return the process rank? DIRECT forms only
+    (mirrors ``_rank_aliases``): ``return rank``, ``return self.rank``,
+    ``return jax.process_index()``. A rank merely nested in a returned
+    constructor call does not make the whole object a rank."""
+    names = RANK_NAMES | aliases
+    for n in _own_nodes(node):
+        if not isinstance(n, ast.Return) or n.value is None:
+            continue
+        v = n.value
+        if isinstance(v, ast.Name) and v.id in names:
+            return True
+        if isinstance(v, ast.Attribute) and v.attr in RANK_NAMES:
+            return True
+        if isinstance(v, ast.Call) and (last_attr(v.func) or "") \
+                in RANK_CALLS:
+            return True
+    return False
+
+
+def _terminates(stmts: list[ast.stmt]) -> bool:
+    """Does this straight-line statement list always leave the enclosing
+    block (return/raise/break/continue on every path)? Conservative:
+    only the shapes that matter for branch-summary truncation."""
+    for s in stmts:
+        if isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                          ast.Continue)):
+            return True
+        if isinstance(s, ast.If) and s.orelse and _terminates(s.body) \
+                and _terminates(s.orelse):
+            return True
+        if isinstance(s, (ast.With, ast.AsyncWith)) \
+                and _terminates(s.body):
+            return True
+    return False
+
+
+def _proto_tree(ctx: FileContext, node: ast.AST, aliases: set[str],
+                sources: dict[str, str]) -> list:
+    """The function body as a structured event tree — the ISSUE-18
+    ``proto`` fact. Node shapes (JSON lists, cache-stable):
+
+    * ``["coll", op, canon, line, core]`` — a lexical collective call
+      (``core`` 1 for the TPM11xx alphabet, 0 for broadcast-class
+      replication points, which TPM1101 deliberately cannot see);
+    * ``["span", op|None, axis|None, line]`` — a telemetry span
+      emitter: the runtime event a ``kind:"span"`` record witnesses
+      (``op None`` = dynamically named);
+    * ``["call", canon, line]`` — a resolvable outgoing call;
+    * ``["loop", line, rank, taints, body]`` — ``for``/``while`` with
+      the bound's rank-dependence (lexical bit + taint candidates);
+    * ``["alt", line, col, rank, taints, then, orelse]`` — a branch;
+    * ``["try", line, body, [[terminates, handler_body], ...]]``;
+    * ``["exit", line]`` — return/raise/break/continue.
+    """
+
+    def classify(call: ast.Call) -> list | None:
+        last = last_attr(call.func)
+        canon = canon_target(ctx, call.func) or ""
+        if last in SPAN_EMITTERS and canon.startswith("tpu_mpi_tests"):
+            axis = None
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    axis = _const_str(kw.value)
+            op = _const_str(call.args[0]) if call.args else None
+            return ["span", op, axis, call.lineno]
+        if last == "call" and len(call.args) >= 2 \
+                and _const_str(call.args[0]) is not None:
+            # DispatchWindow.call(op, fn, *args): dispatch + drain emit
+            # spans under that constant op name
+            return ["span", _const_str(call.args[0]), None, call.lineno]
+        if _is_collective(canon, last):
+            return ["coll", last, canon, call.lineno, 1]
+        if canon and last in BROADCAST_CALLS \
+                and canon.startswith(COLLECTIVE_ORIGINS):
+            return ["coll", last, canon, call.lineno, 0]
+        if canon:
+            return ["call", canon, call.lineno]
+        return None
+
+    def expr_events(expr: ast.AST | None) -> list:
+        if expr is None:
+            return []
+        out = []
+        for n in _unit_nodes(expr):
+            if isinstance(n, ast.Call):
+                ev = classify(n)
+                if ev is not None:
+                    out.append(ev)
+        return out
+
+    def loop_node(s, bound: ast.AST, body: list[ast.stmt]) -> list:
+        rk = 1 if _rank_dependent(bound, aliases) else 0
+        taints = [] if rk else _test_taints(ctx, bound, sources)
+        return ["loop", s.lineno, rk, taints, walk(body)]
+
+    def walk(stmts: list[ast.stmt]) -> list:
+        out: list = []
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            if isinstance(s, ast.If):
+                out.extend(expr_events(s.test))
+                rk = 1 if _rank_dependent(s.test, aliases) else 0
+                taints = [] if rk else _test_taints(ctx, s.test, sources)
+                out.append(["alt", s.lineno, s.col_offset, rk, taints,
+                            walk(s.body), walk(s.orelse)])
+            elif isinstance(s, (ast.For, ast.AsyncFor)):
+                out.extend(expr_events(s.iter))
+                out.append(loop_node(s, s.iter, s.body))
+                out.extend(walk(s.orelse))
+            elif isinstance(s, ast.While):
+                out.extend(expr_events(s.test))
+                out.append(loop_node(s, s.test, s.body))
+                out.extend(walk(s.orelse))
+            elif isinstance(s, (ast.With, ast.AsyncWith)):
+                for item in s.items:
+                    out.extend(expr_events(item.context_expr))
+                out.extend(walk(s.body))
+            elif isinstance(s, ast.Try):
+                body = walk(s.body) + walk(s.orelse)
+                handlers = [
+                    [1 if _terminates(h.body) else 0, walk(h.body)]
+                    for h in s.handlers
+                ]
+                out.append(["try", s.lineno, body, handlers])
+                out.extend(walk(s.finalbody))
+            elif isinstance(s, (ast.Return, ast.Raise)):
+                out.extend(expr_events(getattr(s, "value", None)
+                                       or getattr(s, "exc", None)))
+                out.append(["exit", s.lineno])
+            elif isinstance(s, (ast.Break, ast.Continue)):
+                out.append(["exit", s.lineno])
+            else:
+                out.extend(expr_events(s))
+        return out
+
+    return walk(list(getattr(node, "body", [])))
+
+
 def _path_events(ctx: FileContext, graph: cfg_mod.CFG,
                  entry: cfg_mod.Block) -> list:
     """Ordered ``["coll", op]`` / ``["call", target]`` events along the
@@ -672,6 +878,7 @@ def _function_facts(ctx: FileContext, qual: str, node: ast.AST,
                     graph: cfg_mod.CFG | None = None) -> dict:
     params = [a.arg for a in (node.args.posonlyargs + node.args.args)]
     pidx = {p: i for i, p in enumerate(params)}
+    aliases = _rank_aliases(node)
     dispatches = syncs = returns_handle = False
     events: list = []
     forwards: list = []
@@ -746,6 +953,12 @@ def _function_facts(ctx: FileContext, qual: str, node: ast.AST,
         # the TPM802 candidates (a name loaded ANYWHERE in the def,
         # nested closures included, counts as consumed)
         "handle_drops": [a for a in assigned_calls if a[0] not in loads],
+        # ISSUE 18: the structured event tree (loops, branches, try
+        # blocks, span emitters) the protocol layer compiles into the
+        # schedule automaton, plus the return-value rank taint bit
+        "proto": _proto_tree(ctx, node, aliases,
+                             _taint_sources(ctx, node)),
+        "rank_ret": _returns_rank(node, aliases),
     }
 
 

@@ -8,9 +8,10 @@ serialized facts (``analysis/program.py``) — the ISSUE-10 families
 (TPM11xx/TPM12xx), the interprocedural upgrades (TPM102/TPM502/
 TPM802), the ISSUE-12 flow-sensitive families (TPM1102 early-exit
 divergence, TPM1301 broadcast-consistency, TPM14xx record-contract),
-and the ISSUE-13 lockset concurrency layer (TPM16xx races/deadlocks/
-hook-slot rebinds, with TPM601 demoted to its single-file fallback)
-all live there.
+the ISSUE-13 lockset concurrency layer (TPM16xx races/deadlocks/
+hook-slot rebinds, with TPM601 demoted to its single-file fallback),
+and the ISSUE-18 collective-protocol verifier (TPM17xx whole-program
+schedule automata + ``--conform`` runtime conformance) all live there.
 """
 
 from tpu_mpi_tests.analysis.rules.axis_consistency import (
@@ -43,6 +44,9 @@ from tpu_mpi_tests.analysis.rules.record_contract import (
 from tpu_mpi_tests.analysis.rules.schedule_constants import (
     ScheduleConstants,
 )
+from tpu_mpi_tests.analysis.rules.schedule_protocol import (
+    ScheduleProtocol,
+)
 from tpu_mpi_tests.analysis.rules.sync_honesty import (
     InterprocSyncHonesty,
     SyncHonesty,
@@ -69,4 +73,5 @@ ALL_RULES = [
     DonationSafety(),
     BroadcastConsistency(),
     RecordContract(),
+    ScheduleProtocol(),
 ]

@@ -1072,6 +1072,54 @@ def format_finding(f: dict) -> str:
     return " ".join(parts) + f" — {f['detail']}"
 
 
+def _attach_protocol_evidence(findings: list[dict],
+                              streams: list[_Stream],
+                              cache_arg: str) -> None:
+    """ISSUE 18 (``--protocol-model``): for each missing_rank/straggler
+    conviction, replay the convicted rank's span stream through the
+    schedule automaton rebuilt from tpumt-lint's analysis cache and
+    append the statically-expected next collective as one more evidence
+    line. Strictly additive and best-effort by contract: a cold/absent
+    cache, a pre-seq stream, or a stream outside the model changes
+    nothing, and the analysis package (itself stdlib-only) is imported
+    lazily only under the flag — without it the doctor's output is
+    byte-identical."""
+    try:
+        from tpu_mpi_tests.analysis.lintcache import default_cache_path
+        from tpu_mpi_tests.analysis.protocol import (
+            automaton_from_cache,
+            expected_after,
+        )
+
+        auto = automaton_from_cache(cache_arg or default_cache_path())
+    except Exception:
+        return
+    if auto is None:
+        return
+    by_rank = {s.rank: s for s in streams}
+    for f in findings:
+        if f["class"] not in ("missing_rank", "straggler"):
+            continue
+        s = by_rank.get(f["rank"])
+        if s is None:
+            continue
+        try:
+            sibs = [load_with_lines(o.path) for o in streams
+                    if o.rank != f["rank"]]
+            model = expected_after(load_with_lines(s.path), auto, sibs)
+        except Exception:
+            continue
+        if not model:
+            continue
+        f.setdefault("evidence", []).append(
+            f"protocol-model: after {model['matched']} matched span(s) "
+            f"the schedule automaton expects "
+            f"{', '.join(model['expected'])} next from rank "
+            f"{f['rank']} ({model['states']} automaton state(s); "
+            f"source: tpumt-lint analysis cache)"
+        )
+
+
 def _print_findings(findings: list[dict], streams: list[_Stream],
                     as_json: bool, files: list[str]) -> None:
     if as_json:
@@ -1301,6 +1349,16 @@ def main(argv: list[str] | None = None) -> int:
         "run",
     )
     p.add_argument(
+        "--protocol-model", nargs="?", const="", default=None,
+        metavar="CACHE",
+        help="cite the statically-expected next collective for each "
+        "missing_rank/straggler rank, replayed from tpumt-lint's "
+        "analysis cache (optional cache path; default "
+        "~/.cache/tpumt/lint.json or $TPU_MPI_LINT_CACHE). Purely "
+        "additive evidence — a cold cache or pre-seq stream changes "
+        "nothing, and without the flag output is byte-identical",
+    )
+    p.add_argument(
         "--expect", default=None, metavar="CLASS:RANK",
         help="CI contract mode: exit 0 iff the diagnosis is EXACTLY "
         "one finding of CLASS convicting RANK (e.g. --expect "
@@ -1334,6 +1392,9 @@ def main(argv: list[str] | None = None) -> int:
         streams, ctx, skew_threshold=args.skew_threshold,
         gap_s=args.gap_s,
     )
+    if args.protocol_model is not None:
+        _attach_protocol_evidence(findings, streams,
+                                  args.protocol_model)
     _print_findings(findings, streams, args.json, files)
     if expect is not None:
         return _expect_verdict(findings, expect, args.json)

@@ -151,7 +151,10 @@ def clock_sync_record(rounds: int = 5) -> dict:
     }
     if jax.process_count() <= 1:
         return rec
-    try:
+    # The only raisers below are environmental (import/backend),
+    # identical on every rank, so the handler's skip is symmetric —
+    # not a partner mismatch.
+    try:  # tpumt: ignore[TPM1703] — never-raises contract (docstring)
         import numpy as np
         from jax.experimental import multihost_utils
 
