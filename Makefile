@@ -1,8 +1,8 @@
 # Dev workflow (≅ the reference's root Makefile role).
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
-	serve-smoke replay-smoke overlap-smoke moe-smoke chaos-smoke \
-	anatomy-smoke live-smoke fleet-smoke lint lint-smoke \
+	serve-smoke replay-smoke overlap-smoke moe-smoke decode-smoke \
+	chaos-smoke anatomy-smoke live-smoke fleet-smoke lint lint-smoke \
 	protocol-smoke records records-check ci clean
 
 test:
@@ -400,6 +400,89 @@ moe-smoke:
 	grep -q 'decode:allreduce:1x16:us_per_op.*REGRESSION' \
 		/tmp/_tpumt_moe_smoke.diff.txt
 	@echo "moe-smoke OK: route + decode rows + ROUTE table + diff gate"
+
+# decode-tier smoke (ISSUE 19): the fixed-cost collective tier, end to
+# end on 2 fake CPU devices (the Pallas kernels execute in interpret
+# mode on this backend). Leg 1 — the sweeper prices the tier: a --tune
+# collbench run at a decode-class payload (1 KiB/shard) must record
+# per-candidate tune records with the one-shot tier MEASURED (its
+# pad-to-tile wrapper prices at every payload, where the rdma twin
+# records its lane-floor error), persist the winner, and a re-run must
+# resolve as a PURE cache hit (tune_hit records only). Leg 2 — the
+# decode rows consume the SAME schedule: a decode run over the same
+# payload must stamp the cached winner into its DECODE [variant] rows
+# and records, and tpumt-report must render the DECODE table. Leg 3 —
+# --diff must gate a degraded copy (10x us/op) with exit 1 naming the
+# decode series.
+decode-smoke:
+	rm -f /tmp/_tpumt_dec_smoke*
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.collbench \
+		--fake-devices 2 --collectives auto --sizes-kib 1 \
+		--n-iter 20 --tune \
+		--tune-cache /tmp/_tpumt_dec_smoke.cache.json \
+		--jsonl /tmp/_tpumt_dec_smoke.sweep.jsonl
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_dec_smoke.sweep.jsonl')]; \
+		tune = [r for r in recs if r.get('kind') == 'tune' \
+			and r.get('knob') == 'coll_variant/allreduce']; \
+		cands = {t['candidate'] for t in tune}; \
+		assert cands == {'xla', 'rdma', 'oneshot'}, cands; \
+		one = [t for t in tune if t['candidate'] == 'oneshot']; \
+		assert one and all('seconds' in t for t in one), one; \
+		res = [r for r in recs if r.get('kind') == 'tune_result' \
+			and r.get('knob') == 'coll_variant/allreduce']; \
+		assert len(res) == 1, res; \
+		d = json.load(open('/tmp/_tpumt_dec_smoke.cache.json')); \
+		assert d['entries'], 'empty cache'; \
+		print('decode-smoke sweep OK: oneshot priced, winner', \
+			res[0]['value'])"
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.drivers.collbench \
+		--fake-devices 2 --collectives auto --sizes-kib 1 \
+		--n-iter 20 --tune \
+		--tune-cache /tmp/_tpumt_dec_smoke.cache.json \
+		--jsonl /tmp/_tpumt_dec_smoke.hit.jsonl
+	python -c "import json; \
+		kinds = [json.loads(l).get('kind') for l in \
+			open('/tmp/_tpumt_dec_smoke.hit.jsonl')]; \
+		assert 'tune_hit' in kinds, kinds; \
+		assert 'tune' not in kinds and 'tune_result' not in kinds, kinds; \
+		print('decode-smoke cache-hit OK')"
+	env JAX_PLATFORMS=cpu python -m tpu_mpi_tests.workloads.decode \
+		--fake-devices 2 --batches 16 --heads 16 --n-iter 100 \
+		--colls allreduce \
+		--tune-cache /tmp/_tpumt_dec_smoke.cache.json \
+		--jsonl /tmp/_tpumt_dec_smoke.dec.jsonl
+	python -c "import json; \
+		sweep = [json.loads(l) for l in \
+			open('/tmp/_tpumt_dec_smoke.sweep.jsonl')]; \
+		win = [r for r in sweep if r.get('kind') == 'tune_result' \
+			and r.get('knob') == 'coll_variant/allreduce'][0]['value']; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_dec_smoke.dec.jsonl')]; \
+		dec = [r for r in recs if r.get('kind') == 'decode']; \
+		assert len(dec) == 1, dec; \
+		assert dec[0]['variant'] == win, (dec[0]['variant'], win); \
+		assert dec[0]['shard_bytes'] == 1024, dec; \
+		print('decode-smoke rows OK: DECODE stamped with the swept', \
+			win, 'schedule')"
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_dec_smoke.dec.jsonl > /tmp/_tpumt_dec_smoke.report.txt
+	grep -q '^DECODE allreduce:16x16: ' /tmp/_tpumt_dec_smoke.report.txt
+	python -c "import json; \
+		recs = [json.loads(l) for l in \
+			open('/tmp/_tpumt_dec_smoke.dec.jsonl')]; \
+		f = open('/tmp/_tpumt_dec_smoke.bad.jsonl', 'w'); \
+		[f.write(json.dumps({**r, **({'us_per_op': r['us_per_op'] * 10} \
+			if r.get('kind') == 'decode' else {})}) + chr(10)) \
+			for r in recs]; \
+		f.close()"
+	python -m tpu_mpi_tests.instrument.aggregate --diff \
+		/tmp/_tpumt_dec_smoke.dec.jsonl /tmp/_tpumt_dec_smoke.bad.jsonl \
+		> /tmp/_tpumt_dec_smoke.diff.txt; test $$? -eq 1
+	grep -q 'decode:allreduce:16x16:us_per_op.*REGRESSION' \
+		/tmp/_tpumt_dec_smoke.diff.txt
+	@echo "decode-smoke OK: sweep prices the one-shot tier + DECODE rows carry the winner + cache hit + diff gate"
 
 # chaos-verified diagnosis smoke (README "Chaos & diagnosis"): inject
 # every fault class — kill, straggler, wedge, OOM ramp, serve flood —
@@ -1028,16 +1111,17 @@ protocol-smoke:
 # CI umbrella: the tier-1 gate, the timeline-pipeline smoke, the
 # autotuner sweep→persist→cache-hit smoke, the memory/compile
 # observability smoke, the serving-pipeline smoke, the overlap-engine
-# smoke, the workload-spec pillar smoke, the chaos-verified diagnosis
-# smoke, the live-observability smoke (OpenMetrics endpoint + online
-# doctor), the fleet-tuning smoke (rank-0 2-process sweep + pack
-# round-trip + closed-loop retune), the lint self-clean gate, the
-# lint-cache incrementality + engine-salt smoke, the collective-
-# protocol smoke (schedule-automaton mutation gates + static↔runtime
-# conformance), and the RECORDS.md staleness gate
+# smoke, the workload-spec pillar smoke, the decode-tier smoke (one-
+# shot collective sweep → DECODE consumption → diff gate), the chaos-
+# verified diagnosis smoke, the live-observability smoke (OpenMetrics
+# endpoint + online doctor), the fleet-tuning smoke (rank-0 2-process
+# sweep + pack round-trip + closed-loop retune), the lint self-clean
+# gate, the lint-cache incrementality + engine-salt smoke, the
+# collective-protocol smoke (schedule-automaton mutation gates +
+# static↔runtime conformance), and the RECORDS.md staleness gate
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke replay-smoke \
-	overlap-smoke moe-smoke chaos-smoke anatomy-smoke live-smoke \
-	fleet-smoke lint lint-smoke protocol-smoke records-check
+	overlap-smoke moe-smoke decode-smoke chaos-smoke anatomy-smoke \
+	live-smoke fleet-smoke lint lint-smoke protocol-smoke records-check
 
 clean:
 	$(MAKE) -C native clean

@@ -108,13 +108,11 @@ def _resolve_tier(env_val: "str | None", dtype_name: str, *, n: int,
         )
     if platform != "tpu":
         if env_val is not None and env_val != "xla":
-            import sys
+            from tpu_mpi_tests.drivers._common import decline_note
 
-            print(
-                f"NOTE TPU_MPI_BENCH_TIER={env_val} not applicable "
-                f"(platform={platform}); running the xla tier",
-                file=sys.stderr,
-                flush=True,
+            decline_note(
+                f"TPU_MPI_BENCH_TIER={env_val} not applicable "
+                f"(platform={platform}); running the xla tier"
             )
         return "xla"
     return resolve_stencil_tier(
@@ -191,14 +189,12 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
         # never silently mis-attribute a schedule: a requested block count
         # that fails the gate is reported (stderr — stdout stays the one
         # JSON line) and the JSON records the schedule that actually ran
-        import sys
+        from tpu_mpi_tests.drivers._common import decline_note
 
-        print(
-            f"NOTE TPU_MPI_BENCH_BLOCKS={n_blocks} not applicable "
+        decline_note(
+            f"TPU_MPI_BENCH_BLOCKS={n_blocks} not applicable "
             f"(platform={topo.platform} world={world} steps={steps} "
-            f"n={n}); running the dim-1 single-buffer schedule",
-            file=sys.stderr,
-            flush=True,
+            f"n={n}); running the dim-1 single-buffer schedule"
         )
     bench_dim = 0 if (use_blocks or tier == "rdma-fused") else 1
     d = Domain2D(
@@ -223,15 +219,13 @@ def _build_schedule(dtype_name: str, *, n, steps, world, mesh, axis_name,
     ):
         ov_eff = 2
     elif ov_depth >= 2:
-        import sys
+        from tpu_mpi_tests.drivers._common import decline_note
 
-        print(
-            f"NOTE overlap depth {ov_depth} not applicable "
+        decline_note(
+            f"overlap depth {ov_depth} not applicable "
             f"(platform={topo.platform} steps={steps} "
             f"blocks={n_blocks} tier={tier}); running the serialized "
-            f"schedule (_ov1)",
-            file=sys.stderr,
-            flush=True,
+            f"schedule (_ov1)"
         )
     if use_blocks:
         from tpu_mpi_tests.comm.halo import (
@@ -400,13 +394,11 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         # a cached/requested tier infeasible at THIS geometry (e.g. the
         # fused tier's seam blocking) degrades to the prior tier with a
         # visible NOTE — never a dead headline, never a mislabeled one
-        import sys
+        from tpu_mpi_tests.drivers._common import decline_note
 
-        print(
-            f"NOTE tier {tier} infeasible at n={n} world={world} "
-            f"steps={steps} ({e}); running the blocks tier",
-            file=sys.stderr,
-            flush=True,
+        decline_note(
+            f"tier {tier} infeasible at n={n} world={world} "
+            f"steps={steps} ({e}); running the blocks tier"
         )
         run, zg, use_blocks, ov_eff, bench_dim, tier = _build_schedule(
             dtype_name, n=n, steps=steps, world=world, mesh=mesh,
@@ -573,13 +565,11 @@ def main() -> None:
     if second_dtype == dtype_name:
         # explicit-but-redundant request: say so rather than silently
         # dropping the sub-object (stdout stays the one JSON line)
-        import sys
+        from tpu_mpi_tests.drivers._common import decline_note
 
-        print(
-            f"NOTE TPU_MPI_BENCH_SECOND_DTYPE={second!r} equals the "
-            "primary dtype; no second measurement",
-            file=sys.stderr,
-            flush=True,
+        decline_note(
+            f"TPU_MPI_BENCH_SECOND_DTYPE={second!r} equals the "
+            "primary dtype; no second measurement"
         )
     elif second_dtype:
         # same process, back-to-back → same contention window as the

@@ -98,6 +98,74 @@ def test_replicate(mesh8):
     assert all(s.data.shape == (10,) for s in x.addressable_shards)
 
 
+class TestOneshotTier:
+    """ISSUE 19 fixed-cost tier: ONE in-kernel all-to-all DMA burst per
+    collective (``kernels/collectives_pallas.py``). Honesty gates are
+    BITWISE: the gather must replicate the sharded input exactly, and
+    the reduce's fold order is fixed (ascending source rank), so every
+    rank must equal ``reduce(np.add, rows)`` bit for bit."""
+
+    def test_gather_bitwise_and_replicated(self, mesh8):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal(4096).astype(np.float32))
+        g = C.all_gather_oneshot(C.shard_1d(x, mesh8), mesh8)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(x))
+        # replicated: every device holds the full array
+        assert all(
+            s.data.shape == (4096,) for s in g.addressable_shards
+        )
+
+    def test_gather_decode_payload_below_ring_floor(self, mesh8):
+        # 8 f32 per shard (32 B): far below the ring tier's lane floor —
+        # the pad-to-tile wrapper is what admits the decode regime
+        x = jnp.arange(64.0, dtype=jnp.float32)
+        g = C.all_gather_oneshot(C.shard_1d(x, mesh8), mesh8)
+        np.testing.assert_array_equal(
+            np.asarray(g), np.arange(64.0, dtype=np.float32)
+        )
+
+    def test_gather_2d_rows(self, mesh8):
+        z = jnp.asarray(
+            np.random.default_rng(5)
+            .standard_normal((64, 3))
+            .astype(np.float32)
+        )
+        g = C.all_gather_oneshot(C.shard_1d(z, mesh8), mesh8)
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(z))
+
+    def test_reduce_bitwise_matches_fixed_fold(self, mesh8):
+        import functools as ft
+
+        rng = np.random.default_rng(7)
+        per_rank = rng.standard_normal((8, 1024)).astype(np.float32)
+        out = C.allreduce_oneshot(
+            C.shard_1d(jnp.asarray(per_rank), mesh8), mesh8
+        )
+        # the pinned sum order: ascending source rank, rank-independent
+        want = ft.reduce(np.add, [per_rank[r] for r in range(8)])
+        got = np.asarray(out)
+        assert got.shape == (8, 1024)
+        for row in got:
+            np.testing.assert_array_equal(row, want)
+
+    def test_reduce_decode_payload(self, mesh8):
+        # the tier's target regime: a (8, 4) f32 payload — 16 B rows
+        import functools as ft
+
+        per_rank = (np.arange(32, dtype=np.float32).reshape(8, 4)
+                    % 13) - 5
+        out = C.allreduce_oneshot(
+            C.shard_1d(jnp.asarray(per_rank), mesh8), mesh8
+        )
+        want = ft.reduce(np.add, [per_rank[r] for r in range(8)])
+        for row in np.asarray(out):
+            np.testing.assert_array_equal(row, want)
+
+    def test_reduce_wrong_shape_raises(self, mesh8):
+        with pytest.raises(ValueError, match="n_ranks=8"):
+            C.allreduce_oneshot(jnp.ones((4, 64), jnp.float32), mesh8)
+
+
 class TestReduceScatter:
     def test_rank_r_gets_chunk_r_of_sum(self, mesh8):
         per_rank = (np.arange(8 * 64, dtype=np.float32).reshape(8, 64)

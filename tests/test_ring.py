@@ -305,6 +305,110 @@ def test_ring_flash_attention_matches_full(mesh8, causal):
     assert np.allclose(got, ref, atol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "causal,stripe", [(False, False), (True, False), (True, True)]
+)
+def test_ring_fused_tier_matches_reference(mesh8, causal, stripe):
+    """ISSUE 19 tentpole b: the one-launch fused-RDMA rotation tier
+    (``tier="fused"`` — in-kernel K/V rotation overlapped with the
+    block matmul) matches the exact reference AND the pipelined tier at
+    every layout; swapping the rotation schedule never moves the
+    numerics beyond kernel-order rounding."""
+    rng = np.random.default_rng(11)
+    L, d = 8 * 16, 32
+    q, k, v = (rng.normal(size=(L, d)).astype(np.float32)
+               for _ in range(3))
+    ref = reference_attention(
+        q.astype(np.float64), k.astype(np.float64),
+        v.astype(np.float64), causal=causal,
+    )
+    if stripe:  # inputs AND outputs live in the striped layout
+        q, k, v = (R.to_striped(t, 8) for t in (q, k, v))
+
+    def run(tier):
+        attn = R.ring_attention_fn(
+            mesh8, "shard", causal=causal, stripe=stripe, tier=tier
+        )
+        out = np.asarray(
+            attn(
+                shard_1d(jnp.asarray(q), mesh8),
+                shard_1d(jnp.asarray(k), mesh8),
+                shard_1d(jnp.asarray(v), mesh8),
+            )
+        )
+        return np.asarray(R.from_striped(jnp.asarray(out), 8)) \
+            if stripe else out
+
+    fused = run("fused")
+    assert np.isfinite(fused).all()
+    assert np.allclose(fused, ref, atol=2e-5)
+    # tier-swap gate: fused vs pipelined agree to kernel-order rounding
+    # (bitwise on this interpret-mode CPU config)
+    np.testing.assert_allclose(fused, run("pipelined"), atol=1e-5)
+
+
+def test_ring_fused_tier_infeasible_raises(mesh8):
+    """An EXPLICIT fused request at a geometry whose live block set
+    exceeds VMEM is a loud error naming the pipelined escape hatch —
+    only a cached winner degrades silently (``ring_attention``)."""
+    from tpu_mpi_tests.kernels.collectives_pallas import (
+        fused_ring_feasible,
+    )
+
+    assert not fused_ring_feasible(2048, 2048, 256, np.float32)
+    big = jnp.zeros((8 * 2048, 256), jnp.float32)
+    attn = R.ring_attention_fn(mesh8, "shard", tier="fused")
+    with pytest.raises(ValueError, match="pipelined"):
+        attn(
+            shard_1d(big, mesh8), shard_1d(big, mesh8),
+            shard_1d(big, mesh8),
+        )
+
+
+def test_ring_fused_tier_cached_winner_degrades_at_infeasible(
+    mesh8, tmp_path
+):
+    """A cached fused winner traveling to an infeasible geometry
+    degrades to the pipelined schedule instead of crashing: the result
+    must be byte-identical to an explicit pipelined run."""
+    from tpu_mpi_tests.tune import registry as tr
+    from tpu_mpi_tests.tune.fingerprint import fingerprint
+
+    lq, d = 2048, 256  # feasibility: lq*lk score block alone > 14 MiB
+    from tpu_mpi_tests.kernels.collectives_pallas import (
+        fused_ring_feasible,
+    )
+
+    assert not fused_ring_feasible(lq, lq, d, np.float32)
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    try:
+        tr.configured_cache().store(
+            "ring/tier", fingerprint(dtype="float32", lq=lq), "fused"
+        )
+        rng = np.random.default_rng(13)
+        q, k, v = (
+            jnp.asarray(
+                rng.normal(size=(8 * lq, d)).astype(np.float32)
+            )
+            for _ in range(3)
+        )
+        got = np.asarray(
+            R.ring_attention_fn(mesh8, "shard")(
+                shard_1d(q, mesh8), shard_1d(k, mesh8),
+                shard_1d(v, mesh8),
+            )
+        )
+        want = np.asarray(
+            R.ring_attention_fn(mesh8, "shard", tier="pipelined")(
+                shard_1d(q, mesh8), shard_1d(k, mesh8),
+                shard_1d(v, mesh8),
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+    finally:
+        tr.deconfigure()
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_flash_attention_matches_full(mesh8, causal):
     """Ulysses with the per-head Pallas flash local kernel == exact

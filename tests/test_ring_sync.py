@@ -51,6 +51,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.pallas import tpu as pltpu
 
 from tpu_mpi_tests.comm import collectives as C
+from tpu_mpi_tests.kernels import collectives_pallas as CP
 from tpu_mpi_tests.kernels import pallas_kernels as PK
 
 # happens-before analysis is interleaving-independent, so one schedule
@@ -304,6 +305,159 @@ def test_fused_rdma_executes_race_free(w):
     got = np.asarray(fused(C.shard_1d(jnp.asarray(zg), mesh, axis=0), 2))
     assert np.array_equal(got, want)
     assert not _races().races_found
+
+
+@pytest.mark.parametrize("op", ["gather", "sum"])
+@pytest.mark.parametrize("w", [4, 8])
+def test_oneshot_collective_executes_race_free(w, op):
+    """ISSUE 19: the one-shot in-kernel burst (every rank fires w−1
+    remote copies into per-source comm slots in one launch) under the
+    threaded simulator — the entry barrier plus the counting recv-sem
+    wait are the happens-before edges between each arrival and the
+    combine read. Exact against the fixed ascending-src fold / the
+    sharded input, and the vector-clock detector must stay clean."""
+    _reset_sim()
+    mesh = _mesh(w)
+    rows = 8  # one f32 sublane tile per shard
+    per_rank = (
+        np.arange(w * rows * 8, dtype=np.float32).reshape(w, rows, 8)
+        % 41
+    ) - 20.0
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def run(x):
+        if op == "gather":
+            return CP.oneshot_allgather_pallas(
+                x[0], axis_name="shard", interpret=SIM
+            ).reshape(x.shape[1:])[None]
+        return CP.oneshot_allreduce_pallas(
+            x[0].reshape(-1), axis_name="shard", interpret=SIM
+        ).reshape(x.shape[1:])[None]
+
+    got = np.asarray(run(C.shard_1d(jnp.asarray(per_rank), mesh)))
+    if op == "gather":
+        # every rank holds the full concatenation; shard r of the
+        # (w, rows, 8) output is the gathered array's slice r
+        want = per_rank.reshape(w * rows, 8).reshape(w, rows, 8)
+    else:
+        acc = per_rank[0].reshape(-1)
+        for r in range(1, w):  # the pinned ascending-src fold order
+            acc = acc + per_rank[r].reshape(-1)
+        want = np.broadcast_to(acc.reshape(rows, 8), (w, rows, 8))
+    assert np.array_equal(got, want)
+    assert not _races().races_found
+
+
+def test_oneshot_without_recv_wait_races():
+    """Negative control: with the recv-semaphore waits removed
+    (``unsafe_no_recv_wait=True``) the combine reads the comm slots
+    with no happens-before edge to the peers' remote writes — the
+    detector MUST report it (the gather's comm→out copy exists
+    precisely so the skipped wait is an in-kernel RAW hazard, not an
+    invisible one)."""
+    _reset_sim()
+    w = 8
+    mesh = _mesh(w)
+    x = np.ones((w, 8, 8), np.float32)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("shard"), out_specs=P("shard"),
+        check_vma=False,
+    )
+    def ag(x):
+        return CP.oneshot_allgather_pallas(
+            x[0], axis_name="shard", interpret=SIM,
+            unsafe_no_recv_wait=True,
+        ).reshape(x.shape[1:])[None]
+
+    out = np.asarray(ag(C.shard_1d(jnp.asarray(x), mesh)))
+    assert out.shape == x.shape  # value undefined under a race
+    assert _races().races_found, (
+        "recv-wait-off run reported no race: either the simulator "
+        "stopped modeling remote-DMA ordering or the one-shot combine "
+        "no longer reads the peer landing slots"
+    )
+    _reset_sim()  # don't leak the intentional race into later asserts
+
+
+@pytest.mark.parametrize("w", [4, 8])
+def test_fused_ring_attention_executes_race_free(w):
+    """ISSUE 19 tentpole b: the one-launch fused-RDMA ring attention
+    under the threaded simulator — each step's K/V RDMA is genuinely in
+    flight under the previous block's matmul, the per-parity recv waits
+    and the credit handshake are the happens-before edges, and the
+    detector must stay clean. Exact against the serial-interpret run of
+    the SAME kernel (identical fold order → bitwise)."""
+    _reset_sim()
+    mesh = _mesh(w)
+    lq, d = 16, 16
+    rng = np.random.default_rng(19)
+    q, k, v = (
+        rng.normal(size=(w * lq, d)).astype(np.float32)
+        for _ in range(3)
+    )
+
+    def fn(interp):
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P("shard", None),
+            out_specs=P("shard", None), check_vma=False,
+        )
+        def attn(q, k, v):
+            return CP.fused_ring_attention_pallas(
+                q, k, v, axis_name="shard", interpret=interp
+            )
+
+        return attn
+
+    args = tuple(
+        C.shard_1d(jnp.asarray(t), mesh) for t in (q, k, v)
+    )
+    want = np.asarray(fn(True)(*args))  # serial interpret: no threads
+    _reset_sim()
+    got = np.asarray(fn(SIM)(*args))
+    assert np.array_equal(got, want)
+    assert not _races().races_found
+
+
+def test_fused_ring_attention_without_credits_races():
+    """Negative control: with the credit handshake disabled
+    (``unsafe_no_credits=True``) a fast sender's step-s RDMA can land
+    in the parity slot the receiver is still staging from (run-ahead
+    ≥ 2 on one of two slots) with no happens-before edge — the
+    detector MUST report it. w=8 gives the ring enough run-ahead for
+    the two-slot reuse to occur at every interleaving."""
+    _reset_sim()
+    w = 8
+    mesh = _mesh(w)
+    lq, d = 16, 16
+    z = np.ones((w * lq, d), np.float32)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P("shard", None),
+        out_specs=P("shard", None), check_vma=False,
+    )
+    def attn(q, k, v):
+        return CP.fused_ring_attention_pallas(
+            q, k, v, axis_name="shard", interpret=SIM,
+            unsafe_no_credits=True,
+        )
+
+    zs = C.shard_1d(jnp.asarray(z), mesh)
+    out = np.asarray(attn(zs, zs, zs))
+    assert out.shape == z.shape  # value undefined under a race
+    assert _races().races_found, (
+        "credits-off run reported no race: either the simulator "
+        "stopped modeling remote-DMA ordering or the fused kernel no "
+        "longer reuses its two comm parity slots"
+    )
+    _reset_sim()  # don't leak the intentional race into later asserts
 
 
 def test_fused_rdma_without_seam_wait_races():

@@ -737,6 +737,85 @@ def test_stencil_tier_cached_winner_and_malformed_degrade(tmp_path):
     assert resolve_stencil_tier(None, **ctx) == "blocks"
 
 
+# ------------------------------------------------- ring/tier (ISSUE 19)
+
+
+def test_ring_tier_space_and_prior_parity():
+    """The K/V-rotation tier space is declared with the fused one-launch
+    kernel as a sweepable candidate, prior first; an unconfigured
+    registry resolves the shipped "pipelined" prior (pre-ISSUE-19
+    schedule, byte-identical) and explicit wins."""
+    from tpu_mpi_tests.comm.ring import _resolve_ring_tier
+
+    sp = tr.space("ring/tier")
+    assert sp.prior == priors.RING_TIER == "pipelined"
+    assert "fused" in sp.candidates
+    assert tr.configured_cache() is None
+    assert _resolve_ring_tier(None, dtype="float32", lq=16) == \
+        "pipelined"
+    # explicit wins
+    assert _resolve_ring_tier("fused", dtype="float32", lq=16) == \
+        "fused"
+
+
+def test_ring_tier_cached_winner_and_malformed_degrade(tmp_path):
+    from tpu_mpi_tests.comm.ring import _resolve_ring_tier
+
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    cache = tr.configured_cache()
+    ctx = dict(dtype="float32", lq=16)
+    cache.store("ring/tier", fingerprint(**ctx), "fused")
+    assert _resolve_ring_tier(None, **ctx) == "fused"
+    # a winner tuned at one geometry must not leak to another via the
+    # device-only slot (device_fallback=False — feasibility is
+    # lq/d/dtype-dependent)
+    assert _resolve_ring_tier(None, dtype="bfloat16", lq=16) == \
+        "pipelined"
+    # malformed cache value -> prior, never a crash
+    cache.store("ring/tier", fingerprint(**ctx), "warp-drive")
+    assert _resolve_ring_tier(None, **ctx) == "pipelined"
+
+
+def test_coll_variant_spaces_carry_oneshot_candidate():
+    """ISSUE 19 tentpole wiring contract: the one-shot in-kernel tier
+    enters the EXISTING ``coll_variant/*`` spaces as a candidate — the
+    prior stays "xla" (untuned runs unchanged), and the PR-4/14
+    sweeper/serve machinery picks it up with zero new wiring."""
+    from tpu_mpi_tests.drivers import collbench  # noqa: F401 declares
+
+    for coll in ("allgather", "allreduce"):
+        sp = tr.space(f"coll_variant/{coll}")
+        assert sp.prior == priors.COLL_VARIANT == "xla"
+        assert "oneshot" in sp.candidates
+        assert "rdma" in sp.candidates
+        assert sp.candidates[0] == "xla"
+
+
+def test_decode_serve_handler_hot_swaps_cached_oneshot(tmp_path, mesh8):
+    """ISSUE 19 satellite: a cached in-kernel ("oneshot") winner for a
+    decode-class payload is picked up by the decode serve handler with
+    zero new wiring — cached > prior through the SAME
+    ``coll_variant/allreduce`` resolution the DECODE rows consume — and
+    a malformed cache value degrades the rebuilt handler to the "xla"
+    prior instead of crashing the class."""
+    from tpu_mpi_tests.drivers import _common
+
+    tr.configure(cache_path=str(tmp_path / "t.json"))
+    cache = tr.configured_cache()
+    # decode class (batch=1, heads=8) f32 on world=8: 32 B per shard —
+    # below every ring floor; only the pad-to-tile one-shot tier admits it
+    ctx = dict(dtype="float32", bytes=32, world=8)
+    cache.store("coll_variant/allreduce", fingerprint(**ctx), "oneshot")
+    step = _common.workload_factory("decode")(mesh8, (1, 8), "float32")
+    assert step.tune_info["variant"] == "oneshot"
+    step(2)  # the in-kernel tier actually serves traffic
+    # malformed cache value: the rebuilt handler degrades to the prior
+    cache.store("coll_variant/allreduce", fingerprint(**ctx), "garbage")
+    rebuilt = step.tune_info["rebuild"]()
+    assert rebuilt.tune_info["variant"] == "xla"
+    rebuilt(2)
+
+
 def test_stencil_tier_sweep_visible_degrade(tmp_path):
     """The acceptance shape (ISSUE 15): the fused tier is MEASURED and
     honestly declined when slower — its seconds land in the tune

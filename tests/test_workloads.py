@@ -279,7 +279,7 @@ class TestDecodeSpec:
                                                   "float32")
         info = step.tune_info
         assert info["knob"] == "coll_variant/allreduce"
-        assert info["candidates"] == ("xla", "rdma")
+        assert info["candidates"] == ("xla", "rdma", "oneshot")
         assert info["ctx"]["world"] == 8
         rebuilt = info["rebuild"]("xla")
         rebuilt(2)  # a working, warmed handler

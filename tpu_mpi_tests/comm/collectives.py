@@ -402,6 +402,56 @@ def all_gather_rdma(x_sharded, mesh: Mesh, axis_name: str | None = None,
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _all_gather_oneshot_fn(mesh: Mesh, axis_name: str, ndim: int,
+                           interpret: bool | None):
+    from tpu_mpi_tests.kernels.collectives_pallas import (
+        oneshot_allgather_pallas,
+    )
+
+    spec = [None] * ndim
+    spec[0] = axis_name
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(*spec), out_specs=P(),
+        check_vma=False,
+    )
+    def gather(x):
+        return oneshot_allgather_pallas(
+            x, axis_name=axis_name, interpret=interpret
+        )
+
+    return gather
+
+
+def all_gather_oneshot(x_sharded, mesh: Mesh,
+                       axis_name: str | None = None,
+                       interpret: bool | None = None):
+    """Fixed-cost tier ``all_gather`` (axis 0, tiled): ONE in-kernel
+    all-to-all DMA burst instead of the ring tier's w−1 dependent hops
+    (:func:`all_gather_rdma`) or the XLA tier's dispatch
+    (:func:`all_gather`) — the latency-optimal schedule for
+    decode-shape payloads, where every hop is pure fixed cost
+    (ISSUE 19; ``kernels/collectives_pallas.py``)."""
+    axis_name = axis_name or mesh.axis_names[0]
+    world = mesh.shape[axis_name]
+    from tpu_mpi_tests.instrument.watchdog import note_comm_op
+
+    note_comm_op(
+        f"oneshot_allgather_pallas(world={world}, "
+        f"shape={tuple(x_sharded.shape)})"
+    )
+    return span_call(
+        "all_gather_oneshot",
+        _all_gather_oneshot_fn(mesh, axis_name, x_sharded.ndim, interpret),
+        x_sharded,
+        nbytes=_gather_payload_bytes(x_sharded, world),
+        axis_name=axis_name,
+        world=world,
+    )
+
+
 def all_gather_inplace(allx_sharded, mesh: Mesh, axis_name: str | None = None,
                        axis: int = 0):
     """``MPI_Allgather(MPI_IN_PLACE)`` parity: input is the full-size global
@@ -559,6 +609,65 @@ def allreduce_rdma(per_rank, mesh: Mesh, axis_name: str | None = None,
         _allreduce_rdma_fn(mesh, axis_name, interpret, credits),
         per_rank,
         nbytes=2 * (n - 1) * row_bytes,
+        axis_name=axis_name,
+        world=n,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_oneshot_fn(mesh: Mesh, axis_name: str,
+                          interpret: bool | None):
+    from tpu_mpi_tests.kernels.collectives_pallas import (
+        oneshot_allreduce_pallas,
+    )
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(axis_name),
+        out_specs=P(axis_name), check_vma=False,
+    )
+    def reduce(x):
+        # shard is this logical rank's (1, L) row; the one-shot burst
+        # runs on the row
+        return oneshot_allreduce_pallas(
+            x[0], axis_name=axis_name, interpret=interpret
+        )[None]
+
+    return reduce
+
+
+def allreduce_oneshot(per_rank, mesh: Mesh, axis_name: str | None = None,
+                      interpret: bool | None = None):
+    """Fixed-cost tier :func:`allreduce_sum`: ONE in-kernel all-to-all
+    DMA burst + a local ascending-src-order fold instead of the rdma
+    ring's 2(w−1) dependent hops (:func:`allreduce_rdma`) — the
+    latency-optimal small-payload schedule (ISSUE 19). Same contract
+    (``(n_ranks, L)`` sharded on axis 0 → every row the elementwise
+    sum); NO alignment floor — shards are zero-padded to the DMA tile
+    in-kernel-wrapper (``kernels/collectives_pallas.py``), which is
+    what lets this tier reach the decode payloads the ring rejects.
+    The fold order is fixed and rank-independent, so the result is
+    bitwise ``reduce(add, rows)`` on every rank."""
+    axis_name = axis_name or mesh.axis_names[0]
+    n = mesh.shape[axis_name]
+    if per_rank.ndim != 2 or per_rank.shape[0] != n:
+        raise ValueError(
+            f"allreduce_oneshot: need shape (n_ranks={n}, L), got "
+            f"{per_rank.shape}"
+        )
+    from tpu_mpi_tests.instrument.watchdog import note_comm_op
+
+    note_comm_op(
+        f"oneshot_allreduce_pallas(world={n}, "
+        f"shape={tuple(per_rank.shape)})"
+    )
+    row_bytes = int(getattr(per_rank, "nbytes", 0)) // n
+    return span_call(
+        "allreduce_oneshot",
+        _allreduce_oneshot_fn(mesh, axis_name, interpret),
+        per_rank,
+        # one-shot payload: each rank ships its whole row to w−1 peers
+        nbytes=(n - 1) * row_bytes,
         axis_name=axis_name,
         world=n,
     )

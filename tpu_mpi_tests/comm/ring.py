@@ -214,6 +214,7 @@ FLASH_TILE_SPACES = {
 
 from tpu_mpi_tests.tune.priors import (  # noqa: E402
     RING_PIPELINE_DEPTH,
+    RING_TIER,
 )
 
 #: the ring K/V prefetch pipeline depth (ISSUE 7 tentpole b) — declared
@@ -224,6 +225,21 @@ RING_DEPTH_SPACE = declare_space(
     (RING_PIPELINE_DEPTH, 2, 4),
     describe="K/V rotations kept in flight ahead of the consuming "
              "matmul (1 = rotate after compute)",
+)
+
+#: the K/V rotation tier (ISSUE 19 tentpole b): "pipelined" — the
+#: host-scheduled ppermute ring above, paced by ring/pipeline_depth —
+#: is the prior; "fused" collapses the whole rotation+compute loop into
+#: one Pallas launch whose kernel fires the next step's RDMA before the
+#: current block's matmul (kernels/collectives_pallas.py). The fused
+#: tier only admits geometries whose live working set fits VMEM
+#: (``fused_ring_feasible``), so resolution degrades rather than crash
+#: when a cached winner travels to an infeasible shape.
+RING_TIER_SPACE = declare_space(
+    "ring/tier",
+    (RING_TIER, "fused"),
+    describe="K/V rotation schedule: host-pipelined ppermute ring vs "
+             "the one-launch fused-RDMA kernel",
 )
 
 
@@ -242,6 +258,23 @@ def _resolve_pipeline_depth(depth, dtype=None, lq=None) -> int:
         return max(1, int(tuned))
     except (TypeError, ValueError):
         return RING_PIPELINE_DEPTH
+
+
+def _resolve_ring_tier(tier, dtype=None, lq=None) -> str:
+    """Ring K/V rotation tier: explicit > cached winner > prior
+    ("pipelined"). Same context keys as the depth knob; a malformed
+    cache value degrades to the prior, matching every other resolver."""
+    if tier is not None:
+        return str(tier)
+    # geometry-keyed (feasibility depends on lq/d/dtype): a winner tuned
+    # at one shape must not leak to another via the device-only slot
+    tuned = _tune_resolve(
+        "ring/tier", prior=RING_TIER, device_fallback=False,
+        dtype=dtype, lq=lq,
+    )
+    if tuned not in ("pipelined", "fused"):
+        return RING_TIER
+    return tuned
 
 
 def _resolve_tile_field(field: str, stripe: bool, dtype, lq) -> int:
@@ -286,6 +319,7 @@ def ring_attention(
     skip_tile: int | None = None,
     stripe: bool = False,
     depth: int | None = None,
+    tier: str | None = None,
 ):
     """Blockwise ring attention for one shard (call inside ``shard_map``).
 
@@ -318,6 +352,15 @@ def ring_attention(
     0.79-0.83x at bf16 (per-cell fixed cost dominates the halved matmul
     work) — keep the contiguous layout for bf16 workloads (BASELINE
     round-5 stripebalance dtype note).
+
+    ``tier`` (ISSUE 19): ``None`` resolves the K/V rotation schedule
+    through the cache (``ring/tier``, prior "pipelined" — today's
+    host-scheduled ppermute loop, byte-identical untuned). "fused"
+    dispatches the whole rotation+compute loop as ONE Pallas launch
+    (``kernels.collectives_pallas.fused_ring_attention_pallas``) whose
+    kernel overlaps each step's RDMA with the previous block's matmul;
+    an explicitly-requested fused tier raises when the geometry's live
+    set exceeds VMEM, while a cached winner degrades to "pipelined".
     """
     d = q.shape[-1]
     if scale is None:
@@ -330,6 +373,28 @@ def ring_attention(
     # cache context: dtype + local block length (bucketed) — a tuned
     # winner from attnbench --tune at this shape/width applies here
     _dt = str(jnp.dtype(q.dtype))
+    # K/V rotation tier (ISSUE 19): explicit > cached > prior. The
+    # fused one-launch kernel replaces the whole ring below; an
+    # explicit request propagates its feasibility ValueError (loud,
+    # like every explicit knob) while a cached winner that traveled to
+    # an infeasible geometry degrades to the pipelined schedule.
+    _explicit_tier = tier is not None
+    tier = _resolve_ring_tier(tier, dtype=_dt, lq=q.shape[0])
+    if tier == "fused":
+        from tpu_mpi_tests.kernels.collectives_pallas import (
+            fused_ring_attention_pallas,
+            fused_ring_feasible,
+        )
+
+        if _explicit_tier or fused_ring_feasible(
+            q.shape[0], k.shape[0], d, q.dtype
+        ):
+            return fused_ring_attention_pallas(
+                q, k, v, axis_name=axis_name, scale=float(scale),
+                causal=causal, stripe=stripe, precision=precision,
+                interpret=interpret,
+            )
+        tier = "pipelined"
     k_tile = _resolve_k_tile(k_tile, stripe, dtype=_dt, lq=q.shape[0])
     skip_tile = _resolve_skip_tile(
         skip_tile, stripe, dtype=_dt, lq=q.shape[0]
@@ -416,6 +481,7 @@ def ring_attention_fn(
     precision=lax.Precision.HIGHEST,
     stripe: bool = False,
     depth: int | None = None,
+    tier: str | None = None,
 ):
     """Jitted ring attention over a sequence sharded along ``axis_name``
     (inputs (L_global, d) sharded on axis 0). ``flash=True`` uses the
@@ -429,7 +495,10 @@ def ring_attention_fn(
     (:func:`to_striped`/:func:`from_striped` convert globally).
     ``depth=None`` resolves the K/V prefetch pipeline depth through the
     schedule cache (``ring/pipeline_depth``, prior 1 — README "Overlap
-    engine"); results are depth-independent bit for bit.
+    engine"); results are depth-independent bit for bit. ``tier=None``
+    resolves the rotation schedule through the cache (``ring/tier``,
+    prior "pipelined"; "fused" = the one-launch fused-RDMA kernel —
+    README "Pallas collective tier").
 
     Choosing ``stripe`` is DTYPE-dependent (BASELINE round-5
     stripebalance dtype note, single-chip paced proxy at lq=4096):
@@ -452,7 +521,7 @@ def ring_attention_fn(
             q, k, v, axis_name, causal=causal, flash=flash,
             interpret=interpret, q_tile=q_tile, k_tile=k_tile,
             skip_tile=skip_tile, precision=precision, stripe=stripe,
-            depth=depth,
+            depth=depth, tier=tier,
         )
 
     world = mesh.shape[axis_name]

@@ -36,6 +36,20 @@ def register_workload(name: str, factory: Callable) -> Callable:
     return factory
 
 
+def decline_note(msg: str) -> None:
+    """Print a schedule-decline ``NOTE`` to stderr (flushed).
+
+    The shared voice of every "requested schedule cannot run here"
+    message (bench.py's tier/blocks/overlap declines, the fused
+    ring/collective tier declines — ISSUE 19 satellite): stderr so the
+    headline stdout stays parseable (bench.py's one-JSON-line contract),
+    prefixed ``NOTE `` so log scrapers find every decline with one
+    grep. Callers pass the message WITHOUT the prefix."""
+    import sys
+
+    print(f"NOTE {msg}", file=sys.stderr, flush=True)
+
+
 def workload_names() -> tuple[str, ...]:
     _import_workload_owners()
     return tuple(sorted(_WORKLOAD_FACTORIES))

@@ -51,6 +51,10 @@ def run(args) -> int:
     from tpu_mpi_tests.comm.ring import (
         _resolve_k_tile,
         _resolve_pipeline_depth,
+        _resolve_ring_tier,
+    )
+    from tpu_mpi_tests.kernels.collectives_pallas import (
+        fused_ring_feasible,
     )
 
     # stripe only affects the RING tier's layout; flash/ulysses always
@@ -65,7 +69,7 @@ def run(args) -> int:
             f"attnbench: L={args.seq_len} d={args.head_dim} tiers={args.tiers} "
             f"dtype={args.dtype} causal={args.causal} stripe={args.stripe} "
             f"k_tile={args.k_tile} skip_tile={args.skip_tile} "
-            f"n_iter={args.n_iter} world={world}"
+            f"ring_tier={args.ring_tier} n_iter={args.n_iter} world={world}"
         )
         if args.stripe and args.dtype == "bfloat16":
             # measured regression, not an error: the striped balance win is
@@ -128,7 +132,7 @@ def run(args) -> int:
                     for kk in jax.random.split(key, 3)
                 )
 
-            def make_attn(kt, st, tier=tier, depth=None):
+            def make_attn(kt, st, tier=tier, depth=None, rtier=None):
                 if tier == "ring":
                     return ring_attention_fn(
                         mesh, axis_name, causal=args.causal, flash=True,
@@ -136,6 +140,8 @@ def run(args) -> int:
                         k_tile=kt, skip_tile=st,
                         depth=depth if depth is not None
                         else args.ring_depth,
+                        tier=rtier if rtier is not None
+                        else args.ring_tier,
                     )
                 if tier == "ulysses":
                     return ulysses_attention_fn(
@@ -182,8 +188,13 @@ def run(args) -> int:
                     n_long = max(11, args.n_iter // 10)
 
                     def measure(cand):
+                        # tile knobs parameterize the per-step flash
+                        # kernel — pin the ring rotation to pipelined so
+                        # a cached fused winner (which has no tile
+                        # knobs) cannot flatten this sweep
                         loop = make_loop(
-                            make_attn(cand["k_tile"], cand["skip_tile"])
+                            make_attn(cand["k_tile"], cand["skip_tile"],
+                                      rtier="pipelined")
                         )
                         sec, st = chain_rate(
                             loop, make_qkv(),
@@ -213,9 +224,12 @@ def run(args) -> int:
                 n_long = max(11, args.n_iter // 10)
 
                 def measure_depth(cand):
+                    # depth parameterizes the PIPELINED rotation only —
+                    # pin the tier so a cached fused winner cannot turn
+                    # this sweep into w identical fused measurements
                     loop = make_loop(
                         make_attn(args.k_tile, args.skip_tile,
-                                  depth=int(cand))
+                                  depth=int(cand), rtier="pipelined")
                     )
                     sec, st = chain_rate(
                         loop, make_qkv(),
@@ -229,7 +243,72 @@ def run(args) -> int:
                     dtype=args.dtype, lq=lq_local,
                 )
 
-            attn = make_attn(args.k_tile, args.skip_tile)
+            if (
+                args.tune and tier == "ring"
+                and args.ring_tier is None
+                and ("tier", lq_local) not in tuned_layouts
+            ):
+                # ring rotation-tier sweep (ISSUE 19): price the
+                # one-launch fused-RDMA kernel against the pipelined
+                # ppermute ring on the REAL tier pipeline, after the
+                # tile/depth sweeps so pipelined competes at its tuned
+                # schedule. Infeasible geometry declines the sweep
+                # outright — resolution then falls to the prior.
+                from tpu_mpi_tests.tune.sweep import ensure_tuned
+
+                tuned_layouts.add(("tier", lq_local))
+                if not fused_ring_feasible(lq_local, lq_local, d, dtype):
+                    _common.decline_note(
+                        f"ring/tier sweep: fused candidate "
+                        f"infeasible at lq={lq_local} d={d} "
+                        f"{args.dtype} (live block set exceeds VMEM); "
+                        f"keeping the pipelined tier"
+                    )
+                else:
+                    n_long = max(11, args.n_iter // 10)
+
+                    def measure_tier(cand):
+                        loop = make_loop(
+                            make_attn(args.k_tile, args.skip_tile,
+                                      rtier=str(cand))
+                        )
+                        sec, st = chain_rate(
+                            loop, make_qkv(),
+                            n_short=n_long // 10 or 1, n_long=n_long,
+                        )
+                        del st
+                        return sec
+
+                    ensure_tuned(
+                        "ring/tier", measure_tier,
+                        dtype=args.dtype, lq=lq_local,
+                    )
+
+            # effective rotation tier for this row (ring only):
+            # explicit > cached > prior, then the driver-level decline —
+            # a fused request/winner at a geometry whose live set
+            # exceeds VMEM runs the pipelined tier with a NOTE instead
+            # of crashing mid-benchmark (the bench.py tier idiom)
+            ring_tier_eff = None
+            if tier == "ring":
+                ring_tier_eff = _resolve_ring_tier(
+                    args.ring_tier, dtype=args.dtype, lq=lq_local
+                )
+                if ring_tier_eff == "fused" and not fused_ring_feasible(
+                    lq_local, lq_local, d, dtype
+                ):
+                    # same voice as bench.py's stencil-tier decline:
+                    # stderr NOTE + the row/line stamp below names what
+                    # actually ran — never a mislabeled headline
+                    _common.decline_note(
+                        f"ring tier fused infeasible at "
+                        f"lq={lq_local} d={d} {args.dtype} (live block "
+                        f"set exceeds VMEM); running the pipelined tier"
+                    )
+                    ring_tier_eff = "pipelined"
+
+            attn = make_attn(args.k_tile, args.skip_tile,
+                             rtier=ring_tier_eff)
             loop = make_loop(attn)
             state0 = make_qkv()
             # compile-cost probe (telemetry runs only): the chained loop
@@ -240,7 +319,8 @@ def run(args) -> int:
 
             costs.compile_probe(
                 loop, (state0, args.n_iter),
-                label=f"attn_{tier}{'[striped]' if striped else ''}",
+                label=f"attn_{tier}{'[striped]' if striped else ''}"
+                      f"{'[fused]' if ring_tier_eff == 'fused' else ''}",
                 dtype=args.dtype, lq=lq_local, world=world,
             )
             sec, state = chain_rate(
@@ -252,6 +332,13 @@ def run(args) -> int:
             tflops = flops / sec / 1e12
             heads = world if tier == "ulysses" else 1
             striped = tier == "ring" and args.stripe
+            # schedule stamp (ISSUE 19 satellite, the bench.py _ov/_tier
+            # idiom): the ring line names the EFFECTIVE rotation tier —
+            # "[fused]" only when the one-launch kernel actually ran, so
+            # the default pipelined line stays byte-identical
+            tag = ("[striped]" if striped else "") + (
+                "[fused]" if ring_tier_eff == "fused" else ""
+            )
             row = {"kind": "attn", "tier": tier, "L": L, "d": d,
                    "dtype": args.dtype, "causal": args.causal,
                    "stripe": striped,
@@ -263,6 +350,10 @@ def run(args) -> int:
                 row["ring_depth"] = _resolve_pipeline_depth(
                     args.ring_depth, dtype=args.dtype, lq=lq_local
                 )
+                # rotation-tier attribution (ISSUE 19): the EFFECTIVE
+                # tier after the feasibility decline above — never the
+                # request, which may have been declined
+                row["ring_tier"] = ring_tier_eff
             if tier != "xla":  # flash-kernel tiers only
                 row["k_tile_ceiling"] = _resolve_k_tile(
                     args.k_tile, striped, dtype=args.dtype, lq=lq_local
@@ -278,7 +369,7 @@ def run(args) -> int:
                     # record the request, never a possibly-wrong constant
                     row["skip_tile_req"] = None
             rep.line(
-                f"ATTN {tier}{'[striped]' if striped else ''} L={L} d={d} "
+                f"ATTN {tier}{tag} L={L} d={d} "
                 f"{args.dtype} {tflops * heads:0.1f} TFLOP/s",
                 row,
             )
@@ -382,6 +473,18 @@ def main(argv=None) -> int:
         "sweeps the candidates on the real ring tier first",
     )
     p.add_argument(
+        "--ring-tier", default=None,
+        help="ring K/V rotation tier (ISSUE 19; README 'Pallas "
+        "collective tier'): 'pipelined' = the host-scheduled ppermute "
+        "ring (paced by --ring-depth), 'fused' = the one-launch "
+        "fused-RDMA Pallas kernel (whole rotation+compute loop in one "
+        "dispatch; requires the local block set to fit VMEM — an "
+        "infeasible geometry declines to pipelined with a NOTE). "
+        "Default: the schedule cache's tuned winner for this topology, "
+        "else the prior (pipelined). With --tune, a cache miss sweeps "
+        "both tiers on the real ring pipeline",
+    )
+    p.add_argument(
         "--fast", action="store_true",
         help="MXU-native (DEFAULT) matmul precision instead of HIGHEST "
         "(the throughput configuration BASELINE.md quotes)",
@@ -395,6 +498,10 @@ def main(argv=None) -> int:
         p.error("--n-iter must be >= 10")
     if args.ring_depth is not None and args.ring_depth < 1:
         p.error("--ring-depth must be >= 1")
+    if args.ring_tier is not None and args.ring_tier not in (
+        "pipelined", "fused"
+    ):
+        p.error("--ring-tier must be 'pipelined' or 'fused'")
     if args.k_tile is not None and args.k_tile < 8:
         p.error("--k-tile must be >= 8")
     if args.skip_tile is not None and args.skip_tile != 0 \

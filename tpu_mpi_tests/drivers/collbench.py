@@ -23,6 +23,12 @@ conventions) so numbers are comparable across collectives and world sizes:
 * ``allgather_rdma`` / ``allreduce_rdma`` (hand ring twins, opt-in): same
   bytes as their XLA counterparts — the ring schedule moves exactly the
   accounted volume
+* ``allgather_oneshot`` / ``allreduce_oneshot`` (one-shot in-kernel tier,
+  ISSUE 19): accounted with the SAME per-collective formula even though
+  the one-shot schedule physically ships (w−1)·shard per rank — busbw is
+  the nccl-tests algorithm-normalized convention precisely so tiers are
+  comparable per row; the one-shot tier trades wire bytes for a single
+  fixed-cost hop and is expected to win only at the small end
 
 On a 1-device world the collectives execute (XLA degenerate lowering) but
 move nothing; busbw is reported as 0 — the sweep is meaningful on ≥2
@@ -45,16 +51,22 @@ COLLECTIVES = (
 # rather than default because their lane-alignment rules skip the smallest
 # ladder sizes (the skip is reported, not silent)
 COLLECTIVES_RDMA = ("allgather_rdma", "allreduce_rdma")
+# one-shot in-kernel tier (kernels/collectives_pallas.py, ISSUE 19): one
+# launch, one DMA hop, pad-to-tile — no alignment skip, reaches every
+# ladder size including the decode payloads the ring floors reject
+COLLECTIVES_ONESHOT = ("allgather_oneshot", "allreduce_oneshot")
 
-#: collectives with a hand-ring twin: the variant (XLA lowering vs
-#: explicit-RDMA ring) is a tunable schedule — ``--collectives auto``
-#: resolves each through the cache (prior: xla), ``--tune`` sweeps both
-#: on a miss. Declared here because the variant choice lives here.
+#: collectives with hand-written twins: the variant (XLA lowering vs
+#: explicit-RDMA ring vs one-shot in-kernel burst) is a tunable
+#: schedule — ``--collectives auto`` resolves each through the cache
+#: (prior: xla), ``--tune`` sweeps all three on a miss. Declared here
+#: because the variant choice lives here.
 COLL_VARIANT_SPACES = {
     base: declare_space(
         f"coll_variant/{base}",
-        (_priors.COLL_VARIANT, "rdma"),
-        describe="XLA collective vs hand-written RDMA ring twin",
+        (_priors.COLL_VARIANT, "rdma", "oneshot"),
+        describe="XLA collective vs hand-written RDMA ring twin vs "
+                 "one-shot in-kernel burst",
     )
     for base in ("allgather", "allreduce")
 }
@@ -125,6 +137,23 @@ def _loop_fn(mesh, axis_name: str, name: str, world: int,
                 return ring_allreduce_pallas(
                     x, axis_name=axis_name, credits=rdma_credits
                 ) * (1.0 / world)
+        elif name == "allgather_oneshot":
+            from tpu_mpi_tests.kernels.collectives_pallas import (
+                oneshot_allgather_pallas,
+            )
+
+            def body(_, x):
+                g = oneshot_allgather_pallas(x, axis_name=axis_name)
+                return consume_neighbor(g, x)
+        elif name == "allreduce_oneshot":
+            from tpu_mpi_tests.kernels.collectives_pallas import (
+                oneshot_allreduce_pallas,
+            )
+
+            def body(_, x):
+                return oneshot_allreduce_pallas(
+                    x, axis_name=axis_name
+                ) * (1.0 / world)
         else:  # alltoall
             def body(_, x):
                 y = x.reshape(world, x.shape[0] // world)
@@ -151,9 +180,10 @@ def _resolve_variant(base, args, mesh, axis_name, world, n, dtype,
                      shard_bytes) -> str:
     """The collective name to actually run for an ``auto`` entry:
     explicit names never reach here; the variant knob resolves cached >
-    prior, and with ``--tune`` a miss prices BOTH twins on-device at
+    prior, and with ``--tune`` a miss prices ALL tiers on-device at
     this payload size (the rdma twin's lane-alignment floor surfaces as
-    a recorded error candidate, leaving the XLA tier the winner)."""
+    a recorded error candidate; the one-shot tier pads to tile and so
+    always prices)."""
     import jax
     import jax.numpy as jnp
 
@@ -162,7 +192,7 @@ def _resolve_variant(base, args, mesh, axis_name, world, n, dtype,
     from tpu_mpi_tests.tune.sweep import ensure_tuned
 
     def eff_of(variant: str) -> str:
-        return base if variant == "xla" else f"{base}_rdma"
+        return base if variant == "xla" else f"{base}_{variant}"
 
     def measure(variant):
         eff = eff_of(variant)
@@ -190,7 +220,7 @@ def _resolve_variant(base, args, mesh, axis_name, world, n, dtype,
         device_fallback=False,
         dtype=args.dtype, bytes=shard_bytes, world=world,
     )
-    if variant not in ("xla", "rdma"):
+    if variant not in ("xla", "rdma", "oneshot"):
         variant = "xla"  # malformed cache value degrades to the prior
     return eff_of(variant)
 
@@ -243,7 +273,11 @@ def _tune_dispatch_depth(args, mesh, axis_name: str, world: int) -> None:
 
 
 def _busbw_bytes(name: str, shard_bytes: int, world: int) -> float:
-    name = name.removesuffix("_rdma")  # ring twins move the same bytes
+    # tiers are accounted with the base collective's formula (nccl-tests
+    # algorithm-normalized convention): the ring twins move exactly the
+    # accounted volume; the one-shot tier ships more bytes by design and
+    # is normalized anyway so rows stay comparable across tiers
+    name = name.removesuffix("_rdma").removesuffix("_oneshot")
     if world < 2:
         return 0.0
     if name == "allgather":
@@ -282,7 +316,8 @@ def run(args) -> int:
 
         names = _common.parse_choice_list(
             args.collectives,
-            COLLECTIVES + COLLECTIVES_RDMA + ("auto",),
+            COLLECTIVES + COLLECTIVES_RDMA + COLLECTIVES_ONESHOT
+            + ("auto",),
             "collective",
         )
         if names is None:
@@ -444,10 +479,11 @@ def main(argv=None) -> int:
         help="comma list of collectives to sweep; beyond the default XLA "
         f"tier, {'/'.join(COLLECTIVES_RDMA)} select the hand-written "
         "RDMA ring twins (sizes below their lane-alignment floor are "
-        "reported as COLL-SKIP); 'auto' runs the twin-backed "
-        "collectives with each size's variant resolved from the "
-        "schedule cache (with --tune, a cache miss prices both twins "
-        "on-device first)",
+        f"reported as COLL-SKIP) and {'/'.join(COLLECTIVES_ONESHOT)} "
+        "the one-shot in-kernel tier (pad-to-tile, every size); 'auto' "
+        "runs the twin-backed collectives with each size's variant "
+        "resolved from the schedule cache (with --tune, a cache miss "
+        "prices all tiers on-device first)",
     )
     p.add_argument(
         "--rdma-credits", type=int, default=1, choices=(1, 2),

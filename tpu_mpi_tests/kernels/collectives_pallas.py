@@ -1,0 +1,632 @@
+"""Fixed-cost Pallas collective tier: one-shot in-kernel collectives for
+decode-shape payloads + fused-RDMA ring attention (ISSUE 19).
+
+The reference suite measures exactly the regime where per-op FIXED costs
+dominate — tiny ``MPI_Allreduce``/halo payloads on the GENE pattern
+(``mpi_stencil2d_gt.cc:574-649``) — and the DECODE pillar reports µs/op
+for the same reason: at decode shapes the wire time is nanoseconds while
+every XLA dispatch costs microseconds. The ring kernels in
+``pallas_kernels.py`` are BANDWIDTH-optimal (2(w−1)/w·n bytes moved) but
+pay w−1 (allgather) or 2(w−1) (allreduce) dependent hops; this module
+trades bytes for hops:
+
+* :func:`oneshot_allgather_pallas` / :func:`oneshot_allreduce_pallas` —
+  ONE ``pallas_call`` in which every rank fires w−1 async remote copies
+  of its whole shard directly into every peer's arrival buffer, waits
+  the semaphores, and combines arrivals locally. One hop, one launch,
+  w−1 · n bytes per rank — the latency-optimal schedule (the
+  "one-shot"/direct allreduce of NCCL/MSCCL small-message protocols),
+  wins exactly where the DECODE pillar lives and loses at bandwidth
+  scale. The sweeper prices the crossover per payload
+  (``coll_variant/*``, drivers/collbench.py).
+* :func:`fused_ring_attention_pallas` — the PR-15 fused-RDMA pattern
+  applied to ring attention: all w ring steps inside one kernel, the
+  K/V rotation an in-kernel async remote copy overlapped with the block
+  matmul, double-buffered arrival slots with the reduce-scatter's
+  receiver-credit handshake. Replaces w ``ppermute`` dispatches + w
+  kernel launches with ONE launch (knob ``ring/tier``, comm/ring.py).
+
+Determinism contract: the one-shot allreduce combines arrival slots in
+ascending source-rank order through VMEM tiles, so its sum is BITWISE
+equal to a sequential left fold over rank shards — gated against the
+XLA tier in tests/test_collectives.py. The fused attention kernel reuses
+``online_softmax_update`` and the ``_qk/_pv_operands`` precision helpers
+from the flash tier, so it can only differ by reassociation (err-norm
+gate). Synchronization honesty: every kernel keeps its barrier/handshake
+ENABLED under the simulated multi-device interpreter and carries an
+``unsafe_*`` negative control that races detectably
+(tests/test_ring_sync.py vector-clock contract, PR 15).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_mpi_tests.compat import axis_size, tpu_compiler_params
+from tpu_mpi_tests.kernels.pallas_kernels import (
+    _VMEM_BUDGET_BYTES,
+    _auto_interpret,
+    _fit_divisor,
+    _pv_operands,
+    _qk_operands,
+    _serial_interpret,
+    _wants_true_f32,
+)
+
+
+def _sublane(dtype) -> int:
+    """Sublane tile height for ``dtype`` (8 f32/f64, 16 bf16, 32 int8)."""
+    return max(8, 8 * 4 // jnp.dtype(dtype).itemsize)
+
+
+# ---------------------------------------------------------------------------
+# One-shot (single-hop) collectives
+# ---------------------------------------------------------------------------
+
+
+def _oneshot_kernel(x_ref, out_ref, comm_ref, acc_a, acc_b,
+                    copy_sem, copy_sem2, send_sem, recv_sem,
+                    *, axis_name, w, tile_rows, use_barrier,
+                    unsafe_no_recv_wait, op):
+    """One-shot collective: every rank DMAs its WHOLE shard into slot
+    ``my`` of every peer's ``comm_ref`` in a single burst, then combines
+    the w arrivals locally. Latency-optimal: one dependent hop instead
+    of the ring's w−1 (gather) / 2(w−1) (allreduce).
+
+    Slot safety needs no per-step semaphores or credit handshake: each
+    of the w comm slots is written by exactly ONE DMA in the whole
+    program (slot r by rank r's single copy), so a counting
+    ``recv_sem`` wait for all w−1 arrivals cannot be satisfied early by
+    a same-slot successor — there is none. The entry barrier is
+    all-to-all (w−1 signals/waits, not the ring kernels' ±1
+    neighborhood): rank p's DMA lands in MY buffer, so MY buffer must
+    exist-and-be-quiet before ANY peer starts, not just my neighbors.
+
+    ``unsafe_no_recv_wait`` (negative control, tests/test_ring_sync.py)
+    skips the arrival wait: the local combine then reads comm slots
+    concurrently with the incoming remote writes — an in-kernel RAW
+    race the vector-clock interpreter detects.
+
+    ``op``: ``"gather"`` copies the assembled ``comm_ref`` to
+    ``out_ref``; ``"sum"`` folds the slots in ASCENDING source-rank
+    order through VMEM tiles (``acc_a``/``acc_b``) — the fixed sum
+    order that makes the result bitwise-reproducible and
+    world-placement independent (same combine order on every rank,
+    unlike a ring whose partial-sum order is rank-relative)."""
+    my = lax.axis_index(axis_name)
+    n = x_ref.shape[0]
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        for k in range(1, w):
+            peer = lax.rem(my + jnp.int32(k), jnp.int32(w))
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=peer,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+        pltpu.semaphore_wait(barrier, w - 1)
+
+    # own shard into own slot (local DMA, overlaps the remote burst)
+    own = pltpu.make_async_copy(
+        x_ref, comm_ref.at[pl.ds(my * n, n)], copy_sem
+    )
+    own.start()
+
+    # the one-shot burst: full shard to slot `my` of every peer, all
+    # in flight at once. Shared counting send/recv semaphores are safe
+    # (see docstring); iteration k is the uniform shift-by-k
+    # permutation, which is also what lets the serialized interpreter
+    # emulate each iteration as one collective.
+    handles = []
+    for k in range(1, w):
+        peer = lax.rem(my + jnp.int32(k), jnp.int32(w))
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref,
+            dst_ref=comm_ref.at[pl.ds(my * n, n)],
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        handles.append(rdma)
+    own.wait()
+    for h in handles:
+        h.wait_send()
+    if not unsafe_no_recv_wait:
+        for h in handles:
+            h.wait_recv()
+
+    if op == "gather":
+        # one local copy comm → out. Deliberately NOT aliased away:
+        # reading the arrival buffer here is what makes the skipped
+        # recv-wait control an in-kernel RAW race instead of a
+        # silently-correct no-op.
+        cp = pltpu.make_async_copy(comm_ref, out_ref, copy_sem)
+        cp.start()
+        cp.wait()
+        return
+
+    # allreduce: ascending-src-order fold through VMEM tiles
+    for j in range(n // tile_rows):
+        ca = pltpu.make_async_copy(
+            comm_ref.at[pl.ds(j * tile_rows, tile_rows)], acc_a, copy_sem
+        )
+        ca.start()
+        ca.wait()
+        for s in range(1, w):
+            cb = pltpu.make_async_copy(
+                comm_ref.at[pl.ds(s * n + j * tile_rows, tile_rows)],
+                acc_b, copy_sem2,
+            )
+            cb.start()
+            cb.wait()
+            acc_a[:] = acc_a[:] + acc_b[:]
+        cw = pltpu.make_async_copy(
+            acc_a, out_ref.at[pl.ds(j * tile_rows, tile_rows)], copy_sem
+        )
+        cw.start()
+        cw.wait()
+
+
+def _oneshot_call(x, *, axis_name, op, collective_id, interpret,
+                  unsafe_no_recv_wait, fn_name):
+    """Shared wrapper for the two one-shot ops: pad-to-tile, 1-D lane
+    fold, VMEM tile fit, and the ``pallas_call``.
+
+    PAD-TO-TILE, not an alignment floor: the sliced comm-slot DMAs need
+    sublane-aligned rows (1-D shards: 128·sublane elements) like the
+    ring kernels — but where the ring tier REJECTS misaligned decode
+    payloads (its chunking floor also carries a factor w), this tier
+    zero-pads the shard up to the tile and slices the result back. The
+    one-shot schedule exists for payloads whose wire time is noise
+    against the per-hop fixed cost, so shipping a padded lane tile
+    costs the same single hop — and the pad rows are zeros folded into
+    zeros (sum) or sliced away (gather), never observable."""
+    sublane = _sublane(x.dtype)
+    w = axis_size(axis_name)
+    n = x.shape[0]
+    if x.ndim == 1:
+        unit = 128 * sublane
+        pad = (-n) % unit
+        if pad:
+            out = _oneshot_call(
+                jnp.pad(x, (0, pad)), axis_name=axis_name, op=op,
+                collective_id=collective_id, interpret=interpret,
+                unsafe_no_recv_wait=unsafe_no_recv_wait, fn_name=fn_name,
+            )
+            if op == "gather":
+                return out.reshape(w, -1)[:, :n].reshape(-1)
+            return out[:n]
+        # fold to 128-lane rows (Mosaic sliced DMA needs full lane tiles)
+        out = _oneshot_call(
+            x.reshape(-1, 128), axis_name=axis_name, op=op,
+            collective_id=collective_id, interpret=interpret,
+            unsafe_no_recv_wait=unsafe_no_recv_wait, fn_name=fn_name,
+        )
+        return out.reshape(-1)
+    pad = (-n) % sublane
+    if pad:
+        out = _oneshot_call(
+            jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1)),
+            axis_name=axis_name, op=op, collective_id=collective_id,
+            interpret=interpret,
+            unsafe_no_recv_wait=unsafe_no_recv_wait, fn_name=fn_name,
+        )
+        if op == "gather":
+            return out.reshape((w, -1) + x.shape[1:])[:, :n].reshape(
+                (w * n,) + x.shape[1:]
+            )
+        return out[:n]
+    interp = _auto_interpret(interpret)
+    row_bytes = jnp.dtype(x.dtype).itemsize * math.prod(x.shape[1:])
+    # accumulate tiles: sublane-aligned divisor of n, two tiles within
+    # the VMEM budget (decode payloads fit whole; the fit only engages
+    # when someone points the one-shot tier at bandwidth-scale shards)
+    max_units = max(1, _VMEM_BUDGET_BYTES // max(1, 2 * row_bytes * sublane))
+    tile_rows = sublane * _fit_divisor(n // sublane, max_units)
+    out_rows = w * n if op == "gather" else n
+    out_struct = jax.ShapeDtypeStruct((out_rows, *x.shape[1:]), x.dtype)
+    comm_struct = jax.ShapeDtypeStruct((w * n, *x.shape[1:]), x.dtype)
+    out, _ = pl.pallas_call(
+        functools.partial(
+            _oneshot_kernel,
+            axis_name=axis_name,
+            w=w,
+            tile_rows=tile_rows,
+            use_barrier=not _serial_interpret(interp),
+            unsafe_no_recv_wait=unsafe_no_recv_wait,
+            op=op,
+        ),
+        out_shape=(out_struct, comm_struct),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        # comm_ref is an OUT ref (not scratch): remote DMAs land in it,
+        # so it must be addressable by peers — and the serialized
+        # interpreter can only emulate remote copies between
+        # program-visible buffers (the reduce-scatter's comm_ref idiom)
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tile_rows, *x.shape[1:]), x.dtype),
+            pltpu.VMEM((tile_rows, *x.shape[1:]), x.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interp,
+    )(x)
+    return out
+
+
+def oneshot_allgather_pallas(
+    x,
+    *,
+    axis_name: str,
+    collective_id: int = 13,
+    interpret: bool | None = None,
+    unsafe_no_recv_wait: bool = False,
+):
+    """One-shot all-gather along axis 0: every rank remote-copies its
+    whole (n, m) shard directly into slot ``r`` of every peer's arrival
+    buffer in a single launch — one dependent hop vs the ring tier's
+    w−1 (:func:`~tpu_mpi_tests.kernels.pallas_kernels.ring_allgather_pallas`).
+    Call *inside* ``shard_map``; returns the (w·n, m) gathered array.
+
+    Moves (w−1)·n rows per rank instead of the ring's same total spread
+    over w−1 DEPENDENT steps: at decode payloads where each hop is pure
+    fixed cost, total time collapses from (w−1)·t_hop to ~t_hop. The
+    crossover against the bandwidth-optimal ring is priced per payload
+    by the ``coll_variant/*`` sweep (drivers/collbench.py).
+
+    Alignment: none required — misaligned shards are zero-padded up to
+    the DMA tile and sliced back (see ``_oneshot_call``); at the
+    latency-bound payloads this tier targets, a padded lane tile costs
+    the same single hop. The ring tier instead REJECTS payloads below
+    its w·128·sublane chunking floor — which is exactly the decode
+    range."""
+    return _oneshot_call(
+        x, axis_name=axis_name, op="gather",
+        collective_id=collective_id, interpret=interpret,
+        unsafe_no_recv_wait=unsafe_no_recv_wait,
+        fn_name="oneshot_allgather_pallas",
+    )
+
+
+def oneshot_allreduce_pallas(
+    x,
+    *,
+    axis_name: str,
+    collective_id: int = 14,
+    interpret: bool | None = None,
+    unsafe_no_recv_wait: bool = False,
+):
+    """One-shot allreduce(sum): the one-hop gather burst of
+    :func:`oneshot_allgather_pallas`, then each rank folds the w arrival
+    slots locally in ASCENDING source-rank order through VMEM tiles.
+    Call *inside* ``shard_map``; every rank returns the full (n, m)
+    elementwise sum.
+
+    vs the ring allreduce's 2(w−1) dependent hops
+    (reduce-scatter + allgather): one hop, at the cost of w−1 full
+    shards on the wire per rank and the full w-term fold on every rank
+    — the classic latency/bandwidth trade the sweeper prices.
+
+    Determinism: the ascending-src fold makes the sum bitwise equal to
+    ``functools.reduce(np.add, [shard_0, …, shard_{w-1}])`` on every
+    rank — a FIXED, rank-independent order (the ring tier's partial-sum
+    order is rank-relative), gated in tests/test_collectives.py."""
+    return _oneshot_call(
+        x, axis_name=axis_name, op="sum",
+        collective_id=collective_id, interpret=interpret,
+        unsafe_no_recv_wait=unsafe_no_recv_wait,
+        fn_name="oneshot_allreduce_pallas",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused-RDMA ring attention
+# ---------------------------------------------------------------------------
+
+
+def _fused_live_bytes(lq: int, lk: int, d: int, dtype) -> int:
+    """VMEM live model for the fused ring-attention kernel: the staged
+    q/k/v tiles + the result tile, the f32 carry (m, l, acc), the f32
+    scores block and its dtype-cast probability copy, and (for sub-f32
+    inputs, which the HIGHEST-precision default upcasts in-kernel) the
+    f32 operand copies — the ``_fit_flash_tiles`` live model with the
+    whole local block as the single tile."""
+    item = jnp.dtype(dtype).itemsize
+    return (
+        (2 * lq + 2 * lk) * d * item        # q_buf, o_buf, k_buf, v_buf
+        + 2 * lq * 4                        # m, l carries (f32)
+        + lq * d * 4                        # acc carry (f32)
+        + lq * lk * (4 + item)              # scores f32 + p dtype copy
+        + ((lq + 2 * lk) * d * 4 if item < 4 else 0)
+    )
+
+
+def fused_ring_feasible(lq: int, lk: int, d: int, dtype) -> bool:
+    """Can the fused one-launch ring-attention kernel run this geometry?
+    True when the whole local block fits the VMEM live model AND the
+    K/V block height is sublane-aligned (the arrival-slot DMA floor).
+    Drivers consult this to decline the fused tier with a NOTE instead
+    of tripping the kernel's ValueError (bench.py stencil-tier idiom);
+    the crossover being SMALL geometries is by design — the fused tier
+    is the fixed-cost end of the spectrum, the host-pipelined tier
+    (``ring/pipeline_depth``) remains the bandwidth end."""
+    return (
+        lk % _sublane(dtype) == 0
+        and _fused_live_bytes(lq, lk, d, dtype) <= _VMEM_BUDGET_BYTES
+    )
+
+
+def _fused_ring_attention_kernel(
+    q_ref, k_ref, v_ref, out_ref, comm_ref,
+    q_buf, k_buf, v_buf, o_buf,
+    copy_sem, copy_sem2, send_sem, recv_sem, ready_sem,
+    *, axis_name, w, lk, scale, causal, stripe, precision,
+    use_barrier, use_handshake, credits,
+):
+    """All w ring-attention steps in ONE kernel: step ``s`` forwards the
+    current K/V block to the right neighbor via async remote copy and
+    runs the flash fold on it WHILE the DMA flies — the PR-15 fused-RDMA
+    overlap, with the launch/dispatch cost paid once instead of per
+    step.
+
+    ``comm_ref`` holds two parity slots of (K rows ‖ V rows); step ``s``
+    consumes slot ``s % 2`` and receives into slot ``(s+1) % 2``. Slot
+    safety is the reduce-scatter's credits=2 contract verbatim: sends
+    ``s ≥ credits`` wait one receiver credit on ``ready_sem``, consumers
+    signal left after retiring slot ``s ≤ w−2−credits``, and PER-PARITY
+    ``recv_sem`` indices keep an anonymous counting wait from being
+    satisfied by the ``s+1`` arrival while slot ``s % 2`` is still being
+    written (the round-4 RAW hazard class). ``unsafe_no_credits``
+    (negative control) drops the credit waits/signals: writes ``s`` and
+    ``s+2`` then share a slot with nothing separating them — the
+    vector-clock interpreter detects the overwrite race at w ≥ 4.
+
+    The fold itself reuses ``online_softmax_update`` and the
+    ``_qk/_pv_operands`` precision helpers from the flash tier — same
+    recurrence, same masking, so the tiers differ only by
+    reassociation. Causal masking is a full-width ``where`` in global
+    positions (contiguous: ``r·L+i``; striped: ``i·w+r``): fused-tier
+    geometries are decode/latency scale, where the three-regime skip
+    machinery's bookkeeping outweighs the masked FLOPs it saves."""
+    from tpu_mpi_tests.comm.ring import online_softmax_update
+
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, jnp.int32(w))
+    left = lax.rem(my - 1 + jnp.int32(w), jnp.int32(w))
+
+    if use_barrier:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    # stage q once; seed arrival parity 0 with the local K/V block
+    qc = pltpu.make_async_copy(q_ref, q_buf, copy_sem)
+    qc.start()
+    if w > 1:
+        sk = pltpu.make_async_copy(
+            k_ref, comm_ref.at[pl.ds(0, lk)], copy_sem2
+        )
+        sk.start()
+        sk.wait()
+        sv = pltpu.make_async_copy(
+            v_ref, comm_ref.at[pl.ds(lk, lk)], copy_sem2
+        )
+        sv.start()
+        sv.wait()
+    qc.wait()
+
+    lq, d = q_buf.shape
+    q = q_buf[:]
+    if _wants_true_f32(precision) and q.dtype != jnp.float32:
+        q = q.astype(jnp.float32)
+    if causal:
+        if stripe:  # striped position of row i on shard p: i·w + p
+            q_pos = my + jnp.int32(w) * lax.broadcasted_iota(
+                jnp.int32, (lq, 1), 0
+            )
+            k_iota = jnp.int32(w) * lax.broadcasted_iota(
+                jnp.int32, (1, lk), 1
+            )
+        else:
+            q_pos = my * lq + lax.broadcasted_iota(jnp.int32, (lq, 1), 0)
+            k_iota = lax.broadcasted_iota(jnp.int32, (1, lk), 1)
+
+    m = jnp.full((lq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((lq, 1), jnp.float32)
+    acc = jnp.zeros((lq, d), jnp.float32)
+
+    for s in range(w):
+        cur, nxt = s % 2, (s + 1) % 2
+        rdma = None
+        if s < w - 1:
+            if use_handshake and s >= credits:
+                # right retired my payload s − credits: a slot is free
+                pltpu.semaphore_wait(ready_sem, 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_ref.at[pl.ds(cur * 2 * lk, 2 * lk)],
+                dst_ref=comm_ref.at[pl.ds(nxt * 2 * lk, 2 * lk)],
+                send_sem=send_sem,
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()  # flies under the fold below
+
+        # stage this step's K/V block into VMEM (ANY-space arrival
+        # slots cannot feed the MXU directly); step 0 reads the inputs
+        # straight, skipping the comm round trip
+        if s == 0:
+            ck = pltpu.make_async_copy(k_ref, k_buf, copy_sem)
+            cv = pltpu.make_async_copy(v_ref, v_buf, copy_sem2)
+        else:
+            ck = pltpu.make_async_copy(
+                comm_ref.at[pl.ds(cur * 2 * lk, lk)], k_buf, copy_sem
+            )
+            cv = pltpu.make_async_copy(
+                comm_ref.at[pl.ds(cur * 2 * lk + lk, lk)], v_buf,
+                copy_sem2,
+            )
+        ck.start()
+        cv.start()
+        ck.wait()
+        cv.wait()
+
+        # flash fold of the block from source rank (my − s) mod w
+        kb, vb = k_buf[:], v_buf[:]
+        scores = lax.dot_general(
+            *_qk_operands(q, kb, precision), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        ) * scale
+        if causal:
+            src = lax.rem(my - jnp.int32(s) + jnp.int32(w), jnp.int32(w))
+            k_pos = (src if stripe else src * lk) + k_iota
+            scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+        m, l, p, corr = online_softmax_update(m, l, scores, keepdims=True)
+        acc = acc * corr + lax.dot_general(
+            *_pv_operands(p, vb, precision), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )
+
+        if rdma is not None:
+            # own send done + next block arrived (parity recv wait)
+            rdma.wait()
+            if use_handshake and s <= w - 2 - credits:
+                # slot `cur` is retired (staged to VMEM above, send
+                # landed): release left's send s + credits
+                pltpu.semaphore_signal(
+                    ready_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+
+    o_buf[:] = (acc / l).astype(o_buf.dtype)
+    oc = pltpu.make_async_copy(o_buf, out_ref, copy_sem)
+    oc.start()
+    oc.wait()
+
+
+def fused_ring_attention_pallas(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str,
+    scale: float | None = None,
+    causal: bool = False,
+    stripe: bool = False,
+    precision=lax.Precision.HIGHEST,
+    interpret: bool | None = None,
+    collective_id: int = 15,
+    unsafe_no_credits: bool = False,
+):
+    """One-launch fused-RDMA ring attention for one shard (call *inside*
+    ``shard_map``): all w ring steps in a single ``pallas_call``, the
+    K/V rotation an in-kernel async remote copy overlapped with the
+    block matmul — the fixed-cost tier of the ring-attention pair (knob
+    ``ring/tier``, comm/ring.py), replacing w ``ppermute`` dispatches +
+    w kernel launches with one launch.
+
+    ``q``/``k``/``v``: this rank's (L_local, d) blocks; same semantics,
+    masking, and precision contract as
+    :func:`~tpu_mpi_tests.comm.ring.ring_attention` (striped layout
+    included) — the tiers are interchangeable per test, differing only
+    by reassociation.
+
+    The whole local block must fit the VMEM live model
+    (:func:`fused_ring_feasible`): the fused tier deliberately has NO
+    streaming fallback — where it does not fit, the host-pipelined tier
+    is the right tool and callers decline with a NOTE instead
+    (drivers/attnbench.py)."""
+    if q.ndim != 2 or k.shape != v.shape or q.shape[-1] != k.shape[-1]:
+        raise ValueError(
+            f"fused_ring_attention_pallas expects (L, d) blocks with "
+            f"matching K/V, got q={q.shape} k={k.shape} v={v.shape}"
+        )
+    if stripe and not causal:
+        raise ValueError(
+            "stripe=True only makes sense for causal ring attention "
+            "(non-causal work is already balanced)"
+        )
+    lq, d = q.shape
+    lk = k.shape[0]
+    sublane = _sublane(k.dtype)
+    if lk % sublane != 0:
+        raise ValueError(
+            f"fused_ring_attention_pallas needs K/V rows % {sublane} "
+            f"== 0 for {jnp.dtype(k.dtype).name} (arrival-slot DMA "
+            f"tile), got {lk}"
+        )
+    if not fused_ring_feasible(lq, lk, d, q.dtype):
+        raise ValueError(
+            f"fused ring attention block does not fit VMEM: lq={lq} "
+            f"lk={lk} d={d} {jnp.dtype(q.dtype).name} needs "
+            f"{_fused_live_bytes(lq, lk, d, q.dtype) / 2**20:.1f} MiB "
+            f"vs the ~{_VMEM_BUDGET_BYTES / 2**20:.0f} MiB budget; use "
+            f"the pipelined tier (ring/tier=pipelined) at this geometry"
+        )
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    interp = _auto_interpret(interpret)
+    w = axis_size(axis_name)
+    out_struct = jax.ShapeDtypeStruct((lq, d), q.dtype)
+    comm_struct = jax.ShapeDtypeStruct((2 * 2 * lk, d), k.dtype)
+    out, _ = pl.pallas_call(
+        functools.partial(
+            _fused_ring_attention_kernel,
+            axis_name=axis_name,
+            w=w,
+            lk=lk,
+            scale=float(scale),
+            causal=causal,
+            stripe=stripe,
+            precision=precision,
+            use_barrier=not _serial_interpret(interp),
+            use_handshake=(
+                not _serial_interpret(interp) and not unsafe_no_credits
+            ),
+            credits=2,
+        ),
+        out_shape=(out_struct, comm_struct),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((lq, d), q.dtype),
+            pltpu.VMEM((lk, d), k.dtype),
+            pltpu.VMEM((lk, d), v.dtype),
+            pltpu.VMEM((lq, d), q.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=tpu_compiler_params(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interp,
+    )(q, k, v)
+    return out

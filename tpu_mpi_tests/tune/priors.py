@@ -74,9 +74,21 @@ STENCIL_TIER = "blocks"
 HALO_STAGING = "direct"
 
 # Collective variant prior: the XLA lowering ("xla"), with the
-# hand-written RDMA ring twin ("rdma") as the sweep alternative where
-# one exists (allgather/allreduce).
+# hand-written RDMA ring twin ("rdma") and the one-shot in-kernel
+# burst ("oneshot", ISSUE 19) as the sweep alternatives where twins
+# exist (allgather/allreduce). The prior stays "xla": new tiers enter
+# as CANDIDATES the sweeper must price, never as default behavior.
 COLL_VARIANT = "xla"
+
+# Ring-attention tier prior (ISSUE 19): the host-pipelined ring
+# (``ring_scan`` + per-step flash kernel, paced by ``ring/
+# pipeline_depth``) is the shipped schedule; the one-launch fused-RDMA
+# kernel ("fused", kernels/collectives_pallas.py) is the sweep
+# candidate — it collapses w dispatches + w launches into one and is
+# expected to win only at latency-bound geometries where the whole
+# local block fits VMEM. Untuned runs stay byte-identical to the
+# pre-ISSUE-19 schedule.
+RING_TIER = "pipelined"
 
 # Overlap-engine depth priors (ISSUE 7). All three ship at 1 — today's
 # strictly-serialized schedules — so an untuned run stays byte-identical

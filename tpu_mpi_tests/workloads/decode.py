@@ -35,9 +35,12 @@ from tpu_mpi_tests.workloads.spec import RunContext, WorkloadSpec
 DECODE_COLLS = ("allreduce", "allgather")
 
 # the DECODE line's parse pattern lives NEXT TO its format string so a
-# format change is a one-site edit (the collbench COLL_LINE_RE idiom)
+# format change is a one-site edit (the collbench COLL_LINE_RE idiom).
+# The [variant] token is the resolved ``coll_variant/*`` tier — the
+# schedule-stamp idiom BENCH rows use (``_ov<d>_<tier>``), so a µs/op
+# move is attributable to the tier that produced it
 DECODE_LINE_RE = (
-    r"DECODE (\w+) batch=(\d+) heads=(\d+) bytes=(\d+) "
+    r"DECODE (\w+)\[(\w+)\] batch=(\d+) heads=(\d+) bytes=(\d+) "
     r"([\d.e+-]+|nan) us/op  n=(\d+)"
 )
 
@@ -50,10 +53,11 @@ def _effective_coll(coll, mesh, axis_name, world, n, dtype, dtype_name,
     payload-size-sensitive, like collbench's own resolution). A cached
     ``rdma`` winner below the ring kernel's lane-alignment floor at
     THIS payload degrades to the XLA tier with a visible NOTE (``line``
-    is the printer — the one-shot path passes ``rep.line``, the serve
-    factory ``print``), and a malformed cache value degrades to the
-    prior. Collectives without a ring twin resolve to themselves
-    (variant None)."""
+    is the printer — the one-shot driver passes ``rep.line``, the serve
+    factory ``print``; same probe for ``oneshot``, though its
+    pad-to-tile wrapper makes that tier feasible at every payload), and
+    a malformed cache value degrades to the prior. Collectives without
+    hand twins resolve to themselves (variant None)."""
     from tpu_mpi_tests.tune import registry as tr
 
     if coll not in ("allgather", "allreduce"):
@@ -62,30 +66,30 @@ def _effective_coll(coll, mesh, axis_name, world, n, dtype, dtype_name,
         f"coll_variant/{coll}", explicit=explicit, device_fallback=False,
         dtype=dtype_name, bytes=shard_bytes, world=world,
     )
-    if variant not in ("xla", "rdma"):
+    if variant not in ("xla", "rdma", "oneshot"):
         variant = "xla"  # malformed cache value degrades to the prior
-    if variant == "rdma":
+    if variant in ("rdma", "oneshot"):
         import jax
 
         from tpu_mpi_tests.drivers.collbench import _loop_fn
 
-        fn = _loop_fn(mesh, axis_name, f"{coll}_rdma", world)
+        fn = _loop_fn(mesh, axis_name, f"{coll}_{variant}", world)
         try:
             jax.eval_shape(
                 fn, jax.ShapeDtypeStruct((n * world,), dtype), 1
             )
         except Exception as e:
-            if explicit == "rdma":
+            if explicit == variant:
                 # an explicitly requested candidate (a re-sweep's
                 # measure) must ERROR so the sweep records it as
                 # infeasible, never silently measure the other tier
                 raise
             if line is not None:
-                line(f"NOTE decode {coll}: cached rdma variant "
+                line(f"NOTE decode {coll}: cached {variant} variant "
                      f"infeasible at {shard_bytes} B ({e}); "
                      f"using xla")
             return coll, "xla"
-        return f"{coll}_rdma", "rdma"
+        return f"{coll}_{variant}", variant
     return coll, "xla"
 
 
@@ -195,7 +199,7 @@ class DecodeSpec(WorkloadSpec):
                     }
                     state["rows"].append(row)
                     ctx.rep.line(
-                        f"DECODE {coll} batch={batch} "
+                        f"DECODE {coll}[{variant}] batch={batch} "
                         f"heads={args.heads} bytes={shard_bytes} "
                         f"{us:0.3f} us/op  n={args.n_iter}",
                         row,
@@ -318,7 +322,10 @@ class DecodeSpec(WorkloadSpec):
             step.tune_info = {
                 "knob": "coll_variant/allreduce",
                 "ctx": dict(ctx),
-                "candidates": ("xla", "rdma"),
+                "candidates": ("xla", "rdma", "oneshot"),
+                # the RESOLVED tier this handler is serving (schedule
+                # provenance, the DECODE [variant] stamp's serve twin)
+                "variant": _v,
                 "rebuild": build,
             }
             return step
