@@ -2,8 +2,8 @@
 SHELL := /bin/bash
 .PHONY: test verify native bench smoke trace-smoke tune-smoke mem-smoke \
 	serve-smoke replay-smoke overlap-smoke moe-smoke decode-smoke \
-	chaos-smoke anatomy-smoke live-smoke fleet-smoke lint lint-smoke \
-	protocol-smoke records records-check ci clean
+	chaos-smoke anatomy-smoke topo-smoke live-smoke fleet-smoke lint \
+	lint-smoke protocol-smoke records records-check ci clean
 
 test:
 	python -m pytest tests/ -q
@@ -680,6 +680,83 @@ anatomy-smoke:
 		/tmp/_tpumt_anat.diff.txt
 	@echo "anatomy-smoke OK: wait/wire convicts the injected straggler, clean run holds the honesty floor, diff names the series"
 
+# topology-observability smoke (README "Topology observability"): two
+# REAL native-launcher processes form a discovered h2x1 topology (one
+# rank per jax process — every cross-rank pair is inter_host), so
+# (a) each rank's JSONL carries the kind:"topo" audit record and its
+# comm spans the wrapper-build link/partner_link stamps; (b) the
+# report renders the TOPOLOGY shape + per-link-class GB/s tables, the
+# per-op ANATOMY [inter_host] split rows, the COMMGRAPH link suffix,
+# and the hosts= header; (c) the Perfetto export carries the per-link
+# "comm bytes by link" counter track and span link args; (d) the pack
+# shape gate: importing a pack tuned on h2x4 into a cache holding
+# flat-machine entries refuses (exit 3, NOTE names both shapes — no
+# schedule could ever resolve) and --allow-topology-mismatch
+# overrides.
+topo-smoke:
+	rm -f /tmp/_tpumt_topo*
+	$(MAKE) -C native tpumt_run
+	env JAX_PLATFORMS=cpu \
+		./native/tpumt_run -n 2 -o /tmp/_tpumt_topo.rank -- \
+		python -m tpu_mpi_tests.drivers.stencil1d --fake-devices 1 \
+		--n-global 65536 --dtype float64 --overlap 1 \
+		--overlap-iters 8 --telemetry \
+		--jsonl /tmp/_tpumt_topo.jsonl
+	grep -q '"kind": "topo".*"topology": "h2x1"' /tmp/_tpumt_topo.p0.jsonl
+	grep -q '"link": "inter_host"' /tmp/_tpumt_topo.p0.jsonl
+	grep -q '"partner_link": \["inter_host", "inter_host"\]' \
+		/tmp/_tpumt_topo.p0.jsonl
+	python -m tpu_mpi_tests.instrument.aggregate \
+		/tmp/_tpumt_topo.p0.jsonl /tmp/_tpumt_topo.p1.jsonl \
+		> /tmp/_tpumt_topo.report.txt
+	grep -q '^RUN .*hosts=2x1' /tmp/_tpumt_topo.report.txt
+	grep -q '^TOPOLOGY h2x1: world=2 hosts=2x1.*links=inter_host' \
+		/tmp/_tpumt_topo.report.txt
+	grep -q '^TOPOLOGY inter_host: calls=.*GB/s' \
+		/tmp/_tpumt_topo.report.txt
+	grep -q '^ANATOMY halo_exchange\[inter_host\]: ' \
+		/tmp/_tpumt_topo.report.txt
+	grep -q '^COMMGRAPH 0->1: bytes=.*link=inter_host' \
+		/tmp/_tpumt_topo.report.txt
+	python -m tpu_mpi_tests.instrument.timeline \
+		/tmp/_tpumt_topo.p0.jsonl /tmp/_tpumt_topo.p1.jsonl \
+		-o /tmp/_tpumt_topo.trace.json
+	python -c "import json; \
+		d = json.load(open('/tmp/_tpumt_topo.trace.json')); \
+		cnt = [e for e in d['traceEvents'] if e.get('ph') == 'C' \
+			and e['name'] == 'comm bytes by link']; \
+		assert cnt and all(e['cat'] == 'traffic' for e in cnt), cnt; \
+		assert all(set(e['args']) == {'inter_host'} for e in cnt); \
+		sp = [e for e in d['traceEvents'] if e.get('ph') == 'X' \
+			and e.get('args', {}).get('link') == 'inter_host']; \
+		assert sp, 'no link-stamped spans in trace'; \
+		print('topo-smoke trace:', len(cnt), 'link counter samples,', \
+			len(sp), 'link-stamped spans')"
+	python -c "import json; \
+		from tpu_mpi_tests.tune import pack as tp; \
+		from tpu_mpi_tests.tune.cache import ScheduleCache; \
+		fp = 'device=v5e;hosts=2;platform=tpu;rph=4'; \
+		doc = tp.make_pack({'demo/k|' + fp: {'value': 7, \
+			'seconds': 0.1, 'knob': 'demo/k', 'fingerprint': fp, \
+			't': 100.0}}); \
+		open('/tmp/_tpumt_topo.pack.json', 'w').write( \
+			json.dumps(doc)); \
+		c = ScheduleCache.load('/tmp/_tpumt_topo.cache.json'); \
+		c.store('demo/k', 'device=v5e;platform=tpu', 1, seconds=0.1); \
+		c.save(); \
+		print('topo-smoke: h2x4 pack vs flat cache staged')"
+	python -m tpu_mpi_tests.tune.pack import \
+		/tmp/_tpumt_topo.pack.json \
+		--cache /tmp/_tpumt_topo.cache.json \
+		> /tmp/_tpumt_topo.imp.txt; test $$? -eq 3
+	grep -q 'NOTE topology mismatch: pack measured on h2x4' \
+		/tmp/_tpumt_topo.imp.txt
+	python -m tpu_mpi_tests.tune.pack import \
+		/tmp/_tpumt_topo.pack.json \
+		--cache /tmp/_tpumt_topo.cache.json \
+		--allow-topology-mismatch > /dev/null
+	@echo "topo-smoke OK: h2x1 discovered, link-class tables + trace counters rendered, mismatched pack import refused"
+
 # live-observability smoke (README "Live observability"): (a) a serve
 # run armed with --metrics-port must expose well-formed OpenMetrics at
 # /metrics MID-RUN (curl'd while the loop serves) with nonzero serve
@@ -1113,7 +1190,9 @@ protocol-smoke:
 # observability smoke, the serving-pipeline smoke, the overlap-engine
 # smoke, the workload-spec pillar smoke, the decode-tier smoke (one-
 # shot collective sweep → DECODE consumption → diff gate), the chaos-
-# verified diagnosis smoke, the live-observability smoke (OpenMetrics
+# verified diagnosis smoke, the topology smoke (2-process h2x1
+# discovery + link-class attribution + pack shape gate), the
+# live-observability smoke (OpenMetrics
 # endpoint + online doctor), the fleet-tuning smoke (rank-0 2-process
 # sweep + pack round-trip + closed-loop retune), the lint self-clean
 # gate, the lint-cache incrementality + engine-salt smoke, the
@@ -1121,7 +1200,8 @@ protocol-smoke:
 # static↔runtime conformance), and the RECORDS.md staleness gate
 ci: verify trace-smoke tune-smoke mem-smoke serve-smoke replay-smoke \
 	overlap-smoke moe-smoke decode-smoke chaos-smoke anatomy-smoke \
-	live-smoke fleet-smoke lint lint-smoke protocol-smoke records-check
+	topo-smoke live-smoke fleet-smoke lint lint-smoke protocol-smoke \
+	records-check
 
 clean:
 	$(MAKE) -C native clean

@@ -55,6 +55,21 @@ def _tune_emit(rec) -> None:
     print(json.dumps(rec), file=sys.stderr, flush=True)
 
 
+def _topo_suffix(world: int) -> str:
+    """Topology token for bench schedule strings (``_h1x8``): hosts x
+    ranks-per-host, stamped UNCONDITIONALLY — a flat/declared-flat run
+    reads ``h1x<world>`` — so BENCH_r* rounds stay attributable when
+    runs move across slice shapes. Ragged shapes stamp hosts only (no
+    honest single rph number)."""
+    from tpu_mpi_tests.comm.topology import current
+
+    t = current()
+    if t.is_flat:
+        return f"_h1x{world}"
+    rph = t.ranks_per_host
+    return f"_h{t.num_hosts}x{rph}" if rph else f"_h{t.num_hosts}"
+
+
 def _resolve_steps(env_val: "str | None", *, n: int, world: int) -> int:
     """Temporal-blocking depth: explicit env > cached winner > shipped
     prior (tune/priors.BENCH_STEPS) — the bench precedence contract,
@@ -469,19 +484,22 @@ def _measure(dtype_name: str, *, n, steps, world, mesh, axis_name, topo,
         # which per-iteration schedule actually ran (the blocks gate
         # can decline a requested TPU_MPI_BENCH_BLOCKS, the overlap
         # gate a requested depth, the tier gate a requested tier) —
-        # the _ov<d> suffix attributes the row to a pipeline depth and
-        # the trailing token to the executing KERNEL TIER (ISSUE 15:
-        # blocks / rdma-chained / rdma-fused / xla), so BENCH_r* rounds
-        # are attributable to a tier, not just blocks/steps
+        # the _ov<d> suffix attributes the row to a pipeline depth, the
+        # next token to the executing KERNEL TIER (ISSUE 15: blocks /
+        # rdma-chained / rdma-fused / xla), and the trailing _h<H>x<R>
+        # token to the host topology the run measured on (ISSUE 20) —
+        # so BENCH_r* rounds are attributable to a tier AND a slice
+        # shape, not just blocks/steps
         "schedule": (
             f"blocks{n_blocks}_dim0_world{world}_{dtype_name}"
-            f"_ov{ov_eff}_{tier}"
+            f"_ov{ov_eff}_{tier}{_topo_suffix(world)}"
             if use_blocks
             else f"dim{bench_dim}_world{world}_{dtype_name}"
-                 f"_ov{ov_eff}_{tier}"
+                 f"_ov{ov_eff}_{tier}{_topo_suffix(world)}"
         ),
         "steps": steps,
         "tier": tier,
+        "topology": _topo_suffix(world).lstrip("_"),
     }
 
 

@@ -57,7 +57,7 @@ def test_bench_prints_one_json_line_smoke():
     rec = json.loads(lines[-1])
     per_dtype = {"value", "unit", "vs_baseline",
                  "vs_f64_reference_roofline", "dtype", "samples",
-                 "schedule", "steps", "tier"}
+                 "schedule", "steps", "tier", "topology"}
     # round 5 (VERDICT r4 #3): one invocation carries BOTH dtypes — the
     # primary keeps the top-level headline fields, the secondary is a
     # same-shaped sub-object under its dtype name
@@ -75,10 +75,13 @@ def test_bench_prints_one_json_line_smoke():
     assert sub["value"] > 0
     assert sub["schedule"].startswith("dim1_")
     # tier provenance (ISSUE 15): the schedule string and the JSON both
-    # name the EXECUTING kernel tier — xla is the only CPU tier
+    # name the EXECUTING kernel tier — xla is the only CPU tier — and
+    # the trailing token stamps the host topology (ISSUE 20:
+    # unconditional, h1x<world> on a flat 4-fake-device mesh)
     assert rec["tier"] == "xla" and sub["tier"] == "xla"
-    assert rec["schedule"].endswith("_xla")
-    assert sub["schedule"].endswith("_xla")
+    assert rec["schedule"].endswith("_xla_h1x4")
+    assert sub["schedule"].endswith("_xla_h1x4")
+    assert rec["topology"] == "h1x4" and sub["topology"] == "h1x4"
 
 
 def test_bench_second_dtype_disable():

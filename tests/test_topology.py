@@ -1,0 +1,533 @@
+"""Topology observability (ISSUE 20): host/link-class discovery from
+fabricated device lists, wrapper-build-time mesh stamps, the per-link
+anatomy split and its report/trace/live/doctor surfaces, topology-keyed
+fingerprints, and the pack import shape gate — plus the flat-topology
+degrade every surface keys its legacy shape on (fields absent, never
+guessed; single-host/CPU reports grow no lines).
+
+Fixtures follow tests/test_anatomy.py: fabricated per-rank JSONL with
+KNOWN clock offsets so the per-link decompositions check as exact
+arithmetic (rank 1 runs +0.5 s raw and enters 0.2 s late — each call
+splits wait=0.2 wire=0.1 exactly).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_mpi_tests.comm import topology
+from tpu_mpi_tests.instrument import aggregate, anatomy, diagnose, timeline
+from tpu_mpi_tests.instrument.live import Dashboard, render
+
+
+class _Dev:
+    """A fabricated device: just the identity attributes discovery
+    reads (absent slice_index == backend does not report one)."""
+
+    def __init__(self, process_index=None, slice_index=None):
+        if process_index is not None:
+            self.process_index = process_index
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+
+class _Mesh:
+    """Mesh stand-in for the stamp helpers: ``devices`` ndarray +
+    ``axis_names``, hashable by identity like the real Mesh."""
+
+    def __init__(self, shape, axis_names, devs):
+        self.axis_names = axis_names
+        self.devices = np.empty(shape, dtype=object)
+        self.devices.ravel()[:] = devs
+
+
+def _hosts(*pids, slices=None):
+    if slices is None:
+        return [_Dev(p) for p in pids]
+    return [_Dev(p, s) for p, s in zip(pids, slices)]
+
+
+def _write_jsonl(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def _manifest(rank, n=2, **extra):
+    return {"kind": "manifest", "process_index": rank,
+            "process_count": n, "platform": "cpu",
+            "global_device_count": n, "device_kinds": ["cpu"],
+            "jax": "0.0-test", "argv": ["topo-test"], **extra}
+
+
+def _topo(world=2, hosts=2, rph=1):
+    return {"kind": "topo", "world": world, "topology": f"h{hosts}x{rph}",
+            "declared": "discovered", "hosts": hosts,
+            "ranks_per_host": rph,
+            "host_by_rank": [r // rph for r in range(world)],
+            "link_classes": (["intra_host", "inter_host"] if rph > 1
+                             else ["inter_host"])}
+
+
+def _sync(rank, offset, spread=0.0005):
+    return {"kind": "clock_sync", "rank": rank, "offset_s": offset,
+            "spread_s": spread, "method": "barrier_echo",
+            "run_sync_us": 1}
+
+
+def _span(op, seq, t0, t1, *, axis="x", world=2, nbytes=1 << 20,
+          **extra):
+    return {"kind": "span", "op": op, "axis": axis, "seq": seq,
+            "world": world, "nbytes": nbytes, "seconds": t1 - t0,
+            "t_start": t0, "t_end": t1, **extra}
+
+
+def _stamped_run(tmp_path, calls=4, link="inter_host", with_topo=True):
+    """The test_anatomy skew fixture on a fabricated 2-host shape:
+    every span link-stamped; per call r0 waits 0.2 wire 0.1."""
+    shape = {"hosts": 2, "ranks_per_host": 1} if with_topo else {}
+    r0 = [_manifest(0, **shape), _sync(0, 0.0)]
+    r1 = [_manifest(1, **shape), _sync(1, 0.5)]
+    if with_topo:
+        r0.insert(1, _topo())
+        r1.insert(1, _topo())
+    extra = {"link": link} if link else {}
+    for k in range(calls):
+        r0.append(_span("allreduce", k, 100.0 + k, 100.3 + k, **extra))
+        r1.append(_span("allreduce", k, 100.7 + k, 100.8 + k, **extra))
+    _write_jsonl(tmp_path / "run.p0.jsonl", r0)
+    _write_jsonl(tmp_path / "run.p1.jsonl", r1)
+    return [str(tmp_path / "run.p0.jsonl"),
+            str(tmp_path / "run.p1.jsonl")]
+
+
+# -------------------------------------------------------------- discovery
+
+
+class TestDiscovery:
+    def test_two_host_shape(self):
+        t = topology.discover(_hosts(0, 0, 1, 1))
+        assert t.declared == "discovered" and not t.is_flat
+        assert (t.world, t.num_hosts, t.ranks_per_host) == (4, 2, 2)
+        assert t.label() == "h2x2"
+        assert t.classes() == ("intra_host", "inter_host")
+        assert t.link_class(0, 0) == "self"
+        assert t.link_class(0, 1) == "intra_host"
+        assert t.link_class(0, 2) == "inter_host"
+
+    def test_slice_axis_classifies_strongest(self):
+        t = topology.discover(_hosts(0, 1, 2, 3, slices=[0, 0, 1, 1]))
+        assert t.label() == "s2h4x1"
+        assert t.link_class(0, 1) == "inter_host"
+        assert t.link_class(0, 2) == "inter_slice"
+        assert t.classes() == ("inter_host", "inter_slice")
+
+    def test_missing_process_index_declares_flat(self):
+        t = topology.discover([_Dev(0), _Dev()])
+        assert t.declared == "flat" and t.is_flat
+        assert t.hosts is None and t.slices is None
+        assert t.label() == "flat"
+
+    def test_bool_process_index_is_not_an_index(self):
+        # a truthy-but-wrong attribute must degrade, not classify
+        assert topology.discover([_Dev(True), _Dev(True)]).declared \
+            == "flat"
+
+    def test_partial_slice_index_contributes_nothing(self):
+        t = topology.discover([_Dev(0, 0), _Dev(1)])
+        assert t.declared == "discovered"
+        assert t.slices is None and t.hosts == (0, 1)
+
+    def test_ragged_hosts_have_no_rph(self):
+        t = topology.discover(_hosts(0, 0, 1))
+        assert t.ranks_per_host is None
+        assert t.label() == "h2"
+
+    def test_single_host_is_flat(self):
+        t = topology.discover(_hosts(0, 0))
+        assert t.is_flat and t.label() == "flat"
+
+    def test_strength_order_and_anatomy_lockstep(self):
+        assert topology.stronger("intra_host", "inter_host") \
+            == "inter_host"
+        assert topology.stronger("inter_slice", "self") == "inter_slice"
+        # anatomy is stdlib-only and duplicates the vocabulary — the
+        # two tuples must never drift
+        assert anatomy.LINK_ORDER == topology.LINK_CLASSES
+
+    def test_topo_record_fields_absent_when_undiscovered(self):
+        rec = topology.topo_record(topology.discover(_hosts(0, 0, 1, 1)))
+        assert rec["kind"] == "topo" and rec["topology"] == "h2x2"
+        assert rec["hosts"] == 2 and rec["ranks_per_host"] == 2
+        assert rec["host_by_rank"] == [0, 0, 1, 1]
+        assert rec["link_classes"] == ["intra_host", "inter_host"]
+        flat = topology.topo_record(topology.discover([_Dev(), _Dev()]))
+        assert flat["declared"] == "flat"
+        for k in ("hosts", "ranks_per_host", "host_by_rank", "slices",
+                  "link_classes"):
+            assert k not in flat
+
+
+# ------------------------------------------------------------ mesh stamps
+
+
+class TestMeshStamps:
+    def test_two_level_mesh_axes_classify(self):
+        # 2 hosts x 2 local devices: the dcn axis crosses hosts, the
+        # ici axis stays inside one — the observability win
+        devs = [_Dev(h) for h in (0, 0, 1, 1)]
+        mesh = _Mesh((2, 2), ("dcn", "ici"),
+                     [devs[0], devs[1], devs[2], devs[3]])
+        assert topology.mesh_link_meta(mesh, "ici") \
+            == {"link": "intra_host"}
+        assert topology.mesh_link_meta(mesh, "dcn") \
+            == {"link": "inter_host"}
+
+    def test_flat_mesh_stamps_nothing(self):
+        mesh = _Mesh((4,), ("x",), _hosts(0, 0, 0, 0))
+        assert topology.mesh_link_meta(mesh, "x") == {}
+        assert topology.mesh_partner_links(mesh, "x", (-1, 1), False) \
+            == {}
+
+    def test_partner_links_strongest_per_offset(self):
+        mesh = _Mesh((4,), ("x",), _hosts(0, 0, 1, 1))
+        got = topology.mesh_partner_links(mesh, "x", (-1, 1), False)
+        # offset ±1 each cross the host seam somewhere on the ring —
+        # the honest scalar for an aggregated-edges span is strongest
+        assert got == {"partner_link": ["inter_host", "inter_host"],
+                       "link": "inter_host"}
+
+
+# --------------------------------------------------------- anatomy split
+
+
+class TestAnatomyByLink:
+    def test_by_link_split_exact(self, tmp_path):
+        files = _stamped_run(tmp_path)
+        row = anatomy.anatomize(
+            timeline.rank_streams(files))["ops"]["allreduce"]
+        sub = row["by_link"]["inter_host"]
+        # every call stamped inter_host: the split IS the op row
+        assert sub["calls"] == 4
+        assert sub["wait_s"] == pytest.approx(row["wait_s"])
+        assert sub["wire_s"] == pytest.approx(row["wire_s"])
+        assert sub["bytes"] == row["bytes"]
+        assert sub["wait_frac"] == pytest.approx(0.5)
+        assert sub["pure_gbps"] == pytest.approx(row["pure_gbps"])
+        assert sub["eff_gbps"] == pytest.approx(row["eff_gbps"])
+
+    def test_mixed_classes_split_per_seq(self, tmp_path):
+        r0 = [_manifest(0), _sync(0, 0.0)]
+        r1 = [_manifest(1), _sync(1, 0.5)]
+        for k in range(4):
+            cls = "intra_host" if k < 2 else "inter_host"
+            r0.append(_span("allreduce", k, 100.0 + k, 100.3 + k,
+                            link=cls))
+            r1.append(_span("allreduce", k, 100.7 + k, 100.8 + k,
+                            link=cls))
+        _write_jsonl(tmp_path / "run.p0.jsonl", r0)
+        _write_jsonl(tmp_path / "run.p1.jsonl", r1)
+        anat = anatomy.anatomize(timeline.rank_streams(
+            [str(tmp_path / "run.p0.jsonl"),
+             str(tmp_path / "run.p1.jsonl")]))
+        by_link = anat["ops"]["allreduce"]["by_link"]
+        assert by_link["intra_host"]["calls"] == 2
+        assert by_link["inter_host"]["calls"] == 2
+        assert by_link["intra_host"]["wait_s"] == pytest.approx(0.4)
+        # top-level per-class aggregate feeds the TOPOLOGY table
+        assert anat["by_link"]["inter_host"]["calls"] == 2
+        assert anat["by_link"]["inter_host"]["wait_frac"] \
+            == pytest.approx(0.5)
+
+    def test_unstamped_spans_keep_legacy_row_shape(self, tmp_path):
+        files = _stamped_run(tmp_path, link=None, with_topo=False)
+        # link=None serializes as null → treated as unstamped
+        anat = anatomy.anatomize(timeline.rank_streams(files))
+        assert "by_link" not in anat["ops"]["allreduce"]
+        assert "by_link" not in anat
+
+    def test_edge_link_classes_mirror_partner_drop_rule(self, tmp_path):
+        for rank in (0, 1):
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", [
+                _manifest(rank), _sync(rank, 0.0),
+                _span("halo_exchange", 0, 100.0, 100.1,
+                      partners=[-1, 1], periodic=False,
+                      partner_nbytes=256,
+                      partner_link=["intra_host", "inter_host"],
+                      link="inter_host"),
+            ])
+        streams = timeline.rank_streams(
+            [str(tmp_path / f"run.p{r}.jsonl") for r in (0, 1)])
+        # rank 0 keeps only +1 (its class), rank 1 only -1 — the
+        # out-of-range offsets drop exactly as partner_edges drops them
+        assert anatomy.edge_link_classes(streams) \
+            == {(0, 1): "inter_host", (1, 0): "intra_host"}
+        m = anatomy.anatomize(streams)["matrix"]
+        assert m["0->1"]["link"] == "inter_host"
+        assert m["1->0"]["link"] == "intra_host"
+
+
+# --------------------------------------------------------- report surface
+
+
+class TestReportSurface:
+    def test_topology_tables_and_header(self, tmp_path, capsys):
+        files = _stamped_run(tmp_path)
+        assert aggregate.main(files) == 0
+        out = capsys.readouterr().out
+        run_line = next(ln for ln in out.splitlines()
+                        if ln.startswith("RUN "))
+        assert "hosts=2x1" in run_line
+        assert "TOPOLOGY h2x1: world=2 hosts=2x1 links=inter_host" in out
+        link_row = next(ln for ln in out.splitlines()
+                        if ln.startswith("TOPOLOGY inter_host:"))
+        assert "calls=4" in link_row and "wait_frac=0.500" in link_row
+        split = next(ln for ln in out.splitlines()
+                     if ln.startswith("ANATOMY allreduce[inter_host]:"))
+        assert "calls=4" in split and "wait_frac=0.500" in split
+
+    def test_json_summary_carries_topo(self, tmp_path, capsys):
+        files = _stamped_run(tmp_path)
+        assert aggregate.main(files + ["--json"]) == 0
+        s = json.loads(capsys.readouterr().out)
+        assert s["topo"]["topology"] == "h2x1"
+        assert s["anatomy"]["by_link"]["inter_host"]["calls"] == 4
+
+    def test_flat_run_report_grows_no_lines(self, tmp_path, capsys):
+        """The acceptance byte-shape gate: unstamped files produce a
+        report with no TOPOLOGY lines, no [link] rows, no header
+        suffix, no summary key."""
+        files = _stamped_run(tmp_path, link=None, with_topo=False)
+        assert aggregate.main(files) == 0
+        out = capsys.readouterr().out
+        assert "TOPOLOGY" not in out
+        assert "allreduce[" not in out
+        assert "hosts=" not in next(ln for ln in out.splitlines()
+                                    if ln.startswith("RUN "))
+        assert aggregate.main(files + ["--json"]) == 0
+        assert "topo" not in json.loads(capsys.readouterr().out)
+
+    def test_diff_series_per_link_class(self, tmp_path):
+        files = _stamped_run(tmp_path)
+        m = aggregate._metrics_from_summary(aggregate.summarize(files))
+        key = "anatomy:allreduce:inter_host:pure_gbps"
+        assert m[key]["higher_better"] is True
+        assert m[key]["value"] == pytest.approx(
+            4 * 2 * (1 << 20) / 0.8 / 1e9)
+
+
+# ---------------------------------------------------------- trace surface
+
+
+class TestTraceSurface:
+    def _halo_files(self, tmp_path, stamped=True):
+        extra = ({"partner_link": ["inter_host", "inter_host"],
+                  "link": "inter_host"} if stamped else {})
+        for rank in (0, 1):
+            _write_jsonl(tmp_path / f"run.p{rank}.jsonl", [
+                _manifest(rank), _sync(rank, 0.0),
+                _span("halo_exchange", 0, 100.0, 100.1,
+                      partners=[-1, 1], periodic=False,
+                      partner_nbytes=256, **extra),
+                _span("halo_exchange", 1, 101.0, 101.1,
+                      partners=[-1, 1], periodic=False,
+                      partner_nbytes=256, **extra),
+            ])
+        return [str(tmp_path / f"run.p{r}.jsonl") for r in (0, 1)]
+
+    def test_link_counter_track_cumulative(self, tmp_path):
+        doc = timeline.chrome_trace(self._halo_files(tmp_path))
+        cnt = [e for e in doc["traceEvents"]
+               if e.get("ph") == "C"
+               and e["name"] == "comm bytes by link"]
+        assert cnt and all(e["cat"] == "traffic" for e in cnt)
+        last = max((e for e in cnt if e["pid"] == cnt[0]["pid"]),
+                   key=lambda e: e["ts"])
+        # each rank keeps ONE in-range edge per call (non-periodic
+        # pair): 2 calls x 256 B, all inter_host
+        assert last["args"] == {"inter_host": 512}
+        # span args carry the link class for hover inspection
+        spans = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "X" and e["name"] == "halo_exchange"]
+        assert spans and all(
+            e["args"].get("link") == "inter_host" for e in spans)
+
+    def test_unstamped_trace_has_no_link_track(self, tmp_path):
+        doc = timeline.chrome_trace(
+            self._halo_files(tmp_path, stamped=False))
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert "comm bytes sent" in names
+        assert "comm bytes by link" not in names
+
+
+# ----------------------------------------------------- live/top surface
+
+
+class TestLiveSurface:
+    def test_link_table_renders(self, tmp_path):
+        files = _stamped_run(tmp_path)
+        dash = Dashboard()
+        for path in files:
+            for ln in open(path):
+                dash.feed(json.loads(ln), path)
+        frame = render(dash, files)
+        hdr = next(ln for ln in frame.splitlines()
+                   if ln.startswith("LINK"))
+        assert "class" in hdr and "GB/s" in hdr
+        assert any("inter_host" in ln for ln in frame.splitlines()
+                   if not ln.startswith("TOPO"))
+
+    def test_flat_feed_has_no_link_table(self, tmp_path):
+        files = _stamped_run(tmp_path, link=None, with_topo=False)
+        dash = Dashboard()
+        for path in files:
+            for ln in open(path):
+                dash.feed(json.loads(ln), path)
+        assert not any(ln.startswith("LINK")
+                       for ln in render(dash, files).splitlines())
+
+
+# ---------------------------------------------------------- doctor link
+
+
+class TestDoctorLinkEvidence:
+    def _streams(self, tmp_path, link="inter_host", mixed=False):
+        r0 = [_manifest(0), _sync(0, 0.0, 0.001)]
+        r1 = [_manifest(1), _sync(1, 0.0, 0.001)]
+        for k in range(6):
+            cls = ("intra_host" if mixed and k % 2 else link)
+            extra = {"link": cls} if cls else {}
+            r0.append(_span("halo_exchange", k, 100.0 + k, 100.5 + k,
+                            **extra))
+            r1.append(_span("halo_exchange", k, 100.49 + k, 100.5 + k,
+                            **extra))
+        for recs, rank in ((r0, 0), (r1, 1)):
+            recs += [{"kind": "mem", "event": "final", "t": 120.0,
+                      "live_bytes": 100},
+                     {"kind": "telemetry_summary", "op": "x",
+                      "rank": rank, "ops": 1, "bytes": 1,
+                      "seconds": 0.0}]
+        _write_jsonl(tmp_path / "run.p0.jsonl", r0)
+        _write_jsonl(tmp_path / "run.p1.jsonl", r1)
+        return [str(tmp_path / "run.p0.jsonl"),
+                str(tmp_path / "run.p1.jsonl")]
+
+    def test_all_inter_host_ops_note_link(self, tmp_path):
+        (f,) = diagnose.diagnose_files(self._streams(tmp_path))
+        assert f["class"] == "straggler" and f["link"] == "inter_host"
+        assert "link=inter_host" in diagnose.format_finding(f)
+
+    def test_mixed_classes_claim_nothing(self, tmp_path):
+        (f,) = diagnose.diagnose_files(
+            self._streams(tmp_path, mixed=True))
+        assert f["link"] is None
+
+    def test_unstamped_streams_claim_nothing(self, tmp_path):
+        (f,) = diagnose.diagnose_files(self._streams(tmp_path, link=None))
+        assert f["link"] is None
+        assert "link=" not in diagnose.format_finding(f)
+
+
+# ------------------------------------------------- fingerprint and packs
+
+
+class TestFingerprintTopology:
+    @pytest.fixture(autouse=True)
+    def _fresh_fields(self):
+        from tpu_mpi_tests.tune import fingerprint as fp
+
+        fp.device_fields.cache_clear()
+        yield
+        fp.device_fields.cache_clear()
+
+    def test_non_flat_fields_and_flat_unchanged(self, monkeypatch):
+        from tpu_mpi_tests.tune import fingerprint as fp
+
+        monkeypatch.setattr(
+            topology, "current",
+            lambda: topology.discover(_hosts(0, 0, 1, 1)))
+        fields = dict(fp.device_fields())
+        assert fields["hosts"] == "2" and fields["rph"] == "2"
+        fp.device_fields.cache_clear()
+        monkeypatch.setattr(
+            topology, "current",
+            lambda: topology.discover(_hosts(0, 0, 0, 0)))
+        flat = dict(fp.device_fields())
+        # PR-4 precedence contract: flat fingerprints are unchanged
+        assert "hosts" not in flat and "rph" not in flat
+        assert set(flat) == {"platform", "device", "ndev", "procs"}
+
+
+class TestPackTopologyGate:
+    def _pack(self, tmp_path, name, fp_extra=""):
+        from tpu_mpi_tests.tune import pack as tp
+
+        fp = "device=v5e;platform=tpu" + fp_extra
+        doc = tp.make_pack({f"demo/k|{fp}": {
+            "value": 7, "seconds": 0.1, "knob": "demo/k",
+            "fingerprint": fp, "t": 100.0}})
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p), doc
+
+    def test_fp_topology_labels(self):
+        from tpu_mpi_tests.tune import pack as tp
+
+        assert tp._fp_topology({"hosts": "2", "rph": "4"}) == "h2x4"
+        assert tp._fp_topology({"hosts": "2"}) == "h2"
+        assert tp._fp_topology({}) == "flat"
+
+    def test_provenance_records_topologies(self, tmp_path):
+        _, doc = self._pack(tmp_path, "p.json", ";hosts=2;rph=4")
+        assert doc["provenance"]["topologies"] == ["h2x4"]
+
+    def test_import_refuses_disjoint_shapes(self, tmp_path, capsys):
+        from tpu_mpi_tests.tune import pack as tp
+        from tpu_mpi_tests.tune.cache import ScheduleCache
+
+        packed, _ = self._pack(tmp_path, "p.json", ";hosts=2;rph=4")
+        dest = tmp_path / "cache.json"
+        c = ScheduleCache.load(str(dest))
+        c.store("demo/k", "device=v5e;platform=tpu", 1, seconds=0.1)
+        c.save()
+        assert tp.main(["import", packed, "--cache", str(dest)]) == 3
+        out = capsys.readouterr().out
+        assert "NOTE topology mismatch" in out
+        assert "h2x4" in out and "flat" in out
+        # override flag and same-shape/fresh-cache imports go through
+        assert tp.main(["import", packed, "--cache", str(dest),
+                        "--allow-topology-mismatch"]) == 0
+        fresh = tmp_path / "fresh.json"
+        assert tp.main(["import", packed, "--cache", str(fresh)]) == 0
+
+    def test_pack_line_names_topology(self, tmp_path, capsys):
+        from tpu_mpi_tests.tune import pack as tp
+        from tpu_mpi_tests.tune.cache import ScheduleCache
+
+        c = ScheduleCache.load(str(tmp_path / "w.json"))
+        c.store("demo/k", "device=v5e;hosts=2;platform=tpu;rph=4", 1,
+                seconds=0.1)
+        c.save()
+        assert tp.main(["pack", "--cache", str(tmp_path / "w.json"),
+                        "-o", str(tmp_path / "o.json")]) == 0
+        assert "topo=h2x4" in capsys.readouterr().out
+
+    def test_driver_pack_note_on_mismatch(self, tmp_path, capsys,
+                                          monkeypatch):
+        import argparse
+
+        from tpu_mpi_tests.drivers import _common
+
+        packed, _ = self._pack(tmp_path, "p.json", ";hosts=2;rph=2")
+        monkeypatch.setattr(
+            topology, "current",
+            lambda: topology.discover(_hosts(0, 0)))
+        _common._check_pack_topology(
+            argparse.Namespace(tune_pack=packed))
+        assert "will not resolve here" in capsys.readouterr().err
+        # same-shape pack (live h2x2) says nothing
+        monkeypatch.setattr(
+            topology, "current",
+            lambda: topology.discover(_hosts(0, 0, 1, 1)))
+        _common._check_pack_topology(
+            argparse.Namespace(tune_pack=packed))
+        assert "will not resolve" not in capsys.readouterr().err

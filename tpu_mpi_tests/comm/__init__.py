@@ -12,3 +12,8 @@ from tpu_mpi_tests.comm.mesh import (  # noqa: F401
     make_mesh,
     topology,
 )
+from tpu_mpi_tests.comm.topology import (  # noqa: F401
+    LINK_CLASSES,
+    TopologyMap,
+    discover,
+)

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_mpi_tests.compat import axis_size, shard_map
 from tpu_mpi_tests.comm.ring import online_softmax_update
+from tpu_mpi_tests.comm.topology import mesh_link_meta
 from tpu_mpi_tests.instrument import telemetry as _telemetry
 from tpu_mpi_tests.instrument.telemetry import span_call
 from tpu_mpi_tests.utils import check_divisible
@@ -225,6 +226,7 @@ def ulysses_attention_fn(mesh: Mesh, axis_name: str, causal: bool = False,
             nbytes=nbytes,
             axis_name=axis_name, world=world,
             flash=flash, causal=causal,
+            **mesh_link_meta(mesh, axis_name),
         )
 
     return attn_recorded

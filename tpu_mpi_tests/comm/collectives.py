@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_mpi_tests.compat import shard_map
+from tpu_mpi_tests.comm.topology import mesh_link_meta
 from tpu_mpi_tests.instrument.telemetry import (
     async_span,
     comm_span,
@@ -338,6 +339,7 @@ def all_gather(x_sharded, mesh: Mesh, axis_name: str | None = None,
         nbytes=_gather_payload_bytes(x_sharded, world),
         axis_name=axis_name,
         world=world,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -399,6 +401,7 @@ def all_gather_rdma(x_sharded, mesh: Mesh, axis_name: str | None = None,
         nbytes=_gather_payload_bytes(x_sharded, world),
         axis_name=axis_name,
         world=world,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -449,6 +452,7 @@ def all_gather_oneshot(x_sharded, mesh: Mesh,
         nbytes=_gather_payload_bytes(x_sharded, world),
         axis_name=axis_name,
         world=world,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -469,6 +473,7 @@ def all_gather_inplace(allx_sharded, mesh: Mesh, axis_name: str | None = None,
         nbytes=nbytes,
         axis_name=axis_name,
         world=world,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -510,6 +515,7 @@ def allreduce_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
         nbytes=2 * (n - 1) * row_bytes,
         axis_name=axis_name,
         world=n,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -556,6 +562,7 @@ def reduce_scatter_sum(per_rank, mesh: Mesh, axis_name: str | None = None):
         nbytes=(n - 1) * row_bytes,
         axis_name=axis_name,
         world=n,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -611,6 +618,7 @@ def allreduce_rdma(per_rank, mesh: Mesh, axis_name: str | None = None,
         nbytes=2 * (n - 1) * row_bytes,
         axis_name=axis_name,
         world=n,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -670,6 +678,7 @@ def allreduce_oneshot(per_rank, mesh: Mesh, axis_name: str | None = None,
         nbytes=(n - 1) * row_bytes,
         axis_name=axis_name,
         world=n,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 

@@ -37,6 +37,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_mpi_tests.compat import shard_map
+from tpu_mpi_tests.comm.topology import mesh_link_meta
 from tpu_mpi_tests.instrument.telemetry import span_call
 from tpu_mpi_tests.tune import priors as _priors
 from tpu_mpi_tests.tune.registry import (
@@ -114,6 +115,7 @@ def embedding_lookup(table, ids, mesh: Mesh, axis_name: str | None = None,
         table, ids,
         nbytes=2 * (world - 1) * row_bytes,
         axis_name=axis_name, world=world, variant=variant,
+        **mesh_link_meta(mesh, axis_name),
     )
 
 
@@ -163,4 +165,5 @@ def embedding_scatter_add(table, ids, updates, mesh: Mesh,
         table, ids, updates,
         nbytes=nbytes,
         axis_name=axis_name, world=world,
+        **mesh_link_meta(mesh, axis_name),
     )

@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_mpi_tests.compat import axis_size, shard_map
+from tpu_mpi_tests.comm.topology import mesh_partner_links
 from tpu_mpi_tests.instrument.telemetry import span_call
 from tpu_mpi_tests.kernels.pack import pack_edges, unpack_ghosts
 from tpu_mpi_tests.tune import priors as _priors
@@ -420,9 +421,13 @@ def halo_exchange(
     # reconstructed (src,dst) matrix sums back to ``nbytes`` and halo
     # symmetry — bytes(r→r+1) == bytes(r+1→r) — holds by construction.
     pairs = world if periodic else world - 1
+    # link attribution (comm/topology.py): per-offset link classes,
+    # resolved once per (mesh, axis) — {} on a flat topology, so flat
+    # runs keep their span records byte-identical
     partner_meta = (
         {"partners": [-1, 1], "periodic": periodic,
-         "partner_nbytes": nbytes // (2 * pairs)}
+         "partner_nbytes": nbytes // (2 * pairs),
+         **mesh_partner_links(mesh, axis_name, (-1, 1), periodic)}
         if pairs > 0 else {}
     )
     if staging is Staging.HOST_STAGED:

@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_mpi_tests.comm.collectives import host_value
+from tpu_mpi_tests.comm.topology import mesh_link_meta
 from tpu_mpi_tests.compat import shard_map
 from tpu_mpi_tests.instrument import telemetry
 from tpu_mpi_tests.instrument.telemetry import span_call
@@ -253,6 +254,7 @@ def route_tokens(x, dest, mesh: Mesh, capacity: int,
         nbytes=route_payload_bytes(x, world, capacity, combine),
         axis_name=axis_name, world=world, combine=combine,
         capacity=int(capacity),
+        **mesh_link_meta(mesh, axis_name),
     )
     stats = RouteStats(
         world=world, capacity=int(capacity),

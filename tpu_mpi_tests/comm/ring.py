@@ -37,6 +37,7 @@ from tpu_mpi_tests.compat import (
     pcast_varying,
     shard_map,
 )
+from tpu_mpi_tests.comm.topology import mesh_partner_links
 from tpu_mpi_tests.instrument.telemetry import span_call
 
 
@@ -543,6 +544,7 @@ def ring_attention_fn(
             flash=flash, causal=causal, stripe=stripe,
             partners=[1], periodic=True,
             partner_nbytes=(world - 1) * kv_bytes,
+            **mesh_partner_links(mesh, axis_name, (1,), True),
         )
 
     return attn_recorded

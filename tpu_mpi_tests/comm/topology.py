@@ -1,0 +1,282 @@
+"""Host/link-class topology discovery and span link attribution.
+
+The reference probes machine structure as a first-class signal — node
+count via ``MPI_Comm_split_type(MPI_COMM_TYPE_SHARED)``
+(``mpi_daxpy_nvtx.cc:72-82``) — because the link a message rides
+(shared memory vs network there; same-host ICI vs cross-host DCN here)
+dominates its cost at scale. This module is the discovery half: read
+the live device list once, group ranks into hosts (``process_index``)
+and slices (``slice_index``, only when EVERY device reports one), and
+classify every directed rank pair into a link class::
+
+    self < intra_host < inter_host < inter_slice
+
+ordered by strength — the strongest class a collective group crosses is
+the link that prices it.
+
+Degrade contract (the memwatch convention): fabricated devices and
+backends that report no ``process_index`` yield a *declared* ``flat``
+topology — host/slice fields ABSENT, never guessed — and every
+downstream stamp helper returns ``{}`` for a flat topology, so
+single-host/CPU runs keep their JSONL spans and report shape
+byte-identical.
+
+Stamping is resolved at wrapper-build time (:func:`mesh_link_meta` /
+:func:`mesh_partner_links` are lru-cached per ``(mesh, axis)``), so the
+per-call comm path pays zero topology cost — the same budget rule as
+the telemetry spans themselves.
+
+Pure-python core: :func:`discover` and :class:`TopologyMap` take any
+device-like sequence (objects with ``process_index``), so tests drive
+multi-host classification with fabricated device lists and no backend.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+
+#: link classes, weakest to strongest — index order IS the strength
+#: ordering (the strongest pair class prices a collective group)
+LINK_CLASSES = ("self", "intra_host", "inter_host", "inter_slice")
+
+_STRENGTH = {c: i for i, c in enumerate(LINK_CLASSES)}
+
+
+def stronger(a: str, b: str) -> str:
+    """The stronger (more expensive) of two link classes."""
+    return a if _STRENGTH[a] >= _STRENGTH[b] else b
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyMap:
+    """Discovered rank→host/slice structure for one device ordering.
+
+    ``hosts``/``slices`` are per-rank group indices in device order
+    (rank = position, the same rank space ``mpirun -np N`` ≅
+    fake-devices uses everywhere else). ``None`` means the axis was not
+    reported — a declared-flat degrade, not a measured single group.
+    """
+
+    world: int
+    hosts: tuple[int, ...] | None
+    slices: tuple[int, ...] | None
+    declared: str  # "discovered" | "flat"
+
+    @property
+    def num_hosts(self) -> int:
+        return len(set(self.hosts)) if self.hosts else 1
+
+    @property
+    def num_slices(self) -> int:
+        return len(set(self.slices)) if self.slices else 1
+
+    @property
+    def ranks_per_host(self) -> int | None:
+        """Uniform ranks-per-host, or ``None`` when ragged (a ragged
+        shape has no honest single number — absent, never averaged)."""
+        if not self.hosts:
+            return None
+        counts = set(collections.Counter(self.hosts).values())
+        return counts.pop() if len(counts) == 1 else None
+
+    @property
+    def is_flat(self) -> bool:
+        """One host, one slice (measured or declared): nothing to
+        attribute — every stamp helper goes silent."""
+        return self.num_hosts <= 1 and self.num_slices <= 1
+
+    def link_class(self, a: int, b: int) -> str:
+        """Directed-pair link class for ranks ``a``→``b``. With no
+        host/slice info every cross-rank pair reads ``intra_host``
+        (callers gate on :attr:`is_flat` before stamping, so the
+        single-group read is only reachable by direct query)."""
+        if a == b:
+            return "self"
+        if self.slices is not None and self.slices[a] != self.slices[b]:
+            return "inter_slice"
+        if self.hosts is not None and self.hosts[a] != self.hosts[b]:
+            return "inter_host"
+        return "intra_host"
+
+    def classes(self) -> tuple[str, ...]:
+        """Cross-rank link classes present, weakest first — computed
+        from the group structure, not an O(world²) pair sweep."""
+        if self.world <= 1:
+            return ()
+        hosts = self.hosts or (0,) * self.world
+        slices = self.slices or (0,) * self.world
+        groups = collections.Counter(zip(slices, hosts))
+        hosts_by_slice: dict[int, set[int]] = {}
+        for s, h in groups:
+            hosts_by_slice.setdefault(s, set()).add(h)
+        present = set()
+        if any(n >= 2 for n in groups.values()):
+            present.add("intra_host")
+        if any(len(hs) >= 2 for hs in hosts_by_slice.values()):
+            present.add("inter_host")
+        if len(hosts_by_slice) >= 2:
+            present.add("inter_slice")
+        return tuple(c for c in LINK_CLASSES if c in present)
+
+    def label(self) -> str:
+        """Canonical shape label: ``h{hosts}x{ranks_per_host}``
+        (``h2x4``), ``h{hosts}`` when ragged, ``s{slices}`` prefix when
+        a multi-slice axis is reported, ``flat`` otherwise — the token
+        bench schedule strings and pack provenance carry."""
+        if self.is_flat:
+            return "flat"
+        rph = self.ranks_per_host
+        lbl = f"h{self.num_hosts}" + (f"x{rph}" if rph else "")
+        if self.num_slices > 1:
+            lbl = f"s{self.num_slices}" + lbl
+        return lbl
+
+
+def discover(devices=None) -> TopologyMap:
+    """Build a :class:`TopologyMap` from a device list (default: the
+    live ``jax.devices()``). Any device missing an integer
+    ``process_index`` declares the whole topology flat — fields absent,
+    never guessed; ``slice_index`` contributes only when every device
+    reports one."""
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    world = len(devices)
+    hosts: list[int] = []
+    for d in devices:
+        try:
+            p = getattr(d, "process_index", None)
+        except Exception:
+            p = None
+        if not isinstance(p, int) or isinstance(p, bool):
+            return TopologyMap(world=world, hosts=None, slices=None,
+                               declared="flat")
+        hosts.append(p)
+    slices: list[int] | None = []
+    for d in devices:
+        try:
+            s = getattr(d, "slice_index", None)
+        except Exception:
+            s = None
+        if not isinstance(s, int) or isinstance(s, bool):
+            slices = None
+            break
+        slices.append(s)
+    return TopologyMap(
+        world=world,
+        hosts=tuple(hosts),
+        slices=tuple(slices) if slices else None,
+        declared="discovered",
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def current() -> TopologyMap:
+    """The live backend's topology, probed once per process (tests
+    monkeypatching the device list must ``current.cache_clear()``)."""
+    return discover()
+
+
+def topo_record(topo: TopologyMap | None = None) -> dict:
+    """The auditable ``kind:"topo"`` JSONL record (manifest-adjacent,
+    emitted by ``make_reporter``): world, shape label, host/slice
+    grouping, and the link classes present. Host/slice fields are
+    ABSENT (not null) on a flat or declared-flat topology."""
+    topo = current() if topo is None else topo
+    rec = {
+        "kind": "topo",
+        "world": topo.world,
+        "topology": topo.label(),
+        "declared": topo.declared,
+        "hosts": topo.num_hosts if topo.hosts is not None else None,
+        "ranks_per_host": (topo.ranks_per_host
+                           if topo.hosts is not None else None),
+        "host_by_rank": (list(topo.hosts)
+                         if topo.hosts is not None else None),
+        "slices": topo.num_slices if topo.slices is not None else None,
+        # a declared-flat topology MEASURED nothing — claiming
+        # intra_host for its pairs would be the single-group guess the
+        # degrade contract forbids
+        "link_classes": (list(topo.classes()) or None
+                         if topo.declared == "discovered" else None),
+    }
+    return {k: v for k, v in rec.items() if v is not None}
+
+
+def _axis_rings(mesh, axis_name: str):
+    """The device rings one mesh axis's collectives run over: every
+    1-D group along ``axis_name`` (other axes fixed), as rows."""
+    import numpy as np
+
+    ax = list(mesh.axis_names).index(axis_name)
+    moved = np.moveaxis(mesh.devices, ax, -1)
+    return moved.reshape(-1, moved.shape[-1])
+
+
+def _ring_topos(mesh, axis_name: str) -> list[TopologyMap] | None:
+    """Per-ring positional topologies for one mesh axis, or ``None``
+    when the mesh's own devices form a flat topology (the stamp gate)."""
+    try:
+        rings = _axis_rings(mesh, axis_name)
+    except Exception:
+        return None
+    if discover([d for ring in rings for d in ring]).is_flat:
+        return None
+    return [discover(list(ring)) for ring in rings]
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_link_meta(mesh, axis_name: str) -> dict:
+    """``{"link": cls}`` for collective spans over ``axis_name`` — the
+    strongest link class any collective group on that axis crosses —
+    or ``{}`` when the mesh's devices form a flat topology (flat runs
+    stamp nothing; spans stay byte-identical). Resolved once per
+    ``(mesh, axis)`` at wrapper-build time."""
+    topos = _ring_topos(mesh, axis_name)
+    if topos is None:
+        return {}
+    cls = None
+    for t in topos:
+        present = t.classes()
+        if present:
+            c = present[-1]
+            cls = c if cls is None else stronger(cls, c)
+    return {"link": cls} if cls else {}
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_partner_links(
+    mesh, axis_name: str, partners: tuple, periodic: bool,
+) -> dict:
+    """Per-offset link classes for neighbor-exchange spans:
+    ``{"partner_link": [cls per offset], "link": strongest}`` parallel
+    to the ``partners`` ring-offset metadata (anatomy's
+    ``partner_edges`` order), or ``{}`` on a flat topology. Each
+    offset's class is the strongest pair class any rank's edge at that
+    offset crosses — the honest scalar for a span that aggregates every
+    local edge."""
+    topos = _ring_topos(mesh, axis_name)
+    if topos is None:
+        return {}
+    links = []
+    for d in partners:
+        cls = None
+        for t in topos:
+            n = t.world
+            for i in range(n):
+                j = i + int(d)
+                if periodic:
+                    j %= n
+                elif not (0 <= j < n):
+                    continue
+                c = t.link_class(i, j)
+                cls = c if cls is None else stronger(cls, c)
+        links.append(cls or "self")
+    strongest = links[0]
+    for c in links[1:]:
+        strongest = stronger(strongest, c)
+    return {"partner_link": links, "link": strongest}

@@ -316,6 +316,15 @@ def make_reporter(args, rank: int = 0, size: int = 1,
         # design (run_manifest merges **extra)
         m = run_manifest(**(manifest_extra or {}))
         rep.jsonl(m)
+        # manifest-adjacent topology audit record (comm/topology.py):
+        # world/host/slice structure + link classes, per run — emitted
+        # unconditionally (a flat run records its declared flatness;
+        # the REPORT surfaces stay silent on it)
+        from tpu_mpi_tests.comm.topology import topo_record
+
+        rep.jsonl(topo_record())
+        if rep.rank == 0:
+            _check_pack_topology(args)
         if rep.jsonl_path:
             cs = clock_sync_record()
             rep.jsonl(cs)
@@ -527,6 +536,36 @@ def setup_platform(args) -> None:
     if compile_cache:
         enable_compile_cache(compile_cache)
     setup_tuning(args)
+
+
+def _check_pack_topology(args) -> None:
+    """Topology-portability visibility for ``--tune-pack``: the
+    fingerprints already guarantee a mismatched-shape entry never
+    resolves (hosts/ranks-per-host are key fields — tune/fingerprint),
+    so a pack tuned on a different slice shape silently contributes
+    nothing. This note names that at run start instead of leaving the
+    user to wonder why the pack "didn't take". Same-shape packs (or
+    flat-on-flat) say nothing. Never raises — observability only."""
+    pack_path = getattr(args, "tune_pack", None)
+    if not pack_path:
+        return
+    try:
+        from tpu_mpi_tests.comm.topology import current
+        from tpu_mpi_tests.tune import pack as tp
+
+        doc = tp.load_pack(pack_path)
+        packed = tp.provenance(
+            doc.get("entries") or []
+        ).get("topologies") or []
+        live = current().label()
+        if packed and live not in packed:
+            decline_note(
+                f"--tune-pack {pack_path}: pack topology "
+                f"{','.join(packed)} does not match this run's "
+                f"{live}; its schedule entries will not resolve here"
+            )
+    except Exception:
+        pass
 
 
 def setup_tuning(args) -> None:

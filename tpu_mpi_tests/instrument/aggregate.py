@@ -197,6 +197,7 @@ def summarize(
     workload: dict[str, dict] = {}
     control: dict[str, dict] = {}
     traffic: dict | None = None
+    topo: dict | None = None
 
     for file_idx, path in enumerate(files):
         file_rank = file_idx
@@ -214,6 +215,12 @@ def summarize(
                 )
                 if manifest is None or rec.get("process_index") == 0:
                     manifest = rec
+            elif kind == "topo":
+                # manifest-adjacent topology audit record
+                # (comm/topology.py): one per rank, SPMD-identical —
+                # first wins; absent entirely on pre-topo files
+                if topo is None:
+                    topo = rec
             elif kind == "time":
                 if rec.get("event") == "progress":
                     # live cumulative snapshots (metrics plane) repeat
@@ -472,6 +479,9 @@ def summarize(
         "files": list(files),
         "manifest": manifest,
         "manifest_count": manifests,
+        # topology audit record — key present ONLY when the run emitted
+        # one (pre-topo files keep their exact --json shape)
+        **({"topo": topo} if topo else {}),
         # rank-set completeness: which manifest ranks the merged file
         # set actually covers — a crashed rank whose file is missing
         # must be a visible NOTE (and a refused --diff baseline), not
@@ -756,9 +766,17 @@ def _print_text(summary: dict, skew_threshold: float,
     m = summary["manifest"]
     if m:
         kinds = ",".join(m.get("device_kinds", []))
+        # hosts suffix only when the manifest carries the (non-flat)
+        # topology stamp — flat/CPU runs keep the exact header
+        hosts = (
+            f" hosts={m['hosts']}"
+            + (f"x{m['ranks_per_host']}" if m.get("ranks_per_host")
+               else "")
+            if m.get("hosts") else ""
+        )
         print(
             f"RUN {m.get('platform', '?')}x{m.get('global_device_count', 0)}"
-            f" ({kinds}) procs={m.get('process_count', 1)}"
+            f" ({kinds}) procs={m.get('process_count', 1)}{hosts}"
             f" jax={m.get('jax', '?')} git={m.get('git_sha') or 'unknown'}"
         )
         print(f"ARGV {' '.join(m.get('argv', []))}")
@@ -793,6 +811,7 @@ def _print_text(summary: dict, skew_threshold: float,
             f"skew={op['skew']:.3g}{gb}"
         )
 
+    _print_topology(summary.get("topo"), summary.get("anatomy"))
     _print_anatomy(summary.get("anatomy"))
 
     for cls, sv in summary.get("serve", {}).items():
@@ -935,7 +954,44 @@ def _print_text(summary: dict, skew_threshold: float,
             f"confidence={f['confidence']:.2f}"
             + (f" last_op={f['last_op']}" if f.get("last_op") else "")
             + (f" phase={f['phase']}" if f.get("phase") else "")
+            + (f" link={f['link']}" if f.get("link") else "")
             + f" — {f['detail']}"
+        )
+
+
+def _print_topology(topo: dict | None, anat: dict | None) -> None:
+    """TOPOLOGY table: the discovered shape (``kind:"topo"`` record)
+    plus per-link-class aggregate GB/s (anatomy ``by_link``). Silent on
+    flat topologies AND on pre-topo files — a single-host/CPU run's
+    report grows no lines (the same degrade contract as ANATOMY)."""
+    shape = topo if topo and (
+        int(topo.get("hosts") or 1) > 1 or int(topo.get("slices") or 1) > 1
+    ) else None
+    if shape:
+        hosts = f" hosts={shape.get('hosts')}"
+        if shape.get("ranks_per_host"):
+            hosts += f"x{shape['ranks_per_host']}"
+        slices = (f" slices={shape['slices']}"
+                  if int(shape.get("slices") or 1) > 1 else "")
+        links = ",".join(shape.get("link_classes") or []) or "-"
+        print(
+            f"TOPOLOGY {shape.get('topology', '?')}: "
+            f"world={shape.get('world')}{hosts}{slices} links={links}"
+        )
+    from tpu_mpi_tests.instrument.anatomy import LINK_ORDER
+
+    by_link = (anat or {}).get("by_link") or {}
+    for cls in sorted(by_link, key=lambda c: (
+            LINK_ORDER.index(c) if c in LINK_ORDER else len(LINK_ORDER), c)):
+        agg = by_link[cls]
+        pure = ("-" if agg.get("pure_gbps") is None
+                else format(agg["pure_gbps"], ".4g"))
+        eff = ("-" if agg.get("eff_gbps") is None
+               else format(agg["eff_gbps"], ".4g"))
+        print(
+            f"TOPOLOGY {cls}: calls={agg['calls']} bytes={agg['bytes']} "
+            f"wait_frac={agg['wait_frac']:.3f} "
+            f"pure={pure}GB/s eff={eff}GB/s"
         )
 
 
@@ -974,6 +1030,21 @@ def _print_anatomy(anat: dict | None) -> None:
             f"unc=±{anat['clock_unc_s'] * 1e3:.3g}ms"
             + (f" wait_share {share}" if share else "")
         )
+        # link-class split rows (comm/topology.py stamps): present
+        # only when the op's spans carried a link class — flat runs
+        # render the exact legacy table
+        for cls in sorted(row.get("by_link") or {}):
+            sub = row["by_link"][cls]
+            spure = ("-" if sub.get("pure_gbps") is None
+                     else format(sub["pure_gbps"], ".4g"))
+            seff = ("-" if sub.get("eff_gbps") is None
+                    else format(sub["eff_gbps"], ".4g"))
+            print(
+                f"ANATOMY {op}[{cls}]: calls={sub['calls']} "
+                f"wait_frac={sub['wait_frac']:.3f} "
+                f"wait={sub['wait_s']:.6g}s wire={sub['wire_s']:.6g}s "
+                f"pure={spure}GB/s eff={seff}GB/s"
+            )
     path = anat.get("critical_path") or []
     if path and anat.get("ops"):
         total = sum(seg["seconds"] for seg in path)
@@ -990,9 +1061,12 @@ def _print_anatomy(anat: dict | None) -> None:
     for edge in sorted(anat.get("matrix", {})):
         by_op = anat["matrix"][edge]
         ops = " ".join(
-            f"{op}={by_op[op]}" for op in sorted(by_op) if op != "total"
+            f"{op}={by_op[op]}" for op in sorted(by_op)
+            if op not in ("total", "link")
         )
-        print(f"COMMGRAPH {edge}: bytes={by_op['total']} {ops}".rstrip())
+        link = f" link={by_op['link']}" if by_op.get("link") else ""
+        print(f"COMMGRAPH {edge}: bytes={by_op['total']} {ops}".rstrip()
+              + link)
 
 
 def _print_memory(memory: dict) -> None:
@@ -1153,6 +1227,17 @@ def _metrics_from_summary(s: dict) -> dict[str, dict]:
                 "band": row.get("pure_gbps_band", 0.0),
                 "higher_better": True,
             }
+        # per-link-class fabric rate (ISSUE 20): a regression confined
+        # to the inter_host edges must flag even when the intra_host
+        # majority keeps the op-level pure GB/s flat. Absent on
+        # flat-topology runs (no stamps → no series).
+        for cls, sub in (row.get("by_link") or {}).items():
+            if isinstance(sub.get("pure_gbps"), (int, float)):
+                out[f"anatomy:{op}:{cls}:pure_gbps"] = {
+                    "value": float(sub["pure_gbps"]),
+                    "band": sub.get("pure_gbps_band", 0.0),
+                    "higher_better": True,
+                }
     peak = (s.get("memory") or {}).get("peak") or {}
     if "peak_bytes_in_use" in peak:
         out["mem:peak_bytes_in_use"] = {

@@ -51,6 +51,22 @@ from tpu_mpi_tests.instrument.aggregate import _noise_band
 #: reading aid, not a database
 CRITPATH_MAX_SEGMENTS = 32
 
+#: link classes weakest→strongest, kept in lockstep with
+#: ``comm/topology.LINK_CLASSES`` (tests assert the match) — anatomy
+#: is stdlib-only and cannot import the comm package, whose __init__
+#: pulls jax
+LINK_ORDER = ("self", "intra_host", "inter_host", "inter_slice")
+
+
+def _stronger(a: str, b: str) -> str:
+    """The stronger of two link classes; unknown classes (a newer
+    producer's vocabulary) sort strongest — never silently weakened."""
+
+    def rank(c):
+        return LINK_ORDER.index(c) if c in LINK_ORDER else len(LINK_ORDER)
+
+    return a if rank(a) >= rank(b) else b
+
 
 def _eligible(rec: dict) -> bool:
     """Spans the cross-rank match may align: sync-honest collective
@@ -148,6 +164,36 @@ def traffic_matrix(streams) -> dict[tuple[int, int], dict[str, int]]:
     return matrix
 
 
+def edge_link_classes(streams) -> dict[tuple[int, int], str]:
+    """``{(src, dst): link class}`` from spans carrying both
+    ``partners`` and the parallel ``partner_link`` stamp
+    (``comm/topology.py`` — resolved at wrapper-build time); conflicting
+    stamps keep the stronger class. Empty on flat-topology runs (no
+    stamps), which is the COMMGRAPH link-suffix degrade gate. Offset →
+    dst mapping mirrors :func:`partner_edges` exactly, including the
+    non-periodic edge drops."""
+    out: dict[tuple[int, int], str] = {}
+    for rank, _offset, records in streams:
+        for rec in records:
+            links = rec.get("partner_link")
+            if (rec.get("kind") != "span" or not links
+                    or not rec.get("partners")):
+                continue
+            world = int(rec.get("world") or 1)
+            if world < 2:
+                continue
+            for d, cls in zip(rec["partners"], links):
+                dst = rank + int(d)
+                if rec.get("periodic"):
+                    dst %= world
+                elif not (0 <= dst < world):
+                    continue
+                prev = out.get((rank, dst))
+                out[(rank, dst)] = (str(cls) if prev is None
+                                    else _stronger(prev, str(cls)))
+    return out
+
+
 def critical_path(streams) -> list[dict]:
     """The chain of slowest segments across ranks: starting from the
     globally last-ending phase/op segment, repeatedly step to the
@@ -216,11 +262,13 @@ def anatomize(streams) -> dict | None:
             "span_s": 0.0, "wait_s": 0.0, "wire_s": 0.0, "bytes": 0,
             "wait_by_rank": {},
             "_wait_fracs": [], "_pure_gbps": [],
+            "by_link": {},
         })
         row["ranks"] = sorted(set(row["ranks"]) | ranks)
         for r in sorted(ranks):
             row["wait_by_rank"].setdefault(r, 0.0)
         nbytes_by_seq = _bytes_by_seq(streams, op, axis)
+        link_by_seq = _link_by_seq(streams, op, axis)
         for seq in sorted(by_seq):
             entries = by_seq[seq]
             if set(entries) != ranks:
@@ -252,6 +300,23 @@ def anatomize(streams) -> dict | None:
                 row["_wait_fracs"].append(wait_s / span_s)
             if nb and wire_s > unc:
                 row["_pure_gbps"].append(nb / wire_s / 1e9)
+            # per-link-class split (comm/topology.py wrapper stamps):
+            # the same accumulators keyed by the call's link class —
+            # present ONLY when spans carry ``link``, so flat-topology
+            # runs keep the exact per-op row shape
+            cls = link_by_seq.get(seq)
+            if cls is not None:
+                sub = row["by_link"].setdefault(cls, {
+                    "calls": 0, "span_s": 0.0, "wait_s": 0.0,
+                    "wire_s": 0.0, "bytes": 0, "_pure_gbps": [],
+                })
+                sub["calls"] += 1
+                sub["span_s"] += span_s
+                sub["wait_s"] += wait_s
+                sub["wire_s"] += wire_s
+                sub["bytes"] += nb
+                if nb and wire_s > unc:
+                    sub["_pure_gbps"].append(nb / wire_s / 1e9)
     for op, row in list(ops.items()):
         if not row["calls"] and not row["unmatched"]:
             del ops[op]
@@ -275,7 +340,40 @@ def anatomize(streams) -> dict | None:
         # wait_frac jitters call to call demands a bigger delta to flag
         row["wait_frac_band"] = _noise_band(row.pop("_wait_fracs"))
         row["pure_gbps_band"] = _noise_band(row.pop("_pure_gbps"))
+        for sub in row["by_link"].values():
+            sub["wait_frac"] = (sub["wait_s"] / sub["span_s"]
+                                if sub["span_s"] > 0 else 0.0)
+            sub["eff_gbps"] = (sub["bytes"] / sub["span_s"] / 1e9
+                               if sub["bytes"] and sub["span_s"] > 0
+                               else None)
+            sub["pure_gbps"] = (sub["bytes"] / sub["wire_s"] / 1e9
+                                if sub["bytes"] and sub["wire_s"] > unc
+                                else None)
+            sub["pure_gbps_band"] = _noise_band(sub.pop("_pure_gbps"))
+        if not row["by_link"]:
+            del row["by_link"]
+    # per-class aggregate across ops — the TOPOLOGY table's GB/s rows;
+    # absent (like every link surface) when no span carried a stamp
+    by_link: dict[str, dict] = {}
+    for row in ops.values():
+        for cls, sub in (row.get("by_link") or {}).items():
+            agg = by_link.setdefault(cls, {
+                "calls": 0, "span_s": 0.0, "wait_s": 0.0,
+                "wire_s": 0.0, "bytes": 0,
+            })
+            for k in ("calls", "span_s", "wait_s", "wire_s", "bytes"):
+                agg[k] += sub[k]
+    for agg in by_link.values():
+        agg["wait_frac"] = (agg["wait_s"] / agg["span_s"]
+                            if agg["span_s"] > 0 else 0.0)
+        agg["eff_gbps"] = (agg["bytes"] / agg["span_s"] / 1e9
+                           if agg["bytes"] and agg["span_s"] > 0
+                           else None)
+        agg["pure_gbps"] = (agg["bytes"] / agg["wire_s"] / 1e9
+                            if agg["bytes"] and agg["wire_s"] > unc
+                            else None)
     matrix = traffic_matrix(streams)
+    links = edge_link_classes(streams)
     if not ops and not matrix:
         return None
     return {
@@ -283,11 +381,16 @@ def anatomize(streams) -> dict | None:
         "clock_spread_s": {str(r): s for r, s in sorted(spreads.items())},
         "ops": ops,
         "matrix": {
-            f"{src}->{dst}": dict(sorted(by_op.items()),
-                                  total=sum(by_op.values()))
+            f"{src}->{dst}": dict(
+                sorted(by_op.items()),
+                total=sum(by_op.values()),
+                **({"link": links[(src, dst)]}
+                   if (src, dst) in links else {}),
+            )
             for (src, dst), by_op in sorted(matrix.items())
         },
         "critical_path": critical_path(streams),
+        **({"by_link": by_link} if by_link else {}),
     }
 
 
@@ -301,6 +404,20 @@ def _bytes_by_seq(streams, op: str, axis) -> dict[int, int]:
             if (_eligible(rec) and rec.get("op", "?") == op
                     and rec.get("axis") == axis and rec.get("nbytes")):
                 out.setdefault(int(rec["seq"]), int(rec["nbytes"]))
+    return out
+
+
+def _link_by_seq(streams, op: str, axis) -> dict[int, str]:
+    """Per-seq link class for one (op, axis) from the wrapper-build
+    ``link`` stamp (``comm/topology.py``; first record wins — SPMD
+    stamps match). Empty on flat-topology runs, which is the by_link
+    degrade gate."""
+    out: dict[int, str] = {}
+    for _rank, _offset, records in streams:
+        for rec in records:
+            if (_eligible(rec) and rec.get("op", "?") == op
+                    and rec.get("axis") == axis and rec.get("link")):
+                out.setdefault(int(rec["seq"]), str(rec["link"]))
     return out
 
 

@@ -170,6 +170,11 @@ class _Stream:
         self.phase_last_t: dict[str, float] = {}
         self.op_tot: dict[str, tuple[float, int]] = {}
         self.op_last_t: dict[str, float] = {}
+        # link classes seen per collective op (comm/topology.py stamps
+        # them at wrapper build time) — straggler evidence: skew whose
+        # ops are ALL inter_host points at the cross-host fabric, not
+        # the rank. Bounded by the op-name x link-class product.
+        self.op_links: dict[str, set] = {}
         # anatomy digest (instrument/anatomy.py semantics): recent
         # seq-stamped collective calls per op — (seq, t_start, t_end,
         # line) on this stream's OWN clock; the straggler judge
@@ -247,6 +252,9 @@ class _Stream:
                 tot, cnt = self.op_tot.get(name, (0.0, 0))
                 self.op_tot[name] = (
                     tot + float(rec.get("seconds") or 0.0), cnt + 1)
+                link = rec.get("link")
+                if isinstance(link, str):
+                    self.op_links.setdefault(name, set()).add(link)
                 if (rec.get("seq") is not None
                         and rec.get("t_start") is not None):
                     dq = self.op_calls.setdefault(
@@ -474,7 +482,7 @@ def load_streams(
 
 def _finding(cls: str, rank, confidence: float, detail: str,
              evidence: list[str], last_op=None, phase=None,
-             t=None) -> dict:
+             t=None, link=None) -> dict:
     return {
         "kind": "finding",
         "class": cls,
@@ -483,6 +491,7 @@ def _finding(cls: str, rank, confidence: float, detail: str,
         "last_op": last_op,
         "phase": phase,
         "t": t,
+        "link": link,
         "detail": detail,
         "evidence": evidence[:6],
     }
@@ -713,9 +722,16 @@ def _straggler_findings(streams: list[_Stream], opts,
                 ]
             entry = by_rank.setdefault(
                 culprit, {"conf": conf, "items": [], "evidence": [],
-                          "first": (what, name)})
+                          "links": [], "first": (what, name)})
             entry["conf"] = max(entry["conf"], conf)
             entry["evidence"].extend(evidence)
+            if invert:
+                # link classes this op ran over, unioned across ranks
+                # (topology stamp; empty set when the spans are
+                # unstamped — pre-topology streams claim nothing)
+                entry["links"].append(
+                    set().union(*(by_stream[s.rank].op_links.get(
+                        name, set()) for s in alive)))
             entry["items"].append(
                 f"{what} {name}: rank {worst} spent {secs[worst]:.3g}s "
                 f"vs rank {best}'s {secs[best]:.3g}s "
@@ -743,6 +759,13 @@ def _straggler_findings(streams: list[_Stream], opts,
     out = []
     for rank, entry in sorted(by_rank.items()):
         what, name = entry["first"]
+        # link evidence: when EVERY skewed collective op ran purely
+        # over the cross-host fabric, say so — "rank N is slow at
+        # inter_host ops only" reads as a host/NIC problem, not a slow
+        # chip. Any unstamped or mixed-class op withholds the claim.
+        links = entry["links"]
+        link = ("inter_host" if links
+                and all(ls == {"inter_host"} for ls in links) else None)
         # anchor the verdict at the culprit's last record of the
         # convicting phase/op so tpumt-trace can place the FINDING
         # marker on its track (a skew has no single instant; the last
@@ -761,7 +784,7 @@ def _straggler_findings(streams: list[_Stream], opts,
             # collective-span skew names the op
             last_op=name if what == "collective" else None,
             phase=name if what == "phase" else None,
-            t=anchor,
+            t=anchor, link=link,
         ))
     return out
 
@@ -1069,6 +1092,8 @@ def format_finding(f: dict) -> str:
         parts.append(f"last_op={f['last_op']}")
     if f.get("phase"):
         parts.append(f"phase={f['phase']}")
+    if f.get("link"):
+        parts.append(f"link={f['link']}")
     return " ".join(parts) + f" — {f['detail']}"
 
 

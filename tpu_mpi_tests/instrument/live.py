@@ -380,6 +380,23 @@ def render(dash: Dashboard, files: list[str]) -> str:
                 f"{_fmt(rf * 100 if rf is not None else None, 6, 1)} "
                 f"{_fmt(wf * 100 if wf is not None else None, 6, 1)}")
 
+    # per-link-class bandwidth (comm/topology.py span stamps): intra-
+    # vs inter-host traffic live. No stamps (flat topology) → no LINK
+    # block, same degrade as every other optional table.
+    link_bytes = _sample_map(snap, "tpumt_span_link_bytes", "link")
+    if link_bytes:
+        lgbps = _sample_map(snap, "tpumt_span_link_gbps_window", "link")
+        lsecs = _sample_map(snap, "tpumt_span_link_seconds", "link")
+        lines.append(
+            f"LINK  {'class':28s} {'bytes':>10s} {'secs':>8s} "
+            f"{'GB/s':>8s}")
+        for cls in sorted(link_bytes):
+            g = lgbps.get(cls) or {}
+            lines.append(
+                f"      {cls:28s} "
+                f"{_human_bytes(link_bytes[cls]):>10s} "
+                f"{_fmt(lsecs.get(cls))} {_fmt(g.get('p50'))}")
+
     if dash.mem:
         parts = []
         for rank in sorted(dash.mem):

@@ -85,6 +85,17 @@ def run_manifest(argv: list[str] | None = None, **extra) -> dict:
               if "bytes_limit" in s]
     if limits:
         record["hbm_bytes_limit"] = max(limits)
+    # topology identity (comm/topology.py): host/slice shape summary,
+    # stamped ONLY when non-flat — single-host/CPU manifests (and the
+    # report header they drive) stay byte-identical
+    from tpu_mpi_tests.comm.topology import current as _topology
+
+    topo = _topology()
+    if not topo.is_flat:
+        record["hosts"] = topo.num_hosts
+        if topo.ranks_per_host:
+            record["ranks_per_host"] = topo.ranks_per_host
+        record["topology"] = topo.label()
     record.update(extra)
     return record
 

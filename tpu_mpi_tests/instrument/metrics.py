@@ -363,6 +363,20 @@ class MetricsRegistry:
             self.set_gauge("tpumt_span_gbps", L, float(gbps))
             self.observe_sample("tpumt_span_gbps_window", L,
                                 float(gbps))
+        # per-link-class series (comm/topology.py wrapper stamps):
+        # intra- vs inter-host bytes and bandwidth live on tpumt-top.
+        # Flat-topology runs carry no ``link`` → no series appear.
+        link = rec.get("link")
+        if isinstance(link, str):
+            LL = (("link", link),)
+            self.inc("tpumt_span_link_bytes", LL,
+                     int(rec.get("nbytes") or 0))
+            if isinstance(secs, (int, float)):
+                self.inc("tpumt_span_link_seconds", LL, float(secs))
+            if isinstance(gbps, (int, float)):
+                self.set_gauge("tpumt_span_link_gbps", LL, float(gbps))
+                self.observe_sample("tpumt_span_link_gbps_window", LL,
+                                    float(gbps))
         rf = rec.get("roofline_frac")
         if isinstance(rf, (int, float)):
             self.set_gauge("tpumt_roofline_frac", L, float(rf))

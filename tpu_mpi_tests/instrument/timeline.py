@@ -177,6 +177,9 @@ def _collect(streams):
     # each partner-annotated span's end — the traffic matrix as
     # Perfetto counter tracks
     sent: dict[int, dict[str, int]] = {}
+    # cumulative bytes per link class (comm/topology.py partner_link
+    # stamps) — its own counter track, present only on non-flat runs
+    sent_link: dict[int, dict[str, int]] = {}
 
     def args_from(rec, keys):
         return {k: rec[k] for k in keys if rec.get(k) is not None}
@@ -198,7 +201,7 @@ def _collect(streams):
                                     "seconds", "cost_bytes",
                                     "model_gbps", "roofline_frac",
                                     "async", "overlap_depth",
-                                    "dispatch_depth", "seq")),
+                                    "dispatch_depth", "seq", "link")),
                 ))
                 # wait/wire sub-spans nested under the collective span
                 # (appended after the parent, so stable ts-sorting
@@ -230,6 +233,23 @@ def _collect(streams):
                         cum[key] = cum.get(key, 0) + nbytes
                     counters.append((rank, "comm bytes sent", end,
                                      dict(cum)))
+                    links = rec.get("partner_link")
+                    if links:
+                        # align classes with the kept edges — the same
+                        # out-of-range drop rule as partner_edges
+                        world = int(rec.get("world") or 1)
+                        kept = [
+                            str(cls)
+                            for d, cls in zip(rec.get("partners") or [],
+                                              links)
+                            if rec.get("periodic")
+                            or 0 <= rank + int(d) < world
+                        ]
+                        lcum = sent_link.setdefault(rank, {})
+                        for (_dst, nbytes), cls in zip(edges, kept):
+                            lcum[cls] = lcum.get(cls, 0) + nbytes
+                        counters.append((rank, "comm bytes by link",
+                                         end, dict(lcum)))
             elif kind == "time":
                 if rec.get("event") == "progress":
                     # live cumulative snapshots (metrics plane): their
@@ -422,7 +442,9 @@ def chrome_trace(
     # watermarks (one series per device, or the census-only live-bytes
     # series) and the cumulative per-neighbor traffic-matrix bytes
     for rank, name, t, series in sorted(counters, key=lambda c: c[2]):
-        cat = "traffic" if name == "comm bytes sent" else "mem"
+        cat = ("traffic"
+               if name in ("comm bytes sent", "comm bytes by link")
+               else "mem")
         events.append({"ph": "C", "name": name, "cat": cat, "pid": rank,
                        "tid": 0, "ts": (t - t0) * _US, "args": series})
     return {

@@ -44,13 +44,19 @@ def shape_bucket(n: int) -> int:
 @functools.lru_cache(maxsize=None)
 def device_fields() -> tuple[tuple[str, str], ...]:
     """The live backend's identity fields, probed once per process:
-    platform, device kind, global device count, process count. Requires
-    an initialized jax backend — only reached when a cache lookup or a
-    sweep actually needs a key."""
+    platform, device kind, global device count, process count — plus
+    the topology shape (hosts, ranks-per-host) when the machine is
+    NOT flat. Flat/CPU fingerprints are unchanged (the PR-4 precedence
+    contract and every existing cache entry stay intact); a winner
+    measured on a 4-host slice resolves on any same-shape slice and
+    never on a different one. Requires an initialized jax backend —
+    only reached when a cache lookup or a sweep actually needs a key."""
     import jax
 
+    from tpu_mpi_tests.comm.topology import current
+
     devs = jax.devices()
-    return (
+    fields = (
         ("platform", devs[0].platform),
         ("device", devs[0].device_kind.replace(";", ",")),
         # named ndev, not world: knob contexts pass their mesh-axis ring
@@ -58,6 +64,12 @@ def device_fields() -> tuple[tuple[str, str], ...]:
         ("ndev", str(len(devs))),
         ("procs", str(jax.process_count())),
     )
+    topo = current()
+    if not topo.is_flat:
+        fields += (("hosts", str(topo.num_hosts)),)
+        if topo.ranks_per_host:
+            fields += (("rph", str(topo.ranks_per_host)),)
+    return fields
 
 
 def compose(base: dict[str, str] | None = None, **ctx) -> str:

@@ -30,7 +30,7 @@ Artifact shape::
      "engine": "<tpu-mpi-tests version>",
      "provenance": {"devices": [...], "platforms": [...],
                     "worlds": [...], "procs": [...],
-                    "knobs": [...], "entries": N},
+                    "knobs": [...], "topologies": [...], "entries": N},
      "entries": {"<knob>|<fingerprint>": {value, seconds, knob,
                                           fingerprint, t}}}
 """
@@ -67,6 +67,31 @@ def _fp_fields(fp: str) -> dict[str, str]:
     return out
 
 
+def _fp_topology(fields: dict[str, str]) -> str:
+    """Topology shape label of one fingerprint: ``h{hosts}x{rph}``
+    from the topology key fields (tune/fingerprint stamps them only on
+    non-flat machines), ``flat`` when absent — absent fields mean a
+    single-host measurement, by the discovery degrade contract."""
+    hosts = fields.get("hosts")
+    if not hosts:
+        return "flat"
+    rph = fields.get("rph")
+    return f"h{hosts}" + (f"x{rph}" if rph else "")
+
+
+def entry_topologies(entries: dict) -> set[str]:
+    """The set of topology shape labels a pack/cache's entries were
+    measured on (see :func:`_fp_topology`)."""
+    topos: set[str] = set()
+    for key, e in entries.items():
+        if not isinstance(e, dict):
+            continue
+        topos.add(_fp_topology(_fp_fields(
+            e.get("fingerprint") or key.split("|", 1)[-1]
+        )))
+    return topos
+
+
 def provenance(entries: dict) -> dict:
     """What hardware/topology these winners were measured on, read back
     out of the fingerprints the sweeps stored them under."""
@@ -91,6 +116,7 @@ def provenance(entries: dict) -> dict:
         "worlds": sorted(worlds),
         "procs": sorted(procs),
         "knobs": sorted(knobs),
+        "topologies": sorted(entry_topologies(entries)),
         "entries": len(entries),
     }
 
@@ -195,7 +221,9 @@ def _cmd_pack(args) -> int:
     print(f"PACK {args.output}: {p['entries']} entries, "
           f"{len(p['knobs'])} knobs, devices={','.join(p['devices']) or '-'} "
           f"worlds={','.join(p['worlds']) or '-'} "
-          f"procs={','.join(p['procs']) or '-'} engine={doc['engine']}")
+          f"procs={','.join(p['procs']) or '-'} "
+          f"topo={','.join(p.get('topologies') or []) or '-'} "
+          f"engine={doc['engine']}")
     return 0
 
 
@@ -231,6 +259,22 @@ def _cmd_import(args) -> int:
               f"(corrupted packs degrade to empty)")
     cache_path = args.cache or default_cache_path()
     cache = ScheduleCache.load(cache_path)
+    # topology gate (ISSUE 20): a pack measured on one slice shape
+    # contributes nothing on a different shape (the fingerprints can
+    # never match) — importing it anyway would only bloat the cache and
+    # LOOK like a successful deployment. Disjoint non-empty shape sets
+    # refuse with a NOTE; an empty destination cache has no shape
+    # evidence and accepts (first import on a fresh machine).
+    pack_topos = entry_topologies(doc["entries"])
+    cache_topos = entry_topologies(cache.entries)
+    if (pack_topos and cache_topos and not (pack_topos & cache_topos)
+            and not args.allow_topology_mismatch):
+        print(f"NOTE topology mismatch: pack measured on "
+              f"{','.join(sorted(pack_topos))}, cache holds "
+              f"{','.join(sorted(cache_topos))} entries — no schedule "
+              f"could ever resolve; refusing import "
+              f"(--allow-topology-mismatch to override)")
+        return 3
     merged, conflicts = merge_entries(cache.entries, doc["entries"])
     added = [k for k in merged if k not in cache.entries]
     updated = [k for k in merged
@@ -283,6 +327,11 @@ def main(argv: list[str] | None = None) -> int:
                     "$TPU_MPI_TUNE_CACHE, else ~/.cache/tpumt/tune.json)")
     si.add_argument("--dry-run", action="store_true",
                     help="print the add/update/keep diff without writing")
+    si.add_argument("--allow-topology-mismatch", action="store_true",
+                    help="import even when the pack's topology shape "
+                    "labels share nothing with the destination cache's "
+                    "(the entries still only resolve where their "
+                    "fingerprints match)")
     si.set_defaults(fn=_cmd_import)
 
     args = p.parse_args(argv)

@@ -338,6 +338,7 @@ def _run_overlap(args, rep, mesh, topo, zg, d) -> int:
     import numpy as np
 
     from tpu_mpi_tests.comm import halo as H
+    from tpu_mpi_tests.comm.topology import mesh_link_meta
     from tpu_mpi_tests.instrument.timers import PhaseTimer, block
 
     world = topo.global_device_count
@@ -357,6 +358,7 @@ def _run_overlap(args, rep, mesh, topo, zg, d) -> int:
             "halo_exchange", depth=depth, nbytes=nbytes,
             axis_name=axis_name, world=world, timer=timer,
             phase="overlap_interior",
+            **mesh_link_meta(mesh, axis_name),
         )
         z = block(zg + 0)
         for _ in range(n):
